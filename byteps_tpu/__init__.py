@@ -1,0 +1,45 @@
+"""byteps_tpu — a TPU-native distributed training framework with the
+capabilities of BytePS (reference: /root/reference, ruipeterpan/byteps).
+
+Public API mirrors the reference's Horovod-compatible plugin surface
+(reference: byteps/torch/__init__.py:23-28) re-designed for JAX/XLA:
+
+    import byteps_tpu as bps
+    bps.init()
+    opt = bps.DistributedOptimizer(optax.adam(1e-3))
+    step = bps.build_train_step(loss_fn, opt, bps.get_mesh())
+"""
+
+from .version import __version__
+
+from .common.api import (
+    init, shutdown, suspend, resume,
+    rank, size, local_rank, local_size,
+    declare, declared_key,
+    push_pull, push_pull_async, synchronize, poll,
+    broadcast_parameters, broadcast_optimizer_state,
+    get_pushpull_speed, mark_step, current_step,
+)
+from .ops.compression import Compression
+from .ops import collectives
+from .parallel.data_parallel import (
+    DistributedOptimizer, distributed_gradient_transform, build_train_step,
+)
+from .parallel.mesh import (
+    make_mesh, make_hierarchical_mesh, get_mesh, set_mesh, reset_mesh,
+)
+
+__all__ = [
+    "__version__",
+    "init", "shutdown", "suspend", "resume",
+    "rank", "size", "local_rank", "local_size",
+    "declare", "declared_key",
+    "push_pull", "push_pull_async", "synchronize", "poll",
+    "broadcast_parameters", "broadcast_optimizer_state",
+    "get_pushpull_speed", "mark_step", "current_step",
+    "Compression", "collectives",
+    "DistributedOptimizer", "distributed_gradient_transform",
+    "build_train_step",
+    "make_mesh", "make_hierarchical_mesh", "get_mesh", "set_mesh",
+    "reset_mesh",
+]

@@ -1,0 +1,331 @@
+"""Horovod-compatible top-level API.
+
+Mirrors the reference's plugin surface — init/shutdown/suspend/resume, rank/
+size/local_rank/local_size, declare, push_pull(_async)/synchronize/poll,
+broadcast_parameters/broadcast_optimizer_state, get_pushpull_speed
+(reference: byteps/torch/__init__.py:23-28, byteps/common/__init__.py:52-139,
+byteps/torch/ops.py:157-236) — re-mapped onto JAX's single-controller model:
+
+  - a *worker* is a JAX process (host); devices a process drives are its
+    "local GPUs", but unlike the reference (one process per GPU,
+    communicator.cc:60-96) the intra-host tier needs no UDS/shm machinery —
+    the in-jit mesh collectives cover it.
+  - eager push_pull reduces across processes via a jitted collective
+    (multihost_utils); inside jit, use byteps_tpu.ops.collectives /
+    DistributedOptimizer, which is the hot path.
+
+The eager path exists for API parity and for small out-of-graph tensors
+(metric averaging, parameter broadcast), exactly the role the reference's
+synchronous handle API plays for torch.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import threading
+import time
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from .config import Config, get_config
+from .logging import get_logger
+from ..core.native import get_core
+
+PyTree = Any
+
+
+@dataclasses.dataclass
+class _State:
+    initialized: bool = False
+    config: Optional[Config] = None
+    step: int = 0
+    step_start_us: Optional[int] = None
+    jax_dist_initialized: bool = False
+    handles: Dict[int, Any] = dataclasses.field(default_factory=dict)
+    lock: threading.Lock = dataclasses.field(default_factory=threading.Lock)
+    ps_session: Optional[Any] = None  # PS-mode client session, when enabled
+
+
+_state = _State()
+
+
+def _require_init():
+    if not _state.initialized:
+        raise RuntimeError("byteps_tpu not initialized; call bps.init() first")
+
+
+# ---------------------------------------------------------------------------
+# Lifecycle (reference: operations.cc:28-119)
+# ---------------------------------------------------------------------------
+def init(lazy: bool = True) -> None:
+    """Initialize the framework.
+
+    If the DMLC_* multi-host envs describe a JAX distributed run
+    (coordinator + process id), `jax.distributed.initialize` is called so the
+    process joins the global mesh — the analog of the reference's ps-lite
+    StartAsync + scheduler barrier (reference: global.cc:283-297).
+    """
+    if _state.initialized:
+        return
+    cfg = get_config(refresh=True)
+    _state.config = cfg
+    if cfg.num_worker > 1 and os.environ.get("BYTEPS_TPU_JAX_DIST", "0") == "1":
+        # Multi-host: map the reference's scheduler to JAX's coordinator.
+        jax.distributed.initialize(
+            coordinator_address=f"{cfg.scheduler_uri}:{cfg.scheduler_port}",
+            num_processes=cfg.num_worker,
+            process_id=cfg.worker_id,
+        )
+        _state.jax_dist_initialized = True
+    core = get_core()
+    if cfg.trace_on:
+        core.trace_enable(True)
+    if cfg.ps_mode and cfg.role == "worker":
+        try:
+            from ..server.client import PSSession
+        except ImportError as e:
+            raise RuntimeError(
+                "BYTEPS_TPU_PS_MODE=1 requires the PS server tier "
+                "(byteps_tpu.server.client), which is missing from this "
+                "build") from e
+        _state.ps_session = PSSession.from_config(cfg)
+        _state.ps_session.barrier()
+    _state.initialized = True
+    get_logger().info(
+        "byteps_tpu initialized: role=%s rank=%d/%d local_size=%d devices=%d",
+        cfg.role, rank(), size(), local_size(), jax.device_count())
+
+
+def shutdown() -> None:
+    if not _state.initialized:
+        return
+    if _state.ps_session is not None:
+        _state.ps_session.close()
+        _state.ps_session = None
+    _maybe_dump_trace(final=True)
+    if _state.jax_dist_initialized:
+        # Required for elastic resume: a second jax.distributed.initialize
+        # raises unless the first is torn down.
+        jax.distributed.shutdown()
+        _state.jax_dist_initialized = False
+    _state.initialized = False
+
+
+def suspend() -> None:
+    """Elastic suspend: tear down communication, keep the registry so keys
+    stay stable on resume (reference: operations.cc:96-105)."""
+    shutdown()
+
+
+def resume(num_workers: int, num_servers: int = 0) -> None:
+    """Elastic resume with a new cluster size.  Re-reads env config and
+    re-declares all tensors in original order so key assignment is unchanged
+    (reference: operations.cc:107-119, global.cc:446-451)."""
+    os.environ["DMLC_NUM_WORKER"] = str(num_workers)
+    os.environ["DMLC_NUM_SERVER"] = str(num_servers)
+    core = get_core()
+    # The registry is preserved across suspend (the whole point); walk it so
+    # any native-side rebuild keeps the original order.
+    names = [core.declared_name(i) for i in range(core.num_declared())]
+    init(lazy=True)
+    for n in names:
+        if n is not None:
+            core.declare_tensor(n)
+
+
+# ---------------------------------------------------------------------------
+# Topology (reference: common/__init__.py:83-128)
+# ---------------------------------------------------------------------------
+def rank() -> int:
+    cfg = _state.config or get_config()
+    if cfg.global_rank is not None:
+        return cfg.global_rank
+    return jax.process_index()
+
+
+def size() -> int:
+    return jax.process_count()
+
+
+def local_rank() -> int:
+    cfg = _state.config or get_config()
+    return cfg.local_rank
+
+
+def local_size() -> int:
+    return jax.local_device_count()
+
+
+# ---------------------------------------------------------------------------
+# Declaration & keys (reference: global.cc:427-451, operations.cc:301-311)
+# ---------------------------------------------------------------------------
+def declare(name: str) -> int:
+    """Assign (or look up) the deterministic key for a named tensor."""
+    return get_core().declare_tensor(name)
+
+
+def declared_key(name: str) -> int:
+    return get_core().get_declared_key(name)
+
+
+# ---------------------------------------------------------------------------
+# Eager push_pull (reference: torch/ops.py:157-236)
+# ---------------------------------------------------------------------------
+def _eager_sum_across_processes(x: jax.Array) -> jax.Array:
+    from jax.experimental import multihost_utils
+    gathered = multihost_utils.process_allgather(x)
+    return gathered.sum(axis=0)
+
+
+def push_pull(tensor: jax.Array, name: Optional[str] = None,
+              average: bool = True, priority: int = 0,
+              compression=None) -> jax.Array:
+    """Synchronous eager all-reduce across worker processes.
+
+    For the in-graph hot path use DistributedOptimizer /
+    ops.collectives.bucketed_tree_all_reduce instead.
+    """
+    h = push_pull_async(tensor, name=name, average=average, priority=priority,
+                        compression=compression)
+    return synchronize(h)
+
+
+def push_pull_async(tensor: jax.Array, name: Optional[str] = None,
+                    average: bool = True, priority: int = 0,
+                    compression=None) -> int:
+    _require_init()
+    from ..ops.compression import Compression
+    compression = compression or Compression.none
+    tensor = jnp.asarray(tensor)
+    if name is None:
+        name = f"byteps_tpu.tensor_{get_core().num_declared()}"
+    dk = declare(name)
+    core = get_core()
+    handle = core.handle_allocate()
+    t0 = core.trace_now_us()
+    wire, ctx = compression.compress(tensor)
+    if _state.ps_session is not None:
+        out = _state.ps_session.push_pull(dk, wire, priority=priority)
+    elif size() > 1:
+        out = _eager_sum_across_processes(wire)
+    else:
+        out = wire  # sum over a single worker
+    out = compression.decompress(out, ctx)
+    if average:
+        out = out / size()
+    cfg = _state.config or get_config()
+    if cfg.telemetry_on:
+        core.telemetry_record(tensor.size * tensor.dtype.itemsize)
+    with _state.lock:
+        _state.handles[handle] = (out, name, t0)
+    return handle
+
+
+def synchronize(handle: int) -> jax.Array:
+    """Block until the handle's communication completes (reference:
+    torch/ops.py:222-236 spins on PollHandle; JAX gives us
+    block_until_ready)."""
+    with _state.lock:
+        if handle not in _state.handles:
+            raise ValueError(
+                f"unknown or already-synchronized handle {handle}")
+        out, name, t0 = _state.handles.pop(handle)
+    out = jax.block_until_ready(out)
+    core = get_core()
+    core.handle_mark_done(handle)
+    core.trace_record(name, "PUSH_PULL", t0, core.trace_now_us() - t0)
+    core.handle_release(handle)
+    return out
+
+
+def poll(handle: int) -> bool:
+    """True if the async op has completed.  JAX's async dispatch means the
+    value exists as soon as dispatch returns; completion == buffer ready.
+    Raises ValueError for a handle that was never allocated or was already
+    synchronized (matching the reference's check in torch/ops.cc poll)."""
+    with _state.lock:
+        entry = _state.handles.get(handle)
+    if entry is None:
+        status = get_core().handle_poll(handle)
+        if status == -1:
+            raise ValueError(
+                f"unknown or already-synchronized handle {handle}")
+        return status == 1
+    try:
+        # Committed when the underlying buffer is ready.
+        return entry[0].is_ready() if hasattr(entry[0], "is_ready") else True
+    except Exception:
+        return True
+
+
+# ---------------------------------------------------------------------------
+# Broadcast (reference: torch/__init__.py:259-409 — implemented there as
+# zero-non-root + push_pull sum; multihost_utils gives us the direct op)
+# ---------------------------------------------------------------------------
+def broadcast_parameters(params: PyTree, root_rank: int = 0) -> PyTree:
+    """Make `params` identical on every worker, taking root_rank's values."""
+    _require_init()
+    if size() == 1:
+        return params
+    from jax.experimental import multihost_utils
+    return multihost_utils.broadcast_one_to_all(
+        params, is_source=rank() == root_rank)
+
+
+def broadcast_optimizer_state(opt_state: PyTree, root_rank: int = 0) -> PyTree:
+    """Optimizer-state counterpart of broadcast_parameters.  optax states are
+    pytrees of arrays/scalars, so one tree broadcast covers what the reference
+    does with per-scalar tensor-ization (reference: torch/__init__.py:293-409)."""
+    return broadcast_parameters(opt_state, root_rank)
+
+
+# ---------------------------------------------------------------------------
+# Telemetry & tracing (reference: global.cc:712-767, 463-579)
+# ---------------------------------------------------------------------------
+def get_pushpull_speed() -> tuple:
+    """(timestamp, MB/s) moving average, like byteps_get_pushpull_speed."""
+    return (time.time(), get_core().telemetry_speed_mbps())
+
+
+def timeline_start_step() -> int:
+    cfg = _state.config or get_config()
+    return cfg.trace_start_step
+
+
+def mark_step() -> None:
+    """Advance the training-step counter driving the trace window
+    (reference gates tracing on BYTEPS_TRACE_START/END_STEP,
+    global.cc:113-124).  Within the window each step contributes a
+    STEP timeline event; in-graph collective detail comes from
+    jax.profiler, which this windowing composes with."""
+    cfg = _state.config or get_config()
+    core = get_core()
+    now = core.trace_now_us()
+    if cfg.trace_on and _state.step_start_us is not None \
+            and cfg.trace_start_step <= _state.step <= cfg.trace_end_step:
+        core.trace_record(f"step_{_state.step}", "STEP",
+                          _state.step_start_us, now - _state.step_start_us)
+    _state.step += 1
+    _state.step_start_us = now
+    if cfg.trace_on:
+        core.trace_enable(cfg.trace_start_step <= _state.step
+                          <= cfg.trace_end_step)
+        if _state.step == cfg.trace_end_step + 1:
+            _maybe_dump_trace()
+
+
+def _maybe_dump_trace(final: bool = False) -> None:
+    cfg = _state.config or get_config()
+    core = get_core()
+    if not cfg.trace_on or core.trace_count() == 0:
+        return
+    d = os.path.join(cfg.trace_dir, str(local_rank()))
+    os.makedirs(d, exist_ok=True)
+    core.trace_dump(os.path.join(d, "comm.json"), rank())
+
+
+def current_step() -> int:
+    return _state.step

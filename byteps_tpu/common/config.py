@@ -1,0 +1,148 @@
+"""Environment-variable configuration surface.
+
+The reference framework is configured purely through environment variables
+(reference: docs/env.md; parsing spread across byteps/common/global.cc:105-281,
+byteps/common/communicator.cc:60-96, byteps/server/server.cc:416-448).  We keep
+the same variable names so launch tooling carries over, add `BYTEPS_TPU_*`
+extensions for mesh/TPU-specific knobs, and centralise every read here so the
+rest of the codebase never calls os.environ directly.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Optional
+
+_TRUTHY = {"1", "true", "yes", "on"}
+
+
+def _env_int(name: str, default: int) -> int:
+    v = os.environ.get(name)
+    if v is None or v == "":
+        return default
+    return int(v)
+
+
+def _env_bool(name: str, default: bool = False) -> bool:
+    v = os.environ.get(name)
+    if v is None or v == "":
+        return default
+    return v.strip().lower() in _TRUTHY
+
+
+def _env_str(name: str, default: str) -> str:
+    v = os.environ.get(name)
+    return default if v is None or v == "" else v
+
+
+# Page size used for partition alignment (reference: common.h:281-285 Align()).
+ALIGN_BYTES = 4096
+
+
+@dataclasses.dataclass
+class Config:
+    """Snapshot of all knobs. Built by `Config.from_env()` at init time.
+
+    Field names follow the reference env vars they mirror.
+    """
+
+    # ---- bootstrap / roles (reference: docs/env.md "required" section) ----
+    role: str = "worker"                 # DMLC_ROLE: worker | server | scheduler | joint
+    worker_id: int = 0                   # DMLC_WORKER_ID
+    num_worker: int = 1                  # DMLC_NUM_WORKER
+    num_server: int = 0                  # DMLC_NUM_SERVER
+    scheduler_uri: str = "127.0.0.1"     # DMLC_PS_ROOT_URI
+    scheduler_port: int = 9000           # DMLC_PS_ROOT_PORT
+    local_rank: int = 0                  # BYTEPS_LOCAL_RANK
+    local_size: int = 1                  # BYTEPS_LOCAL_SIZE
+    global_rank: Optional[int] = None    # BYTEPS_GLOBAL_RANK override
+    force_distributed: bool = False      # BYTEPS_FORCE_DISTRIBUTED
+
+    # ---- communication tuning (reference: global.cc:42-43,134-144) ----
+    partition_bytes: int = 4 * 1024 * 1024   # BYTEPS_PARTITION_BYTES
+    min_compress_bytes: int = 65536          # BYTEPS_MIN_COMPRESS_BYTES
+    scheduling_credit: int = 0               # BYTEPS_SCHEDULING_CREDIT (0 = off)
+    server_engine_threads: int = 4           # BYTEPS_SERVER_ENGINE_THREAD
+    server_enable_schedule: bool = False     # BYTEPS_SERVER_ENABLE_SCHEDULE
+    enable_async: bool = False               # BYTEPS_ENABLE_ASYNC
+    key_hash_fn: str = "djb2"                # BYTEPS_KEY_HASH_FN
+
+    # ---- tracing / telemetry (reference: global.cc:113-124,712-767) ----
+    trace_on: bool = False               # BYTEPS_TRACE_ON
+    trace_start_step: int = 10           # BYTEPS_TRACE_START_STEP
+    trace_end_step: int = 20             # BYTEPS_TRACE_END_STEP
+    trace_dir: str = "./traces"          # BYTEPS_TRACE_DIR
+    telemetry_on: bool = True            # BYTEPS_TELEMETRY_ON
+
+    # ---- logging ----
+    log_level: str = "WARNING"           # BYTEPS_LOG_LEVEL
+
+    # ---- TPU-native extensions (no reference equivalent) ----
+    # Mesh axis sizes; 0/unset means "derive from jax.device_count()".
+    mesh_dp: int = 0                     # BYTEPS_TPU_MESH_DP
+    mesh_tp: int = 1                     # BYTEPS_TPU_MESH_TP
+    mesh_sp: int = 1                     # BYTEPS_TPU_MESH_SP
+    mesh_pp: int = 1                     # BYTEPS_TPU_MESH_PP
+    mesh_ep: int = 1                     # BYTEPS_TPU_MESH_EP
+    # Hierarchical reduce: devices per ICI island when spanning DCN.
+    ici_size: int = 0                    # BYTEPS_TPU_ICI_SIZE (0 = all local)
+    cross_barrier: bool = False          # BYTEPS_CROSS_BARRIER
+    # PS parity mode: route push_pull through the host KV server tier
+    # instead of XLA collectives (reference default path).
+    ps_mode: bool = False                # BYTEPS_TPU_PS_MODE
+
+    @classmethod
+    def from_env(cls) -> "Config":
+        gr = os.environ.get("BYTEPS_GLOBAL_RANK")
+        return cls(
+            role=_env_str("DMLC_ROLE", "worker"),
+            worker_id=_env_int("DMLC_WORKER_ID", 0),
+            num_worker=_env_int("DMLC_NUM_WORKER", 1),
+            num_server=_env_int("DMLC_NUM_SERVER", 0),
+            scheduler_uri=_env_str("DMLC_PS_ROOT_URI", "127.0.0.1"),
+            scheduler_port=_env_int("DMLC_PS_ROOT_PORT", 9000),
+            local_rank=_env_int("BYTEPS_LOCAL_RANK", 0),
+            local_size=_env_int("BYTEPS_LOCAL_SIZE", 1),
+            global_rank=int(gr) if gr not in (None, "") else None,
+            force_distributed=_env_bool("BYTEPS_FORCE_DISTRIBUTED"),
+            partition_bytes=_env_int("BYTEPS_PARTITION_BYTES", 4 * 1024 * 1024),
+            min_compress_bytes=_env_int("BYTEPS_MIN_COMPRESS_BYTES", 65536),
+            scheduling_credit=_env_int("BYTEPS_SCHEDULING_CREDIT", 0),
+            server_engine_threads=_env_int("BYTEPS_SERVER_ENGINE_THREAD", 4),
+            server_enable_schedule=_env_bool("BYTEPS_SERVER_ENABLE_SCHEDULE"),
+            enable_async=_env_bool("BYTEPS_ENABLE_ASYNC"),
+            key_hash_fn=_env_str("BYTEPS_KEY_HASH_FN", "djb2"),
+            trace_on=_env_bool("BYTEPS_TRACE_ON"),
+            trace_start_step=_env_int("BYTEPS_TRACE_START_STEP", 10),
+            trace_end_step=_env_int("BYTEPS_TRACE_END_STEP", 20),
+            trace_dir=_env_str("BYTEPS_TRACE_DIR", "./traces"),
+            telemetry_on=_env_bool("BYTEPS_TELEMETRY_ON", True),
+            log_level=_env_str("BYTEPS_LOG_LEVEL", "WARNING"),
+            mesh_dp=_env_int("BYTEPS_TPU_MESH_DP", 0),
+            mesh_tp=_env_int("BYTEPS_TPU_MESH_TP", 1),
+            mesh_sp=_env_int("BYTEPS_TPU_MESH_SP", 1),
+            mesh_pp=_env_int("BYTEPS_TPU_MESH_PP", 1),
+            mesh_ep=_env_int("BYTEPS_TPU_MESH_EP", 1),
+            ici_size=_env_int("BYTEPS_TPU_ICI_SIZE", 0),
+            cross_barrier=_env_bool("BYTEPS_CROSS_BARRIER"),
+            ps_mode=_env_bool("BYTEPS_TPU_PS_MODE"),
+        )
+
+
+_config: Optional[Config] = None
+
+
+def get_config(refresh: bool = False) -> Config:
+    """Process-wide config singleton; `refresh=True` re-reads the environment
+    (used by resume(), mirroring the reference re-reading DMLC_* on
+    byteps_resume — operations.cc:96-119)."""
+    global _config
+    if _config is None or refresh:
+        _config = Config.from_env()
+    return _config
+
+
+def align(size: int, alignment: int = ALIGN_BYTES) -> int:
+    """Round `size` up to a multiple of `alignment` (reference common.h:281-285)."""
+    return ((size + alignment - 1) // alignment) * alignment

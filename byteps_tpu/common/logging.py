@@ -1,0 +1,48 @@
+"""Leveled logger gated by BYTEPS_LOG_LEVEL (reference: common/logging.{h,cc}).
+
+The reference implements its own TRACE..FATAL logger; here we adapt Python's
+stdlib logging to the same level names and env var, so user-facing behavior
+(`BYTEPS_LOG_LEVEL=TRACE` etc.) matches.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import sys
+
+TRACE = 5
+logging.addLevelName(TRACE, "TRACE")
+
+_LEVELS = {
+    "TRACE": TRACE,
+    "DEBUG": logging.DEBUG,
+    "INFO": logging.INFO,
+    "WARNING": logging.WARNING,
+    "ERROR": logging.ERROR,
+    "FATAL": logging.CRITICAL,
+}
+
+_logger: logging.Logger | None = None
+
+
+def get_logger() -> logging.Logger:
+    global _logger
+    if _logger is None:
+        lg = logging.getLogger("byteps_tpu")
+        level = _LEVELS.get(os.environ.get("BYTEPS_LOG_LEVEL", "WARNING").upper(),
+                            logging.WARNING)
+        lg.setLevel(level)
+        if not lg.handlers:
+            h = logging.StreamHandler(sys.stderr)
+            h.setFormatter(logging.Formatter(
+                "[%(asctime)s] [%(levelname)s] byteps_tpu: %(message)s",
+                datefmt="%H:%M:%S"))
+            lg.addHandler(h)
+        lg.propagate = False
+        _logger = lg
+    return _logger
+
+
+def trace(msg: str, *args) -> None:
+    get_logger().log(TRACE, msg, *args)

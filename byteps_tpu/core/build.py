@@ -1,0 +1,58 @@
+"""Build the native host core (`libbyteps_core.so`).
+
+The reference builds its C++ core through setup.py extensions
+(reference: setup.py:249-337).  Here the core is framework-independent host
+logic, so a plain g++ shared-object build is enough; it is (re)built lazily on
+first import when the sources are newer than the binary.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+_CORE_DIR = os.path.dirname(os.path.abspath(__file__))
+_SOURCES = ["core.cc", "server.cc"]
+_LIB_NAME = "libbyteps_core.so"
+
+
+def lib_path() -> str:
+    return os.path.join(_CORE_DIR, _LIB_NAME)
+
+
+def _needs_build() -> bool:
+    lib = lib_path()
+    if not os.path.exists(lib):
+        return True
+    lib_mtime = os.path.getmtime(lib)
+    for src in _SOURCES:
+        p = os.path.join(_CORE_DIR, src)
+        if os.path.exists(p) and os.path.getmtime(p) > lib_mtime:
+            return True
+    return False
+
+
+def build(force: bool = False, verbose: bool = False) -> str:
+    """Compile the native core if needed; returns the .so path.
+
+    Raises CalledProcessError on compile failure (callers fall back to the
+    pure-Python implementation in that case).
+    """
+    if not force and not _needs_build():
+        return lib_path()
+    srcs = [os.path.join(_CORE_DIR, s) for s in _SOURCES
+            if os.path.exists(os.path.join(_CORE_DIR, s))]
+    cmd = [
+        "g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-pthread",
+        "-fvisibility=hidden", "-o", lib_path(), *srcs,
+    ]
+    if verbose:
+        print(" ".join(cmd), file=sys.stderr)
+    subprocess.run(cmd, check=True, capture_output=not verbose)
+    return lib_path()
+
+
+if __name__ == "__main__":
+    build(force="--force" in sys.argv, verbose=True)
+    print(lib_path())

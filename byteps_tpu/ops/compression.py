@@ -1,0 +1,60 @@
+"""Framework-level ("intra-node") compression: the `Compression` enum.
+
+Mirrors the reference's two-level compression design
+(reference: docs/gradient-compression.md:11-17): this module is level 1 — the
+Horovod-style fp16 cast applied before communication and undone after
+(reference: byteps/torch/compression.py equivalent, byteps/tensorflow/
+__init__.py:66-81).  Level 2 (the inter-node onebit/topk/randomk/dithering
+compressors with error-feedback and momentum) lives in
+byteps_tpu.ops.compressor as Pallas kernels.
+
+On TPU the natural wire dtype is bfloat16 (no loss of exponent range), so
+`Compression.fp16` maps to bf16 by default; `Compression.f16` forces IEEE
+half for bit-parity with the reference.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+class Compressor:
+    """A bidirectional dtype cast around communication."""
+
+    def compress(self, tensor: jax.Array):
+        """Returns (compressed_tensor, ctx) — ctx is whatever decompress needs."""
+        raise NotImplementedError
+
+    def decompress(self, tensor: jax.Array, ctx):
+        raise NotImplementedError
+
+
+class NoneCompressor(Compressor):
+    def compress(self, tensor):
+        return tensor, None
+
+    def decompress(self, tensor, ctx):
+        return tensor
+
+
+class CastCompressor(Compressor):
+    def __init__(self, wire_dtype):
+        self.wire_dtype = jnp.dtype(wire_dtype)
+
+    def compress(self, tensor):
+        if jnp.issubdtype(tensor.dtype, jnp.floating):
+            return tensor.astype(self.wire_dtype), tensor.dtype
+        return tensor, None
+
+    def decompress(self, tensor, ctx):
+        return tensor if ctx is None else tensor.astype(ctx)
+
+
+class Compression:
+    """Namespace matching the reference API: bps.Compression.fp16 etc."""
+
+    none = NoneCompressor()
+    fp16 = CastCompressor(jnp.bfloat16)   # TPU-native half: bf16
+    f16 = CastCompressor(jnp.float16)     # strict IEEE half
+    bf16 = CastCompressor(jnp.bfloat16)

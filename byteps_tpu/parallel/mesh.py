@@ -1,0 +1,105 @@
+"""Device mesh construction for the framework.
+
+The reference's device topology is (machines × GPUs-per-machine) with NCCL
+rings intra-node and ps-lite across nodes (reference: docs/architecture.md,
+byteps/common/nccl_manager.cc).  The TPU-native equivalent is a single
+`jax.sharding.Mesh` whose axes name the parallelism dimensions:
+
+  - ``dp``  data parallelism (the reference's only strategy)
+  - ``ici_dp`` / ``dcn_dp``  hierarchical split of dp into intra-slice (ICI)
+    and inter-slice (DCN) axes, mirroring the reference's local-NCCL-reduce →
+    ps-lite-push two-level reduction (reference: core_loops.cc:188-267 +
+    536-616)
+  - ``tp`` tensor parallelism, ``sp`` sequence/context parallelism,
+    ``pp`` pipeline parallelism, ``ep`` expert parallelism — absent from the
+    reference (SURVEY §2.6) but first-class here.
+
+All collectives in byteps_tpu.ops ride these axis names; XLA lays ICI
+collectives onto the torus automatically when the mesh is built with
+`jax.experimental.mesh_utils.create_device_mesh`.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+from ..common.config import get_config
+
+# Canonical axis order: dcn-crossing axis outermost, then pp, dp, ep, sp, tp
+# innermost (tp needs the fastest wires; dp tolerates DCN).
+AXIS_ORDER = ("pp", "dp", "ep", "sp", "tp")
+
+_mesh: Optional[Mesh] = None
+
+
+def make_mesh(dp: int = 0, tp: int = 1, sp: int = 1, pp: int = 1, ep: int = 1,
+              devices: Optional[Sequence] = None) -> Mesh:
+    """Build a mesh over `devices` (default: all). dp=0 means "the rest"."""
+    devs = list(devices) if devices is not None else jax.devices()
+    n = len(devs)
+    other = tp * sp * pp * ep
+    if dp <= 0:
+        if n % other != 0:
+            raise ValueError(
+                f"device count {n} not divisible by tp*sp*pp*ep={other}")
+        dp = n // other
+    total = dp * other
+    if total != n:
+        raise ValueError(f"mesh {dp=}*{tp=}*{sp=}*{pp=}*{ep=}={total} != "
+                         f"device count {n}")
+    sizes = dict(pp=pp, dp=dp, ep=ep, sp=sp, tp=tp)
+    shape = tuple(sizes[a] for a in AXIS_ORDER)
+    try:
+        from jax.experimental import mesh_utils
+        mesh_devs = mesh_utils.create_device_mesh(shape, devices=devs)
+    except Exception:
+        mesh_devs = np.asarray(devs).reshape(shape)
+    return Mesh(mesh_devs, AXIS_ORDER)
+
+
+def make_hierarchical_mesh(ici_size: int,
+                           devices: Optional[Sequence] = None) -> Mesh:
+    """Two-level DP mesh ('dcn_dp', 'ici_dp') for hierarchical reduction.
+
+    `ici_size` devices per ICI island; islands are connected over DCN.  The
+    reference analog: GPUs under one PCIe switch reduce via NCCL, roots push
+    over the network (reference: docs/architecture.md:26-33).
+    """
+    devs = list(devices) if devices is not None else jax.devices()
+    n = len(devs)
+    if ici_size <= 0:
+        ici_size = n
+    if n % ici_size != 0:
+        raise ValueError(f"{n} devices not divisible by ici_size={ici_size}")
+    arr = np.asarray(devs).reshape(n // ici_size, ici_size)
+    return Mesh(arr, ("dcn_dp", "ici_dp"))
+
+
+def get_mesh(refresh: bool = False) -> Mesh:
+    """Process-wide default mesh built from config (BYTEPS_TPU_MESH_*)."""
+    global _mesh
+    if _mesh is None or refresh:
+        cfg = get_config(refresh=refresh)
+        _mesh = make_mesh(dp=cfg.mesh_dp, tp=cfg.mesh_tp, sp=cfg.mesh_sp,
+                          pp=cfg.mesh_pp, ep=cfg.mesh_ep)
+    return _mesh
+
+
+def set_mesh(mesh: Mesh) -> None:
+    global _mesh
+    _mesh = mesh
+
+
+def reset_mesh() -> None:
+    global _mesh
+    _mesh = None
+
+
+def dp_axis_size(mesh: Optional[Mesh] = None) -> int:
+    m = mesh or get_mesh()
+    return int(math.prod(m.shape[a] for a in ("dp",) if a in m.shape))

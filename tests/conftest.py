@@ -1,0 +1,47 @@
+"""Test harness: fake an 8-device TPU pod on CPU.
+
+The reference fakes a distributed cluster on one machine by launching
+scheduler/server subprocesses and forcing the distributed code path
+(reference: tests/meta_test.py:26-84, BYTEPS_FORCE_DISTRIBUTED=1).  The
+TPU-native analog: force the JAX host platform to expose 8 virtual CPU
+devices so every mesh/sharding/collective path compiles and runs exactly as
+it would on an 8-chip slice.  Must run before jax is imported anywhere.
+"""
+
+import os
+
+# Force CPU even if the ambient environment selects a TPU platform
+# (BYTEPS_TEST_TPU=1 opts back into real hardware).
+if os.environ.get("BYTEPS_TEST_TPU", "0") != "1":
+    os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+# Keep tests deterministic and quiet.
+os.environ.setdefault("BYTEPS_LOG_LEVEL", "ERROR")
+
+# jax may already be (partially) imported at interpreter startup, in which
+# case it has snapshotted JAX_PLATFORMS into its config — override there too.
+if os.environ.get("BYTEPS_TEST_TPU", "0") != "1":
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def bps_initialized():
+    import byteps_tpu as bps
+    bps.init()
+    yield bps
+    bps.shutdown()
+
+
+@pytest.fixture
+def mesh8():
+    import byteps_tpu as bps
+    m = bps.make_mesh()  # all 8 devices on dp
+    bps.set_mesh(m)
+    yield m
+    bps.reset_mesh()

@@ -1,0 +1,85 @@
+"""Top-level Horovod-compatible API tests (single-process path).
+
+The reference validates push_pull semantics through its fake-distributed
+harness (reference: tests/meta_test.py, tests/test_mxnet.py); here the
+single-worker path must behave like the reference's non-distributed mode
+(sum over one worker == identity, average == identity).
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+
+def test_init_rank_size(bps_initialized):
+    bps = bps_initialized
+    assert bps.size() == 1
+    assert bps.rank() == 0
+    assert bps.local_rank() == 0
+    assert bps.local_size() == 8  # virtual CPU devices
+
+
+def test_declare_keys_are_stable(bps_initialized):
+    bps = bps_initialized
+    k1 = bps.declare("api.param.a")
+    k2 = bps.declare("api.param.b")
+    assert k2 == k1 + 1
+    assert bps.declare("api.param.a") == k1
+    assert bps.declared_key("api.param.b") == k2
+
+
+def test_eager_push_pull_identity_single_worker(bps_initialized):
+    bps = bps_initialized
+    x = jnp.arange(12, dtype=jnp.float32).reshape(3, 4)
+    out = bps.push_pull(x, name="api.t0", average=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(x))
+    out = bps.push_pull(x, name="api.t0", average=False)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(x))
+
+
+def test_eager_push_pull_fp16_compression(bps_initialized):
+    bps = bps_initialized
+    x = jnp.linspace(-2, 2, 64, dtype=jnp.float32)
+    out = bps.push_pull(x, name="api.t1", compression=bps.Compression.fp16)
+    assert out.dtype == jnp.float32  # decompressed back
+    np.testing.assert_allclose(np.asarray(out), np.asarray(x), atol=2e-2)
+
+
+def test_async_handles(bps_initialized):
+    bps = bps_initialized
+    x = jnp.ones((16,), jnp.float32)
+    h = bps.push_pull_async(x, name="api.t2")
+    assert isinstance(h, int)
+    assert bps.poll(h) in (True, False)  # pending handle is pollable
+    out = bps.synchronize(h)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(x))
+    # synchronize releases the handle: poll and a second synchronize on a
+    # released handle raise (reference: torch/ops.cc checks handle validity).
+    with pytest.raises(ValueError):
+        bps.poll(h)
+    with pytest.raises(ValueError):
+        bps.synchronize(h)
+
+
+def test_broadcast_parameters_noop_single_worker(bps_initialized):
+    bps = bps_initialized
+    params = {"w": jnp.ones((4, 4)), "b": jnp.zeros((4,))}
+    out = bps.broadcast_parameters(params, root_rank=0)
+    np.testing.assert_allclose(np.asarray(out["w"]), np.ones((4, 4)))
+
+
+def test_pushpull_speed_telemetry(bps_initialized):
+    bps = bps_initialized
+    for _ in range(5):
+        bps.push_pull(jnp.ones((1024,), jnp.float32), name="api.t3")
+    ts, mbps = bps.get_pushpull_speed()
+    assert mbps >= 0.0
+
+
+def test_suspend_resume_keeps_keys(bps_initialized):
+    bps = bps_initialized
+    k = bps.declare("api.elastic.w")
+    bps.suspend()
+    bps.resume(num_workers=1)
+    # Keys survive elastic restart (reference: operations.cc:96-119).
+    assert bps.declared_key("api.elastic.w") == k

@@ -1,0 +1,140 @@
+"""End-to-end data-parallel training on the 8-device mesh.
+
+The reference's minimum end-to-end example is MNIST per framework
+(reference: example/pytorch/train_mnist_byteps.py).  Equivalent here: an MLP
+classifier on synthetic MNIST-shaped data, trained with DistributedOptimizer
+over dp=8, asserting (a) the loss drops, and (b) distributed training is
+numerically equivalent to single-device training on the concatenated batch.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax.sharding import PartitionSpec as P
+
+import byteps_tpu as bps
+
+
+def _mlp_init(key, sizes=(784, 64, 10)):
+    params = []
+    for i in range(len(sizes) - 1):
+        key, k1, k2 = jax.random.split(key, 3)
+        params.append({
+            "w": jax.random.normal(k1, (sizes[i], sizes[i + 1])) * 0.05,
+            "b": jnp.zeros((sizes[i + 1],)),
+        })
+    return params
+
+
+def _mlp_apply(params, x):
+    for i, layer in enumerate(params):
+        x = x @ layer["w"] + layer["b"]
+        if i < len(params) - 1:
+            x = jax.nn.relu(x)
+    return x
+
+
+def _loss_fn(params, batch):
+    x, y = batch
+    logits = _mlp_apply(params, x)
+    return optax.softmax_cross_entropy_with_integer_labels(logits, y).mean()
+
+
+# Fixed random projection makes labels a deterministic, learnable function
+# of the inputs.
+_LABEL_PROJ = jax.random.normal(jax.random.PRNGKey(999), (784, 10))
+
+
+def _synthetic_batch(key, n):
+    x = jax.random.normal(key, (n, 784))
+    y = jnp.argmax(x @ _LABEL_PROJ, axis=-1)
+    return x, y
+
+
+@pytest.mark.parametrize("partition_bytes", [256, 4 * 1024 * 1024])
+def test_mnist_mlp_loss_decreases(mesh8, partition_bytes):
+    bps.init()
+    params = _mlp_init(jax.random.PRNGKey(0))
+    opt = bps.DistributedOptimizer(optax.sgd(0.1),
+                                   partition_bytes=partition_bytes)
+    opt_state = opt.init(params)
+    step = bps.build_train_step(_loss_fn, opt, mesh8, batch_spec=P("dp"))
+
+    batch = _synthetic_batch(jax.random.PRNGKey(0), 64)
+    losses = []
+    for i in range(20):
+        params, opt_state, loss = step(params, opt_state, batch)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0] * 0.5, losses
+
+
+def test_distributed_matches_single_device():
+    """dp=8 training must produce the same params as single-device training
+    on the full batch (the reference asserts pulled tensors equal the local
+    sum — tests/test_mxnet.py:39-75; this is the training-loop version)."""
+    mesh = bps.make_mesh()
+    params = _mlp_init(jax.random.PRNGKey(42))
+    opt = bps.DistributedOptimizer(optax.sgd(0.05), partition_bytes=512)
+    opt_state = opt.init(params)
+    step = bps.build_train_step(_loss_fn, opt, mesh, donate=False)
+
+    sd_params = jax.tree.map(lambda x: x.copy(), params)
+    sd_opt = optax.sgd(0.05)
+    sd_state = sd_opt.init(sd_params)
+
+    for i in range(5):
+        batch = _synthetic_batch(jax.random.PRNGKey(100 + i), 64)
+        params, opt_state, _ = step(params, opt_state, batch)
+        # single device on the identical full batch
+        loss, grads = jax.value_and_grad(_loss_fn)(sd_params, batch)
+        upd, sd_state = sd_opt.update(grads, sd_state, sd_params)
+        sd_params = optax.apply_updates(sd_params, upd)
+
+    for pd, ps in zip(jax.tree.leaves(params), jax.tree.leaves(sd_params)):
+        np.testing.assert_allclose(np.asarray(pd), np.asarray(ps),
+                                   rtol=2e-4, atol=2e-5)
+
+
+def test_hierarchical_optimizer_trains():
+    """Two-level (dcn=2 × ici=4) hierarchical reduction end-to-end."""
+    mesh = bps.make_hierarchical_mesh(ici_size=4)
+    params = _mlp_init(jax.random.PRNGKey(1))
+    opt = bps.DistributedOptimizer(optax.sgd(0.1), hierarchical=True,
+                                   partition_bytes=1024)
+    opt_state = opt.init(params)
+
+    import functools
+    @functools.partial(
+        jax.shard_map, mesh=mesh,
+        in_specs=(P(), P(), P(("dcn_dp", "ici_dp"))),
+        out_specs=(P(), P(), P()), check_vma=False)
+    def _step(params, opt_state, batch):
+        loss, grads = jax.value_and_grad(_loss_fn)(params, batch)
+        updates, opt_state = opt.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        loss = jax.lax.pmean(jax.lax.pmean(loss, "ici_dp"), "dcn_dp")
+        return params, opt_state, loss
+
+    step = jax.jit(_step)
+    batch = _synthetic_batch(jax.random.PRNGKey(0), 64)
+    losses = []
+    for i in range(15):
+        params, opt_state, loss = step(params, opt_state, batch)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0] * 0.6, losses
+
+
+def test_fp16_compressed_training_converges(mesh8):
+    params = _mlp_init(jax.random.PRNGKey(2))
+    opt = bps.DistributedOptimizer(optax.sgd(0.1),
+                                   compression=bps.Compression.fp16)
+    opt_state = opt.init(params)
+    step = bps.build_train_step(_loss_fn, opt, mesh8)
+    batch = _synthetic_batch(jax.random.PRNGKey(0), 64)
+    losses = []
+    for i in range(15):
+        params, opt_state, loss = step(params, opt_state, batch)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0] * 0.6, losses
