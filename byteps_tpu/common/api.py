@@ -139,14 +139,27 @@ def resume(num_workers: int, num_servers: int = 0) -> None:
 # ---------------------------------------------------------------------------
 # Topology (reference: common/__init__.py:83-128)
 # ---------------------------------------------------------------------------
+def _env_cluster(cfg) -> bool:
+    """True when the DMLC_* envs describe a multi-worker cluster that JAX's
+    process topology doesn't know about (PS mode, or pre-jax.distributed
+    launch): rank/size must come from the env, as the reference's do
+    (reference: communicator.cc:60-96)."""
+    return cfg.num_worker > 1 and not _state.jax_dist_initialized
+
+
 def rank() -> int:
     cfg = _state.config or get_config()
     if cfg.global_rank is not None:
         return cfg.global_rank
+    if _state.ps_session is not None or _env_cluster(cfg):
+        return cfg.worker_id
     return jax.process_index()
 
 
 def size() -> int:
+    cfg = _state.config or get_config()
+    if _state.ps_session is not None or _env_cluster(cfg):
+        return cfg.num_worker
     return jax.process_count()
 
 

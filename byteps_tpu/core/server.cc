@@ -1,0 +1,453 @@
+// Native PS server tier: TCP KV server with engine threads.
+//
+// TPU-parity re-design of the reference server (reference:
+// byteps/server/server.cc, byteps/server/queue.h — see SURVEY §2.3): a
+// KVServer request handler feeding N engine threads through per-thread
+// priority queues, summing pushed gradient partitions across workers and
+// answering pulls from the merged buffer once every worker contributed.
+// The ps-lite/ZMQ transport is replaced by a plain length-prefixed TCP
+// protocol (the TPU data plane is XLA collectives; this tier exists for
+// PS-mode parity: CPU-host-assisted aggregation, async training, elastic
+// scenarios), and CUDA/NUMA specifics are dropped.
+//
+// Request : u8 cmd | u8 dtype | u16 flags | u32 worker_id | u64 key | u64 len | payload[len]
+// Response: u8 status | u64 key | u64 len | payload[len]
+// cmds: 0 HELLO, 1 INIT, 2 PUSH, 3 PULL, 4 BARRIER, 5 SHUTDOWN, 6 PING
+//
+// Threading model (mirrors the reference):
+//   - acceptor thread + one reader thread per connection (parse & enqueue)
+//   - kEngineThreads engine threads, each owning a PriorityQueue; a key is
+//     assigned to the engine with the least accumulated bytes (reference:
+//     server.h:149-173), so per-key state is single-threaded
+//   - priority = per-key push count when scheduling is enabled — keys
+//     closest to round completion run first (reference: queue.h:31-105)
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <queue>
+#include <set>
+#include <string>
+#include <thread>
+#include <unordered_map>
+#include <vector>
+
+namespace bps_server {
+
+enum Cmd : uint8_t {
+  kHello = 0, kInit = 1, kPush = 2, kPull = 3, kBarrier = 4,
+  kShutdown = 5, kPing = 6,
+};
+enum Status : uint8_t { kOk = 0, kError = 1 };
+
+#pragma pack(push, 1)
+struct ReqHeader {
+  uint8_t cmd;
+  uint8_t dtype;   // 0 = f32 (summed); 1 = raw bytes (last-write-wins)
+  uint16_t flags;
+  uint32_t worker_id;
+  uint64_t key;
+  uint64_t len;
+};
+struct RespHeader {
+  uint8_t status;
+  uint64_t key;
+  uint64_t len;
+};
+#pragma pack(pop)
+
+struct Conn {
+  int fd;
+  std::mutex write_mu;
+};
+
+struct PendingPull {
+  Conn* conn;
+  uint64_t key;
+  uint16_t want_round = 0;  // pull round (mod 2^16) the worker expects
+};
+
+// Per-key merge state — the reference's BytePSArray + update buffers
+// (reference: server.h "UpdateBuf", server.cc:48-84).
+struct KeyState {
+  std::vector<char> store;     // in-progress merge buffer
+  std::vector<char> out;       // last completed round (served to pulls) —
+                               // the reference's store_/update_buf split
+                               // (reference: server.cc:48-84) that keeps a
+                               // straggler's round-r pull valid while
+                               // round r+1 is already merging
+  std::set<uint32_t> seen;     // worker ids seen this round (dedup,
+                               // reference: server.cc:150-177 seen_sender)
+  uint64_t completed_round = 0;
+  uint8_t dtype = 0;
+  std::vector<PendingPull> pending;
+  std::atomic<uint64_t> push_count{0};  // total pushes (schedule priority);
+                                        // atomic: written by engine, read
+                                        // by reader threads
+};
+
+struct Task {
+  uint8_t cmd;
+  uint8_t dtype;
+  uint16_t flags;
+  uint32_t worker_id;
+  uint64_t key;
+  std::vector<char> payload;
+  Conn* conn;
+  uint64_t priority;  // higher = sooner when scheduling enabled
+  uint64_t seq;       // FIFO tiebreak
+};
+
+struct TaskCmp {
+  bool operator()(const Task& a, const Task& b) const {
+    if (a.priority != b.priority) return a.priority < b.priority;
+    return a.seq > b.seq;  // earlier first
+  }
+};
+
+// Per-engine priority queue (reference: queue.h:31-105).
+class EngineQueue {
+ public:
+  void Push(Task&& t) {
+    std::lock_guard<std::mutex> lk(mu_);
+    q_.push(std::move(t));
+    cv_.notify_one();
+  }
+  bool Pop(Task* out) {
+    std::unique_lock<std::mutex> lk(mu_);
+    cv_.wait(lk, [&] { return !q_.empty() || stopped_; });
+    if (q_.empty()) return false;
+    // priority_queue has no non-const top-move; const_cast is the standard
+    // workaround for move-only payloads.
+    *out = std::move(const_cast<Task&>(q_.top()));
+    q_.pop();
+    return true;
+  }
+  void Stop() {
+    std::lock_guard<std::mutex> lk(mu_);
+    stopped_ = true;
+    cv_.notify_all();
+  }
+
+ private:
+  std::priority_queue<Task, std::vector<Task>, TaskCmp> q_;
+  std::mutex mu_;
+  std::condition_variable cv_;
+  bool stopped_ = false;
+};
+
+class Server {
+ public:
+  Server(int port, int num_workers, int engine_threads, bool schedule,
+         bool async_mode)
+      : port_(port), num_workers_(num_workers),
+        engine_threads_(engine_threads < 1 ? 1 : engine_threads),
+        schedule_(schedule), async_(async_mode),
+        queues_(engine_threads_), engine_load_(engine_threads_, 0) {}
+
+  int Run() {
+    listen_fd_ = socket(AF_INET, SOCK_STREAM, 0);
+    if (listen_fd_ < 0) return 1;
+    int one = 1;
+    setsockopt(listen_fd_, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_addr.s_addr = htonl(INADDR_ANY);
+    addr.sin_port = htons(static_cast<uint16_t>(port_));
+    if (bind(listen_fd_, reinterpret_cast<sockaddr*>(&addr),
+             sizeof(addr)) != 0)
+      return 2;
+    if (listen(listen_fd_, 64) != 0) return 3;
+
+    for (int i = 0; i < engine_threads_; ++i)
+      engines_.emplace_back(&Server::EngineLoop, this, i);
+
+    while (!shutdown_.load()) {
+      int fd = accept(listen_fd_, nullptr, nullptr);
+      if (fd < 0) break;
+      setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+      auto* conn = new Conn{fd, {}};
+      {
+        std::lock_guard<std::mutex> lk(conns_mu_);
+        conns_.push_back(conn);
+      }
+      readers_.emplace_back(&Server::ReaderLoop, this, conn);
+    }
+    for (auto& q : queues_) q.Stop();
+    for (auto& t : engines_) t.join();
+    {
+      // Readers may be blocked in recv() on idle-but-open worker sockets;
+      // a half-close unblocks them so join() terminates.
+      std::lock_guard<std::mutex> lk(conns_mu_);
+      for (auto* c : conns_) ::shutdown(c->fd, SHUT_RDWR);
+    }
+    for (auto& t : readers_) t.join();
+    {
+      std::lock_guard<std::mutex> lk(conns_mu_);
+      for (auto* c : conns_) { close(c->fd); delete c; }
+      conns_.clear();
+    }
+    close(listen_fd_);
+    return 0;
+  }
+
+ private:
+  static bool ReadFull(int fd, void* buf, size_t n) {
+    char* p = static_cast<char*>(buf);
+    while (n > 0) {
+      ssize_t r = recv(fd, p, n, 0);
+      if (r <= 0) return false;
+      p += r;
+      n -= static_cast<size_t>(r);
+    }
+    return true;
+  }
+
+  static bool WriteFull(int fd, const void* buf, size_t n) {
+    const char* p = static_cast<const char*>(buf);
+    while (n > 0) {
+      ssize_t r = send(fd, p, n, MSG_NOSIGNAL);
+      if (r <= 0) return false;
+      p += r;
+      n -= static_cast<size_t>(r);
+    }
+    return true;
+  }
+
+  static void Respond(Conn* c, uint8_t status, uint64_t key,
+                      const char* data, uint64_t len) {
+    std::lock_guard<std::mutex> lk(c->write_mu);
+    RespHeader h{status, key, len};
+    if (!WriteFull(c->fd, &h, sizeof(h))) return;
+    if (len) WriteFull(c->fd, data, len);
+  }
+
+  // Key -> engine by least accumulated load (reference: server.h:149-173).
+  int EngineFor(uint64_t key, uint64_t bytes) {
+    std::lock_guard<std::mutex> lk(assign_mu_);
+    auto it = key_engine_.find(key);
+    if (it != key_engine_.end()) return it->second;
+    int best = 0;
+    for (int i = 1; i < engine_threads_; ++i)
+      if (engine_load_[i] < engine_load_[best]) best = i;
+    engine_load_[best] += bytes;
+    key_engine_[key] = best;
+    return best;
+  }
+
+  void ReaderLoop(Conn* conn) {
+    ReqHeader h;
+    while (!shutdown_.load()) {
+      if (!ReadFull(conn->fd, &h, sizeof(h))) break;
+      std::vector<char> payload(h.len);
+      if (h.len && !ReadFull(conn->fd, payload.data(), h.len)) break;
+      switch (h.cmd) {
+        case kHello:
+        case kPing:
+          Respond(conn, kOk, h.key, nullptr, 0);
+          break;
+        case kBarrier:
+          HandleBarrier(conn, h.key);
+          break;
+        case kShutdown:
+          Respond(conn, kOk, h.key, nullptr, 0);
+          shutdown_.store(true);
+          // Unblock accept().
+          { int s = socket(AF_INET, SOCK_STREAM, 0);
+            sockaddr_in a{};
+            a.sin_family = AF_INET;
+            a.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+            a.sin_port = htons(static_cast<uint16_t>(port_));
+            connect(s, reinterpret_cast<sockaddr*>(&a), sizeof(a));
+            close(s); }
+          return;
+        default: {
+          Task t;
+          t.cmd = h.cmd;
+          t.dtype = h.dtype;
+          t.flags = h.flags;
+          t.worker_id = h.worker_id;
+          t.key = h.key;
+          t.payload = std::move(payload);
+          t.conn = conn;
+          t.seq = seq_.fetch_add(1);
+          t.priority = 0;
+          int idx = EngineFor(h.key, h.len);
+          if (schedule_) {
+            std::lock_guard<std::mutex> lk(store_mu_);
+            t.priority = store_[h.key].push_count.load(
+                std::memory_order_relaxed);  // closest-to-done first
+          }
+          queues_[idx].Push(std::move(t));
+        }
+      }
+    }
+  }
+
+  void HandleBarrier(Conn* conn, uint64_t gen) {
+    std::vector<PendingPull> to_release;
+    {
+      std::lock_guard<std::mutex> lk(barrier_mu_);
+      barrier_waiters_.push_back({conn, gen});
+      if (static_cast<int>(barrier_waiters_.size()) >= num_workers_) {
+        to_release.swap(barrier_waiters_);
+      }
+    }
+    for (auto& w : to_release) Respond(w.conn, kOk, w.key, nullptr, 0);
+  }
+
+  void EngineLoop(int idx) {
+    Task t;
+    while (queues_[idx].Pop(&t)) {
+      switch (t.cmd) {
+        case kInit: HandleInit(t); break;
+        case kPush: HandlePush(t); break;
+        case kPull: HandlePull(t); break;
+        default: Respond(t.conn, kError, t.key, nullptr, 0);
+      }
+    }
+  }
+
+  KeyState& StateFor(uint64_t key) {
+    std::lock_guard<std::mutex> lk(store_mu_);
+    return store_[key];
+  }
+
+  void HandleInit(Task& t) {
+    // Init allocates the merged store; like the reference's init push it is
+    // idempotent and sized by the declared length (reference:
+    // server.cc:270-298).
+    KeyState& ks = StateFor(t.key);
+    uint64_t n = t.payload.size() >= 8
+        ? *reinterpret_cast<const uint64_t*>(t.payload.data()) : 0;
+    if (ks.store.size() != n) ks.store.assign(n, 0);
+    ks.dtype = t.dtype;
+    Respond(t.conn, kOk, t.key, nullptr, 0);
+  }
+
+  void HandlePush(Task& t) {
+    KeyState& ks = StateFor(t.key);
+    if (ks.store.size() != t.payload.size())
+      ks.store.assign(t.payload.size(), 0);
+    ks.dtype = t.dtype;
+    ks.push_count.fetch_add(1, std::memory_order_relaxed);
+    if (async_) {
+      // Async PS mode: store += payload immediately, no round tracking
+      // (reference: server.cc:319-323, BYTEPS_ENABLE_ASYNC).
+      SumInto(ks, t.payload);
+      ks.out = ks.store;
+      Respond(t.conn, kOk, t.key, nullptr, 0);
+      FlushPulls(ks, t.key);
+      return;
+    }
+    if (ks.seen.count(t.worker_id)) {
+      // Duplicate within a round — ignore merge, still ack (reference dedups
+      // by seen_sender, server.cc:150-177).
+      Respond(t.conn, kOk, t.key, nullptr, 0);
+      return;
+    }
+    if (ks.seen.empty()) {
+      // COPY_FIRST (reference: server.cc:299-379)
+      std::memcpy(ks.store.data(), t.payload.data(), t.payload.size());
+    } else {
+      SumInto(ks, t.payload);  // SUM_RECV
+    }
+    ks.seen.insert(t.worker_id);
+    Respond(t.conn, kOk, t.key, nullptr, 0);
+    if (static_cast<int>(ks.seen.size()) >= num_workers_) {
+      // ALL_RECV: publish the completed round and start a fresh merge.
+      ks.out = ks.store;
+      ks.completed_round++;
+      ks.seen.clear();
+      FlushPulls(ks, t.key);
+    }
+  }
+
+  void SumInto(KeyState& ks, const std::vector<char>& payload) {
+    if (ks.dtype == 0) {
+      auto* dst = reinterpret_cast<float*>(ks.store.data());
+      auto* src = reinterpret_cast<const float*>(payload.data());
+      size_t n = payload.size() / sizeof(float);
+      #pragma omp simd
+      for (size_t i = 0; i < n; ++i) dst[i] += src[i];
+    } else {
+      std::memcpy(ks.store.data(), payload.data(), payload.size());
+    }
+  }
+
+  void HandlePull(Task& t) {
+    KeyState& ks = StateFor(t.key);
+    // t.flags = the round (mod 2^16) the worker just pushed; its result is
+    // ready once that round has been published.
+    bool ready = async_ ||
+        (ks.completed_round & 0xFFFF) != t.flags;
+    if (ready) {
+      Respond(t.conn, kOk, t.key, ks.out.data(), ks.out.size());
+    } else {
+      ks.pending.push_back({t.conn, t.key, t.flags});
+    }
+  }
+
+  void FlushPulls(KeyState& ks, uint64_t key) {
+    std::vector<PendingPull> still;
+    for (auto& p : ks.pending) {
+      if (async_ || (ks.completed_round & 0xFFFF) != p.want_round)
+        Respond(p.conn, kOk, key, ks.out.data(), ks.out.size());
+      else
+        still.push_back(p);
+    }
+    ks.pending.swap(still);
+  }
+
+  int port_;
+  int num_workers_;
+  int engine_threads_;
+  bool schedule_;
+  bool async_;
+  int listen_fd_ = -1;
+
+  std::vector<EngineQueue> queues_;
+  std::vector<std::thread> engines_;
+  std::vector<std::thread> readers_;
+
+  std::mutex assign_mu_;
+  std::unordered_map<uint64_t, int> key_engine_;
+  std::vector<uint64_t> engine_load_;
+
+  std::mutex store_mu_;
+  std::map<uint64_t, KeyState> store_;
+
+  std::mutex barrier_mu_;
+  std::vector<PendingPull> barrier_waiters_;
+
+  std::mutex conns_mu_;
+  std::vector<Conn*> conns_;
+
+  std::atomic<bool> shutdown_{false};
+  std::atomic<uint64_t> seq_{0};
+};
+
+}  // namespace bps_server
+
+extern "C" {
+
+// Blocking server entry, the analog of `byteps_server()`
+// (reference: server.h:186, server/__init__.py:21-27).
+__attribute__((visibility("default")))
+int bps_ps_server_run(int port, int num_workers, int engine_threads,
+                      int enable_schedule, int enable_async) {
+  bps_server::Server s(port, num_workers, engine_threads,
+                       enable_schedule != 0, enable_async != 0);
+  return s.Run();
+}
+
+}  // extern "C"

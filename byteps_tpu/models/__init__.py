@@ -1,0 +1,31 @@
+"""Model families for byteps_tpu.
+
+The reference ships benchmark/example models via torchvision/gluon model
+zoos (reference: example/pytorch/benchmark_byteps.py uses
+torchvision.models, example/mxnet uses gluon model_zoo); this package is
+the in-tree TPU-native equivalent: a transformer LM family (flagship —
+BERT-large is the reference's headline benchmark, README.md:38-46), a CNN
+family (ResNet/VGG — docs/performance.md benchmarks), and an MNIST MLP.
+"""
+
+from . import transformer
+from . import cnn
+from . import mlp
+
+from .transformer import (
+    TransformerConfig, get_config as get_transformer_config,
+    init_params as init_transformer, forward as transformer_forward,
+    loss_fn as transformer_loss,
+)
+from .cnn import create_cnn, cnn_loss_fn
+from .mlp import (
+    init_params as init_mlp, forward as mlp_forward, loss_fn as mlp_loss,
+)
+
+__all__ = [
+    "transformer", "cnn", "mlp",
+    "TransformerConfig", "get_transformer_config", "init_transformer",
+    "transformer_forward", "transformer_loss",
+    "create_cnn", "cnn_loss_fn",
+    "init_mlp", "mlp_forward", "mlp_loss",
+]

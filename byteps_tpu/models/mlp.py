@@ -1,0 +1,46 @@
+"""MNIST-scale MLP — the "hello world" model family.
+
+Every reference framework plugin ships an MNIST example
+(reference: example/pytorch/train_mnist_byteps.py, example/mxnet/
+train_mnist_byteps.py, example/keras/mnist_advanced.py); this is the
+pure-JAX equivalent used by the end-to-end training tests.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+PyTree = Any
+
+
+def init_params(rng: jax.Array, sizes: Sequence[int] = (784, 256, 128, 10),
+                dtype=jnp.float32) -> PyTree:
+    params = []
+    keys = jax.random.split(rng, len(sizes) - 1)
+    for k, (fin, fout) in zip(keys, zip(sizes[:-1], sizes[1:])):
+        w = jax.random.normal(k, (fin, fout), dtype) / jnp.sqrt(fin)
+        params.append({"w": w, "b": jnp.zeros((fout,), dtype)})
+    return params
+
+
+def forward(params: PyTree, x: jax.Array) -> jax.Array:
+    for i, layer in enumerate(params):
+        x = x @ layer["w"] + layer["b"]
+        if i < len(params) - 1:
+            x = jax.nn.relu(x)
+    return x
+
+
+def loss_fn(params: PyTree, batch: Tuple[jax.Array, jax.Array]) -> jax.Array:
+    x, y = batch
+    logits = forward(params, x)
+    logp = jax.nn.log_softmax(logits)
+    return -jnp.take_along_axis(logp, y[:, None], axis=-1).mean()
+
+
+def accuracy(params: PyTree, batch: Tuple[jax.Array, jax.Array]) -> jax.Array:
+    x, y = batch
+    return (forward(params, x).argmax(-1) == y).mean()

@@ -1,0 +1,260 @@
+"""TPU-native transformer language model (the flagship model family).
+
+The reference's headline benchmark is BERT-large data-parallel training
+(reference: README.md:38-46 — ~90% scaling efficiency at 256 GPUs, GluonNLP
+BERT via an external repo; the reference itself ships no model code).  This
+module supplies the model the reference outsources: a pure-JAX transformer
+encoder/decoder LM designed for the MXU —
+
+  - all matmuls are (batch*seq, d_model) x (d_model, N) shaped, bf16 by
+    default, so XLA tiles them onto the systolic array;
+  - per-layer `jax.checkpoint` (rematerialisation) trades FLOPs for HBM;
+  - params are a flat pytree of named arrays with an accompanying
+    PartitionSpec tree (`param_specs`) giving Megatron-style tensor
+    parallelism over the 'tp' mesh axis: QKV and MLP-in are column-sharded,
+    attention-out and MLP-out row-sharded, everything else replicated;
+  - layers are stacked with `lax.scan` over a single stacked param tree
+    (compile time stays O(1) in depth, and the leading layer axis doubles as
+    the pipeline-stage axis for 'pp').
+
+Configs mirror the reference benchmark suite: bert_base/bert_large
+(README.md:38-46) plus tiny variants for tests.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+PyTree = Any
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int = 32768
+    num_layers: int = 12
+    d_model: int = 768
+    num_heads: int = 12
+    d_ff: int = 3072
+    max_seq_len: int = 512
+    dtype: Any = jnp.bfloat16          # activation/compute dtype (MXU-native)
+    param_dtype: Any = jnp.float32     # master params stay f32
+    causal: bool = True                # decoder LM; False = BERT-style encoder
+    remat: bool = True                 # per-layer rematerialisation
+    attn_impl: str = "dense"           # "dense" | "ring" (sp-sharded)
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.num_heads
+
+
+# Benchmark-suite configs (reference README.md:38-46 benchmarks BERT-large;
+# docs/performance.md benchmarks ResNet50/VGG16 — see models/cnn.py).
+CONFIGS: Dict[str, TransformerConfig] = {
+    "tiny": TransformerConfig(vocab_size=1024, num_layers=2, d_model=64,
+                              num_heads=4, d_ff=128, max_seq_len=128),
+    "bert_base": TransformerConfig(num_layers=12, d_model=768, num_heads=12,
+                                   d_ff=3072, causal=False),
+    "bert_large": TransformerConfig(num_layers=24, d_model=1024, num_heads=16,
+                                    d_ff=4096, causal=False),
+    "gpt_small": TransformerConfig(num_layers=12, d_model=768, num_heads=12,
+                                   d_ff=3072, causal=True),
+    "gpt_medium": TransformerConfig(num_layers=24, d_model=1024, num_heads=16,
+                                    d_ff=4096, causal=True),
+}
+
+
+def get_config(name: str, **overrides) -> TransformerConfig:
+    cfg = CONFIGS[name]
+    return dataclasses.replace(cfg, **overrides) if overrides else cfg
+
+
+# ---------------------------------------------------------------------------
+# Parameter init.  Layer params are stacked along a leading num_layers axis.
+# ---------------------------------------------------------------------------
+def init_params(rng: jax.Array, cfg: TransformerConfig) -> PyTree:
+    dt = cfg.param_dtype
+    k_emb, k_pos, k_layers, k_out = jax.random.split(rng, 4)
+
+    def dense_init(key, shape, fan_in):
+        return (jax.random.normal(key, shape, dt) / jnp.sqrt(fan_in)).astype(dt)
+
+    L, D, F = cfg.num_layers, cfg.d_model, cfg.d_ff
+    lkeys = jax.random.split(k_layers, 6)
+
+    def stack(key, shape, fan_in):
+        ks = jax.random.split(key, L)
+        return jnp.stack([dense_init(k, shape, fan_in) for k in ks])
+
+    layers = {
+        "qkv_w": stack(lkeys[0], (D, 3 * D), D),
+        "attn_out_w": stack(lkeys[1], (D, D), D),
+        "mlp_in_w": stack(lkeys[2], (D, F), D),
+        "mlp_out_w": stack(lkeys[3], (F, D), F),
+        "ln1_scale": jnp.ones((L, D), dt),
+        "ln1_bias": jnp.zeros((L, D), dt),
+        "ln2_scale": jnp.ones((L, D), dt),
+        "ln2_bias": jnp.zeros((L, D), dt),
+        "qkv_b": jnp.zeros((L, 3 * D), dt),
+        "attn_out_b": jnp.zeros((L, D), dt),
+        "mlp_in_b": jnp.zeros((L, F), dt),
+        "mlp_out_b": jnp.zeros((L, D), dt),
+    }
+    return {
+        "embed": dense_init(k_emb, (cfg.vocab_size, D), D),
+        "pos_embed": (jax.random.normal(k_pos, (cfg.max_seq_len, D), dt)
+                      * 0.02).astype(dt),
+        "layers": layers,
+        "ln_f_scale": jnp.ones((D,), dt),
+        "ln_f_bias": jnp.zeros((D,), dt),
+    }
+
+
+def param_specs(cfg: TransformerConfig, tp_axis: str = "tp",
+                pp_axis: Optional[str] = None) -> PyTree:
+    """PartitionSpec tree for Megatron-style TP (column/row split) with the
+    stacked layer axis optionally sharded over the pipeline axis."""
+    del cfg
+    pp = pp_axis  # leading stacked-layer dim
+    layers = {
+        "qkv_w": P(pp, None, tp_axis),
+        "attn_out_w": P(pp, tp_axis, None),
+        "mlp_in_w": P(pp, None, tp_axis),
+        "mlp_out_w": P(pp, tp_axis, None),
+        "ln1_scale": P(pp, None),
+        "ln1_bias": P(pp, None),
+        "ln2_scale": P(pp, None),
+        "ln2_bias": P(pp, None),
+        "qkv_b": P(pp, tp_axis),
+        "attn_out_b": P(pp, None),
+        "mlp_in_b": P(pp, tp_axis),
+        "mlp_out_b": P(pp, None),
+    }
+    return {
+        "embed": P(None, None),
+        "pos_embed": P(None, None),
+        "layers": layers,
+        "ln_f_scale": P(None),
+        "ln_f_bias": P(None),
+    }
+
+
+# ---------------------------------------------------------------------------
+# Forward pass.
+# ---------------------------------------------------------------------------
+def _layer_norm(x, scale, bias, eps=1e-5):
+    x32 = x.astype(jnp.float32)
+    mu = x32.mean(-1, keepdims=True)
+    var = x32.var(-1, keepdims=True)
+    y = (x32 - mu) * jax.lax.rsqrt(var + eps)
+    return (y * scale.astype(jnp.float32)
+            + bias.astype(jnp.float32)).astype(x.dtype)
+
+
+def dense_attention(q, k, v, causal: bool):
+    """q,k,v: [B, H, S, Dh].  Softmax in f32 for stability."""
+    dh = q.shape[-1]
+    logits = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32)
+    logits = logits / jnp.sqrt(jnp.asarray(dh, jnp.float32))
+    if causal:
+        s = q.shape[2]
+        mask = jnp.tril(jnp.ones((s, s), bool))
+        logits = jnp.where(mask, logits, jnp.finfo(jnp.float32).min)
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bhkd->bhqd", probs, v)
+
+
+def _block(x, lp, cfg: TransformerConfig, attn_fn):
+    """One transformer block.  x: [B, S, D]; lp: this layer's param slice."""
+    dt = cfg.dtype
+    B, S, D = x.shape
+    H, Dh = cfg.num_heads, cfg.head_dim
+
+    h = _layer_norm(x, lp["ln1_scale"], lp["ln1_bias"])
+    qkv = jnp.einsum("bsd,de->bse", h, lp["qkv_w"].astype(dt)) \
+        + lp["qkv_b"].astype(dt)
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+
+    def heads(t):
+        return t.reshape(B, S, -1, Dh).transpose(0, 2, 1, 3)
+    attn = attn_fn(heads(q), heads(k), heads(v), cfg.causal)
+    attn = attn.transpose(0, 2, 1, 3).reshape(B, S, -1)
+    attn = jnp.einsum("bse,ed->bsd", attn, lp["attn_out_w"].astype(dt)) \
+        + lp["attn_out_b"].astype(dt)
+    x = x + attn
+
+    h = _layer_norm(x, lp["ln2_scale"], lp["ln2_bias"])
+    h = jnp.einsum("bsd,df->bsf", h, lp["mlp_in_w"].astype(dt)) \
+        + lp["mlp_in_b"].astype(dt)
+    h = jax.nn.gelu(h)
+    h = jnp.einsum("bsf,fd->bsd", h, lp["mlp_out_w"].astype(dt)) \
+        + lp["mlp_out_b"].astype(dt)
+    return x + h
+
+
+def forward(params: PyTree, tokens: jax.Array, cfg: TransformerConfig,
+            attn_fn=None) -> jax.Array:
+    """tokens [B, S] int32 -> logits [B, S, vocab].
+
+    Layers run under `lax.scan` over the stacked params; each step is
+    optionally rematerialised.  `attn_fn(q,k,v,causal)` defaults to dense
+    attention; ring attention (ops/ring_attention.py) slots in when the
+    sequence is sharded over 'sp'.
+    """
+    attn_fn = attn_fn or dense_attention
+    dt = cfg.dtype
+    B, S = tokens.shape
+    x = params["embed"].astype(dt)[tokens]
+    x = x + params["pos_embed"].astype(dt)[:S]
+
+    def body(carry, lp):
+        y = _block(carry, lp, cfg, attn_fn)
+        return y, None
+
+    step = jax.checkpoint(body) if cfg.remat else body
+    x, _ = lax.scan(step, x, params["layers"])
+    x = _layer_norm(x, params["ln_f_scale"], params["ln_f_bias"])
+    # Weight-tied readout against the embedding (keeps the big vocab matmul
+    # on the MXU once, not twice).
+    logits = jnp.einsum("bsd,vd->bsv", x.astype(jnp.float32),
+                        params["embed"].astype(jnp.float32))
+    return logits
+
+
+def loss_fn(params: PyTree, batch: Tuple[jax.Array, jax.Array],
+            cfg: TransformerConfig, attn_fn=None) -> jax.Array:
+    """Cross-entropy LM loss.  batch = (tokens [B,S], targets [B,S])."""
+    tokens, targets = batch
+    logits = forward(params, tokens, cfg, attn_fn=attn_fn)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return nll.mean()
+
+
+def num_params(params: PyTree) -> int:
+    return sum(p.size for p in jax.tree.leaves(params))
+
+
+def flops_per_token(cfg: TransformerConfig) -> float:
+    """Approximate training FLOPs/token (6N rule + attention)."""
+    n = (cfg.num_layers * (3 * cfg.d_model * cfg.d_model * 3      # qkv
+                           + cfg.d_model * cfg.d_model            # attn out
+                           + 2 * cfg.d_model * cfg.d_ff)          # mlp
+         + cfg.vocab_size * cfg.d_model)
+    attn = cfg.num_layers * 2 * cfg.max_seq_len * cfg.d_model
+    return 6.0 * (n + attn)
+
+
+def synthetic_batch(rng: jax.Array, batch_size: int, seq_len: int,
+                    cfg: TransformerConfig) -> Tuple[jax.Array, jax.Array]:
+    """Random token batch for benchmarking (the reference benchmarks with
+    synthetic data too — example/pytorch/benchmark_byteps.py)."""
+    toks = jax.random.randint(rng, (batch_size, seq_len + 1), 0,
+                              cfg.vocab_size, jnp.int32)
+    return toks[:, :-1], toks[:, 1:]

@@ -23,6 +23,8 @@ shape-static so XLA can pipeline the collectives with compute.
 
 from __future__ import annotations
 
+import contextlib
+import contextvars
 import functools
 from typing import Any, Callable, List, Optional, Sequence, Tuple
 
@@ -34,21 +36,51 @@ from ..common.config import get_config
 
 PyTree = Any
 
+# Trace-time "local mode": when set, every collective in this module is the
+# identity and axis sizes are 1.  This is the analog of the reference's
+# single-worker non-distributed queue list, which skips PUSH/PULL entirely
+# (reference: operations.cc:429-485) — build_train_step enables it when the
+# mesh has one device so the whole step lowers to a plain jit with zero
+# communication or sharding machinery.
+_local_mode: contextvars.ContextVar[bool] = contextvars.ContextVar(
+    "byteps_tpu_local_mode", default=False)
+
+
+@contextlib.contextmanager
+def local_mode():
+    tok = _local_mode.set(True)
+    try:
+        yield
+    finally:
+        _local_mode.reset(tok)
+
+
+def is_local() -> bool:
+    return _local_mode.get()
+
+
+def axis_size(axis_name: str) -> int:
+    return 1 if is_local() else lax.axis_size(axis_name)
+
 
 # ---------------------------------------------------------------------------
 # Thin wrappers (named to match the conceptual ops in SURVEY §2.6).
 # ---------------------------------------------------------------------------
 def all_reduce(x: jax.Array, axis_name: str = "dp") -> jax.Array:
-    return lax.psum(x, axis_name)
+    return x if is_local() else lax.psum(x, axis_name)
 
 
 def all_gather(x: jax.Array, axis_name: str = "dp",
                axis: int = 0, tiled: bool = True) -> jax.Array:
+    if is_local():
+        return x if tiled else jnp.expand_dims(x, axis)
     return lax.all_gather(x, axis_name, axis=axis, tiled=tiled)
 
 
 def reduce_scatter(x: jax.Array, axis_name: str = "dp",
                    axis: int = 0) -> jax.Array:
+    if is_local():
+        return x
     return lax.psum_scatter(x, axis_name, scatter_dimension=axis, tiled=True)
 
 
@@ -140,7 +172,7 @@ def bucketed_tree_all_reduce(
     sizes = tuple(l.size for l in leaves)
     plan = _plan_cache(sizes, pb, jnp.dtype(comm_dtype).itemsize, True)
 
-    denom = lax.psum(jnp.ones((), comm_dtype), axis_name) if average else None
+    denom = jnp.asarray(axis_size(axis_name), comm_dtype) if average else None
 
     out_segments: List[List[Optional[jax.Array]]] = [[] for _ in leaves]
     seg_starts: List[List[int]] = [[] for _ in leaves]
@@ -151,7 +183,7 @@ def bucketed_tree_all_reduce(
         if bucket_transform is not None:
             buf = bucket_transform(buf, bi)
         else:
-            buf = lax.psum(buf, axis_name)
+            buf = all_reduce(buf, axis_name)
         if average:
             buf = buf / denom
         off = 0
@@ -180,9 +212,9 @@ def tree_all_reduce(tree: PyTree, axis_name: str = "dp",
     Kept for benchmarking against the bucketed path.
     """
     def f(x):
-        y = lax.psum(x, axis_name)
+        y = all_reduce(x, axis_name)
         if average:
-            y = y / lax.psum(jnp.ones((), x.dtype), axis_name)
+            y = y / jnp.asarray(axis_size(axis_name), x.dtype)
         return y
     return jax.tree.map(f, tree)
 
@@ -201,13 +233,12 @@ def hierarchical_all_reduce(x: jax.Array, ici_axis: str = "ici_dp",
     bandwidth win the reference gets from summing locally before pushing
     (reference: docs/architecture.md:26-33).
     """
-    shard = lax.psum_scatter(x, ici_axis, scatter_dimension=0, tiled=True)
-    shard = lax.psum(shard, dcn_axis)
-    out = lax.all_gather(shard, ici_axis, axis=0, tiled=True)
+    shard = reduce_scatter(x, ici_axis, axis=0)
+    shard = all_reduce(shard, dcn_axis)
+    out = all_gather(shard, ici_axis, axis=0, tiled=True)
     if average:
-        n = lax.psum(jnp.ones((), x.dtype), ici_axis) * \
-            lax.psum(jnp.ones((), x.dtype), dcn_axis)
-        out = out / n
+        out = out / jnp.asarray(
+            axis_size(ici_axis) * axis_size(dcn_axis), x.dtype)
     return out
 
 
@@ -218,7 +249,7 @@ def hierarchical_tree_all_reduce(tree: PyTree, ici_axis: str = "ici_dp",
                                  ) -> PyTree:
     """Bucketed hierarchical all-reduce of a gradient pytree."""
     def transform(buf: jax.Array, bi: int) -> jax.Array:
-        ici = lax.axis_size(ici_axis)
+        ici = axis_size(ici_axis)
         pad = (-buf.size) % ici
         if pad:
             buf = jnp.concatenate([buf, jnp.zeros((pad,), buf.dtype)])
@@ -231,9 +262,6 @@ def hierarchical_tree_all_reduce(tree: PyTree, ici_axis: str = "ici_dp",
                                    partition_bytes=partition_bytes,
                                    bucket_transform=transform)
     if average:
-        leaves = jax.tree.leaves(out)
-        dt = leaves[0].dtype if leaves else jnp.float32
-        n = lax.psum(jnp.ones((), dt), ici_axis) * \
-            lax.psum(jnp.ones((), dt), dcn_axis)
-        out = jax.tree.map(lambda l: l / n.astype(l.dtype), out)
+        n = axis_size(ici_axis) * axis_size(dcn_axis)
+        out = jax.tree.map(lambda l: l / jnp.asarray(n, l.dtype), out)
     return out

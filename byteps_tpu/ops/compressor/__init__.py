@@ -1,0 +1,30 @@
+"""Inter-node gradient compression subsystem (level 2).
+
+TPU-native re-design of the reference compressor stack
+(reference: byteps/common/compressor/ — see SURVEY §2.2): onebit, topk,
+randomk, dithering compressors; error-feedback and Nesterov-momentum
+decorators; a string-kwargs registry; and the compressed collective
+reduction that replaces the compressed push-pull path.
+"""
+
+from .base import (InterCompressor, Payload, State, xorshift32, rng_uniform,
+                   seed_state)
+from .onebit import OnebitCompressor
+from .topk import TopkCompressor
+from .randomk import RandomkCompressor
+from .dithering import DitheringCompressor
+from .decorators import ErrorFeedback, NesterovMomentum, set_lr_scale
+from .registry import create, register, known_compressors
+from .reduce import (compressed_tree_all_reduce, init_compression_state,
+                     compression_ratio, server_side)
+
+__all__ = [
+    "InterCompressor", "Payload", "State",
+    "xorshift32", "rng_uniform", "seed_state",
+    "OnebitCompressor", "TopkCompressor", "RandomkCompressor",
+    "DitheringCompressor", "ErrorFeedback", "NesterovMomentum",
+    "set_lr_scale", "server_side",
+    "create", "register", "known_compressors",
+    "compressed_tree_all_reduce", "init_compression_state",
+    "compression_ratio",
+]

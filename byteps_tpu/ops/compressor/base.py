@@ -1,0 +1,102 @@
+"""Inter-node gradient compressor interface (level 2 of the two-level design).
+
+The reference's compressor interface is byte-buffer in/out with internal
+scratch (reference: byteps/common/compressor/compressor.h:53-127 —
+Compress/Decompress/FastUpdateError).  A byte-stream API is hostile to XLA
+(dynamic sizes, host round-trips), so the TPU-native contract is functional
+and shape-static:
+
+    payload, state' = compressor.compress(buf, state)     # traced, on-device
+    buf'            = compressor.decompress(payload, n)   # traced, on-device
+
+  - `buf` is a flat f32/bf16 vector (one <=4MB bucket, the analog of one
+    reference partition/key).
+  - `payload` is a dict of fixed-shape arrays — the wire format.  Its total
+    byte size is what travels over ICI/DCN; `payload_bytes()` reports it so
+    telemetry/benchmarks can measure the compression ratio.
+  - `state` carries the PRNG counters and any decorator buffers (error
+    feedback, momentum), threaded functionally — the TPU replacement for the
+    reference's mutable `_buf`/`_error` members.
+
+All compressors are registered by name with string kwargs, mirroring the
+reference registry (compressor_registry.cc:39-56), so user-facing config is
+identical: {"compressor": "onebit", "ef": "vanilla", ...}.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+Payload = Dict[str, jax.Array]
+State = Any
+
+
+class InterCompressor:
+    """Base class. Subclasses are stateless Python objects; all mutable state
+    flows through `state` pytrees so everything jits cleanly."""
+
+    name: str = "base"
+    #: True if the merged (summed) gradient should be re-compressed before
+    #: being "pulled" back — the reference's bidirectional compressors do
+    #: this on the server (reference: impl/onebit.h "bidirectional").
+    bidirectional: bool = False
+
+    def init_state(self, n: int, dtype=jnp.float32) -> State:
+        """Per-bucket state for a bucket of n elements."""
+        del n, dtype
+        return ()
+
+    def compress(self, buf: jax.Array, state: State) -> Tuple[Payload, State]:
+        raise NotImplementedError
+
+    def decompress(self, payload: Payload, n: int,
+                   dtype=jnp.float32) -> jax.Array:
+        raise NotImplementedError
+
+    def payload_bytes(self, n: int, dtype=jnp.float32) -> int:
+        """Wire bytes for an n-element bucket (for telemetry/ratio checks)."""
+        shapes = self.payload_shapes(n, dtype)
+        return sum(int(jnp.prod(jnp.asarray(s))) * jnp.dtype(d).itemsize
+                   for s, d in shapes.values())
+
+    def payload_shapes(self, n: int, dtype=jnp.float32) -> Dict[str, tuple]:
+        raise NotImplementedError
+
+
+# ---------------------------------------------------------------------------
+# TPU-friendly deterministic PRNG: xorshift32, vectorised.
+#
+# The reference seeds an xorshift128+ (compressor/utils.h:74-117) so its
+# Python tests can replay the exact index/rounding choices
+# (tests/utils.py:31-52).  64-bit integer ops are emulated (slow) on TPU
+# vector units, so this build standardises on xorshift32 — same replayability
+# contract (tests/test_compressor.py re-implements it in numpy), full vector
+# width on device.
+# ---------------------------------------------------------------------------
+def xorshift32(state: jax.Array) -> jax.Array:
+    """One xorshift32 step. state: uint32 array (any shape), nonzero."""
+    x = state
+    x = x ^ (x << jnp.uint32(13))
+    x = x ^ (x >> jnp.uint32(17))
+    x = x ^ (x << jnp.uint32(5))
+    return x
+
+
+def rng_uniform(state: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """Advance the per-lane PRNG; return (u in [0,1) f32, new_state)."""
+    s = xorshift32(state)
+    # 24 mantissa-safe bits.
+    u = (s >> jnp.uint32(8)).astype(jnp.float32) * (1.0 / (1 << 24))
+    return u, s
+
+
+def seed_state(seed: int, n: int) -> jax.Array:
+    """n independent nonzero uint32 lanes from a scalar seed (splitmix-style
+    lane spreading, then one warmup round)."""
+    lanes = jnp.arange(1, n + 1, dtype=jnp.uint32)
+    s = lanes * jnp.uint32(2654435761) + jnp.uint32(seed | 1)
+    s = jnp.where(s == 0, jnp.uint32(0x9E3779B9), s)
+    return xorshift32(s)

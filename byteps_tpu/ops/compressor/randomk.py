@@ -1,0 +1,54 @@
+"""Random-k sparsification: k elements at seeded-pseudorandom indices.
+
+Capability parity with the reference randomk compressor
+(reference: byteps/common/compressor/impl/randomk.cc:24-61 — k (idx,val)
+pairs drawn from a seeded xorshift128+).  The TPU build draws k lanes of
+xorshift32 (see base.py for why 32-bit) and maps each to an index by the
+same `u * n` truncation the test-side numpy replica uses, so selection is
+bit-replayable.  Indices may collide (as in the reference); decompress
+scatter-adds, and compress reads whatever value lives at each drawn index.
+
+State = the k-lane uint32 PRNG state, advanced once per compress call, so
+successive steps draw fresh index sets deterministically from the seed.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .base import InterCompressor, Payload, State, seed_state, xorshift32
+
+
+class RandomkCompressor(InterCompressor):
+    name = "randomk"
+
+    def __init__(self, k: int, seed: int = 2020):
+        if k <= 0:
+            raise ValueError(f"randomk requires k > 0, got {k}")
+        self.k = k
+        self.seed = seed
+
+    def init_state(self, n: int, dtype=jnp.float32) -> State:
+        return {"rng": seed_state(self.seed, self.k)}
+
+    def compress(self, buf: jax.Array, state: State) -> Tuple[Payload, State]:
+        n = buf.size
+        k = min(self.k, n)
+        rng = xorshift32(state["rng"])
+        u = (rng >> jnp.uint32(8)).astype(jnp.float32) * (1.0 / (1 << 24))
+        idx = jnp.minimum((u[:k] * n).astype(jnp.int32), n - 1)
+        vals = buf.astype(jnp.float32)[idx]
+        return {"idx": idx, "val": vals}, {"rng": rng}
+
+    def decompress(self, payload: Payload, n: int,
+                   dtype=jnp.float32) -> jax.Array:
+        out = jnp.zeros((n,), jnp.float32)
+        out = out.at[payload["idx"]].add(payload["val"])
+        return out.astype(dtype)
+
+    def payload_shapes(self, n: int, dtype=jnp.float32):
+        k = min(self.k, n)
+        return {"idx": ((k,), jnp.int32), "val": ((k,), jnp.float32)}

@@ -1,0 +1,161 @@
+"""Compressed distributed gradient reduction.
+
+The reference's compressed push-pull: each worker compresses its local
+gradient partition, the server decompresses every worker's payload, sums,
+re-compresses (bidirectional compressors), and workers decompress the pull
+(reference: core_loops.cc:496-534 COMPRESS/DECOMPRESS stages +
+server/server.cc:86-207 engine decompress-sum-compress).
+
+TPU-native data plane: there is no server hop inside a slice — the payload
+is `all_gather`ed over the dp axis (wire volume = compressed bytes x world,
+vs 2 x full gradient for ring all-reduce, a win whenever the ratio beats
+world/2... i.e. aggressive compressors + small dp groups, or the DCN axis of
+a hierarchical mesh where bandwidth is scarcest), each peer's contribution
+is decompressed on-device (vmap), summed, and — for bidirectional
+compressors — requantized with a server-side compressor state so the result
+matches what a PS round-trip would produce.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ...common.config import get_config
+from .. import collectives
+from ..collectives import _plan_cache
+from .base import InterCompressor
+
+PyTree = Any
+
+
+def server_side(compressor: InterCompressor) -> InterCompressor:
+    """The compressor the 'server' leg runs: momentum stripped, matching the
+    reference registry's server instantiation
+    (reference: compressor_registry.cc:49-52)."""
+    from .decorators import NesterovMomentum
+    while isinstance(compressor, NesterovMomentum):
+        compressor = compressor.inner
+    return compressor
+
+
+def _bucketize(tree: PyTree, partition_bytes: Optional[int]):
+    """Flatten a pytree into the standard priority-ordered bucket list.
+    Returns (buckets, rebuild) where rebuild maps reduced bucket vectors back
+    to the original tree structure."""
+    cfg = get_config()
+    pb = partition_bytes or cfg.partition_bytes
+    all_leaves, treedef = jax.tree.flatten(tree)
+    nonempty = [i for i, l in enumerate(all_leaves) if l.size > 0]
+    leaves = [all_leaves[i] for i in nonempty]
+    if not leaves:
+        return [], lambda bufs: tree, None
+    orig_dtypes = [l.dtype for l in leaves]
+    comm_dtype = jnp.result_type(*orig_dtypes)
+    flat = [l.astype(comm_dtype).reshape(-1) for l in leaves]
+    sizes = tuple(l.size for l in leaves)
+    plan = _plan_cache(sizes, pb, jnp.dtype(comm_dtype).itemsize, True)
+
+    buckets = []
+    for bucket in plan.buckets:
+        parts = [lax.dynamic_slice(flat[li], (start,), (length,))
+                 for (li, start, length) in bucket]
+        buckets.append(jnp.concatenate(parts) if len(parts) > 1 else parts[0])
+
+    def rebuild(reduced_bufs: List[jax.Array]) -> PyTree:
+        segs: List[List[jax.Array]] = [[] for _ in leaves]
+        starts: List[List[int]] = [[] for _ in leaves]
+        for buf, bucket in zip(reduced_bufs, plan.buckets):
+            off = 0
+            for (li, start, length) in bucket:
+                segs[li].append(lax.dynamic_slice(buf, (off,), (length,)))
+                starts[li].append(start)
+                off += length
+        out_leaves = list(all_leaves)
+        for j, li in enumerate(nonempty):
+            order = sorted(range(len(segs[j])), key=lambda i: starts[j][i])
+            vec = jnp.concatenate([segs[j][i] for i in order]) \
+                if len(segs[j]) > 1 else segs[j][0]
+            out_leaves[li] = vec.reshape(leaves[j].shape).astype(orig_dtypes[j])
+        return jax.tree.unflatten(treedef, out_leaves)
+
+    return buckets, rebuild, plan
+
+
+def init_compression_state(tree: PyTree, compressor: InterCompressor,
+                           partition_bytes: Optional[int] = None) -> Any:
+    """Per-bucket compressor state for a gradient pytree (worker side plus,
+    for bidirectional compressors, a server-side requantization state)."""
+    buckets, _, _ = _bucketize(tree, partition_bytes)
+    worker = tuple(compressor.init_state(int(b.size)) for b in buckets)
+    srv = server_side(compressor)
+    server = tuple(srv.init_state(int(b.size)) for b in buckets) \
+        if compressor.bidirectional else None
+    return {"worker": worker, "server": server}
+
+
+def compressed_tree_all_reduce(
+    tree: PyTree,
+    compressor: InterCompressor,
+    state: Any = None,
+    axis_name: str = "dp",
+    average: bool = True,
+    partition_bytes: Optional[int] = None,
+    two_way: Optional[bool] = None,
+) -> Tuple[PyTree, Any]:
+    """All-reduce `tree` with compressed wire traffic.
+
+    Returns (reduced_tree, new_state).  `state` must come from
+    `init_compression_state` (or be None for stateless compressors).
+    `two_way=None` defaults to the compressor's bidirectional flag.
+    """
+    buckets, rebuild, _ = _bucketize(tree, partition_bytes)
+    if not buckets:
+        return tree, state
+    if two_way is None:
+        two_way = compressor.bidirectional
+    if state is None:
+        state = init_compression_state(tree, compressor, partition_bytes)
+
+    world = collectives.axis_size(axis_name)
+    srv = server_side(compressor)
+    new_worker, new_server, reduced = [], [], []
+    for bi, buf in enumerate(buckets):
+        n = int(buf.size)
+        payload, wst = compressor.compress(buf, state["worker"][bi])
+        new_worker.append(wst)
+        # push: everyone ships its payload to everyone (the TPU "server").
+        gathered = jax.tree.map(
+            lambda a: collectives.all_gather(a, axis_name, axis=0,
+                                             tiled=False),
+            payload)
+        summed = jax.vmap(
+            lambda p: compressor.decompress(p, n))(gathered).sum(axis=0)
+        if two_way:
+            # Server-side requantize before the pull leg (momentum stripped,
+            # as the reference server does).
+            sst = state["server"][bi] if state["server"] is not None \
+                else srv.init_state(n)
+            payload2, sst = srv.compress(summed, sst)
+            summed = srv.decompress(payload2, n)
+            new_server.append(sst)
+        if average:
+            summed = summed / world
+        reduced.append(summed)
+
+    new_state = {"worker": tuple(new_worker),
+                 "server": tuple(new_server) if new_server else
+                 state.get("server")}
+    return rebuild(reduced), new_state
+
+
+def compression_ratio(tree: PyTree, compressor: InterCompressor,
+                      partition_bytes: Optional[int] = None) -> float:
+    """Raw bytes / wire bytes for one push leg (telemetry helper)."""
+    buckets, _, _ = _bucketize(tree, partition_bytes)
+    raw = sum(int(b.size) * 4 for b in buckets)
+    wire = sum(compressor.payload_bytes(int(b.size)) for b in buckets)
+    return raw / max(wire, 1)

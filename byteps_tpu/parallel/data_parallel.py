@@ -15,7 +15,7 @@ is the cross-barrier effect the reference builds by hand with threads + locks
 from __future__ import annotations
 
 import functools
-from typing import Any, Callable, Optional
+from typing import Any, Callable, NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
@@ -29,6 +29,39 @@ from ..ops.compression import Compression, Compressor
 PyTree = Any
 
 
+@jax.tree_util.register_pytree_with_keys_class
+class CompressionOptState:
+    """Optax state slot holding per-bucket compressor state (EF error
+    buffers, momentum, PRNG lanes) — the functional stand-in for the
+    reference's mutable per-partition compressor objects
+    (reference: operations.cc:380-385).
+
+    `world` (static aux data) records how many per-worker copies the state
+    currently holds; build_train_step tiles/validates it against the mesh's
+    dp axis size so a default-constructed state is automatically expanded.
+    """
+
+    def __init__(self, comp: Any, world: int = 1):
+        self.comp = comp
+        self.world = world
+
+    def tree_flatten_with_keys(self):
+        return ((jax.tree_util.GetAttrKey("comp"), self.comp),), self.world
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(children[0], aux)
+
+    def __repr__(self):
+        return f"CompressionOptState(world={self.world})"
+
+    def __eq__(self, other):
+        return (isinstance(other, CompressionOptState)
+                and other.world == self.world
+                and jax.tree.structure(other.comp)
+                == jax.tree.structure(self.comp))
+
+
 def distributed_gradient_transform(
     axis_name: str = "dp",
     average: bool = True,
@@ -36,16 +69,39 @@ def distributed_gradient_transform(
     inter_compressor: Optional[Any] = None,
     partition_bytes: Optional[int] = None,
     hierarchical: bool = False,
+    world: int = 1,
 ) -> optax.GradientTransformation:
     """An optax transform that all-reduces gradients across `axis_name`.
 
     `compression` is the framework-level cast (Compression.fp16 → bf16 wire
     format); `inter_compressor` is a byteps_tpu.ops.compressor instance
     (onebit/topk/...) applied per bucket on-device.
+
+    `world` must be the dp axis size when a *stateful* inter_compressor is
+    used on a multi-device mesh: compressor state (error-feedback buffers,
+    PRNG lanes) is genuinely per-worker — like the reference's per-process
+    compressor objects (operations.cc:380-385) — so init tiles each state
+    buffer `world` times and build_train_step shards it over `axis_name`,
+    giving every shard its own slice.
     """
     compression = compression or Compression.none
 
     def init_fn(params):
+        if inter_compressor is not None:
+            import jax.numpy as jnp
+            from ..ops.compressor import init_compression_state
+            # The bucket plan must match update_fn's, which bucketizes the
+            # post-cast wire tree — so build state from the wire shapes,
+            # not the raw params.
+            wire_shapes = jax.eval_shape(
+                lambda p: _tree_compress(p, compression)[0], params)
+            zeros = jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype),
+                                 wire_shapes)
+            comp = init_compression_state(zeros, inter_compressor,
+                                          partition_bytes)
+            if world > 1:
+                comp = _tile_state(comp, world)
+            return CompressionOptState(comp, world=world)
         del params
         return optax.EmptyState()
 
@@ -53,15 +109,11 @@ def distributed_gradient_transform(
         del params
         wire, ctxs = _tree_compress(updates, compression)
         if inter_compressor is not None:
-            try:
-                from ..ops.compressor import compressed_tree_all_reduce
-            except ImportError as e:
-                raise RuntimeError(
-                    "inter_compressor requires byteps_tpu.ops.compressor, "
-                    "which is missing from this build") from e
-            reduced = compressed_tree_all_reduce(
-                wire, inter_compressor, axis_name=axis_name, average=average,
-                partition_bytes=partition_bytes)
+            from ..ops.compressor import compressed_tree_all_reduce
+            reduced, new_comp = compressed_tree_all_reduce(
+                wire, inter_compressor, state.comp, axis_name=axis_name,
+                average=average, partition_bytes=partition_bytes)
+            state = CompressionOptState(new_comp, world=state.world)
         elif hierarchical:
             reduced = collectives.hierarchical_tree_all_reduce(
                 wire, average=average, partition_bytes=partition_bytes)
@@ -101,6 +153,7 @@ def DistributedOptimizer(
     partition_bytes: Optional[int] = None,
     hierarchical: bool = False,
     backward_passes_per_step: int = 1,
+    world: int = 1,
 ) -> optax.GradientTransformation:
     """Wrap an optax optimizer so updates are preceded by distributed
     gradient push_pull — the JAX face of the reference's
@@ -113,7 +166,7 @@ def DistributedOptimizer(
     chain = [distributed_gradient_transform(
         axis_name=axis_name, average=average, compression=compression,
         inter_compressor=inter_compressor, partition_bytes=partition_bytes,
-        hierarchical=hierarchical)]
+        hierarchical=hierarchical, world=world)]
     if backward_passes_per_step > 1:
         chain.append(optax.scale(1.0 / backward_passes_per_step))
     chain.append(optimizer)
@@ -146,13 +199,29 @@ def build_train_step(
     """
     if batch_spec is None:
         batch_spec = P(axis_name)
+    donate_argnums = (0, 1) if donate else ()
 
-    replicated = NamedSharding(mesh, P())
+    if mesh.devices.size == 1:
+        # Single-device fast path: the reference's non-distributed mode
+        # builds a queue list with no PUSH/PULL (operations.cc:429-485); here
+        # the whole step lowers to a plain jit — collectives trace as
+        # identity under local_mode, so no sharding machinery or collective
+        # dispatch overhead remains.
+        def _local_step(params, opt_state, batch):
+            with collectives.local_mode():
+                loss, grads = jax.value_and_grad(loss_fn)(params, batch)
+                updates, opt_state = optimizer.update(grads, opt_state,
+                                                      params)
+                params = optax.apply_updates(params, updates)
+            return params, opt_state, loss
 
-    @functools.partial(
-        jax.shard_map, mesh=mesh,
-        in_specs=(P(), P(), batch_spec), out_specs=(P(), P(), P()),
-        check_vma=False)
+        jitted = jax.jit(_local_step, donate_argnums=donate_argnums)
+
+        def local_call(params, opt_state, batch):
+            return jitted(params, _retile_comp_state(opt_state, 1), batch)
+
+        return local_call
+
     def _step(params, opt_state, batch):
         loss, grads = jax.value_and_grad(loss_fn)(params, batch)
         updates, opt_state = optimizer.update(grads, opt_state, params)
@@ -161,5 +230,67 @@ def build_train_step(
         loss = jax.lax.pmean(loss, axis_name)
         return params, opt_state, loss
 
-    donate_argnums = (0, 1) if donate else ()
-    return jax.jit(_step, donate_argnums=donate_argnums)
+    # Compressor state inside the opt state is per-worker (see
+    # distributed_gradient_transform's `world`): those leaves are sharded
+    # over the dp axis; everything else is replicated.  The specs depend on
+    # the opt_state pytree structure, so the shard_map is built lazily on
+    # first call and cached per structure.
+    cache = {}
+    dp_world = int(mesh.shape.get(axis_name, 1))
+
+    def call(params, opt_state, batch):
+        opt_state = _retile_comp_state(opt_state, dp_world)
+        key = (jax.tree.structure(params), jax.tree.structure(opt_state))
+        if key not in cache:
+            state_specs = _opt_state_specs(opt_state, axis_name)
+            sm = jax.shard_map(
+                _step, mesh=mesh, in_specs=(P(), state_specs, batch_spec),
+                out_specs=(P(), state_specs, P()), check_vma=False)
+            cache[key] = jax.jit(sm, donate_argnums=donate_argnums)
+        return cache[key](params, opt_state, batch)
+
+    return call
+
+
+def _tile_state(comp: PyTree, world: int) -> PyTree:
+    return jax.tree.map(
+        lambda l: jnp.tile(l, (world,) + (1,) * (l.ndim - 1))
+        if l.ndim >= 1 else l, comp)
+
+
+def _retile_comp_state(opt_state: PyTree, dp_world: int) -> PyTree:
+    """Expand (or validate) per-worker compressor state against the mesh's
+    dp axis size, so a default-constructed (world=1) state just works on any
+    mesh and a mismatched one fails loudly instead of silently slicing PRNG
+    lanes / EF buffers."""
+    def fix(node):
+        if not isinstance(node, CompressionOptState):
+            return node
+        if node.world == dp_world:
+            return node
+        if node.world == 1:
+            return CompressionOptState(_tile_state(node.comp, dp_world),
+                                       world=dp_world)
+        raise ValueError(
+            f"compressor state was initialised for world={node.world} but "
+            f"the mesh dp axis has {dp_world} shards; re-init the optimizer "
+            f"state (opt.init) for this mesh")
+    return jax.tree.map(
+        fix, opt_state,
+        is_leaf=lambda x: isinstance(x, CompressionOptState))
+
+
+def _opt_state_specs(opt_state: PyTree, axis_name: str) -> PyTree:
+    """P(axis_name) for per-worker compressor-state leaves (identified by
+    sitting under a CompressionOptState), P() for everything else."""
+    from jax.tree_util import tree_flatten_with_path
+
+    paths_leaves, treedef = tree_flatten_with_path(opt_state)
+    specs = []
+    for path, leaf in paths_leaves:
+        in_comp = any(getattr(k, "name", None) == "comp" for k in path)
+        if in_comp and getattr(leaf, "ndim", 0) >= 1:
+            specs.append(P(axis_name))
+        else:
+            specs.append(P())
+    return jax.tree.unflatten(treedef, specs)

@@ -1,0 +1,94 @@
+"""GSPMD sharded training: the pjit/jit + NamedSharding path.
+
+The reference has exactly one parallelism strategy — DP with hand-built
+communication (SURVEY §2.6).  On TPU the idiomatic generalisation is to
+annotate parameter and batch shardings over a named mesh and let XLA insert
+the collectives: DP gradient reduction becomes the psum GSPMD derives from a
+dp-sharded batch against replicated params; TP comes from Megatron-style
+column/row PartitionSpecs on the weights (models/transformer.param_specs);
+SP shards the sequence dimension.  This module packages that recipe.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Optional, Tuple
+
+import jax
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+PyTree = Any
+
+
+def _is_spec(x):
+    return isinstance(x, P)
+
+
+def make_param_shardings(mesh: Mesh, specs: PyTree) -> PyTree:
+    return jax.tree.map(lambda s: NamedSharding(mesh, s), specs,
+                        is_leaf=_is_spec)
+
+
+def shard_params(params: PyTree, mesh: Mesh, specs: PyTree) -> PyTree:
+    """Place a param pytree onto the mesh under `specs` (PartitionSpec
+    tree with the same structure)."""
+    shardings = make_param_shardings(mesh, specs)
+    return jax.tree.map(jax.device_put, params, shardings)
+
+
+def opt_state_specs(optimizer: optax.GradientTransformation, params: PyTree,
+                    specs: PyTree) -> PyTree:
+    """Derive PartitionSpecs for the optimizer state: any state leaf whose
+    shape matches a param leaf inherits that param's spec (adam mu/nu etc.);
+    everything else (step counters, scalars) is replicated."""
+    shape_to_spec = {}
+    for p, s in zip(jax.tree.leaves(params),
+                    jax.tree.leaves(specs, is_leaf=_is_spec)):
+        shape_to_spec.setdefault(p.shape, s)
+    state_shape = jax.eval_shape(optimizer.init, params)
+
+    def spec_for(leaf):
+        return shape_to_spec.get(leaf.shape, P())
+    return jax.tree.map(spec_for, state_shape)
+
+
+def build_sharded_train_step(
+    loss_fn: Callable[[PyTree, Any], jax.Array],
+    optimizer: optax.GradientTransformation,
+    mesh: Mesh,
+    param_specs: PyTree,
+    batch_spec: PyTree = P("dp"),
+    donate: bool = True,
+) -> Callable:
+    """jitted `step(params, opt_state, batch) -> (params, opt_state, loss)`
+    under GSPMD sharding.  Gradient communication (dp psum, tp collectives)
+    is derived by XLA from the in/out shardings — the whole reference
+    pipeline (SURVEY §3.2) becomes compiler-inserted collectives fused with
+    backward compute.
+    """
+    p_shardings = make_param_shardings(mesh, param_specs)
+
+    def _step(params, opt_state, batch):
+        loss, grads = jax.value_and_grad(loss_fn)(params, batch)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    donate_argnums = (0, 1) if donate else ()
+    batch_shardings = jax.tree.map(
+        lambda s: NamedSharding(mesh, s), batch_spec, is_leaf=_is_spec)
+
+    return jax.jit(
+        _step,
+        in_shardings=(p_shardings, None, batch_shardings),
+        out_shardings=(p_shardings, None, NamedSharding(mesh, P())),
+        donate_argnums=donate_argnums)
+
+
+def init_sharded(init_fn: Callable[[], PyTree], mesh: Mesh,
+                 specs: PyTree) -> PyTree:
+    """Run `init_fn` under jit with output shardings so large params are
+    created directly on-device in their final layout (no host staging)."""
+    shardings = make_param_shardings(mesh, specs)
+    return jax.jit(init_fn, out_shardings=shardings)()

@@ -1,0 +1,57 @@
+"""Tensor parallelism: Megatron-style column/row sharded matmuls.
+
+Absent from the reference (SURVEY §2.6) but first-class here.  Two usage
+modes:
+
+  1. GSPMD (preferred): annotate weights with the PartitionSpecs from
+     `models.transformer.param_specs` and let XLA place the collectives —
+     column-parallel layers need no forward comm, row-parallel layers get
+     one psum, exactly the f/g operators of Megatron-LM.
+  2. Explicit (shard_map): the helpers below spell the same math out for
+     code running under `shard_map`, where GSPMD is bypassed.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def col_parallel_dense(x: jax.Array, w_local: jax.Array,
+                       b_local: jax.Array = None) -> jax.Array:
+    """Column-parallel dense: inputs replicated, weight column-sharded.
+    y_local = x @ W_local — no communication in forward; autodiff inserts
+    the psum on dx (the Megatron "f" operator)."""
+    y = x @ w_local
+    if b_local is not None:
+        y = y + b_local
+    return y
+
+
+def row_parallel_dense(x_local: jax.Array, w_local: jax.Array,
+                       b: jax.Array = None,
+                       axis_name: str = "tp") -> jax.Array:
+    """Row-parallel dense: inputs sharded on the contracting dim, weight
+    row-sharded; partial products are psummed (the Megatron "g" operator).
+    Bias is added once, post-reduction."""
+    y = lax.psum(x_local @ w_local, axis_name)
+    if b is not None:
+        y = y + b
+    return y
+
+
+def tp_split(x: jax.Array, axis: int, axis_name: str = "tp") -> jax.Array:
+    """Slice the local chunk of a replicated array along `axis` (activation
+    entering a row-parallel layer)."""
+    n = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    size = x.shape[axis] // n
+    return lax.dynamic_slice_in_dim(x, idx * size, size, axis)
+
+
+def tp_all_gather(x_local: jax.Array, axis: int,
+                  axis_name: str = "tp") -> jax.Array:
+    """Re-assemble a sharded activation (exit of a column-parallel layer
+    when the next op needs the full feature dim)."""
+    return lax.all_gather(x_local, axis_name, axis=axis, tiled=True)

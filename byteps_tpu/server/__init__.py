@@ -1,0 +1,40 @@
+"""PS server tier (parity mode).
+
+`python -m byteps_tpu.server` starts the native KV server, mirroring the
+reference's `import byteps.server` entry that dlopens the C++ lib and calls
+`byteps_server()` (reference: byteps/server/__init__.py:21-27,
+server.cc:450-523).  Configuration comes from the same env vars the
+reference uses (DMLC_PS_ROOT_PORT, DMLC_NUM_WORKER,
+BYTEPS_SERVER_ENGINE_THREAD, BYTEPS_SERVER_ENABLE_SCHEDULE,
+BYTEPS_ENABLE_ASYNC — reference: server.cc:416-448).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+
+
+def serve(port: int | None = None, num_workers: int | None = None,
+          engine_threads: int | None = None, schedule: bool | None = None,
+          async_mode: bool | None = None) -> int:
+    """Run the native PS server (blocking). Returns its exit code."""
+    from ..core import build
+    lib = ctypes.CDLL(build.build())
+    lib.bps_ps_server_run.argtypes = [ctypes.c_int] * 5
+    lib.bps_ps_server_run.restype = ctypes.c_int
+    from ..common.config import get_config
+    cfg = get_config(refresh=True)
+    # Single-host port convention matches PSSession.from_config: server i
+    # listens on scheduler_port + 1 + i (the scheduler port itself is
+    # reserved for the jax coordinator).  DMLC_SERVER_ID selects i.
+    server_id = int(os.environ.get("DMLC_SERVER_ID", "0"))
+    default_port = cfg.scheduler_port + 1 + server_id
+    return lib.bps_ps_server_run(
+        int(port if port is not None else default_port),
+        int(num_workers if num_workers is not None else cfg.num_worker),
+        int(engine_threads if engine_threads is not None
+            else cfg.server_engine_threads),
+        int(schedule if schedule is not None else cfg.server_enable_schedule),
+        int(async_mode if async_mode is not None else cfg.enable_async),
+    )

@@ -1,0 +1,6 @@
+import sys
+
+from . import serve
+
+if __name__ == "__main__":
+    sys.exit(serve())

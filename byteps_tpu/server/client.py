@@ -1,0 +1,150 @@
+"""PS client session: the worker side of PS-parity mode.
+
+The reference worker talks to servers through ps-lite ZPush/ZPull with
+per-partition keys spread over servers by hash
+(reference: core_loops.cc:536-616, global.cc:643-692).  Here each worker
+process holds one TCP session per server; tensors are pushed/pulled by
+their framework key, with key -> server placement delegated to the native
+core's hash functions so the layout matches the reference scheme.
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+import threading
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..common.config import Config
+from ..common.logging import get_logger
+from ..core.native import get_core
+
+_REQ = struct.Struct("<BBHIQQ")   # cmd dtype flags worker_id key len
+_RESP = struct.Struct("<BQQ")     # status key len
+
+CMD_HELLO, CMD_INIT, CMD_PUSH, CMD_PULL, CMD_BARRIER, CMD_SHUTDOWN, \
+    CMD_PING = range(7)
+
+
+class _ServerConn:
+    def __init__(self, host: str, port: int, timeout: float = 60.0):
+        self.sock = socket.create_connection((host, port), timeout=timeout)
+        self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self.lock = threading.Lock()
+
+    def request(self, cmd: int, key: int = 0, payload: bytes = b"",
+                worker_id: int = 0, dtype: int = 0, flags: int = 0) -> bytes:
+        with self.lock:
+            hdr = _REQ.pack(cmd, dtype, flags & 0xFFFF, worker_id, key,
+                            len(payload))
+            self.sock.sendall(hdr + payload)
+            return self._read_response(key)
+
+    def _read_response(self, key: int) -> bytes:
+        buf = self._recv_exact(_RESP.size)
+        status, rkey, length = _RESP.unpack(buf)
+        data = self._recv_exact(length) if length else b""
+        if status != 0:
+            raise RuntimeError(f"PS server error for key {rkey}")
+        return data
+
+    def _recv_exact(self, n: int) -> bytes:
+        chunks = []
+        while n > 0:
+            c = self.sock.recv(n)
+            if not c:
+                raise ConnectionError("PS server closed connection")
+            chunks.append(c)
+            n -= len(c)
+        return b"".join(chunks)
+
+    def close(self):
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+
+class PSSession:
+    """One worker's sessions to all PS servers.
+
+    push_pull(key, array) pushes the f32 payload and pulls the across-worker
+    sum — the eager analog of the reference's PUSH→PULL queue pair
+    (reference: operations.cc:429-485).  Partitioning happens above this
+    layer (api.push_pull hands in whole tensors; partition-level keys use
+    the core's encode_key scheme).
+    """
+
+    def __init__(self, hosts: List[str], ports: List[int], worker_id: int,
+                 num_servers: int, hash_fn: str = "djb2"):
+        self.worker_id = worker_id
+        self.num_servers = max(1, num_servers)
+        self.hash_fn = hash_fn
+        self.conns = [_ServerConn(h, p) for h, p in zip(hosts, ports)]
+        self._inited: Dict[int, int] = {}
+        self._round: Dict[int, int] = {}  # per-key push_pull round counter
+        for c in self.conns:
+            c.request(CMD_HELLO, worker_id=worker_id)
+
+    @classmethod
+    def from_config(cls, cfg: Config) -> "PSSession":
+        n = max(1, cfg.num_server)
+        # Single-host convention: servers at scheduler_port+1+i.  Multi-host
+        # deployments list hosts via BYTEPS_TPU_PS_HOSTS=host:port,host:port.
+        import os
+        spec = os.environ.get("BYTEPS_TPU_PS_HOSTS", "")
+        if spec:
+            pairs = [s.rsplit(":", 1) for s in spec.split(",") if s]
+            hosts = [p[0] for p in pairs]
+            ports = [int(p[1]) for p in pairs]
+        else:
+            hosts = [cfg.scheduler_uri] * n
+            ports = [cfg.scheduler_port + 1 + i for i in range(n)]
+        return cls(hosts, ports, cfg.worker_id, n, cfg.key_hash_fn)
+
+    def _conn_for(self, key: int) -> _ServerConn:
+        idx = get_core().key_to_server(key, len(self.conns), self.hash_fn)
+        return self.conns[idx]
+
+    def push_pull(self, key: int, tensor, priority: int = 0) -> np.ndarray:
+        del priority  # ordering is applied by the caller's scheduler
+        arr = np.asarray(tensor)
+        orig_dtype = arr.dtype
+        orig_shape = arr.shape
+        payload = np.ascontiguousarray(arr, dtype=np.float32).tobytes()
+        conn = self._conn_for(key)
+        if self._inited.get(key) != len(payload):
+            conn.request(CMD_INIT, key,
+                         struct.pack("<Q", len(payload)),
+                         worker_id=self.worker_id)
+            self._inited[key] = len(payload)
+        # The round tag makes a straggler's pull match the round it pushed,
+        # even if a fast peer has already started merging the next round
+        # (server keeps the last published round in a separate buffer).
+        rnd = self._round.get(key, 0)
+        conn.request(CMD_PUSH, key, payload, worker_id=self.worker_id,
+                     flags=rnd)
+        data = conn.request(CMD_PULL, key, worker_id=self.worker_id,
+                            flags=rnd)
+        self._round[key] = rnd + 1
+        out = np.frombuffer(data, np.float32).reshape(orig_shape)
+        return out.astype(orig_dtype, copy=False)
+
+    def barrier(self, generation: int = 0) -> None:
+        """Global barrier across workers (reference: Postoffice::Barrier via
+        the scheduler; here server 0 plays the rendezvous role)."""
+        self.conns[0].request(CMD_BARRIER, generation,
+                              worker_id=self.worker_id)
+
+    def shutdown_servers(self) -> None:
+        for c in self.conns:
+            try:
+                c.request(CMD_SHUTDOWN, worker_id=self.worker_id)
+            except (ConnectionError, OSError) as e:
+                get_logger().debug("shutdown race: %s", e)
+
+    def close(self) -> None:
+        for c in self.conns:
+            c.close()
