@@ -28,6 +28,24 @@ from .parallel.data_parallel import (
 from .parallel.mesh import (
     make_mesh, make_hierarchical_mesh, get_mesh, set_mesh, reset_mesh,
 )
+from .parallel.cross_barrier import CrossBarrierDriver, run_cross_barrier
+from .parallel.sharded import (
+    build_sharded_train_step, shard_params, init_sharded,
+)
+from .ops import compressor
+from .ops import ring_attention
+
+
+def __getattr__(name):
+    # Lazy submodules (PEP 562): `models` pulls in flax and `callbacks`
+    # optax schedules — processes that only run the server/launcher
+    # shouldn't pay those imports.
+    if name in ("models", "callbacks", "utils"):
+        import importlib
+        mod = importlib.import_module(f".{name}", __name__)
+        globals()[name] = mod
+        return mod
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
 
 __all__ = [
     "__version__",
@@ -42,4 +60,7 @@ __all__ = [
     "build_train_step",
     "make_mesh", "make_hierarchical_mesh", "get_mesh", "set_mesh",
     "reset_mesh",
+    "CrossBarrierDriver", "run_cross_barrier",
+    "build_sharded_train_step", "shard_params", "init_sharded",
+    "compressor", "ring_attention", "models", "callbacks", "utils",
 ]

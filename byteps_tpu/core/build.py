@@ -15,10 +15,18 @@ import sys
 _CORE_DIR = os.path.dirname(os.path.abspath(__file__))
 _SOURCES = ["core.cc", "server.cc"]
 _LIB_NAME = "libbyteps_core.so"
+_LIB_NAME_TSAN = "libbyteps_core_tsan.so"
+
+
+def _tsan() -> bool:
+    """BYTEPS_TPU_TSAN=1 builds/loads a ThreadSanitizer variant — the race
+    coverage for the host scheduler/server the reference never had
+    (SURVEY §5: 'CI does not run sanitizers')."""
+    return os.environ.get("BYTEPS_TPU_TSAN", "0") == "1"
 
 
 def lib_path() -> str:
-    return os.path.join(_CORE_DIR, _LIB_NAME)
+    return os.path.join(_CORE_DIR, _LIB_NAME_TSAN if _tsan() else _LIB_NAME)
 
 
 def _needs_build() -> bool:
@@ -47,6 +55,9 @@ def build(force: bool = False, verbose: bool = False) -> str:
         "g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-pthread",
         "-fvisibility=hidden", "-o", lib_path(), *srcs,
     ]
+    if _tsan():
+        cmd.insert(1, "-fsanitize=thread")
+        cmd.insert(1, "-g")
     if verbose:
         print(" ".join(cmd), file=sys.stderr)
     subprocess.run(cmd, check=True, capture_output=not verbose)
@@ -56,3 +67,27 @@ def build(force: bool = False, verbose: bool = False) -> str:
 if __name__ == "__main__":
     build(force="--force" in sys.argv, verbose=True)
     print(lib_path())
+
+
+_EXE_NAME = "bps_ps_server"
+_EXE_NAME_TSAN = "bps_ps_server_tsan"
+
+
+def exe_path() -> str:
+    return os.path.join(_CORE_DIR, _EXE_NAME_TSAN if _tsan() else _EXE_NAME)
+
+
+def build_server_exe(force: bool = False) -> str:
+    """Standalone PS-server binary (required for TSAN, usable generally)."""
+    src = os.path.join(_CORE_DIR, "server.cc")
+    out = exe_path()
+    if not force and os.path.exists(out) \
+            and os.path.getmtime(out) >= os.path.getmtime(src):
+        return out
+    cmd = ["g++", "-O2", "-std=c++17", "-pthread", "-DBPS_SERVER_MAIN",
+           "-o", out, src]
+    if _tsan():
+        cmd.insert(1, "-fsanitize=thread")
+        cmd.insert(1, "-g")
+    subprocess.run(cmd, check=True, capture_output=True)
+    return out

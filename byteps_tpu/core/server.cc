@@ -29,6 +29,7 @@
 #include <unistd.h>
 
 #include <atomic>
+#include <cstdlib>
 #include <condition_variable>
 #include <cstdint>
 #include <cstring>
@@ -451,3 +452,14 @@ int bps_ps_server_run(int port, int num_workers, int engine_threads,
 }
 
 }  // extern "C"
+
+#ifdef BPS_SERVER_MAIN
+// Standalone executable entry (used for sanitizer builds, where the TSAN
+// runtime must be loaded at process start and cannot be dlopen'd into an
+// interpreter).  argv: port num_workers engine_threads schedule async
+int main(int argc, char** argv) {
+  if (argc != 6) return 64;
+  return bps_ps_server_run(atoi(argv[1]), atoi(argv[2]), atoi(argv[3]),
+                           atoi(argv[4]), atoi(argv[5]));
+}
+#endif

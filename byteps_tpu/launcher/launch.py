@@ -1,0 +1,86 @@
+"""bpslaunch-equivalent process launcher.
+
+The reference's `bpslaunch` dispatches on DMLC_ROLE: a worker machine
+spawns one training process per GPU with BYTEPS_LOCAL_RANK/SIZE and NUMA
+pinning; servers/schedulers exec `python -c 'import byteps.server'`
+(reference: launcher/launch.py:147-218, NUMA logic at 45-123).
+
+TPU redesign: one JAX process drives every local chip, so the worker role
+launches a SINGLE training process per host (local_rank fan-out and NUMA
+cpusets disappear — XLA owns chip placement).  Server and scheduler roles
+start the native KV tier: servers run the full engine; the scheduler runs
+the same binary as a barrier/rendezvous endpoint on the root port, playing
+the reference scheduler's Postoffice role for PS mode.  Worker multi-host
+rendezvous rides `jax.distributed` via DMLC_PS_ROOT_URI/PORT, so reference
+launch configs carry over unchanged.
+
+Usage:  bpslaunch python train.py ...   (role from DMLC_ROLE)
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+from typing import Dict, List, Optional
+
+
+def build_worker_env(env: Optional[Dict[str, str]] = None) -> Dict[str, str]:
+    """Environment for the (single) worker training process on this host."""
+    e = dict(os.environ if env is None else env)
+    e.setdefault("BYTEPS_LOCAL_RANK", "0")
+    e.setdefault("BYTEPS_LOCAL_SIZE", "1")
+    # Multi-host: map the reference's scheduler to the JAX coordinator.
+    if int(e.get("DMLC_NUM_WORKER", "1")) > 1:
+        e.setdefault("BYTEPS_TPU_JAX_DIST", "1")
+    return e
+
+
+def worker_command(argv: List[str],
+                   env: Optional[Dict[str, str]] = None) -> List[str]:
+    """The command a worker host runs — gdb-wrapped when
+    BYTEPS_ENABLE_GDB=1, like the reference (launcher/launch.py:147-150)."""
+    e = os.environ if env is None else env
+    if e.get("BYTEPS_ENABLE_GDB", "0") == "1":
+        return ["gdb", "-ex", "run", "-ex", "bt", "-batch", "--args"] + argv
+    return list(argv)
+
+
+def server_command(role: str) -> List[str]:
+    """Server/scheduler both run the native KV tier
+    (scheduler = barrier-only instance on the root port)."""
+    if role == "scheduler":
+        return [sys.executable, "-c",
+                "import byteps_tpu.server as s; s.serve(port=None)"]
+    return [sys.executable, "-m", "byteps_tpu.server"]
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    role = os.environ.get("DMLC_ROLE", "worker").lower()
+    procs = []
+    if role in ("server", "scheduler", "joint"):
+        env = dict(os.environ)
+        if role == "scheduler":
+            # The scheduler binds the root port itself.
+            env["DMLC_SERVER_ID"] = "-1"  # port = root_port + 1 + (-1)
+        cmd = server_command(role)
+        if role == "joint":
+            procs.append(subprocess.Popen(cmd, env=env))
+        else:
+            return subprocess.call(cmd, env=env)
+    if role in ("worker", "joint"):
+        if not argv:
+            print("bpslaunch: no training command given", file=sys.stderr)
+            return 2
+        rc = subprocess.call(worker_command(argv),
+                             env=build_worker_env())
+        for p in procs:
+            p.terminate()
+            p.wait()
+        return rc
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

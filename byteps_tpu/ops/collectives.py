@@ -156,6 +156,12 @@ def bucketed_tree_all_reduce(
     (bucket, bucket_index) -> reduced bucket and replaces the psum — this is
     the hook the compression subsystem uses.
     """
+    if is_local() and bucket_transform is None:
+        # Single-device: the sum over one worker is the identity and the
+        # average divides by 1 — skip the bucket round-trip entirely, as the
+        # reference's non-distributed queue list skips PUSH/PULL
+        # (reference: operations.cc:429-485).
+        return tree
     cfg = get_config()
     pb = partition_bytes or cfg.partition_bytes
     all_leaves, treedef = jax.tree.flatten(tree)

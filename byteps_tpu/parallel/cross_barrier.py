@@ -1,0 +1,92 @@
+"""Cross-barrier pipelining: overlap communication/update with next-step
+compute.
+
+The reference implements ByteScheduler-style cross-barrier execution with a
+poller thread, per-parameter optimizers and per-parameter locks that let
+the next iteration's forward start before all push_pulls finish
+(reference: torch/cross_barrier.py:28-231, docs/cross-barrier.md).
+
+On TPU the barrier being removed is the HOST-side sync: inside one jitted
+step XLA's latency-hiding scheduler already overlaps bucket collectives
+with backward compute (the in-graph analog of per-parameter locks), so the
+remaining win is keeping the device queue full across steps.  JAX's async
+dispatch gives exactly that — as long as the host never blocks on a step's
+results.  `CrossBarrierDriver` packages the discipline:
+
+  - steps are dispatched eagerly; the host loop runs ahead of the device,
+  - `max_in_flight` bounds the run-ahead (the reference's credit system,
+    scheduled_queue.cc:136-139, in step units),
+  - losses are fetched asynchronously and only synchronized when read.
+"""
+
+from __future__ import annotations
+
+import collections
+from typing import Any, Callable, Iterable, Iterator, Optional, Tuple
+
+import jax
+
+PyTree = Any
+
+
+class CrossBarrierDriver:
+    """Run a jitted train step without host-side barriers.
+
+    step(params, opt_state, batch) -> (params, opt_state, loss)
+
+    Usage:
+        drv = CrossBarrierDriver(step, params, opt_state, max_in_flight=2)
+        for batch in data:
+            drv.submit(batch)        # returns immediately
+        params, opt_state = drv.finish()
+        losses = drv.losses()        # floats, synchronized
+    """
+
+    def __init__(self, step: Callable, params: PyTree, opt_state: PyTree,
+                 max_in_flight: int = 2):
+        if max_in_flight < 1:
+            raise ValueError("max_in_flight must be >= 1")
+        self._step = step
+        self._params = params
+        self._opt_state = opt_state
+        self._max = max_in_flight
+        self._pending: collections.deque = collections.deque()
+        self._losses: list = []
+
+    def submit(self, batch: PyTree) -> None:
+        """Dispatch one training step; blocks only when more than
+        `max_in_flight` steps' losses are unresolved (the credit gate)."""
+        self._params, self._opt_state, loss = self._step(
+            self._params, self._opt_state, batch)
+        self._pending.append(loss)
+        while len(self._pending) > self._max:
+            # Resolving the oldest loss waits for that step's completion —
+            # bounded run-ahead, like returning communication credits.
+            self._losses.append(float(self._pending.popleft()))
+
+    def finish(self) -> Tuple[PyTree, PyTree]:
+        """Drain the queue; returns (params, opt_state) fully materialized."""
+        while self._pending:
+            self._losses.append(float(self._pending.popleft()))
+        jax.block_until_ready(self._params)
+        return self._params, self._opt_state
+
+    def losses(self) -> list:
+        return list(self._losses)
+
+    @property
+    def state(self) -> Tuple[PyTree, PyTree]:
+        """Current (possibly still-in-flight) params/opt_state."""
+        return self._params, self._opt_state
+
+
+def run_cross_barrier(step: Callable, params: PyTree, opt_state: PyTree,
+                      batches: Iterable, max_in_flight: int = 2
+                      ) -> Tuple[PyTree, PyTree, list]:
+    """Convenience wrapper: train over `batches` with cross-barrier
+    pipelining; returns (params, opt_state, losses)."""
+    drv = CrossBarrierDriver(step, params, opt_state, max_in_flight)
+    for b in batches:
+        drv.submit(b)
+    params, opt_state = drv.finish()
+    return params, opt_state, drv.losses()

@@ -62,20 +62,24 @@ def _dispatch_masks(gate_logits: jax.Array, num_experts: int, capacity: int):
     return dispatch, combine, aux
 
 
-def moe_layer_shard(params: PyTree, x: jax.Array, capacity_factor: float = 2.0,
-                    axis_name: str = "ep") -> Tuple[jax.Array, jax.Array]:
-    """Per-shard Switch-MoE layer (call under shard_map).
+def moe_core(gate_w: jax.Array, ffn_in: jax.Array, ffn_out: jax.Array,
+             x: jax.Array, capacity_factor: float = 2.0,
+             axis_name: str = "ep") -> Tuple[jax.Array, jax.Array]:
+    """The Switch-MoE data path on local tokens (call under shard_map).
 
-    x: [T_local, D] tokens on this device; params['ffn_*'] hold the LOCAL
-    expert slice [E_local, ...]; gate_w is replicated.  Returns (y, aux_loss).
+    x: [T_local, D]; ffn_in/ffn_out: this rank's expert slice
+    [E_local, D, F] / [E_local, F, D]; gate_w [D, E_global] replicated.
+    Returns (y [T_local, D], aux load-balancing loss — local, not reduced).
+    Shared by the standalone moe_layer and the hybrid model's FFN so the
+    dispatch/capacity logic exists exactly once.
     """
     world = lax.axis_size(axis_name)
-    e_local = params["ffn_in"].shape[0]
+    e_local = ffn_in.shape[0]
     E = e_local * world
     T = x.shape[0]
     capacity = max(1, int(capacity_factor * T / E))
 
-    logits = x @ params["gate_w"]                              # [T, E]
+    logits = x @ gate_w                                        # [T, E]
     dispatch, combine, aux = _dispatch_masks(logits, E, capacity)
 
     # Tokens -> expert buffers [E, C, D]; split experts across ranks, gather
@@ -84,15 +88,26 @@ def moe_layer_shard(params: PyTree, x: jax.Array, capacity_factor: float = 2.0,
     # [E, C, D] -> [E/world, world*C, D]
     recv = lax.all_to_all(buffers, axis_name, split_axis=0, concat_axis=1,
                           tiled=True)
-    h = jnp.einsum("ecd,edf->ecf", recv, params["ffn_in"].astype(jnp.float32))
+    h = jnp.einsum("ecd,edf->ecf", recv, ffn_in.astype(jnp.float32))
     h = jax.nn.gelu(h)
-    h = jnp.einsum("ecf,efd->ecd", h, params["ffn_out"].astype(jnp.float32))
+    h = jnp.einsum("ecf,efd->ecd", h, ffn_out.astype(jnp.float32))
     # Route results back to the owners of the tokens.
     back = lax.all_to_all(h, axis_name, split_axis=1, concat_axis=0,
                           tiled=True)                          # [E, C, D]
     y = jnp.einsum("tec,ecd->td", combine, back)
-    aux = lax.pmean(aux, axis_name)
     return y.astype(x.dtype), aux
+
+
+def moe_layer_shard(params: PyTree, x: jax.Array, capacity_factor: float = 2.0,
+                    axis_name: str = "ep") -> Tuple[jax.Array, jax.Array]:
+    """Per-shard Switch-MoE layer (call under shard_map).
+
+    x: [T_local, D] tokens on this device; params['ffn_*'] hold the LOCAL
+    expert slice [E_local, ...]; gate_w is replicated.  Returns (y, aux_loss).
+    """
+    y, aux = moe_core(params["gate_w"], params["ffn_in"], params["ffn_out"],
+                      x, capacity_factor, axis_name)
+    return y, lax.pmean(aux, axis_name)
 
 
 def moe_layer(params: PyTree, x: jax.Array, mesh, capacity_factor: float = 2.0,

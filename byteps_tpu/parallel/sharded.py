@@ -39,18 +39,37 @@ def shard_params(params: PyTree, mesh: Mesh, specs: PyTree) -> PyTree:
 
 def opt_state_specs(optimizer: optax.GradientTransformation, params: PyTree,
                     specs: PyTree) -> PyTree:
-    """Derive PartitionSpecs for the optimizer state: any state leaf whose
-    shape matches a param leaf inherits that param's spec (adam mu/nu etc.);
-    everything else (step counters, scalars) is replicated."""
-    shape_to_spec = {}
-    for p, s in zip(jax.tree.leaves(params),
-                    jax.tree.leaves(specs, is_leaf=_is_spec)):
-        shape_to_spec.setdefault(p.shape, s)
-    state_shape = jax.eval_shape(optimizer.init, params)
+    """Derive PartitionSpecs for the optimizer state.
 
-    def spec_for(leaf):
-        return shape_to_spec.get(leaf.shape, P())
-    return jax.tree.map(spec_for, state_shape)
+    Optimizer state trees (adam mu/nu, momentum buffers) embed copies of the
+    params tree, so each state leaf is matched to its param by PATH SUFFIX
+    — e.g. state path (..., 'mu', 'layers', 'wq') matches param path
+    ('layers', 'wq').  Shape matching alone is ambiguous (wq and wo share a
+    shape but not a layout).  Unmatched leaves (step counters, scalars)
+    replicate."""
+    from jax.tree_util import tree_flatten_with_path
+
+    def key_id(k):
+        return getattr(k, "key", getattr(k, "name", getattr(k, "idx", None)))
+
+    param_paths, _ = tree_flatten_with_path(params)
+    spec_leaves = jax.tree.leaves(specs, is_leaf=_is_spec)
+    by_path = {tuple(key_id(k) for k in path): (leaf.shape, spec)
+               for (path, leaf), spec in zip(param_paths, spec_leaves)}
+
+    state_shape = jax.eval_shape(optimizer.init, params)
+    state_paths, treedef = tree_flatten_with_path(state_shape)
+    out = []
+    for path, leaf in state_paths:
+        ids = tuple(key_id(k) for k in path)
+        spec = P()
+        for start in range(len(ids)):
+            hit = by_path.get(ids[start:])
+            if hit is not None and hit[0] == leaf.shape:
+                spec = hit[1]
+                break
+        out.append(spec)
+    return jax.tree.unflatten(treedef, out)
 
 
 def build_sharded_train_step(

@@ -33,12 +33,57 @@ def row_parallel_dense(x_local: jax.Array, w_local: jax.Array,
                        b: jax.Array = None,
                        axis_name: str = "tp") -> jax.Array:
     """Row-parallel dense: inputs sharded on the contracting dim, weight
-    row-sharded; partial products are psummed (the Megatron "g" operator).
-    Bias is added once, post-reduction."""
-    y = lax.psum(x_local @ w_local, axis_name)
+    row-sharded; partial products are psummed (the Megatron "g" operator,
+    with the transpose-safe custom vjp).  Bias is added once,
+    post-reduction."""
+    y = reduce_from(axis_name)(x_local @ w_local)
     if b is not None:
         y = y + b
     return y
+
+
+def copy_to(axis_name: str):
+    """The Megatron "f" operator: forward identity, backward all-reduce.
+
+    Under shard_map autodiff is purely local, so a replicated activation
+    entering column-parallel branches needs its cotangents summed across the
+    tp ranks explicitly; this factory returns that identity-with-psum-vjp.
+    """
+    @jax.custom_vjp
+    def f(x):
+        return x
+
+    def fwd(x):
+        return x, None
+
+    def bwd(_, g):
+        return (lax.psum(g, axis_name),)
+
+    f.defvjp(fwd, bwd)
+    return f
+
+
+def reduce_from(axis_name: str):
+    """The Megatron "g" operator: forward all-reduce, backward identity.
+
+    Raw `lax.psum` must NOT be differentiated through under
+    shard_map(check_vma=False): its transpose is another psum, which
+    over-counts the cotangent by the axis size when the downstream loss is
+    computed replicated on every rank.  This custom-vjp pins the correct
+    adjoint (the replicated cotangent passes through once).
+    """
+    @jax.custom_vjp
+    def g(x):
+        return lax.psum(x, axis_name)
+
+    def fwd(x):
+        return lax.psum(x, axis_name), None
+
+    def bwd(_, ct):
+        return (ct,)
+
+    g.defvjp(fwd, bwd)
+    return g
 
 
 def tp_split(x: jax.Array, axis: int, axis_name: str = "tp") -> jax.Array:

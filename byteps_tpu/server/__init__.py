@@ -18,11 +18,12 @@ import os
 def serve(port: int | None = None, num_workers: int | None = None,
           engine_threads: int | None = None, schedule: bool | None = None,
           async_mode: bool | None = None) -> int:
-    """Run the native PS server (blocking). Returns its exit code."""
+    """Run the native PS server (blocking). Returns its exit code.
+
+    Under BYTEPS_TPU_TSAN=1 the server runs as a standalone sanitized
+    binary (the TSAN runtime cannot be dlopen'd into an interpreter).
+    """
     from ..core import build
-    lib = ctypes.CDLL(build.build())
-    lib.bps_ps_server_run.argtypes = [ctypes.c_int] * 5
-    lib.bps_ps_server_run.restype = ctypes.c_int
     from ..common.config import get_config
     cfg = get_config(refresh=True)
     # Single-host port convention matches PSSession.from_config: server i
@@ -30,7 +31,7 @@ def serve(port: int | None = None, num_workers: int | None = None,
     # reserved for the jax coordinator).  DMLC_SERVER_ID selects i.
     server_id = int(os.environ.get("DMLC_SERVER_ID", "0"))
     default_port = cfg.scheduler_port + 1 + server_id
-    return lib.bps_ps_server_run(
+    args = (
         int(port if port is not None else default_port),
         int(num_workers if num_workers is not None else cfg.num_worker),
         int(engine_threads if engine_threads is not None
@@ -38,3 +39,11 @@ def serve(port: int | None = None, num_workers: int | None = None,
         int(schedule if schedule is not None else cfg.server_enable_schedule),
         int(async_mode if async_mode is not None else cfg.enable_async),
     )
+    if os.environ.get("BYTEPS_TPU_TSAN", "0") == "1":
+        import subprocess
+        exe = build.build_server_exe()
+        return subprocess.call([exe] + [str(a) for a in args])
+    lib = ctypes.CDLL(build.build())
+    lib.bps_ps_server_run.argtypes = [ctypes.c_int] * 5
+    lib.bps_ps_server_run.restype = ctypes.c_int
+    return lib.bps_ps_server_run(*args)

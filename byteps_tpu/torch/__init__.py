@@ -1,0 +1,211 @@
+"""PyTorch plugin: the reference's torch API surface on the TPU framework.
+
+Mirrors byteps.torch (reference: byteps/torch/__init__.py:23-28,
+torch/ops.py:157-236): `init/shutdown`, `rank/size`, `push_pull(_async)/
+synchronize/poll`, `DistributedOptimizer`, `broadcast_parameters/
+broadcast_optimizer_state`, `DistributedDataParallel` — so training
+scripts written for the reference port by changing the import.
+
+Execution model: torch tensors live on host; communication rides the
+framework's eager push_pull (XLA collectives across JAX processes, or the
+PS tier under BYTEPS_TPU_PS_MODE).  Gradient communication for a step is
+launched async for every parameter first (the backward-hook overlap of the
+reference collapses into JAX async dispatch) and synchronized before the
+inner optimizer step.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Iterable, Optional, Tuple
+
+import numpy as np
+import torch
+
+from ..common import api as _api
+from ..ops.compression import Compression
+
+# Lifecycle / topology re-exports (reference: common/__init__.py:52-139)
+init = _api.init
+shutdown = _api.shutdown
+suspend = _api.suspend
+resume = _api.resume
+rank = _api.rank
+size = _api.size
+local_rank = _api.local_rank
+local_size = _api.local_size
+declare = _api.declare
+get_pushpull_speed = _api.get_pushpull_speed
+
+
+_handles: Dict[int, Tuple[torch.Tensor, bool]] = {}
+
+
+def _to_jax(t: torch.Tensor):
+    import jax.numpy as jnp
+    return jnp.asarray(t.detach().cpu().numpy())
+
+
+def _from_jax(a, like: torch.Tensor) -> torch.Tensor:
+    return torch.from_numpy(np.asarray(a)).to(dtype=like.dtype,
+                                              device=like.device)
+
+
+def push_pull_async(tensor: torch.Tensor, average: bool = True,
+                    name: Optional[str] = None,
+                    priority: int = 0, compression=Compression.none) -> int:
+    """Non-blocking in-place push_pull; returns a handle for synchronize()
+    (reference: torch/ops.py:157-186)."""
+    h = _api.push_pull_async(_to_jax(tensor), name=name, average=average,
+                             priority=priority, compression=compression)
+    _handles[h] = (tensor, average)
+    return h
+
+
+def push_pull_async_inplace(tensor, average=True, name=None, priority=0):
+    return push_pull_async(tensor, average=average, name=name,
+                           priority=priority)
+
+
+def push_pull(tensor: torch.Tensor, average: bool = True,
+              name: Optional[str] = None, priority: int = 0,
+              compression=Compression.none) -> torch.Tensor:
+    """Blocking push_pull; returns a new tensor (reference:
+    torch/ops.py:188-206)."""
+    h = push_pull_async(tensor, average=average, name=name,
+                        priority=priority, compression=compression)
+    return synchronize(h)
+
+
+def synchronize(handle: int) -> torch.Tensor:
+    """Wait for an async push_pull; writes the result back in place and
+    returns the tensor (reference: torch/ops.py:222-236)."""
+    tensor, _ = _handles.pop(handle)
+    out = _api.synchronize(handle)
+    result = _from_jax(out, tensor)
+    with torch.no_grad():
+        tensor.copy_(result)
+    return tensor
+
+
+def poll(handle: int) -> bool:
+    return _api.poll(handle)
+
+
+class _DistributedOptimizer(torch.optim.Optimizer):
+    """Wraps a torch optimizer so step() averages gradients across workers
+    first (reference: torch/__init__.py:115-214)."""
+
+    def __init__(self, optimizer: torch.optim.Optimizer, named_parameters,
+                 compression, backward_passes_per_step: int = 1):
+        self._inner = optimizer
+        self._compression = compression
+        self._bpps = backward_passes_per_step
+        if named_parameters is not None:
+            named = list(named_parameters)
+        else:
+            named = [(f"param.{i}.{j}", p)
+                     for i, g in enumerate(optimizer.param_groups)
+                     for j, p in enumerate(g["params"])]
+        self._names = {p: n for n, p in named}
+        # expose inner state so schedulers etc. keep working
+        self.param_groups = optimizer.param_groups
+        self.defaults = optimizer.defaults
+        self.state = optimizer.state
+
+    def step(self, closure=None):
+        handles = []
+        for group in self.param_groups:
+            for p in group["params"]:
+                if p.grad is None:
+                    continue
+                name = "Gradient." + self._names.get(p, f"anon.{id(p)}")
+                h = push_pull_async(p.grad, average=True, name=name,
+                                    compression=self._compression)
+                handles.append(h)
+        for h in handles:
+            synchronize(h)
+        if self._bpps > 1:
+            for group in self.param_groups:
+                for p in group["params"]:
+                    if p.grad is not None:
+                        p.grad.div_(self._bpps)
+        return self._inner.step(closure)
+
+    def zero_grad(self, set_to_none: bool = True):
+        return self._inner.zero_grad(set_to_none=set_to_none)
+
+    def state_dict(self):
+        return self._inner.state_dict()
+
+    def load_state_dict(self, sd):
+        return self._inner.load_state_dict(sd)
+
+
+def DistributedOptimizer(optimizer: torch.optim.Optimizer,
+                         named_parameters=None,
+                         compression=Compression.none,
+                         backward_passes_per_step: int = 1):
+    return _DistributedOptimizer(optimizer, named_parameters, compression,
+                                 backward_passes_per_step)
+
+
+def broadcast_parameters(params, root_rank: int = 0) -> None:
+    """In-place broadcast of a state_dict or iterable of (name, tensor)
+    (reference: torch/__init__.py:259-291)."""
+    if isinstance(params, dict):
+        items = sorted(params.items())
+    else:
+        items = list(params)
+    for name, t in items:
+        if not torch.is_tensor(t):
+            continue
+        out = _api.broadcast_parameters(_to_jax(t), root_rank)
+        with torch.no_grad():
+            t.copy_(_from_jax(out, t))
+
+
+def broadcast_optimizer_state(optimizer: torch.optim.Optimizer,
+                              root_rank: int = 0) -> None:
+    """Broadcast optimizer state tensors AND scalar hyper-state
+    (reference: torch/__init__.py:293-409 tensor-izes scalars)."""
+    sd = optimizer.state_dict()
+    for pid, pstate in sd.get("state", {}).items():
+        for k, v in list(pstate.items()):
+            if torch.is_tensor(v):
+                out = _api.broadcast_parameters(_to_jax(v), root_rank)
+                with torch.no_grad():
+                    v.copy_(_from_jax(out, v))
+            elif isinstance(v, (int, float)):
+                t = torch.tensor(float(v))
+                out = _api.broadcast_parameters(_to_jax(t), root_rank)
+                pstate[k] = type(v)(np.asarray(out).item())
+    optimizer.load_state_dict(sd)
+
+
+class DistributedDataParallel(torch.nn.Module):
+    """Minimal DDP wrapper: broadcasts module state at construction,
+    re-broadcasts buffers each forward, averages gradients in
+    `synchronize()` (reference: torch/parallel/distributed.py — the
+    backward-hook auto-sync there maps to calling synchronize() before
+    optimizer.step(), which DistributedOptimizer already does; this wrapper
+    exists for API parity and buffer consistency)."""
+
+    def __init__(self, module: torch.nn.Module, broadcast_buffers=True):
+        super().__init__()
+        self.module = module
+        self.broadcast_buffers = broadcast_buffers
+        broadcast_parameters(self.module.state_dict(), root_rank=0)
+
+    def forward(self, *args, **kwargs):
+        if self.broadcast_buffers and size() > 1:
+            broadcast_parameters(dict(self.module.named_buffers()),
+                                 root_rank=0)
+        return self.module(*args, **kwargs)
+
+    def synchronize(self) -> None:
+        handles = [push_pull_async(p.grad, average=True,
+                                   name=f"DDP.Gradient.{n}")
+                   for n, p in self.module.named_parameters()
+                   if p.grad is not None]
+        for h in handles:
+            synchronize(h)

@@ -1,0 +1,8 @@
+"""Utilities: checkpointing (orbax wrapper) and input-pipeline helpers."""
+
+from . import checkpoint
+from . import data
+from .data import shard_batch, prefetch_to_device, synthetic_batches
+
+__all__ = ["checkpoint", "data", "shard_batch", "prefetch_to_device",
+           "synthetic_batches"]

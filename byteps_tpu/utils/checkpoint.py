@@ -1,0 +1,60 @@
+"""Checkpoint save/restore.
+
+The reference has no checkpointing of its own — worker consistency comes
+from broadcast at start, persistence is left to the framework
+(reference: docs/best-practice.md, SURVEY §5).  The TPU build ships the
+missing piece as a thin orbax wrapper handling the distributed details:
+only rank 0 writes (unless the checkpointer is multi-host-aware), every
+rank restores, and restored state is broadcast for bit-identical workers.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Optional
+
+PyTree = Any
+
+
+def _ckptr():
+    import orbax.checkpoint as ocp
+    return ocp.PyTreeCheckpointer()
+
+
+def save(path: str, state: PyTree, force: bool = True) -> None:
+    """Write `state` (any pytree of arrays) to `path` from rank 0."""
+    from ..common.api import rank
+    if rank() != 0:
+        return
+    _ckptr().save(os.path.abspath(os.path.expanduser(path)), state,
+                  force=force)
+
+
+def restore(path: str, template: Optional[PyTree] = None,
+            broadcast: bool = True) -> PyTree:
+    """Load the checkpoint at `path`; with `broadcast` (default) the result
+    is broadcast from rank 0 so all workers start bit-identical — the same
+    consistency contract the reference gets from broadcast_parameters
+    (reference: torch/__init__.py:259-291)."""
+    import jax
+    restored = _ckptr().restore(os.path.abspath(os.path.expanduser(path)))
+    if template is not None:
+        # orbax returns dicts for any pytree; restore the caller's structure.
+        leaves = jax.tree.leaves(restored)
+        restored = jax.tree.unflatten(jax.tree.structure(template), leaves)
+    if broadcast:
+        from ..common.api import broadcast_parameters, size
+        if size() > 1:
+            restored = broadcast_parameters(restored, root_rank=0)
+    return restored
+
+
+def latest_step_dir(root: str) -> Optional[str]:
+    """Convenience for step-numbered checkpoint layouts: returns the path
+    of the highest-numbered subdirectory of `root`, or None."""
+    if not os.path.isdir(root):
+        return None
+    steps = [d for d in os.listdir(root) if d.isdigit()]
+    if not steps:
+        return None
+    return os.path.join(root, max(steps, key=int))

@@ -1,0 +1,57 @@
+"""Input pipeline helpers: sharding and device prefetch.
+
+The reference delegates input loading to each framework's loader; on TPU
+the input pipeline is a first-order performance concern (HBM is fed over
+PCIe from the host), so the framework ships the two standard tools:
+
+  - `shard_batch`: place a host batch onto the mesh with the batch dim
+    split over the dp axis (one host->device transfer per local shard);
+  - `prefetch_to_device`: run the host iterator ahead of the device so
+    step N+1's transfer overlaps step N's compute.
+"""
+
+from __future__ import annotations
+
+import collections
+import itertools
+from typing import Any, Iterable, Iterator, Optional
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+PyTree = Any
+
+
+def shard_batch(batch: PyTree, mesh: Mesh, axis_name: str = "dp") -> PyTree:
+    """device_put a host batch with axis 0 sharded over `axis_name`."""
+    sharding = NamedSharding(mesh, P(axis_name))
+    return jax.tree.map(lambda x: jax.device_put(x, sharding), batch)
+
+
+def prefetch_to_device(iterator: Iterable, size: int = 2,
+                       mesh: Optional[Mesh] = None,
+                       axis_name: str = "dp") -> Iterator:
+    """Wrap a host batch iterator so `size` batches are always in flight to
+    the device.  With a mesh, batches are dp-sharded on the way."""
+    queue: collections.deque = collections.deque()
+    it = iter(iterator)
+
+    def put(batch):
+        if mesh is not None:
+            return shard_batch(batch, mesh, axis_name)
+        return jax.tree.map(jax.device_put, batch)
+
+    for batch in itertools.islice(it, size):
+        queue.append(put(batch))
+    while queue:
+        yield queue.popleft()
+        for batch in itertools.islice(it, 1):
+            queue.append(put(batch))
+
+
+def synthetic_batches(make_batch, n: Optional[int] = None) -> Iterator:
+    """Endless (or n-long) stream of `make_batch(i)` results — the pattern
+    the reference's synthetic benchmarks use."""
+    counter = itertools.count() if n is None else range(n)
+    for i in counter:
+        yield make_batch(i)

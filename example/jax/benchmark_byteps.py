@@ -1,0 +1,89 @@
+"""Synthetic-data throughput benchmark, mirroring the reference benchmark
+CLI (reference: example/pytorch/benchmark_byteps.py — prints img/sec or
+tokens/sec mean+-stddev over timed iterations).
+
+  python example/jax/benchmark_byteps.py --model resnet50 --num-iters 10
+  python example/jax/benchmark_byteps.py --model bert_large --profiler
+"""
+
+import argparse
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+import byteps_tpu as bps
+from byteps_tpu import models
+from byteps_tpu.models import transformer as tfm
+
+
+def build(args, mesh):
+    if args.model in tfm.CONFIGS:
+        cfg = tfm.get_config(args.model, causal=True)
+        params = tfm.init_params(jax.random.key(0), cfg)
+        toks, tgts = tfm.synthetic_batch(
+            jax.random.key(1), args.batch_size, args.seq_len, cfg)
+        loss = lambda p, b: tfm.loss_fn(p, b, cfg)
+        batch = (toks, tgts)
+        unit = "tokens"
+        per_batch = args.batch_size * args.seq_len
+    else:
+        model = models.create_cnn(args.model, num_classes=1000)
+        x = jnp.ones((args.batch_size, args.image_size, args.image_size, 3))
+        params = model.init(jax.random.key(0), x, train=False)
+        labels = jnp.zeros((args.batch_size,), jnp.int32)
+        loss = models.cnn_loss_fn(model)
+        batch = (x, labels)
+        unit = "imgs"
+        per_batch = args.batch_size
+    opt = bps.DistributedOptimizer(optax.sgd(0.01))
+    step = bps.build_train_step(loss, opt, mesh, donate=False)
+    return step, params, opt.init(params), batch, unit, per_batch
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", default="resnet50")
+    ap.add_argument("--batch-size", type=int, default=32)
+    ap.add_argument("--seq-len", type=int, default=512)
+    ap.add_argument("--image-size", type=int, default=224)
+    ap.add_argument("--num-warmup", type=int, default=2)
+    ap.add_argument("--num-iters", type=int, default=10)
+    ap.add_argument("--profiler", action="store_true",
+                    help="wrap timed iters in jax.profiler traces")
+    ap.add_argument("--trace-dir", default="/tmp/byteps_tpu_profile")
+    args = ap.parse_args()
+
+    bps.init()
+    mesh = bps.get_mesh()
+    step, params, opt_state, batch, unit, per_batch = build(args, mesh)
+
+    for _ in range(args.num_warmup):
+        params, opt_state, loss = step(params, opt_state, batch)
+        float(loss)
+
+    if args.profiler:
+        jax.profiler.start_trace(args.trace_dir)
+    rates = []
+    for _ in range(args.num_iters):
+        t0 = time.perf_counter()
+        params, opt_state, loss = step(params, opt_state, batch)
+        float(loss)
+        rates.append(per_batch / (time.perf_counter() - t0))
+    if args.profiler:
+        jax.profiler.stop_trace()
+        print(f"profile written to {args.trace_dir}")
+
+    rates = np.asarray(rates)
+    print(f"{args.model}: {rates.mean():.1f} +- {rates.std():.1f} "
+          f"{unit}/sec per worker "
+          f"(total {rates.mean() * bps.size():.1f})")
+    ts, speed = bps.get_pushpull_speed()
+    print(f"push_pull speed: {speed:.2f} MB/s")
+    bps.shutdown()
+
+
+if __name__ == "__main__":
+    main()

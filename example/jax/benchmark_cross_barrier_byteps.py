@@ -1,0 +1,57 @@
+"""Cross-barrier pipelining benchmark.
+
+Counterpart of the reference's cross-barrier benchmark
+(reference: example/pytorch/benchmark_cross_barrier_byteps.py): compare a
+host-synchronous loop (fetch the loss every step) against the
+cross-barrier driver that keeps the device queue full.
+
+  python example/jax/benchmark_cross_barrier_byteps.py
+"""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import optax
+
+import byteps_tpu as bps
+from byteps_tpu import models
+
+
+def main():
+    bps.init()
+    mesh = bps.get_mesh()
+    params = models.init_mlp(jax.random.key(0), (256, 512, 512, 10))
+    opt = bps.DistributedOptimizer(optax.sgd(0.01))
+    step = bps.build_train_step(models.mlp_loss, opt, mesh, donate=False)
+    opt_state = opt.init(params)
+    x = jax.random.normal(jax.random.key(1), (1024, 256))
+    y = (x.sum(-1) > 0).astype(jnp.int32)
+    n = 50
+
+    # warmup/compile
+    p, s, l = step(params, opt_state, (x, y))
+    float(l)
+
+    t0 = time.perf_counter()
+    p, s = params, opt_state
+    for _ in range(n):
+        p, s, loss = step(p, s, (x, y))
+        float(loss)                       # host barrier every step
+    sync_t = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    drv = bps.CrossBarrierDriver(step, params, opt_state, max_in_flight=8)
+    for _ in range(n):
+        drv.submit((x, y))
+    drv.finish()
+    cb_t = time.perf_counter() - t0
+
+    print(f"synchronous: {n / sync_t:.1f} steps/s")
+    print(f"cross-barrier: {n / cb_t:.1f} steps/s "
+          f"({sync_t / cb_t:.2f}x)")
+    bps.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,57 @@
+"""Elastic suspend/resume demo.
+
+Counterpart of the reference's elastic example
+(reference: example/pytorch/elastic_benchmark_byteps.py:124-133 — training
+suspends, the cluster is resized, training resumes with stable tensor
+keys).
+
+  python example/jax/elastic_benchmark_byteps.py
+"""
+
+import jax
+import jax.numpy as jnp
+import optax
+
+import byteps_tpu as bps
+from byteps_tpu import models
+
+
+def train_steps(params, opt_state, step, n, x, y):
+    for _ in range(n):
+        params, opt_state, loss = step(params, opt_state, (x, y))
+    return params, opt_state, float(loss)
+
+
+def main():
+    bps.init()
+    mesh = bps.get_mesh()
+    params = models.init_mlp(jax.random.key(0), (32, 64, 4))
+    opt = bps.DistributedOptimizer(optax.sgd(0.1))
+    step = bps.build_train_step(models.mlp_loss, opt, mesh)
+    opt_state = opt.init(params)
+    x = jax.random.normal(jax.random.key(1), (256, 32))
+    y = (x.sum(-1) > 0).astype(jnp.int32)
+
+    # declare some tensors so the registry has state worth preserving
+    bps.declare("Gradient.w0")
+    bps.declare("Gradient.b0")
+
+    params, opt_state, loss = train_steps(params, opt_state, step, 5, x, y)
+    print(f"phase 1 done: loss={loss:.4f}, declared={bps.declared_key('Gradient.b0')}")
+
+    # --- elastic suspend: tear down comm, keep registry -------------------
+    bps.suspend()
+    # (a real deployment would wait for the new cluster size here)
+    bps.resume(num_workers=1, num_servers=0)
+
+    # keys survive resume in original order (reference: operations.cc:107-119)
+    assert bps.declared_key("Gradient.w0") == 0
+    assert bps.declared_key("Gradient.b0") == 1
+
+    params, opt_state, loss = train_steps(params, opt_state, step, 5, x, y)
+    print(f"phase 2 done after resume: loss={loss:.4f}")
+    bps.shutdown()
+
+
+if __name__ == "__main__":
+    main()

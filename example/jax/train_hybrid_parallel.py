@@ -1,0 +1,55 @@
+"""Hybrid-parallel training demo: dp x tp x sp x pp x ep in one step.
+
+No reference counterpart — the reference is DP-only (SURVEY §2.6); this
+example shows the TPU-native extension.  On an 8-device host:
+
+  python example/jax/train_hybrid_parallel.py --pp 2 --dp 2 --tp 2
+  python example/jax/train_hybrid_parallel.py --ep 4 --dp 2 --experts 8
+"""
+
+import argparse
+
+import jax
+import jax.numpy as jnp
+import optax
+
+import byteps_tpu as bps
+from byteps_tpu.models import hybrid
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    for ax in ("dp", "tp", "sp", "pp", "ep"):
+        ap.add_argument(f"--{ax}", type=int, default=1)
+    ap.add_argument("--experts", type=int, default=0)
+    ap.add_argument("--layers", type=int, default=4)
+    ap.add_argument("--d-model", type=int, default=128)
+    ap.add_argument("--steps", type=int, default=10)
+    ap.add_argument("--microbatches", type=int, default=2)
+    args = ap.parse_args()
+
+    bps.init()
+    mesh = bps.make_mesh(dp=args.dp, tp=args.tp, sp=args.sp, pp=args.pp,
+                         ep=args.ep)
+    cfg = hybrid.HybridConfig(
+        vocab_size=1024, num_layers=args.layers, d_model=args.d_model,
+        num_heads=8, d_ff=4 * args.d_model, max_seq_len=128,
+        num_experts=args.experts)
+    opt = optax.adamw(1e-3)
+    step, init_fn = hybrid.build_hybrid_train_step(
+        cfg, opt, mesh, num_microbatches=args.microbatches)
+    params = init_fn(jax.random.key(0))
+    opt_state = opt.init(params)
+
+    B = 4 * max(args.dp * args.ep, 1) * args.microbatches
+    S = 32 * args.sp
+    toks = jax.random.randint(jax.random.key(1), (B, S), 0, cfg.vocab_size)
+    tgts = jnp.roll(toks, -1, axis=1)
+    for i in range(args.steps):
+        params, opt_state, loss = step(params, opt_state, (toks, tgts))
+        print(f"step {i}: loss={float(loss):.4f}")
+    bps.shutdown()
+
+
+if __name__ == "__main__":
+    main()

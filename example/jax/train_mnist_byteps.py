@@ -1,0 +1,63 @@
+"""MNIST MLP with byteps_tpu — the hello-world example.
+
+Counterpart of the reference's per-framework MNIST examples
+(reference: example/pytorch/train_mnist_byteps.py).  Uses a synthetic
+MNIST-shaped dataset so it runs hermetically; swap `synthetic_mnist` for a
+real loader in practice.
+
+Run:  python example/jax/train_mnist_byteps.py [--epochs 3]
+"""
+
+import argparse
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+import byteps_tpu as bps
+from byteps_tpu import models
+
+
+def synthetic_mnist(n=4096, seed=0):
+    rng = np.random.RandomState(seed)
+    x = rng.rand(n, 784).astype(np.float32)
+    w = rng.randn(784, 10).astype(np.float32)
+    y = (x @ w).argmax(-1).astype(np.int32)
+    return jnp.asarray(x), jnp.asarray(y)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--epochs", type=int, default=3)
+    ap.add_argument("--batch-size", type=int, default=256)
+    ap.add_argument("--lr", type=float, default=1e-3)
+    args = ap.parse_args()
+
+    bps.init()
+    mesh = bps.get_mesh()
+    print(f"rank {bps.rank()}/{bps.size()}, devices {jax.device_count()}")
+
+    params = models.init_mlp(jax.random.key(0))
+    params = bps.broadcast_parameters(params)
+
+    opt = bps.DistributedOptimizer(
+        optax.adam(bps.callbacks.scaled_lr(args.lr)))
+    opt_state = opt.init(params)
+    step = bps.build_train_step(models.mlp_loss, opt, mesh)
+
+    x, y = synthetic_mnist()
+    nb = x.shape[0] // args.batch_size
+    for epoch in range(args.epochs):
+        for i in range(nb):
+            sl = slice(i * args.batch_size, (i + 1) * args.batch_size)
+            params, opt_state, loss = step(params, opt_state, (x[sl], y[sl]))
+            bps.mark_step()
+        acc = float(models.mlp.accuracy(params, (x, y)))
+        print(f"epoch {epoch}: loss={float(loss):.4f} acc={acc:.3f}")
+
+    bps.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,77 @@
+"""Cross-barrier driver + callbacks tests."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+import byteps_tpu as bps
+from byteps_tpu import callbacks, models
+
+
+def test_cross_barrier_matches_synchronous(mesh8):
+    params = models.init_mlp(jax.random.key(0), (16, 32, 4))
+    opt = bps.DistributedOptimizer(optax.sgd(0.1))
+    step = bps.build_train_step(models.mlp_loss, opt, mesh8, donate=False)
+    opt_state = opt.init(params)
+    x = jax.random.normal(jax.random.key(1), (32, 16))
+    y = (x.sum(-1) > 0).astype(jnp.int32)
+
+    # synchronous
+    p, s = params, opt_state
+    sync_losses = []
+    for _ in range(6):
+        p, s, loss = step(p, s, (x, y))
+        sync_losses.append(float(loss))
+
+    # cross-barrier
+    drv = bps.CrossBarrierDriver(step, params, opt_state, max_in_flight=3)
+    for _ in range(6):
+        drv.submit((x, y))
+    cb_params, _ = drv.finish()
+    np.testing.assert_allclose(drv.losses(), sync_losses, rtol=1e-6)
+    for a, b in zip(jax.tree.leaves(cb_params), jax.tree.leaves(p)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6)
+
+
+def test_cross_barrier_bounds_in_flight(mesh8):
+    params = models.init_mlp(jax.random.key(0), (8, 8, 2))
+    opt = bps.DistributedOptimizer(optax.sgd(0.1))
+    step = bps.build_train_step(models.mlp_loss, opt, mesh8, donate=False)
+    drv = bps.CrossBarrierDriver(step, params, opt.init(params),
+                                 max_in_flight=2)
+    x = jnp.ones((8, 8))
+    y = jnp.zeros((8,), jnp.int32)
+    for _ in range(5):
+        drv.submit((x, y))
+    assert len(drv._pending) <= 2
+    drv.finish()
+    assert len(drv.losses()) == 5
+    with pytest.raises(ValueError):
+        bps.CrossBarrierDriver(step, params, opt.init(params),
+                               max_in_flight=0)
+
+
+def test_metric_average_callback(bps_initialized):
+    cb = callbacks.MetricAverageCallback()
+    out = cb.on_epoch_end({"loss": 2.0, "acc": 0.5})
+    assert out["loss"] == pytest.approx(2.0)  # world of 1
+    assert out["acc"] == pytest.approx(0.5)
+
+
+def test_warmup_schedule():
+    sched = callbacks.warmup_schedule(1.0, 10)
+    assert float(sched(0)) == pytest.approx(1 / 3)
+    assert float(sched(10)) == pytest.approx(1.0)
+    assert float(sched(100)) == pytest.approx(1.0)
+    after = optax.constant_schedule(0.25)
+    sched2 = callbacks.warmup_schedule(1.0, 10, after)
+    assert float(sched2(11)) == pytest.approx(0.25)
+
+
+def test_broadcast_callback(bps_initialized):
+    cb = callbacks.BroadcastGlobalVariablesCallback(0)
+    state = {"w": jnp.ones(3)}
+    out = cb.on_train_begin(state)
+    np.testing.assert_array_equal(np.asarray(out["w"]), np.ones(3))

@@ -1,0 +1,74 @@
+"""Smoke-run every example script as a subprocess on the CPU mesh
+(the reference treats its examples as de-facto integration tests —
+SURVEY §4 'Benchmarks double as tests')."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+EXAMPLES = os.path.join(os.path.dirname(__file__), "..", "example", "jax")
+
+
+def _run(script, *args, timeout=420):
+    env = dict(os.environ)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
+        "PYTHONPATH": os.path.join(os.path.dirname(__file__), ".."),
+    })
+    r = subprocess.run(
+        [sys.executable, os.path.join(EXAMPLES, script), *args],
+        env=env, capture_output=True, text=True, timeout=timeout)
+    assert r.returncode == 0, f"{script} failed:\n{r.stdout}\n{r.stderr}"
+    return r.stdout
+
+
+def test_mnist_example():
+    out = _run("train_mnist_byteps.py", "--epochs", "1",
+               "--batch-size", "512")
+    assert "acc=" in out
+
+
+def test_benchmark_example_cnn():
+    out = _run("benchmark_byteps.py", "--model", "resnet18",
+               "--batch-size", "8", "--image-size", "32",
+               "--num-iters", "2", "--num-warmup", "1")
+    assert "imgs/sec" in out
+
+
+def test_benchmark_example_transformer():
+    out = _run("benchmark_byteps.py", "--model", "tiny",
+               "--batch-size", "8", "--seq-len", "64",
+               "--num-iters", "2", "--num-warmup", "1")
+    assert "tokens/sec" in out
+
+
+def test_compressed_example():
+    out = _run("train_compressed_byteps.py", "--steps", "6",
+               "--compressor", "onebit", "--ef", "vanilla")
+    assert "ratio~" in out
+
+
+def test_elastic_example():
+    out = _run("elastic_benchmark_byteps.py")
+    assert "phase 2 done after resume" in out
+
+
+def test_hybrid_example():
+    out = _run("train_hybrid_parallel.py", "--pp", "2", "--dp", "2",
+               "--tp", "2", "--layers", "2", "--d-model", "32",
+               "--steps", "2")
+    assert "step 1:" in out
+
+
+def test_long_context_example():
+    out = _run("train_long_context.py", "--sp", "8", "--seq-len", "256",
+               "--steps", "2")
+    assert "step 1:" in out
+
+
+def test_cross_barrier_example():
+    out = _run("benchmark_cross_barrier_byteps.py")
+    assert "cross-barrier:" in out

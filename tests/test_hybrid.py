@@ -1,0 +1,110 @@
+"""Hybrid-parallel (dp x tp x sp x pp x ep) train step tests.
+
+The decisive check: the SAME model trained on the SAME global batch must
+produce the same loss trajectory on a 1-device mesh and on an 8-device
+mesh under every axis combination — parallelism must be semantics-free.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+import byteps_tpu as bps
+from byteps_tpu.models import hybrid
+
+
+CFG = hybrid.HybridConfig(vocab_size=64, num_layers=4, d_model=16,
+                          num_heads=4, d_ff=32, max_seq_len=32)
+CFG_MOE = hybrid.HybridConfig(vocab_size=64, num_layers=2, d_model=16,
+                              num_heads=4, d_ff=32, max_seq_len=32,
+                              num_experts=4, capacity_factor=8.0)
+
+
+def _run(cfg, mesh_axes, steps=3, num_microbatches=1, seed=0):
+    mesh = bps.make_mesh(**mesh_axes)
+    opt = optax.sgd(0.1)
+    step, init_fn = hybrid.build_hybrid_train_step(
+        cfg, opt, mesh, num_microbatches=num_microbatches)
+    params = init_fn(jax.random.key(seed))
+    opt_state = opt.init(params)
+    rng = jax.random.key(seed + 1)
+    toks = jax.random.randint(rng, (8, 32), 0, cfg.vocab_size, jnp.int32)
+    tgts = jnp.roll(toks, -1, axis=1)
+    losses = []
+    for _ in range(steps):
+        params, opt_state, loss = step(params, opt_state, (toks, tgts))
+        losses.append(float(loss))
+    return losses, params
+
+
+def test_single_device_baseline_trains():
+    losses, _ = _run(CFG, dict(dp=1, devices=jax.devices()[:1]), steps=6)
+    assert losses[-1] < losses[0]
+    assert all(np.isfinite(losses))
+
+
+@pytest.mark.parametrize("axes", [
+    dict(dp=8),
+    dict(dp=2, tp=2, sp=2),
+    dict(tp=4, sp=2),
+    dict(dp=2, tp=4),
+])
+def test_parallel_axes_match_single_device(axes):
+    ref, _ = _run(CFG, dict(dp=1, devices=jax.devices()[:1]))
+    got, _ = _run(CFG, axes)
+    np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-5)
+
+
+@pytest.mark.parametrize("mb", [2, 4])
+def test_pipeline_matches_single_device(mb):
+    ref, _ = _run(CFG, dict(dp=1, devices=jax.devices()[:1]),
+                  num_microbatches=mb)
+    got, _ = _run(CFG, dict(pp=4, dp=2), num_microbatches=mb)
+    np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-5)
+
+
+def test_pipeline_with_tp_and_sp():
+    ref, _ = _run(CFG, dict(dp=1, devices=jax.devices()[:1]),
+                  num_microbatches=2)
+    got, _ = _run(CFG, dict(pp=2, tp=2, sp=2), num_microbatches=2)
+    np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-5)
+
+
+def test_moe_ep_matches_single_device():
+    ref, _ = _run(CFG_MOE, dict(dp=1, devices=jax.devices()[:1]))
+    got, _ = _run(CFG_MOE, dict(ep=4, dp=2))
+    np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-5)
+
+
+def test_moe_with_tp():
+    ref, _ = _run(CFG_MOE, dict(dp=1, devices=jax.devices()[:1]))
+    got, _ = _run(CFG_MOE, dict(ep=2, tp=2, dp=2))
+    np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-5)
+
+
+def test_all_five_axes_together():
+    """pp=2 x dp=2 x tp=2 on 8 devices with ep/sp present (size 1) — the
+    full composition compiles and trains."""
+    losses, _ = _run(CFG, dict(pp=2, dp=2, tp=2), steps=4,
+                     num_microbatches=2)
+    assert losses[-1] < losses[0]
+
+
+def test_moe_aux_loss_gives_gate_gradient():
+    """With aux_loss_weight > 0 the router receives a load-balancing
+    gradient (Switch-transformer training signal)."""
+    import dataclasses
+    cfg = dataclasses.replace(CFG_MOE, aux_loss_weight=0.01)
+    mesh = bps.make_mesh(ep=4, dp=2)
+    opt = optax.sgd(0.1)
+    step, init_fn = hybrid.build_hybrid_train_step(cfg, opt, mesh)
+    params = init_fn(jax.random.key(0))
+    opt_state = opt.init(params)
+    toks = jax.random.randint(jax.random.key(1), (8, 32), 0, 64, jnp.int32)
+    before = np.asarray(params["layers"]["gate_w"])
+    params, _, loss = step(params, opt_state, (toks, jnp.roll(toks, -1, 1)))
+    assert np.isfinite(float(loss))
+    after = np.asarray(params["layers"]["gate_w"])
+    assert not np.allclose(before, after)

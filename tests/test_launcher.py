@@ -1,0 +1,70 @@
+"""Launcher tests: role dispatch, env construction, ssh command plans."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+from byteps_tpu.launcher import launch as L
+from byteps_tpu.launcher import dist_launcher as DL
+
+
+def test_worker_env_defaults():
+    env = L.build_worker_env({"DMLC_NUM_WORKER": "4"})
+    assert env["BYTEPS_LOCAL_RANK"] == "0"
+    assert env["BYTEPS_TPU_JAX_DIST"] == "1"
+    env1 = L.build_worker_env({"DMLC_NUM_WORKER": "1"})
+    assert "BYTEPS_TPU_JAX_DIST" not in env1
+
+
+def test_worker_command_gdb_wrap():
+    assert L.worker_command(["python", "t.py"], {"BYTEPS_ENABLE_GDB": "1"})[0] \
+        == "gdb"
+    assert L.worker_command(["python", "t.py"], {}) == ["python", "t.py"]
+
+
+def test_launch_worker_role_runs_command(tmp_path):
+    out = tmp_path / "out.txt"
+    env = dict(os.environ)
+    env["DMLC_ROLE"] = "worker"
+    rc = subprocess.call(
+        [sys.executable, "-m", "byteps_tpu.launcher.launch",
+         sys.executable, "-c",
+         f"open(r'{out}', 'w').write('ran')"],
+        env=env)
+    assert rc == 0
+    assert out.read_text() == "ran"
+
+
+def test_launch_no_command_fails():
+    env = dict(os.environ)
+    env["DMLC_ROLE"] = "worker"
+    rc = subprocess.call(
+        [sys.executable, "-m", "byteps_tpu.launcher.launch"], env=env)
+    assert rc == 2
+
+
+def test_dist_launcher_plan(tmp_path):
+    wf = tmp_path / "workers.txt"
+    sf = tmp_path / "servers.txt"
+    wf.write_text("w0\nw1\n")
+    sf.write_text("s0\n")
+    args = DL.parse_args([
+        "--num-workers", "2", "--num-servers", "1",
+        "--worker-hostfile", str(wf), "--server-hostfile", str(sf),
+        "--log-dir", str(tmp_path / "logs"),
+        "python", "train.py", "--lr", "0.1"])
+    cmds = DL.launch(args, dry_run=True)
+    # scheduler + 1 server + 2 workers
+    assert len(cmds) == 4
+    joined = [" ".join(c) for c in cmds]
+    assert any("DMLC_ROLE=scheduler" in c and "s0" in c for c in joined)
+    assert any("DMLC_ROLE=server" in c for c in joined)
+    assert sum("DMLC_ROLE=worker" in c for c in joined) == 2
+    # worker carries its id and the training command
+    w = [c for c in joined if "DMLC_ROLE=worker" in c]
+    assert any("DMLC_WORKER_ID=0" in c for c in w)
+    assert any("DMLC_WORKER_ID=1" in c for c in w)
+    assert all("python train.py --lr 0.1" in c for c in w)
+    assert all("DMLC_PS_ROOT_URI=s0" in c for c in joined)

@@ -46,6 +46,10 @@ def ps_server():
             "BYTEPS_ENABLE_ASYNC": "1" if async_mode else "0",
             "JAX_PLATFORMS": "cpu",
         })
+        if env.get("BYTEPS_TPU_TSAN") == "1":
+            # Make any detected race fatal: the server dies mid-test and the
+            # functional assertions fail, so TSAN findings fail CI.
+            env["TSAN_OPTIONS"] = "halt_on_error=1"
         proc = subprocess.Popen(
             [sys.executable, "-m", "byteps_tpu.server"], env=env,
             stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)

@@ -1,0 +1,84 @@
+"""Torch plugin tests (single-worker semantics; the communication layer
+itself is covered by the API/PS tests)."""
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+import byteps_tpu.torch as bps_torch  # noqa: E402
+
+
+@pytest.fixture
+def initialized():
+    bps_torch.init()
+    yield
+    bps_torch.shutdown()
+
+
+def test_push_pull_inplace(initialized):
+    t = torch.arange(6, dtype=torch.float32)
+    out = bps_torch.push_pull(t, average=True, name="t0")
+    assert out is t  # in-place semantics, like the reference
+    np.testing.assert_allclose(t.numpy(), np.arange(6, dtype=np.float32))
+
+
+def test_async_handles(initialized):
+    t = torch.ones(4)
+    h = bps_torch.push_pull_async(t, name="t1")
+    assert bps_torch.poll(h) in (True, False)
+    bps_torch.synchronize(h)
+    np.testing.assert_allclose(t.numpy(), np.ones(4))
+    with pytest.raises(Exception):
+        bps_torch.synchronize(h)  # double synchronize
+
+
+def test_distributed_optimizer_matches_plain(initialized):
+    torch.manual_seed(0)
+    m1 = torch.nn.Linear(8, 4)
+    m2 = torch.nn.Linear(8, 4)
+    m2.load_state_dict(m1.state_dict())
+    o1 = torch.optim.SGD(m1.parameters(), lr=0.1)
+    o2 = bps_torch.DistributedOptimizer(
+        torch.optim.SGD(m2.parameters(), lr=0.1),
+        named_parameters=m2.named_parameters())
+    x = torch.randn(16, 8)
+    y = torch.randn(16, 4)
+    for _ in range(3):
+        for m, o in ((m1, o1), (m2, o2)):
+            o.zero_grad()
+            loss = ((m(x) - y) ** 2).mean()
+            loss.backward()
+            o.step()
+    for p1, p2 in zip(m1.parameters(), m2.parameters()):
+        np.testing.assert_allclose(p1.detach().numpy(),
+                                   p2.detach().numpy(), rtol=1e-5)
+
+
+def test_broadcast_parameters(initialized):
+    m = torch.nn.Linear(4, 2)
+    before = {k: v.clone() for k, v in m.state_dict().items()}
+    bps_torch.broadcast_parameters(m.state_dict())
+    for k, v in m.state_dict().items():
+        np.testing.assert_allclose(v.numpy(), before[k].numpy())
+
+
+def test_broadcast_optimizer_state(initialized):
+    m = torch.nn.Linear(4, 2)
+    o = torch.optim.Adam(m.parameters(), lr=1e-3)
+    loss = m(torch.randn(3, 4)).sum()
+    loss.backward()
+    o.step()
+    bps_torch.broadcast_optimizer_state(o)
+    # state survives the round-trip
+    st = o.state_dict()["state"]
+    assert any("exp_avg" in s for s in st.values())
+
+
+def test_ddp_wrapper(initialized):
+    m = bps_torch.DistributedDataParallel(torch.nn.Linear(4, 2))
+    out = m(torch.randn(3, 4))
+    out.sum().backward()
+    m.synchronize()
+    for p in m.module.parameters():
+        assert p.grad is not None

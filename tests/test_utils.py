@@ -1,0 +1,50 @@
+"""utils package tests: checkpoint round-trip, prefetch, sharding."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import byteps_tpu as bps
+from byteps_tpu.utils import checkpoint as ckpt
+from byteps_tpu.utils import data as D
+
+
+def test_checkpoint_roundtrip(tmp_path, bps_initialized):
+    state = {"params": [{"w": jnp.arange(6.0).reshape(2, 3),
+                         "b": jnp.zeros(3)}],
+             "step": jnp.asarray(7)}
+    path = str(tmp_path / "ckpt")
+    ckpt.save(path, state)
+    restored = ckpt.restore(path, template=state)
+    assert jax.tree.structure(restored) == jax.tree.structure(state)
+    for a, b in zip(jax.tree.leaves(restored), jax.tree.leaves(state)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_latest_step_dir(tmp_path):
+    assert ckpt.latest_step_dir(str(tmp_path)) is None
+    for s in (10, 2, 300):
+        (tmp_path / str(s)).mkdir()
+    assert ckpt.latest_step_dir(str(tmp_path)).endswith("300")
+
+
+def test_shard_batch(mesh8):
+    x = jnp.arange(64.0).reshape(16, 4)
+    out = D.shard_batch({"x": x}, mesh8)
+    assert out["x"].sharding.spec == jax.sharding.PartitionSpec("dp")
+    np.testing.assert_array_equal(np.asarray(out["x"]), np.asarray(x))
+
+
+def test_prefetch_preserves_order(mesh8):
+    batches = [{"x": jnp.full((8, 2), float(i))} for i in range(5)]
+    out = list(D.prefetch_to_device(batches, size=2, mesh=mesh8))
+    assert len(out) == 5
+    for i, b in enumerate(out):
+        np.testing.assert_array_equal(np.asarray(b["x"]),
+                                      np.full((8, 2), float(i)))
+
+
+def test_synthetic_batches():
+    it = D.synthetic_batches(lambda i: i * 2, n=3)
+    assert list(it) == [0, 2, 4]
