@@ -15,11 +15,12 @@ from .version import __version__
 from .common.api import (
     init, shutdown, suspend, resume,
     rank, size, local_rank, local_size,
-    declare, declared_key,
+    declare, declared_key, register_compressor, get_ps_session,
     push_pull, push_pull_async, synchronize, poll,
     broadcast_parameters, broadcast_optimizer_state,
     get_pushpull_speed, mark_step, current_step,
 )
+from .parallel.async_ps import AsyncPSTrainer
 from .ops.compression import Compression
 from .ops import collectives
 from .parallel.data_parallel import (
@@ -51,8 +52,8 @@ __all__ = [
     "__version__",
     "init", "shutdown", "suspend", "resume",
     "rank", "size", "local_rank", "local_size",
-    "declare", "declared_key",
-    "push_pull", "push_pull_async", "synchronize", "poll",
+    "declare", "declared_key", "register_compressor", "get_ps_session",
+    "push_pull", "push_pull_async", "synchronize", "poll", "AsyncPSTrainer",
     "broadcast_parameters", "broadcast_optimizer_state",
     "get_pushpull_speed", "mark_step", "current_step",
     "Compression", "collectives",

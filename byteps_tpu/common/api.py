@@ -184,6 +184,29 @@ def declared_key(name: str) -> int:
     return get_core().get_declared_key(name)
 
 
+def register_compressor(name: str, kwargs: dict) -> int:
+    """Register inter-node compression for a named tensor's PS traffic.
+
+    The kwargs use the same strings as the reference registry
+    ({"compressor": "onebit", ...}; reference: mxnet/__init__.py:236-317)
+    and are shipped to the server at the tensor's INIT so it can
+    decompress-sum(-recompress) (reference: operations.cc:396-408).
+    Returns the declared key.  No-op outside PS mode: the collective plane
+    configures compression via DistributedOptimizer instead.
+    """
+    _require_init()
+    dk = declare(name)
+    if _state.ps_session is not None:
+        _state.ps_session.register_compressor(dk, kwargs)
+    return dk
+
+
+def get_ps_session():
+    """The live PS-mode session, or None (collective mode).  Used by
+    AsyncPSTrainer and power users driving the KV tier directly."""
+    return _state.ps_session
+
+
 # ---------------------------------------------------------------------------
 # Eager push_pull (reference: torch/ops.py:157-236)
 # ---------------------------------------------------------------------------
@@ -221,14 +244,26 @@ def push_pull_async(tensor: jax.Array, name: Optional[str] = None,
     t0 = core.trace_now_us()
     wire, ctx = compression.compress(tensor)
     if _state.ps_session is not None:
-        out = _state.ps_session.push_pull(dk, wire, priority=priority)
-    elif size() > 1:
-        out = _eager_sum_across_processes(wire)
+        # True async: partitions go through the session's priority-scheduled
+        # dispatcher; the handle resolves on the last partition's pull.
+        ps_handle = _state.ps_session.push_pull_async(
+            dk, wire, priority=priority)
+
+        def _resolve(ph=ps_handle, comp=compression, cctx=ctx, avg=average):
+            out = jnp.asarray(ph.wait())
+            out = comp.decompress(out, cctx)
+            return out / size() if avg else out
+
+        _resolve.ps_handle = ps_handle
+        out = _resolve
     else:
-        out = wire  # sum over a single worker
-    out = compression.decompress(out, ctx)
-    if average:
-        out = out / size()
+        if size() > 1:
+            out = _eager_sum_across_processes(wire)
+        else:
+            out = wire  # sum over a single worker
+        out = compression.decompress(out, ctx)
+        if average:
+            out = out / size()
     cfg = _state.config or get_config()
     if cfg.telemetry_on:
         core.telemetry_record(tensor.size * tensor.dtype.itemsize)
@@ -246,6 +281,8 @@ def synchronize(handle: int) -> jax.Array:
             raise ValueError(
                 f"unknown or already-synchronized handle {handle}")
         out, name, t0 = _state.handles.pop(handle)
+    if callable(out):  # PS-mode deferred result
+        out = out()
     out = jax.block_until_ready(out)
     core = get_core()
     core.handle_mark_done(handle)
@@ -267,9 +304,13 @@ def poll(handle: int) -> bool:
             raise ValueError(
                 f"unknown or already-synchronized handle {handle}")
         return status == 1
+    out = entry[0]
+    if callable(out):  # PS-mode: completed when the last partition pulled
+        ph = getattr(out, "ps_handle", None)
+        return ph.done() if ph is not None else True
     try:
         # Committed when the underlying buffer is ready.
-        return entry[0].is_ready() if hasattr(entry[0], "is_ready") else True
+        return out.is_ready() if hasattr(out, "is_ready") else True
     except Exception:
         return True
 

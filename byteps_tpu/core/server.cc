@@ -10,9 +10,23 @@
 // PS-mode parity: CPU-host-assisted aggregation, async training, elastic
 // scenarios), and CUDA/NUMA specifics are dropped.
 //
-// Request : u8 cmd | u8 dtype | u16 flags | u32 worker_id | u64 key | u64 len | payload[len]
-// Response: u8 status | u64 key | u64 len | payload[len]
+// Request : u8 cmd | u8 dtype | u16 flags | u32 req_id | u32 worker_id
+//           | u64 key | u64 len | payload[len]
+// Response: u8 status | u32 req_id | u64 key | u64 len | payload[len]
 // cmds: 0 HELLO, 1 INIT, 2 PUSH, 3 PULL, 4 BARRIER, 5 SHUTDOWN, 6 PING
+//
+// req_id is client-chosen and echoed back, so one connection multiplexes
+// many outstanding requests — the redesign of ps-lite's ZPush/ZPull
+// completion callbacks (reference: core_loops.cc:536-616) that lets a
+// worker pipeline per-partition pushes/pulls concurrently.
+//
+// INIT payload: u64 declared_len | u32 kwargs_len | kwargs_utf8.  The
+// kwargs string registers a server-side compressor for the key — the
+// analog of the reference's kCompressedPushPull init push
+// (reference: operations.cc:396-408, server.cc:232-261).  The INIT
+// response returns u64 completed_round so a reconnecting worker (crash
+// restart / elastic rejoin) seeds its round counter from server state
+// instead of 0 and cannot be served a stale previous-round pull.
 //
 // Threading model (mirrors the reference):
 //   - acceptor thread + one reader thread per connection (parse & enqueue)
@@ -29,6 +43,7 @@
 #include <unistd.h>
 
 #include <atomic>
+#include <cmath>
 #include <cstdlib>
 #include <condition_variable>
 #include <cstdint>
@@ -49,18 +64,157 @@ enum Cmd : uint8_t {
   kShutdown = 5, kPing = 6,
 };
 enum Status : uint8_t { kOk = 0, kError = 1 };
+enum WireDtype : uint8_t {
+  kF32 = 0,        // summed across workers
+  kRaw = 1,        // last-write-wins bytes
+  kCompressed = 2, // decompress-sum (recompress on pull if bidirectional)
+  kSeed = 3,       // raw write applied ONLY if the key has never been
+                   // pushed — idempotent store seeding that cannot reset a
+                   // live training run when a worker joins late / rejoins
+};
+
+// ---------------------------------------------------------------------------
+// Compressed-payload codec — the server side of the reference's
+// decompress-sum-recompress engine (reference: server/server.cc:86-207,
+// compressor/impl/*).  Wire layout (little-endian), chosen to match the
+// worker-side numpy/JAX compressors bit-for-bit:
+//   u8 comp_id | u32 n_elems | body
+//   onebit(1):    f32 scale | u8 bits[ceil(n/8)]        (LSB-first, 1 = neg)
+//   topk(2):      u32 k | i32 idx[k] | f32 val[k]
+//   randomk(3):   u32 k | i32 idx[k] | f32 val[k]
+//   dithering(4): u8 flags(bit0=natural) | u8 s | f32 norm
+//                 | u8 level[n] | u8 signs[ceil(n/8)]
+// ---------------------------------------------------------------------------
+namespace codec {
+
+enum CompId : uint8_t {
+  kNone = 0, kOnebit = 1, kTopk = 2, kRandomk = 3, kDithering = 4
+};
+
+struct Reader {
+  const char* p;
+  size_t left;
+  bool Take(void* dst, size_t n) {
+    if (n > left) return false;
+    std::memcpy(dst, p, n);
+    p += n;
+    left -= n;
+    return true;
+  }
+};
+
+// Decompress `payload` into n*4 bytes of f32 at `out`. Returns false on a
+// malformed payload (bad sizes / out-of-range indices).
+inline bool Decompress(const std::vector<char>& payload,
+                       std::vector<char>* out) {
+  Reader r{payload.data(), payload.size()};
+  uint8_t comp = 0;
+  uint32_t n = 0;
+  if (!r.Take(&comp, 1) || !r.Take(&n, 4)) return false;
+  out->assign(static_cast<size_t>(n) * 4, 0);
+  float* dst = reinterpret_cast<float*>(out->data());
+  switch (comp) {
+    case kOnebit: {
+      float scale = 0;
+      if (!r.Take(&scale, 4)) return false;
+      size_t nbytes = (n + 7) / 8;
+      if (r.left < nbytes) return false;
+      const unsigned char* bits =
+          reinterpret_cast<const unsigned char*>(r.p);
+      for (uint32_t i = 0; i < n; ++i) {
+        int bit = (bits[i >> 3] >> (i & 7)) & 1;
+        dst[i] = bit ? -scale : scale;
+      }
+      return true;
+    }
+    case kTopk:
+    case kRandomk: {
+      uint32_t k = 0;
+      if (!r.Take(&k, 4)) return false;
+      if (r.left < static_cast<size_t>(k) * 8) return false;
+      // The payload starts at an odd header offset; memcpy keeps the
+      // 4-byte loads aligned (UB otherwise, same pattern as Reader::Take).
+      std::vector<int32_t> idx(k);
+      std::vector<float> val(k);
+      std::memcpy(idx.data(), r.p, static_cast<size_t>(k) * 4);
+      std::memcpy(val.data(), r.p + static_cast<size_t>(k) * 4,
+                  static_cast<size_t>(k) * 4);
+      for (uint32_t i = 0; i < k; ++i) {
+        if (idx[i] < 0 || static_cast<uint32_t>(idx[i]) >= n) return false;
+        dst[idx[i]] += val[i];  // scatter-add (randomk may collide)
+      }
+      return true;
+    }
+    case kDithering: {
+      uint8_t flags = 0, s = 0;
+      float norm = 0;
+      if (!r.Take(&flags, 1) || !r.Take(&s, 1) || !r.Take(&norm, 4))
+        return false;
+      if (s == 0) return false;
+      size_t signbytes = (n + 7) / 8;
+      if (r.left < n + signbytes) return false;
+      const unsigned char* level =
+          reinterpret_cast<const unsigned char*>(r.p);
+      const unsigned char* signs = level + n;
+      bool natural = (flags & 1) != 0;
+      for (uint32_t i = 0; i < n; ++i) {
+        int j = level[i];
+        float mag;
+        if (natural)
+          mag = j == 0 ? 0.0f
+                       : std::pow(2.0f, static_cast<float>(j - s));
+        else
+          mag = static_cast<float>(j) / static_cast<float>(s);
+        int bit = (signs[i >> 3] >> (i & 7)) & 1;
+        dst[i] = (bit ? -1.0f : 1.0f) * mag * norm;
+      }
+      return true;
+    }
+    default:
+      return false;
+  }
+}
+
+// Re-compress the merged f32 buffer with onebit — the bidirectional pull
+// leg (reference: impl/onebit.cc:34-66; server re-compresses merged grads).
+inline void CompressOnebit(const std::vector<char>& store, bool scaled,
+                           std::vector<char>* out) {
+  size_t n = store.size() / 4;
+  const float* x = reinterpret_cast<const float*>(store.data());
+  size_t nbytes = (n + 7) / 8;
+  out->assign(1 + 4 + 4 + nbytes, 0);
+  char* p = out->data();
+  p[0] = static_cast<char>(kOnebit);
+  uint32_t n32 = static_cast<uint32_t>(n);
+  std::memcpy(p + 1, &n32, 4);
+  float scale = 1.0f;
+  if (scaled && n > 0) {
+    double acc = 0;
+    for (size_t i = 0; i < n; ++i) acc += std::fabs(x[i]);
+    scale = static_cast<float>(acc / static_cast<double>(n));
+  }
+  std::memcpy(p + 5, &scale, 4);
+  unsigned char* bits = reinterpret_cast<unsigned char*>(p + 9);
+  for (size_t i = 0; i < n; ++i)
+    if (x[i] < 0.0f) bits[i >> 3] |= static_cast<unsigned char>(1u << (i & 7));
+}
+
+}  // namespace codec
 
 #pragma pack(push, 1)
 struct ReqHeader {
   uint8_t cmd;
-  uint8_t dtype;   // 0 = f32 (summed); 1 = raw bytes (last-write-wins)
+  uint8_t dtype;   // 0 = f32 (summed); 1 = raw bytes (last-write-wins);
+                   // 2 = compressed (decompress-sum, recompress on pull)
   uint16_t flags;
+  uint32_t req_id;
   uint32_t worker_id;
   uint64_t key;
   uint64_t len;
 };
 struct RespHeader {
   uint8_t status;
+  uint32_t req_id;
   uint64_t key;
   uint64_t len;
 };
@@ -73,6 +227,7 @@ struct Conn {
 
 struct PendingPull {
   Conn* conn;
+  uint32_t req_id = 0;
   uint64_t key;
   uint16_t want_round = 0;  // pull round (mod 2^16) the worker expects
 };
@@ -80,7 +235,7 @@ struct PendingPull {
 // Per-key merge state — the reference's BytePSArray + update buffers
 // (reference: server.h "UpdateBuf", server.cc:48-84).
 struct KeyState {
-  std::vector<char> store;     // in-progress merge buffer
+  std::vector<char> store;     // in-progress merge buffer (f32 elements)
   std::vector<char> out;       // last completed round (served to pulls) —
                                // the reference's store_/update_buf split
                                // (reference: server.cc:48-84) that keeps a
@@ -90,6 +245,10 @@ struct KeyState {
                                // reference: server.cc:150-177 seen_sender)
   uint64_t completed_round = 0;
   uint8_t dtype = 0;
+  std::string kwargs;          // compressor registration (INIT payload)
+  bool bidirectional = false;  // recompress merged buffer on the pull leg
+  bool onebit_scaled = true;
+  bool round_compressed = false;  // any push this round arrived compressed
   std::vector<PendingPull> pending;
   std::atomic<uint64_t> push_count{0};  // total pushes (schedule priority);
                                         // atomic: written by engine, read
@@ -100,6 +259,7 @@ struct Task {
   uint8_t cmd;
   uint8_t dtype;
   uint16_t flags;
+  uint32_t req_id;
   uint32_t worker_id;
   uint64_t key;
   std::vector<char> payload;
@@ -224,10 +384,10 @@ class Server {
     return true;
   }
 
-  static void Respond(Conn* c, uint8_t status, uint64_t key,
+  static void Respond(Conn* c, uint8_t status, uint32_t req_id, uint64_t key,
                       const char* data, uint64_t len) {
     std::lock_guard<std::mutex> lk(c->write_mu);
-    RespHeader h{status, key, len};
+    RespHeader h{status, req_id, key, len};
     if (!WriteFull(c->fd, &h, sizeof(h))) return;
     if (len) WriteFull(c->fd, data, len);
   }
@@ -252,15 +412,23 @@ class Server {
       std::vector<char> payload(h.len);
       if (h.len && !ReadFull(conn->fd, payload.data(), h.len)) break;
       switch (h.cmd) {
-        case kHello:
+        case kHello: {
+          // HELLO advertises server mode: u8 async | u8 schedule.  Lets
+          // clients fail fast on mode mismatches (e.g. weight-delta async
+          // training against a sync server would silently train on deltas).
+          char mode[2] = {static_cast<char>(async_ ? 1 : 0),
+                          static_cast<char>(schedule_ ? 1 : 0)};
+          Respond(conn, kOk, h.req_id, h.key, mode, 2);
+          break;
+        }
         case kPing:
-          Respond(conn, kOk, h.key, nullptr, 0);
+          Respond(conn, kOk, h.req_id, h.key, nullptr, 0);
           break;
         case kBarrier:
-          HandleBarrier(conn, h.key);
+          HandleBarrier(conn, h.req_id, h.key);
           break;
         case kShutdown:
-          Respond(conn, kOk, h.key, nullptr, 0);
+          Respond(conn, kOk, h.req_id, h.key, nullptr, 0);
           shutdown_.store(true);
           // Unblock accept().
           { int s = socket(AF_INET, SOCK_STREAM, 0);
@@ -276,6 +444,7 @@ class Server {
           t.cmd = h.cmd;
           t.dtype = h.dtype;
           t.flags = h.flags;
+          t.req_id = h.req_id;
           t.worker_id = h.worker_id;
           t.key = h.key;
           t.payload = std::move(payload);
@@ -294,16 +463,22 @@ class Server {
     }
   }
 
-  void HandleBarrier(Conn* conn, uint64_t gen) {
+  void HandleBarrier(Conn* conn, uint32_t req_id, uint64_t gen) {
+    // Waiters are grouped by generation so overlapping barriers (or a late
+    // worker from generation g arriving amid generation g+1 waiters) can
+    // never release a mixed group early.
     std::vector<PendingPull> to_release;
     {
       std::lock_guard<std::mutex> lk(barrier_mu_);
-      barrier_waiters_.push_back({conn, gen});
-      if (static_cast<int>(barrier_waiters_.size()) >= num_workers_) {
-        to_release.swap(barrier_waiters_);
+      auto& group = barrier_waiters_[gen];
+      group.push_back({conn, req_id, gen});
+      if (static_cast<int>(group.size()) >= num_workers_) {
+        to_release.swap(group);
+        barrier_waiters_.erase(gen);
       }
     }
-    for (auto& w : to_release) Respond(w.conn, kOk, w.key, nullptr, 0);
+    for (auto& w : to_release)
+      Respond(w.conn, kOk, w.req_id, w.key, nullptr, 0);
   }
 
   void EngineLoop(int idx) {
@@ -313,7 +488,7 @@ class Server {
         case kInit: HandleInit(t); break;
         case kPush: HandlePush(t); break;
         case kPull: HandlePull(t); break;
-        default: Respond(t.conn, kError, t.key, nullptr, 0);
+        default: Respond(t.conn, kError, t.req_id, t.key, nullptr, 0);
       }
     }
   }
@@ -326,55 +501,122 @@ class Server {
   void HandleInit(Task& t) {
     // Init allocates the merged store; like the reference's init push it is
     // idempotent and sized by the declared length (reference:
-    // server.cc:270-298).
+    // server.cc:270-298).  Payload: u64 declared_len | u32 kwargs_len |
+    // kwargs (compressor registration, reference: server.cc:232-261).
+    // Responds with u64 completed_round so reconnecting workers re-seed
+    // their round counters from server state.
     KeyState& ks = StateFor(t.key);
-    uint64_t n = t.payload.size() >= 8
-        ? *reinterpret_cast<const uint64_t*>(t.payload.data()) : 0;
-    if (ks.store.size() != n) ks.store.assign(n, 0);
+    uint64_t n = 0;
+    if (t.payload.size() >= 8)
+      std::memcpy(&n, t.payload.data(), 8);
+    if (t.payload.size() >= 12) {
+      uint32_t klen = 0;
+      std::memcpy(&klen, t.payload.data() + 8, 4);
+      if (t.payload.size() >= 12 + klen) {
+        ks.kwargs.assign(t.payload.data() + 12, klen);
+        // "k=v,k=v" kwargs, same strings the reference ships in its
+        // kCompressedPushPull init (reference: server.cc:232-261).
+        ks.bidirectional =
+            ks.kwargs.find("compressor=onebit") != std::string::npos;
+        ks.onebit_scaled =
+            ks.kwargs.find("onebit_scaling=0") == std::string::npos;
+      }
+    }
+    if (ks.store.size() != n) {
+      ks.store.assign(n, 0);
+      ks.seen.clear();
+    }
     ks.dtype = t.dtype;
-    Respond(t.conn, kOk, t.key, nullptr, 0);
+    uint64_t round = ks.completed_round;
+    Respond(t.conn, kOk, t.req_id, t.key,
+            reinterpret_cast<const char*>(&round), sizeof(round));
   }
 
   void HandlePush(Task& t) {
     KeyState& ks = StateFor(t.key);
-    if (ks.store.size() != t.payload.size())
-      ks.store.assign(t.payload.size(), 0);
-    ks.dtype = t.dtype;
+    if (t.dtype == kSeed) {
+      // Store seeding for async weight-delta training: applied only if the
+      // key has never been pushed, so a late-joining/rejoining worker
+      // adopts the live global weights instead of resetting them.
+      // Meaningless under sync rounds — reject there (fail fast beats a
+      // silent round-counter desync).
+      if (!async_) {
+        Respond(t.conn, kError, t.req_id, t.key, nullptr, 0);
+        return;
+      }
+      bool first = ks.push_count.load(std::memory_order_relaxed) == 0;
+      ks.push_count.fetch_add(1, std::memory_order_relaxed);
+      if (first) {
+        ks.store = t.payload;
+        ks.dtype = kF32;
+      }
+      ks.out = ks.store;
+      Respond(t.conn, kOk, t.req_id, t.key, nullptr, 0);
+      FlushPulls(ks, t.key);
+      return;
+    }
+    // Compressed pushes are expanded to f32 before the merge — the
+    // reference server's decompress-sum engine (server.cc:86-207).
+    std::vector<char> scratch;
+    const std::vector<char>* data = &t.payload;
+    if (t.dtype == kCompressed) {
+      if (!codec::Decompress(t.payload, &scratch)) {
+        Respond(t.conn, kError, t.req_id, t.key, nullptr, 0);
+        return;
+      }
+      data = &scratch;
+      ks.round_compressed = true;
+    }
+    if (ks.store.size() != data->size()) {
+      // Size changed mid-stream (re-declared tensor / missing INIT): restart
+      // the merge consistently — clearing `seen` too, so earlier workers'
+      // contributions are never silently discarded while the round counter
+      // still advances on a wrong sum.
+      ks.store.assign(data->size(), 0);
+      ks.seen.clear();
+    }
+    ks.dtype = t.dtype == kCompressed ? kF32 : t.dtype;
     ks.push_count.fetch_add(1, std::memory_order_relaxed);
     if (async_) {
       // Async PS mode: store += payload immediately, no round tracking
       // (reference: server.cc:319-323, BYTEPS_ENABLE_ASYNC).
-      SumInto(ks, t.payload);
+      SumInto(ks, *data);
       ks.out = ks.store;
-      Respond(t.conn, kOk, t.key, nullptr, 0);
+      Respond(t.conn, kOk, t.req_id, t.key, nullptr, 0);
       FlushPulls(ks, t.key);
       return;
     }
     if (ks.seen.count(t.worker_id)) {
       // Duplicate within a round — ignore merge, still ack (reference dedups
       // by seen_sender, server.cc:150-177).
-      Respond(t.conn, kOk, t.key, nullptr, 0);
+      Respond(t.conn, kOk, t.req_id, t.key, nullptr, 0);
       return;
     }
     if (ks.seen.empty()) {
       // COPY_FIRST (reference: server.cc:299-379)
-      std::memcpy(ks.store.data(), t.payload.data(), t.payload.size());
+      std::memcpy(ks.store.data(), data->data(), data->size());
     } else {
-      SumInto(ks, t.payload);  // SUM_RECV
+      SumInto(ks, *data);  // SUM_RECV
     }
     ks.seen.insert(t.worker_id);
-    Respond(t.conn, kOk, t.key, nullptr, 0);
+    Respond(t.conn, kOk, t.req_id, t.key, nullptr, 0);
     if (static_cast<int>(ks.seen.size()) >= num_workers_) {
       // ALL_RECV: publish the completed round and start a fresh merge.
-      ks.out = ks.store;
+      // Bidirectional compressors re-compress the merged buffer for the
+      // pull leg (reference: impl/onebit bidirectional, server engine).
+      if (ks.round_compressed && ks.bidirectional)
+        codec::CompressOnebit(ks.store, ks.onebit_scaled, &ks.out);
+      else
+        ks.out = ks.store;
       ks.completed_round++;
       ks.seen.clear();
+      ks.round_compressed = false;
       FlushPulls(ks, t.key);
     }
   }
 
   void SumInto(KeyState& ks, const std::vector<char>& payload) {
-    if (ks.dtype == 0) {
+    if (ks.dtype == kF32) {
       auto* dst = reinterpret_cast<float*>(ks.store.data());
       auto* src = reinterpret_cast<const float*>(payload.data());
       size_t n = payload.size() / sizeof(float);
@@ -392,9 +634,9 @@ class Server {
     bool ready = async_ ||
         (ks.completed_round & 0xFFFF) != t.flags;
     if (ready) {
-      Respond(t.conn, kOk, t.key, ks.out.data(), ks.out.size());
+      Respond(t.conn, kOk, t.req_id, t.key, ks.out.data(), ks.out.size());
     } else {
-      ks.pending.push_back({t.conn, t.key, t.flags});
+      ks.pending.push_back({t.conn, t.req_id, t.key, t.flags});
     }
   }
 
@@ -402,7 +644,7 @@ class Server {
     std::vector<PendingPull> still;
     for (auto& p : ks.pending) {
       if (async_ || (ks.completed_round & 0xFFFF) != p.want_round)
-        Respond(p.conn, kOk, key, ks.out.data(), ks.out.size());
+        Respond(p.conn, kOk, p.req_id, key, ks.out.data(), ks.out.size());
       else
         still.push_back(p);
     }
@@ -428,7 +670,7 @@ class Server {
   std::map<uint64_t, KeyState> store_;
 
   std::mutex barrier_mu_;
-  std::vector<PendingPull> barrier_waiters_;
+  std::map<uint64_t, std::vector<PendingPull>> barrier_waiters_;
 
   std::mutex conns_mu_;
   std::vector<Conn*> conns_;

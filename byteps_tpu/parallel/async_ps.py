@@ -1,0 +1,96 @@
+"""Asynchronous PS training: workers push weight deltas, no round barrier.
+
+The reference's BYTEPS_ENABLE_ASYNC mode (reference: torch/__init__.py
+step() under `_enable_async` at 186-214, server.cc:319-323): each worker
+runs its local optimizer step, pushes the resulting weight *delta*
+(w_new - w_old), and the server applies `store += delta` immediately —
+no synchronization across workers.  The pull returns the server's current
+global weights, which replace the worker's local params.  Convergence is
+the classic async-SGD contract: workers may compute on slightly stale
+weights.
+
+TPU-native shape: the functional equivalent of the reference's in-place
+`p.data.sub_(old); push_pull(p)` is an explicit trainer object that flattens
+the param pytree once, tracks the last pulled global weights, and exposes
+one `step(updated_params)` call.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import numpy as np
+
+PyTree = Any
+
+
+class AsyncPSTrainer:
+    """Weight-delta async training against an async-mode PS server tier.
+
+    Usage (server must run with BYTEPS_ENABLE_ASYNC=1):
+
+        trainer = AsyncPSTrainer(session, params, name="model")
+        for batch in data:
+            updated = local_sgd_step(trainer.params, batch)  # any local opt
+            trainer.step(updated)          # push delta, pull global weights
+            # trainer.params now holds the global view
+    """
+
+    def __init__(self, session, params: PyTree, name: str = "async_param",
+                 declared_key: Optional[int] = None):
+        import jax
+
+        if getattr(session, "server_async", True) is False:
+            raise RuntimeError(
+                "AsyncPSTrainer requires servers running with "
+                "BYTEPS_ENABLE_ASYNC=1; against a sync server the weight-"
+                "delta protocol would silently train on deltas")
+        self._session = session
+        self._treedef = jax.tree.structure(params)
+        leaves = jax.tree.leaves(params)
+        self._shapes = [np.shape(l) for l in leaves]
+        self._sizes = [int(np.size(l)) for l in leaves]
+        self._dtypes = [np.asarray(l).dtype for l in leaves]
+        if declared_key is None:
+            from ..core.native import get_core
+            declared_key = get_core().declare_tensor(f"AsyncParam.{name}")
+        self._key = declared_key
+        self._flat = self._flatten(params)
+        # Seed the server store with the initial weights.  DT_SEED applies
+        # only if the key has never been pushed — a late-joining or
+        # rejoining worker adopts the live global weights from the pull
+        # instead of resetting them (the analog of the reference's init
+        # push populating the store before deltas flow,
+        # reference: operations.cc:369-378).
+        h = session.push_pull_async(self._key, self._flat, seed=True)
+        self._flat = h.wait().astype(np.float32)
+
+    def _flatten(self, params: PyTree) -> np.ndarray:
+        import jax
+
+        leaves = jax.tree.leaves(params)
+        return np.concatenate(
+            [np.asarray(l, np.float32).ravel() for l in leaves])
+
+    def _unflatten(self, flat: np.ndarray) -> PyTree:
+        import jax
+
+        out, off = [], 0
+        for shape, size, dtype in zip(self._shapes, self._sizes,
+                                      self._dtypes):
+            out.append(flat[off:off + size].reshape(shape).astype(dtype))
+            off += size
+        return jax.tree.unflatten(self._treedef, out)
+
+    @property
+    def params(self) -> PyTree:
+        """The latest pulled global weights, as the original pytree."""
+        return self._unflatten(self._flat)
+
+    def step(self, updated_params: PyTree) -> PyTree:
+        """Push (updated - last_global) delta; pull and adopt global weights."""
+        new_flat = self._flatten(updated_params)
+        delta = new_flat - self._flat
+        self._flat = self._session.push_pull(self._key, delta).astype(
+            np.float32)
+        return self.params

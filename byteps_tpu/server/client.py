@@ -2,10 +2,23 @@
 
 The reference worker talks to servers through ps-lite ZPush/ZPull with
 per-partition keys spread over servers by hash
-(reference: core_loops.cc:536-616, global.cc:643-692).  Here each worker
-process holds one TCP session per server; tensors are pushed/pulled by
-their framework key, with key -> server placement delegated to the native
-core's hash functions so the layout matches the reference scheme.
+(reference: core_loops.cc:536-616, global.cc:643-692).  This is the
+TPU-host redesign of that data path:
+
+  - every tensor is split into <= BYTEPS_PARTITION_BYTES partitions with
+    per-partition keys `declared_key << 16 | part_idx`
+    (reference: operations.cc:140-180, 301-311),
+  - each partition key is placed on a server by the configured hash with
+    accumulated-load logging (reference: global.cc:643-692),
+  - partition pushes are issued by a dispatcher thread in
+    (priority desc, key asc) order through the native priority
+    ScheduledQueue, gated by a credit of
+    BYTEPS_SCHEDULING_CREDIT x BYTEPS_PARTITION_BYTES bytes in flight;
+    completions return credit (reference: scheduled_queue.cc:26-46,136-139),
+  - each connection multiplexes outstanding requests by req_id, the
+    redesign of ps-lite's completion callbacks (core_loops.cc:536-616),
+    so per-partition pushes/pulls to one server pipeline instead of
+    serializing on a blocking round-trip.
 """
 
 from __future__ import annotations
@@ -13,7 +26,7 @@ from __future__ import annotations
 import socket
 import struct
 import threading
-from typing import Dict, List, Optional
+from typing import Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -21,34 +34,126 @@ from ..common.config import Config
 from ..common.logging import get_logger
 from ..core.native import get_core
 
-_REQ = struct.Struct("<BBHIQQ")   # cmd dtype flags worker_id key len
-_RESP = struct.Struct("<BQQ")     # status key len
+_REQ = struct.Struct("<BBHIIQQ")   # cmd dtype flags req_id worker_id key len
+_RESP = struct.Struct("<BIQQ")     # status req_id key len
 
 CMD_HELLO, CMD_INIT, CMD_PUSH, CMD_PULL, CMD_BARRIER, CMD_SHUTDOWN, \
     CMD_PING = range(7)
 
+# dtype byte on the wire (server.cc WireDtype)
+DT_F32, DT_RAW, DT_COMPRESSED, DT_SEED = 0, 1, 2, 3
+
+
+class _Future:
+    """Completion slot for one outstanding request."""
+
+    __slots__ = ("event", "data", "error", "callback")
+
+    def __init__(self, callback: Optional[Callable] = None):
+        self.event = None if callback else threading.Event()
+        self.data: bytes = b""
+        self.error: Optional[Exception] = None
+        self.callback = callback
+
+    def resolve(self, data: bytes, error: Optional[Exception]) -> None:
+        self.data, self.error = data, error
+        if self.callback is not None:
+            self.callback(data, error)
+        else:
+            self.event.set()
+
+    def wait(self, timeout: Optional[float] = None) -> bytes:
+        if not self.event.wait(timeout):
+            raise TimeoutError("PS request timed out")
+        if self.error is not None:
+            raise self.error
+        return self.data
+
 
 class _ServerConn:
+    """One multiplexed connection to a PS server.
+
+    Any thread may `send`; a dedicated receiver thread matches responses to
+    futures by req_id and runs completion callbacks (the ZPush/ZPull
+    callback model, reference: core_loops.cc:564-616).
+    """
+
     def __init__(self, host: str, port: int, timeout: float = 60.0):
         self.sock = socket.create_connection((host, port), timeout=timeout)
+        self.sock.settimeout(None)  # receiver blocks until data or close
         self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-        self.lock = threading.Lock()
+        self.lock = threading.Lock()          # send serialization
+        self._pending: Dict[int, _Future] = {}
+        self._pending_lock = threading.Lock()
+        self._req_counter = 0
+        self._closed = False
+        self._recv_thread = threading.Thread(
+            target=self._recv_loop, daemon=True, name="bps-ps-recv")
+        self._recv_thread.start()
+
+    def send(self, cmd: int, key: int = 0, payload: bytes = b"",
+             worker_id: int = 0, dtype: int = 0, flags: int = 0,
+             callback: Optional[Callable] = None) -> _Future:
+        fut = _Future(callback)
+        with self._pending_lock:
+            if self._closed:
+                raise ConnectionError("PS connection closed")
+            self._req_counter = (self._req_counter + 1) & 0xFFFFFFFF
+            req_id = self._req_counter
+            self._pending[req_id] = fut
+        hdr = _REQ.pack(cmd, dtype, flags & 0xFFFF, req_id, worker_id, key,
+                        len(payload))
+        try:
+            with self.lock:
+                self.sock.sendall(hdr + bytes(payload))
+        except OSError as e:
+            with self._pending_lock:
+                self._pending.pop(req_id, None)
+            raise ConnectionError(f"PS send failed: {e}") from e
+        return fut
 
     def request(self, cmd: int, key: int = 0, payload: bytes = b"",
-                worker_id: int = 0, dtype: int = 0, flags: int = 0) -> bytes:
-        with self.lock:
-            hdr = _REQ.pack(cmd, dtype, flags & 0xFFFF, worker_id, key,
-                            len(payload))
-            self.sock.sendall(hdr + payload)
-            return self._read_response(key)
+                worker_id: int = 0, dtype: int = 0, flags: int = 0,
+                timeout: Optional[float] = 60.0) -> bytes:
+        """Blocking request/response (INIT, BARRIER, control commands).
 
-    def _read_response(self, key: int) -> bytes:
-        buf = self._recv_exact(_RESP.size)
-        status, rkey, length = _RESP.unpack(buf)
-        data = self._recv_exact(length) if length else b""
-        if status != 0:
-            raise RuntimeError(f"PS server error for key {rkey}")
-        return data
+        BARRIER legitimately blocks on peers, so it is sent without a
+        deadline; everything else fails loudly after `timeout` instead of
+        hanging a training job on a wedged server.
+        """
+        if cmd == CMD_BARRIER:
+            timeout = None
+        return self.send(cmd, key, payload, worker_id, dtype,
+                         flags).wait(timeout)
+
+    def _recv_loop(self) -> None:
+        try:
+            while True:
+                buf = self._recv_exact(_RESP.size)
+                status, req_id, rkey, length = _RESP.unpack(buf)
+                data = self._recv_exact(length) if length else b""
+                with self._pending_lock:
+                    fut = self._pending.pop(req_id, None)
+                if fut is None:
+                    continue  # response for a cancelled request
+                err = (RuntimeError(f"PS server error for key {rkey}")
+                       if status != 0 else None)
+                try:
+                    fut.resolve(data, err)
+                except Exception:
+                    get_logger().exception("PS completion callback failed")
+        except (ConnectionError, OSError) as e:
+            self._fail_pending(e)
+
+    def _fail_pending(self, exc: Exception) -> None:
+        with self._pending_lock:
+            self._closed = True
+            pending, self._pending = self._pending, {}
+        for fut in pending.values():
+            try:
+                fut.resolve(b"", ConnectionError(f"PS connection lost: {exc}"))
+            except Exception:
+                pass
 
     def _recv_exact(self, n: int) -> bytes:
         chunks = []
@@ -62,31 +167,134 @@ class _ServerConn:
 
     def close(self):
         try:
+            self.sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
             self.sock.close()
         except OSError:
             pass
+        self._fail_pending(ConnectionError("closed"))
+
+
+class PSHandle:
+    """Async push_pull completion handle (the torch-plugin handle analog,
+    reference: handle_manager.h:33-46)."""
+
+    def __init__(self, shape, dtype, num_parts: int, out: np.ndarray):
+        self.shape = shape
+        self.dtype = dtype
+        self.out = out                      # flat f32 result buffer
+        self._remaining = num_parts
+        self._lock = threading.Lock()
+        self._event = threading.Event()
+        self._error: Optional[Exception] = None
+
+    def _part_done(self, error: Optional[Exception] = None) -> None:
+        with self._lock:
+            if error is not None and self._error is None:
+                self._error = error
+            self._remaining -= 1
+            done = self._remaining <= 0
+        if done or error is not None:
+            self._event.set()
+
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    def wait(self, timeout: Optional[float] = 300.0) -> np.ndarray:
+        if not self._event.wait(timeout):
+            raise TimeoutError("PS push_pull timed out")
+        if self._error is not None:
+            raise self._error
+        return self.out.reshape(self.shape).astype(self.dtype, copy=False)
+
+
+class _PartTask:
+    """One in-flight partition (the reference's TensorTableEntry partition,
+    common.h:221-264)."""
+
+    __slots__ = ("pkey", "payload", "off", "ln", "round", "conn", "handle",
+                 "dtype", "done_evt", "wire_ln", "bidirectional")
+
+    def __init__(self, pkey, payload, off, ln, rnd, conn, handle,
+                 dtype=DT_F32, bidirectional=False):
+        self.pkey = pkey
+        self.payload = payload        # wire bytes (raw f32 or compressed)
+        self.off = off                # raw byte offset in the tensor
+        self.ln = ln                  # raw byte length of the partition
+        self.wire_ln = len(payload)   # bytes actually in flight (credit)
+        self.round = rnd
+        self.conn = conn
+        self.handle = handle
+        self.dtype = dtype
+        self.bidirectional = bidirectional  # pull leg may arrive compressed
+        self.done_evt = threading.Event()  # this partition left _inflight
 
 
 class PSSession:
     """One worker's sessions to all PS servers.
 
-    push_pull(key, array) pushes the f32 payload and pulls the across-worker
-    sum — the eager analog of the reference's PUSH→PULL queue pair
-    (reference: operations.cc:429-485).  Partitioning happens above this
-    layer (api.push_pull hands in whole tensors; partition-level keys use
-    the core's encode_key scheme).
+    push_pull partitions the tensor, spreads partitions across servers, and
+    drives them through the priority-scheduled, credit-gated dispatcher —
+    the eager analog of the reference's PUSH/PULL loops
+    (reference: core_loops.cc:536-616, operations.cc:429-485).
     """
 
     def __init__(self, hosts: List[str], ports: List[int], worker_id: int,
-                 num_servers: int, hash_fn: str = "djb2"):
+                 num_servers: int, hash_fn: str = "djb2",
+                 partition_bytes: int = 4 * 1024 * 1024,
+                 scheduling_credit: int = 0,
+                 min_compress_bytes: int = 65536):
         self.worker_id = worker_id
         self.num_servers = max(1, num_servers)
         self.hash_fn = hash_fn
+        self.partition_bytes = max(1, partition_bytes)
+        # Partitions below this size skip compression — the
+        # BYTEPS_MIN_COMPRESS_BYTES floor (reference: global.cc:43,
+        # operations.cc:362-364).
+        self.min_compress_bytes = min_compress_bytes
         self.conns = [_ServerConn(h, p) for h, p in zip(hosts, ports)]
-        self._inited: Dict[int, int] = {}
-        self._round: Dict[int, int] = {}  # per-key push_pull round counter
+        self._inited: Dict[int, tuple] = {}     # pkey -> (length, kwargs)
+        self._round: Dict[int, int] = {}        # pkey -> next round index
+        self._compressors: Dict[int, object] = {}  # declared_key -> codec
+        self._server_load = [0] * len(self.conns)
+        self._plans: Dict[Tuple[int, int], list] = {}
+
+        # Dispatcher: native priority ScheduledQueue + credit flow control
+        # (reference: scheduled_queue.cc:26-46,136-139).  credit = 0 means
+        # unlimited in-flight bytes, matching the reference default.
+        credit_bytes = scheduling_credit * self.partition_bytes
+        if credit_bytes > 0:
+            credit_bytes = max(credit_bytes, self.partition_bytes)
+        self._queue = get_core().queue_create(credit_bytes)
+        self._inflight: Dict[int, _PartTask] = {}
+        self._inflight_lock = threading.Lock()
+        self._cv = threading.Condition()
+        self._closed = False
+        self._paused = False
+        # Dispatch-order recording is off by default: the list is unbounded
+        # and only priority-order tests/tracing read it.
+        self.record_push_order = False
+        self.push_order: List[int] = []
+        self._dispatcher = threading.Thread(
+            target=self._dispatch_loop, daemon=True, name="bps-ps-dispatch")
+        self._dispatcher.start()
+
+        # HELLO returns the server's mode flags (u8 async | u8 schedule).
+        # All servers must agree — a mixed fleet silently corrupts training
+        # (partitions on a sync server would round-SUM async deltas).
+        modes = []
         for c in self.conns:
-            c.request(CMD_HELLO, worker_id=worker_id)
+            mode = c.request(CMD_HELLO, worker_id=worker_id)
+            modes.append((bool(mode[0]), bool(mode[1]))
+                         if len(mode) >= 2 else (False, False))
+        if len(set(modes)) > 1:
+            raise RuntimeError(
+                f"PS servers report mixed modes (async, schedule): {modes}; "
+                "all servers must share BYTEPS_ENABLE_ASYNC / "
+                "BYTEPS_SERVER_ENABLE_SCHEDULE settings")
+        self.server_async, self.server_schedule = modes[0]
 
     @classmethod
     def from_config(cls, cfg: Config) -> "PSSession":
@@ -102,35 +310,244 @@ class PSSession:
         else:
             hosts = [cfg.scheduler_uri] * n
             ports = [cfg.scheduler_port + 1 + i for i in range(n)]
-        return cls(hosts, ports, cfg.worker_id, n, cfg.key_hash_fn)
+        return cls(hosts, ports, cfg.worker_id, n, cfg.key_hash_fn,
+                   partition_bytes=cfg.partition_bytes,
+                   scheduling_credit=cfg.scheduling_credit,
+                   min_compress_bytes=cfg.min_compress_bytes)
 
-    def _conn_for(self, key: int) -> _ServerConn:
-        idx = get_core().key_to_server(key, len(self.conns), self.hash_fn)
-        return self.conns[idx]
+    def register_compressor(self, declared_key: int, kwargs: dict) -> None:
+        """Register an inter-node compressor for a tensor's PS traffic.
 
-    def push_pull(self, key: int, tensor, priority: int = 0) -> np.ndarray:
-        del priority  # ordering is applied by the caller's scheduler
+        Must be called before the tensor's first push_pull: the kwargs are
+        shipped to the server in each partition's INIT (the
+        kCompressedPushPull analog, reference: operations.cc:396-408,
+        server.cc:232-261), and the server builds its decompress-sum(-
+        recompress) path from them.
+        """
+        from .wire import WireCompressor
+        self._compressors[declared_key] = WireCompressor(
+            {str(k): str(v) for k, v in kwargs.items()})
+
+    # -- partition planning -------------------------------------------------
+    def _plan(self, declared_key: int, nbytes: int) -> list:
+        """[(pkey, offset, length, conn)] for a tensor of `nbytes` bytes.
+
+        Partition bounds and key encoding come from the native core; server
+        placement uses the configured hash over the partition key, with
+        accumulated per-server load logged like the reference's placement
+        summary (reference: global.cc:643-692, 675-682).
+        """
+        cached = self._plans.get((declared_key, nbytes))
+        if cached is not None:
+            return cached
+        core = get_core()
+        bounds = core.partition_bounds(nbytes, self.partition_bytes)
+        plan = []
+        for idx, (off, ln) in enumerate(bounds):
+            pkey = core.encode_key(declared_key, idx)
+            srv = core.key_to_server(pkey, len(self.conns), self.hash_fn)
+            self._server_load[srv] += ln
+            plan.append((pkey, off, ln, self.conns[srv]))
+        self._plans[(declared_key, nbytes)] = plan
+        total = sum(self._server_load) or 1
+        get_logger().debug(
+            "PS placement: tensor key=%d parts=%d; server load %s",
+            declared_key, len(plan),
+            ["%.1f%%" % (100.0 * l / total) for l in self._server_load])
+        return plan
+
+    # -- dispatcher ---------------------------------------------------------
+    def _dispatch_loop(self) -> None:
+        while True:
+            with self._cv:
+                while not self._closed and (
+                        self._paused or self._queue.pending() == 0):
+                    self._cv.wait()
+                if self._closed:
+                    return
+                task = self._queue.get()
+                if task is None:
+                    # Credit exhausted: wait for report_finish to return it.
+                    self._cv.wait(timeout=1.0)
+                    continue
+            pkey, _prio, nbytes = task
+            with self._inflight_lock:
+                part = self._inflight.get(pkey)
+            if part is None:  # cancelled (session closing)
+                self._queue.report_finish(nbytes)
+                continue
+            if self.record_push_order:
+                self.push_order.append(pkey)
+            try:
+                part.conn.send(
+                    CMD_PUSH, pkey, part.payload, worker_id=self.worker_id,
+                    dtype=part.dtype, flags=part.round,
+                    callback=lambda data, err, pkey=pkey, nbytes=nbytes:
+                        self._on_push_ack(pkey, nbytes, err))
+            except ConnectionError as e:
+                self._queue.report_finish(nbytes)
+                self._finish_part(pkey, e)
+
+    def _on_push_ack(self, pkey: int, nbytes: int,
+                     error: Optional[Exception]) -> None:
+        # Push landed on the server: return its credit (the reference
+        # reportFinish, scheduled_queue.cc:197-203) and issue the pull.
+        self._queue.report_finish(nbytes)
+        with self._cv:
+            self._cv.notify_all()
+        if error is not None:
+            self._finish_part(pkey, error)
+            return
+        with self._inflight_lock:
+            part = self._inflight.get(pkey)
+        if part is None:
+            return
+        try:
+            part.conn.send(
+                CMD_PULL, pkey, worker_id=self.worker_id, flags=part.round,
+                callback=lambda data, err, pkey=pkey:
+                    self._on_pull(pkey, data, err))
+        except ConnectionError as e:
+            self._finish_part(pkey, e)
+
+    def _on_pull(self, pkey: int, data: bytes,
+                 error: Optional[Exception]) -> None:
+        if error is not None:
+            self._finish_part(pkey, error)
+            return
+        with self._inflight_lock:
+            part = self._inflight.pop(pkey, None)
+            if part is not None:
+                # Bump inside the lock: a waiter in push_pull_async must see
+                # the new round the moment the key leaves _inflight.
+                self._round[pkey] = part.round + 1
+        if part is None:
+            return
+        try:
+            n = part.ln // 4
+            if part.bidirectional and len(data) != part.ln:
+                # Bidirectional compressor: the merged buffer came back
+                # re-compressed; decode it (reference: worker DECOMPRESS
+                # stage, core_loops.cc:618-646).
+                from .wire import decode as wire_decode
+                got = wire_decode(bytes(data), n)
+            else:
+                got = np.frombuffer(data, np.float32)
+            if got.size != n:
+                raise ValueError(
+                    f"PS pull size mismatch for key {pkey}: "
+                    f"got {got.size} f32, want {n}")
+            part.handle.out[part.off // 4:part.off // 4 + n] = got
+            part.handle._part_done()
+        except Exception as e:
+            part.handle._part_done(e)
+        finally:
+            part.done_evt.set()
+
+    def _finish_part(self, pkey: int, error: Exception) -> None:
+        with self._inflight_lock:
+            part = self._inflight.pop(pkey, None)
+        if part is not None:
+            part.handle._part_done(error)
+            part.done_evt.set()
+
+    # -- test/introspection hooks -------------------------------------------
+    def pause_dispatch(self) -> None:
+        """Hold dispatch so several push_pull_async calls can enqueue before
+        any push is issued (deterministic priority-order tests)."""
+        with self._cv:
+            self._paused = True
+
+    def resume_dispatch(self) -> None:
+        with self._cv:
+            self._paused = False
+            self._cv.notify_all()
+
+    # -- public API ---------------------------------------------------------
+    def push_pull_async(self, declared_key: int, tensor,
+                        priority: int = 0, raw: bool = False,
+                        seed: bool = False) -> PSHandle:
+        """Partitioned, priority-scheduled asynchronous push_pull.
+
+        raw=True pushes last-write-wins bytes instead of f32-summed values.
+        seed=True (async servers only) writes the store ONLY if the key has
+        never been pushed — idempotent initial-weight seeding that cannot
+        reset a live run when a worker joins late or rejoins.
+        """
         arr = np.asarray(tensor)
-        orig_dtype = arr.dtype
-        orig_shape = arr.shape
-        payload = np.ascontiguousarray(arr, dtype=np.float32).tobytes()
-        conn = self._conn_for(key)
-        if self._inited.get(key) != len(payload):
-            conn.request(CMD_INIT, key,
-                         struct.pack("<Q", len(payload)),
-                         worker_id=self.worker_id)
-            self._inited[key] = len(payload)
-        # The round tag makes a straggler's pull match the round it pushed,
-        # even if a fast peer has already started merging the next round
-        # (server keeps the last published round in a separate buffer).
-        rnd = self._round.get(key, 0)
-        conn.request(CMD_PUSH, key, payload, worker_id=self.worker_id,
-                     flags=rnd)
-        data = conn.request(CMD_PULL, key, worker_id=self.worker_id,
-                            flags=rnd)
-        self._round[key] = rnd + 1
-        out = np.frombuffer(data, np.float32).reshape(orig_shape)
-        return out.astype(orig_dtype, copy=False)
+        payload = np.ascontiguousarray(arr, dtype=np.float32).ravel()
+        raw_bytes = payload.tobytes()
+        plan = self._plan(declared_key, len(raw_bytes))
+        handle = PSHandle(arr.shape, arr.dtype, len(plan),
+                          np.zeros(len(raw_bytes) // 4, np.float32))
+        mv = memoryview(raw_bytes)
+        comp = self._compressors.get(declared_key)
+        kw_bytes = comp.kwargs_string().encode() if comp else b""
+        parts = []
+        try:
+            self._stage_parts(plan, payload, mv, comp, kw_bytes, handle,
+                              parts, raw, seed)
+        except Exception:
+            # Roll back partitions already staged in _inflight: leaving them
+            # would wedge the key forever (the sequential-use guard waits on
+            # done_evt, which nothing would ever set).
+            with self._inflight_lock:
+                for p in parts:
+                    if self._inflight.get(p.pkey) is p:
+                        del self._inflight[p.pkey]
+                    p.done_evt.set()
+            raise
+        with self._cv:
+            for p in parts:
+                self._queue.add(p.pkey, priority, p.wire_ln)
+            self._cv.notify_all()
+        return handle
+
+    def _stage_parts(self, plan, payload, mv, comp, kw_bytes, handle,
+                     parts, raw, seed) -> None:
+        for pkey, off, ln, conn in plan:
+            # BYTEPS_MIN_COMPRESS_BYTES floor: small partitions go raw
+            # (reference: operations.cc:362-364).
+            use_comp = (comp is not None and not raw and not seed
+                        and ln >= self.min_compress_bytes)
+            if self._inited.get(pkey) != (ln, kw_bytes):
+                init_payload = struct.pack("<QI", ln, len(kw_bytes)) + kw_bytes
+                resp = conn.request(CMD_INIT, pkey, init_payload,
+                                    worker_id=self.worker_id)
+                # Seed the round counter from server state so a reconnected
+                # worker can never pull a stale previous round.
+                (completed,) = struct.unpack("<Q", resp)
+                self._round[pkey] = completed
+                self._inited[pkey] = (ln, kw_bytes)
+            if use_comp:
+                wire_payload = comp.encode(
+                    pkey, payload[off // 4:(off + ln) // 4])
+                dtype = DT_COMPRESSED
+            else:
+                wire_payload = mv[off:off + ln]
+                dtype = DT_SEED if seed else (DT_RAW if raw else DT_F32)
+            # Sequential-use guard: a second async push_pull of the same
+            # tensor before the first completed waits for that partition.
+            # Check-and-insert is atomic under _inflight_lock, and the round
+            # tag is read inside the same critical section (after any
+            # previous round's _on_pull bumped it).
+            while True:
+                with self._inflight_lock:
+                    prev = self._inflight.get(pkey)
+                    if prev is None:
+                        part = _PartTask(
+                            pkey, wire_payload, off, ln,
+                            self._round.get(pkey, 0), conn, handle,
+                            dtype=dtype,
+                            bidirectional=use_comp and comp.bidirectional)
+                        self._inflight[pkey] = part
+                        parts.append(part)
+                        break
+                prev.done_evt.wait(timeout=60.0)
+
+    def push_pull(self, key: int, tensor, priority: int = 0,
+                  **kw) -> np.ndarray:
+        return self.push_pull_async(key, tensor, priority, **kw).wait()
 
     def barrier(self, generation: int = 0) -> None:
         """Global barrier across workers (reference: Postoffice::Barrier via
@@ -146,5 +563,9 @@ class PSSession:
                 get_logger().debug("shutdown race: %s", e)
 
     def close(self) -> None:
+        with self._cv:
+            self._closed = True
+            self._cv.notify_all()
+        self._dispatcher.join(timeout=10)
         for c in self.conns:
             c.close()

@@ -1,0 +1,192 @@
+"""Worker-side codecs for compressed PS payloads.
+
+numpy implementations of the compressor wire formats, bit-identical to both
+the JAX compressors (byteps_tpu/ops/compressor/*) and the C++ server codec
+(core/server.cc `namespace codec`), so a compressed push_pull through the
+server tier reproduces the in-collective-plane requantization exactly
+(reference: the server's decompress-sum-recompress engine,
+server/server.cc:86-207, fed by kwargs from the init push,
+operations.cc:396-408).
+
+Wire layout (little-endian):
+    u8 comp_id | u32 n_elems | body
+    onebit(1):    f32 scale | u8 bits[ceil(n/8)]       (LSB-first, 1 = neg)
+    topk(2):      u32 k | i32 idx[k] | f32 val[k]
+    randomk(3):   u32 k | i32 idx[k] | f32 val[k]
+    dithering(4): u8 flags(bit0=natural) | u8 s | f32 norm
+                  | u8 level[n] | u8 signs[ceil(n/8)]
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+COMP_ONEBIT, COMP_TOPK, COMP_RANDOMK, COMP_DITHERING = 1, 2, 3, 4
+
+_NAMES = {"onebit": COMP_ONEBIT, "topk": COMP_TOPK,
+          "randomk": COMP_RANDOMK, "dithering": COMP_DITHERING}
+
+
+def _pack_bits(bits: np.ndarray) -> np.ndarray:
+    """bits [n] in {0,1} -> uint8 [ceil(n/8)], LSB-first (matches
+    ops/compressor/onebit._pack_bits and the C++ codec)."""
+    return np.packbits(bits.astype(np.uint8), bitorder="little")
+
+
+def _unpack_bits(packed: np.ndarray, n: int) -> np.ndarray:
+    return np.unpackbits(packed, bitorder="little")[:n]
+
+
+def _xorshift32(x: np.ndarray) -> np.ndarray:
+    x = x ^ (x << np.uint32(13))
+    x = x ^ (x >> np.uint32(17))
+    x = x ^ (x << np.uint32(5))
+    return x
+
+
+def _seed_state(seed: int, n: int) -> np.ndarray:
+    """Mirror of ops/compressor/base.seed_state (numpy)."""
+    lanes = np.arange(1, n + 1, dtype=np.uint32)
+    with np.errstate(over="ignore"):
+        s = lanes * np.uint32(2654435761) + np.uint32(seed | 1)
+    s = np.where(s == 0, np.uint32(0x9E3779B9), s)
+    return _xorshift32(s)
+
+
+class WireCompressor:
+    """Per-tensor compressed-wire codec with per-partition PRNG state.
+
+    Built from the same string kwargs as the registry
+    (ops/compressor/registry.py), which are also shipped verbatim to the
+    server at INIT.
+    """
+
+    def __init__(self, kwargs: Dict[str, str]):
+        from ..ops.compressor.registry import _get, _get_bool  # shared parse
+        ctype = (kwargs.get("compressor") or kwargs.get("compressor_type")
+                 or kwargs.get("byteps_compressor_type"))
+        if ctype not in _NAMES:
+            raise ValueError(
+                f"unsupported PS-wire compressor {ctype!r}; "
+                f"known: {sorted(_NAMES)}")
+        self.name = ctype
+        self.comp_id = _NAMES[ctype]
+        self.kwargs = dict(kwargs)
+        self.scaled = _get_bool(kwargs, "onebit_scaling", True)
+        self.k = int(_get(kwargs, "k", 0))
+        self.seed = int(_get(kwargs, "seed", 2020))
+        self.s = int(_get(kwargs, "k", 127)) if ctype == "dithering" else 0
+        self.partition = str(_get(kwargs, "partition", "linear"))
+        self.normalize = str(_get(kwargs, "normalize", "max"))
+        if ctype in ("topk", "randomk") and self.k <= 0:
+            raise ValueError(f"{ctype} requires k > 0")
+        self.bidirectional = ctype == "onebit"
+        self._rng: Dict[int, np.ndarray] = {}  # per-partition-key PRNG lanes
+
+    def kwargs_string(self) -> str:
+        """Canonical "k=v,k=v" form sent in the INIT payload."""
+        kw = {"compressor": self.name}
+        if self.name == "onebit":
+            kw["onebit_scaling"] = "1" if self.scaled else "0"
+        if self.name in ("topk", "randomk"):
+            kw["k"] = str(self.k)
+        if self.name == "randomk":
+            kw["seed"] = str(self.seed)
+        if self.name == "dithering":
+            kw.update(k=str(self.s), seed=str(self.seed),
+                      partition=self.partition, normalize=self.normalize)
+        return ",".join(f"{k}={v}" for k, v in sorted(kw.items()))
+
+    # -- encode -------------------------------------------------------------
+    def encode(self, pkey: int, x: np.ndarray) -> bytes:
+        x = np.ascontiguousarray(x, np.float32)
+        n = x.size
+        hdr = struct.pack("<BI", self.comp_id, n)
+        if self.comp_id == COMP_ONEBIT:
+            scale = (np.abs(x).sum() / max(n, 1)) if self.scaled else 1.0
+            bits = _pack_bits(x < 0)
+            return hdr + struct.pack("<f", np.float32(scale)) + bits.tobytes()
+        if self.comp_id == COMP_TOPK:
+            k = min(self.k, n)
+            idx = np.argpartition(np.abs(x), -k)[-k:].astype(np.int32)
+            return (hdr + struct.pack("<I", k) + idx.tobytes()
+                    + x[idx].tobytes())
+        if self.comp_id == COMP_RANDOMK:
+            k = min(self.k, n)
+            rng = self._rng.get(pkey)
+            if rng is None:
+                rng = _seed_state(self.seed, self.k)
+            rng = _xorshift32(rng)
+            self._rng[pkey] = rng
+            u = (rng >> np.uint32(8)).astype(np.float32) / np.float32(1 << 24)
+            idx = np.minimum((u[:k] * n).astype(np.int32), n - 1)
+            return (hdr + struct.pack("<I", k) + idx.tobytes()
+                    + x[idx].tobytes())
+        # dithering
+        s = self.s
+        if self.normalize == "max":
+            norm = float(np.max(np.abs(x))) if n else 0.0
+        else:
+            norm = float(np.sqrt(np.sum(x * x)))
+        norm = max(norm, float(np.finfo(np.float32).tiny))
+        mag = np.abs(x) / np.float32(norm)
+        levels = self._levels()
+        j = np.clip(np.searchsorted(levels, mag, side="right") - 1, 0, s - 1)
+        lo, hi = levels[j], levels[j + 1]
+        with np.errstate(divide="ignore", invalid="ignore"):
+            p_up = np.where(hi > lo, (mag - lo) / np.maximum(hi - lo, 1e-30),
+                            0.0)
+        rng = self._rng.get(pkey)
+        if rng is None:
+            rng = _seed_state(self.seed, n)
+        rng = _xorshift32(rng[:n])
+        self._rng[pkey] = rng
+        u = (rng >> np.uint32(8)).astype(np.float32) / np.float32(1 << 24)
+        level = (j + (u < p_up)).astype(np.uint8)
+        flags = 1 if self.partition == "natural" else 0
+        return (hdr + struct.pack("<BBf", flags, s, np.float32(norm))
+                + level.tobytes() + _pack_bits(x < 0).tobytes())
+
+    def _levels(self) -> np.ndarray:
+        s = self.s
+        if self.partition == "linear":
+            return np.arange(s + 1, dtype=np.float32) / np.float32(s)
+        pts = 2.0 ** np.arange(-(s - 1), 1, dtype=np.float32)
+        return np.concatenate([np.zeros(1, np.float32), pts])
+
+
+def decode(data: bytes, n: int) -> np.ndarray:
+    """Decode any compressed wire payload to an n-element f32 vector
+    (the worker pull-leg decompress for bidirectional compressors)."""
+    comp, wn = struct.unpack_from("<BI", data, 0)
+    if wn != n:
+        raise ValueError(f"wire n={wn} != expected {n}")
+    body = memoryview(data)[5:]
+    if comp == COMP_ONEBIT:
+        (scale,) = struct.unpack_from("<f", body, 0)
+        bits = _unpack_bits(
+            np.frombuffer(body[4:4 + (n + 7) // 8], np.uint8), n)
+        return np.where(bits.astype(bool), -scale, scale).astype(np.float32)
+    if comp in (COMP_TOPK, COMP_RANDOMK):
+        (k,) = struct.unpack_from("<I", body, 0)
+        idx = np.frombuffer(body[4:4 + 4 * k], np.int32)
+        val = np.frombuffer(body[4 + 4 * k:4 + 8 * k], np.float32)
+        out = np.zeros(n, np.float32)
+        np.add.at(out, idx, val)
+        return out
+    if comp == COMP_DITHERING:
+        flags, s, norm = struct.unpack_from("<BBf", body, 0)
+        level = np.frombuffer(body[6:6 + n], np.uint8).astype(np.int32)
+        signs = _unpack_bits(
+            np.frombuffer(body[6 + n:6 + n + (n + 7) // 8], np.uint8), n)
+        if flags & 1:
+            mag = np.where(level == 0, 0.0,
+                           2.0 ** (level.astype(np.float32) - s))
+        else:
+            mag = level.astype(np.float32) / np.float32(s)
+        sign = 1.0 - 2.0 * signs.astype(np.float32)
+        return (sign * mag * norm).astype(np.float32)
+    raise ValueError(f"unknown comp_id {comp}")

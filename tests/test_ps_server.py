@@ -231,3 +231,147 @@ def test_shutdown_terminates_server(ps_server):
     idle.close()
     s.close()
     assert down, "server still accepting after SHUTDOWN"
+
+
+def test_large_tensor_partitioned_across_servers(ps_server):
+    """A >16MB tensor must be split into multiple partition keys spread over
+    distinct servers, and the summed result must match bit-for-bit
+    (reference: operations.cc:140-180 partitioning, global.cc:643-692
+    key->server spreading)."""
+    port_a = ps_server(num_workers=2)
+    port_b = ps_server(num_workers=2)
+    n = (17 * 1024 * 1024) // 4  # 17MB of f32
+    rng = np.random.RandomState(0)
+    a = rng.randn(n).astype(np.float32)
+    b = rng.randn(n).astype(np.float32)
+    out = {}
+
+    def worker(wid, data):
+        s = PSSession(["127.0.0.1"] * 2, [port_a, port_b], worker_id=wid,
+                      num_servers=2)
+        plan = s._plan(11, data.nbytes)
+        # >=5 partitions at the default 4MB bound, on >=2 distinct servers
+        assert len(plan) >= 5
+        servers_used = {id(conn) for (_, _, _, conn) in plan}
+        assert len(servers_used) >= 2, "partitions all landed on one server"
+        keys = [pkey for (pkey, _, _, _) in plan]
+        assert len(set(keys)) == len(keys)
+        assert all(k >> 16 == 11 for k in keys)
+        out[wid] = s.push_pull(11, data)
+        s.close()
+
+    ts = [threading.Thread(target=worker, args=(0, a)),
+          threading.Thread(target=worker, args=(1, b))]
+    [t.start() for t in ts]
+    [t.join(timeout=120) for t in ts]
+    expect = a + b  # same add order as server (COPY_FIRST then SUM_RECV)
+    np.testing.assert_array_equal(out[0], expect)
+    np.testing.assert_array_equal(out[1], expect)
+
+
+def test_priority_scheduling_with_credit(ps_server):
+    """With a constrained credit, queued partitions must dispatch in
+    (priority desc, key asc) order: a high-priority tensor enqueued after a
+    low-priority one still pushes first (reference control law:
+    scheduled_queue.cc:26-46,136-139)."""
+    port = ps_server(num_workers=1)
+    s = PSSession(["127.0.0.1"], [port], worker_id=0, num_servers=1,
+                  partition_bytes=1024, scheduling_credit=1)
+    s.record_push_order = True
+    s.pause_dispatch()
+    a = np.ones(1024, np.float32)   # 4096 bytes -> 4 partitions
+    b = np.ones(512, np.float32)    # 2048 bytes -> 2 partitions
+    ha = s.push_pull_async(1, a, priority=0)   # low, enqueued first
+    hb = s.push_pull_async(2, b, priority=10)  # high, enqueued second
+    s.resume_dispatch()
+    ra, rb = ha.wait(), hb.wait()
+    np.testing.assert_array_equal(ra, a)
+    np.testing.assert_array_equal(rb, b)
+    order = list(s.push_order)
+    expect_b = [(2 << 16) | i for i in range(2)]
+    expect_a = [(1 << 16) | i for i in range(4)]
+    assert order == expect_b + expect_a, order
+    s.close()
+
+
+def test_concurrent_partition_pipelining(ps_server):
+    """Without credit limits, many partitions are outstanding at once on a
+    multiplexed connection; results stay correct under 2 workers x 3
+    tensors x several rounds."""
+    port = ps_server(num_workers=2)
+    results = {0: [], 1: []}
+
+    def worker(wid):
+        s = PSSession(["127.0.0.1"], [port], worker_id=wid, num_servers=1,
+                      partition_bytes=256)
+        for step in range(3):
+            hs = [s.push_pull_async(k, np.full(512, float(wid + step + k),
+                                               np.float32), priority=-k)
+                  for k in range(3)]
+            results[wid].append([h.wait() for h in hs])
+        s.close()
+
+    ts = [threading.Thread(target=worker, args=(w,)) for w in (0, 1)]
+    [t.start() for t in ts]
+    [t.join(timeout=120) for t in ts]
+    for wid in (0, 1):
+        for step in range(3):
+            for k in range(3):
+                expect = np.full(512, (0 + step + k) + (1 + step + k),
+                                 np.float32)
+                np.testing.assert_array_equal(results[wid][step][k], expect)
+
+
+def test_reconnect_reseeds_round_from_server(ps_server):
+    """A worker that reconnects (crash restart / elastic rejoin) must seed
+    its round counters from the server's completed_round (returned by INIT)
+    — a fresh client starting at round 0 would otherwise be served the
+    previous round's stale buffer immediately."""
+    port = ps_server(num_workers=1)
+    s1 = _session(port, 0)
+    for step in range(3):
+        s1.push_pull(5, np.full(16, float(step + 1), np.float32))
+    s1.close()
+    # Reconnect: new session, same key, new value. Must get the NEW sum,
+    # not the stale round-3 buffer (which holds 3.0s).
+    s2 = _session(port, 0)
+    got = s2.push_pull(5, np.full(16, 42.0, np.float32))
+    np.testing.assert_array_equal(got, np.full(16, 42.0, np.float32))
+    s2.close()
+
+
+def test_api_push_pull_via_ps_mode(ps_server):
+    """BYTEPS_TPU_PS_MODE=1 routes bps.push_pull through the server tier,
+    partitioned and priority-scheduled, transparently to the API user."""
+    port = ps_server(num_workers=1)
+    code = """
+import numpy as np, jax.numpy as jnp
+import byteps_tpu as bps
+bps.init()
+x = jnp.arange(100000, dtype=jnp.float32)
+out = bps.push_pull(x, name="g", average=False)
+np.testing.assert_array_equal(np.asarray(out),
+                              np.arange(100000, dtype=np.float32))
+h = bps.push_pull_async(2 * x, name="g2", average=False)
+assert bps.poll(h) in (True, False)
+out2 = bps.synchronize(h)
+np.testing.assert_array_equal(np.asarray(out2),
+                              2 * np.arange(100000, dtype=np.float32))
+bps.shutdown()
+print("PS_API_OK")
+"""
+    env = dict(os.environ)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "BYTEPS_TPU_PS_MODE": "1",
+        "DMLC_NUM_WORKER": "1",
+        "DMLC_NUM_SERVER": "1",
+        "DMLC_PS_ROOT_PORT": str(port - 1),
+        # Small partitions so even this test exercises the partitioned path.
+        "BYTEPS_PARTITION_BYTES": "65536",
+        "BYTEPS_SCHEDULING_CREDIT": "4",
+    })
+    proc = subprocess.run([sys.executable, "-c", code], env=env,
+                          capture_output=True, text=True, timeout=180)
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    assert "PS_API_OK" in proc.stdout
