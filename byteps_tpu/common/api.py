@@ -257,7 +257,11 @@ def push_pull_async(tensor: jax.Array, name: Optional[str] = None,
         _resolve.ps_handle = ps_handle
         out = _resolve
     else:
-        if size() > 1:
+        cfg0 = _state.config or get_config()
+        if size() > 1 or cfg0.force_distributed:
+            # BYTEPS_FORCE_DISTRIBUTED exercises the real communication
+            # path even at world size 1 — the reference's test hook
+            # (reference: global.cc:149-152, tests/meta_test.py:27-33).
             out = _eager_sum_across_processes(wire)
         else:
             out = wire  # sum over a single worker

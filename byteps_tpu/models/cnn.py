@@ -151,7 +151,7 @@ def cnn_loss_fn(model: nn.Module):
     (train=False) keeps the loss a pure function of `variables`, which is what
     the DP train-step builder differentiates; models that need train-mode
     batch-stats updates thread the mutable collection explicitly in their
-    training script (see example/cnn/train_imagenet.py).
+    training script (see example/jax/train_imagenet_resnet_byteps.py).
     """
     def loss(variables, batch):
         images, labels = batch

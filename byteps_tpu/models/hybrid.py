@@ -24,10 +24,10 @@ analog of what GSPMD derives:
   stage, dense/tp               dp, ep, sp       (owned per pp rank)
   stage, expert (ffn_e_*)       dp, sp           (owned per (pp, ep) rank)
 
-The Switch load-balancing aux loss is folded in when `aux_loss_weight > 0`
-and pp == 1 (the pipeline carry is a single activation tensor, so under pp
-the aux term is dropped); capacity limiting still bounds imbalance at any
-pp.
+The Switch load-balancing aux loss is folded in whenever
+`aux_loss_weight > 0`, including under pp: the aux scalar rides out-of-band
+beside the pipeline's activation carry, accumulated per stage over its real
+microbatch ticks (parallel/pipeline.gpipe_spmd with_aux=True).
 """
 
 from __future__ import annotations
@@ -231,13 +231,16 @@ def build_hybrid_train_step(
         local_layers = jax.tree.map(lambda l: l[0], params["layers"])
         run = functools.partial(_stage_fn, cfg=cfg, f_tp=f_tp, g_tp=g_tp)
         if pp > 1:
-            # The pipeline carry is a single activation tensor; the MoE aux
-            # loss is dropped under pp (capacity limiting still bounds
-            # imbalance).
-            x = pp_mod.gpipe_spmd(
-                lambda lw, a: run(lw, a)[0], local_layers, x,
-                num_microbatches, axis_name="pp")
-            aux = jnp.zeros((), jnp.float32)
+            # The aux scalar rides out-of-band beside the activation carry:
+            # each pp rank accumulates its own stage's aux over its real
+            # microbatch ticks (bubbles masked), so the router keeps its
+            # load-balancing signal under pipeline parallelism.
+            x, aux = pp_mod.gpipe_spmd(
+                run, local_layers, x, num_microbatches, axis_name="pp",
+                with_aux=True)
+            # Per-microbatch aux terms are means over mb tokens; averaging
+            # over M matches the single-pass (pp=1) per-token mean.
+            aux = aux / num_microbatches
         else:
             x, aux = run(local_layers, x)
 
@@ -252,6 +255,12 @@ def build_hybrid_train_step(
         denom = (B * lax.axis_size("dp") * lax.axis_size("ep")
                  * S * lax.axis_size("sp"))
         loss = nll.sum() / denom
+        # Mask the token loss to the last pp stage so psum over pp
+        # double-counts neither the head path nor the input path of the
+        # shared embedding.  The aux term stays UNmasked: each pp rank owns
+        # the aux of its layer slice (distinct layers), so per-rank terms
+        # sum to the whole-model aux under the final pp psum.
+        loss = jnp.where(lax.axis_index("pp") == pp - 1, loss, 0.0)
         if cfg.num_experts > 0 and cfg.aux_loss_weight > 0.0:
             # Mean aux over layers and over the (dp, ep, sp) shards — the
             # final psum over those axes turns the per-shard term into the
@@ -260,7 +269,7 @@ def build_hybrid_train_step(
                       * lax.axis_size("sp"))
             loss = loss + cfg.aux_loss_weight * aux / (
                 cfg.num_layers * shards)
-        return jnp.where(lax.axis_index("pp") == pp - 1, loss, 0.0)
+        return loss
 
     def grad_sync(grads):
         def sync(path, g):

@@ -6,7 +6,9 @@ Horovod-style fp16 cast applied before communication and undone after
 (reference: byteps/torch/compression.py equivalent, byteps/tensorflow/
 __init__.py:66-81).  Level 2 (the inter-node onebit/topk/randomk/dithering
 compressors with error-feedback and momentum) lives in
-byteps_tpu.ops.compressor as Pallas kernels.
+byteps_tpu.ops.compressor as shape-static jnp/XLA ops (vectorized packing
+via reshape+dot — XLA fuses them into the surrounding collectives; no
+hand-written Pallas kernels are needed at these sizes).
 
 On TPU the natural wire dtype is bfloat16 (no loss of exponent range), so
 `Compression.fp16` maps to bf16 by default; `Compression.f16` forces IEEE

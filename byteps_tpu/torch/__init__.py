@@ -96,10 +96,13 @@ class _DistributedOptimizer(torch.optim.Optimizer):
     first (reference: torch/__init__.py:115-214)."""
 
     def __init__(self, optimizer: torch.optim.Optimizer, named_parameters,
-                 compression, backward_passes_per_step: int = 1):
+                 compression, backward_passes_per_step: int = 1,
+                 enable_async: bool = False):
         self._inner = optimizer
         self._compression = compression
         self._bpps = backward_passes_per_step
+        self._enable_async = enable_async
+        self._async_keys: Dict[int, int] = {}  # id(param) -> declared key
         if named_parameters is not None:
             named = list(named_parameters)
         else:
@@ -113,6 +116,8 @@ class _DistributedOptimizer(torch.optim.Optimizer):
         self.state = optimizer.state
 
     def step(self, closure=None):
+        if self._enable_async:
+            return self._step_async(closure)
         handles = []
         for group in self.param_groups:
             for p in group["params"]:
@@ -131,6 +136,43 @@ class _DistributedOptimizer(torch.optim.Optimizer):
                         p.grad.div_(self._bpps)
         return self._inner.step(closure)
 
+    def _step_async(self, closure):
+        """Async PS mode: run the local optimizer step, push the weight
+        DELTA, adopt the server's global weights (reference:
+        torch/__init__.py:186-214 under BYTEPS_ENABLE_ASYNC)."""
+        sess = _api.get_ps_session()
+        if sess is None or not getattr(sess, "server_async", False):
+            raise RuntimeError(
+                "enable_async requires BYTEPS_TPU_PS_MODE=1 with servers "
+                "running BYTEPS_ENABLE_ASYNC=1")
+        params = [p for g in self.param_groups for p in g["params"]]
+        for p in params:
+            if id(p) in self._async_keys:
+                continue
+            # Seed each (possibly late-added) param's store with its
+            # current weights (apply-only-if-untouched, so late joiners
+            # adopt live weights instead of resetting them).
+            name = "AsyncParam." + self._names.get(p, f"anon.{id(p)}")
+            dk = _api.declare(name)
+            self._async_keys[id(p)] = dk
+            got = sess.push_pull(dk, p.detach().cpu().numpy(), seed=True)
+            with torch.no_grad():
+                p.copy_(_from_jax(got, p))
+        old = {id(p): p.detach().clone() for p in params}
+        loss = self._inner.step(closure)
+        # Dispatch every delta through the session's priority-scheduled
+        # dispatcher first, then adopt — overlapping the per-param
+        # round-trips instead of serializing N RTTs.
+        handles = []
+        for p in params:
+            delta = (p.detach() - old[id(p)]).cpu().numpy()
+            handles.append(
+                (p, sess.push_pull_async(self._async_keys[id(p)], delta)))
+        for p, h in handles:
+            with torch.no_grad():
+                p.copy_(_from_jax(h.wait(), p))
+        return loss
+
     def zero_grad(self, set_to_none: bool = True):
         return self._inner.zero_grad(set_to_none=set_to_none)
 
@@ -144,41 +186,67 @@ class _DistributedOptimizer(torch.optim.Optimizer):
 def DistributedOptimizer(optimizer: torch.optim.Optimizer,
                          named_parameters=None,
                          compression=Compression.none,
-                         backward_passes_per_step: int = 1):
+                         backward_passes_per_step: int = 1,
+                         enable_async: Optional[bool] = None):
+    """enable_async=None reads BYTEPS_ENABLE_ASYNC, matching the reference's
+    env-driven switch (reference: torch/__init__.py:432-446)."""
+    if enable_async is None:
+        from ..common.config import get_config
+        enable_async = get_config().enable_async
     return _DistributedOptimizer(optimizer, named_parameters, compression,
-                                 backward_passes_per_step)
+                                 backward_passes_per_step, enable_async)
 
 
 def broadcast_parameters(params, root_rank: int = 0) -> None:
     """In-place broadcast of a state_dict or iterable of (name, tensor)
-    (reference: torch/__init__.py:259-291)."""
+    (reference: torch/__init__.py:259-291).
+
+    All tensors travel in ONE tree broadcast (a single host->device->host
+    round-trip) instead of one collective per tensor — the host round-trip
+    is the torch plugin's tax for living outside XLA, so it is paid once.
+    """
     if isinstance(params, dict):
         items = sorted(params.items())
     else:
         items = list(params)
-    for name, t in items:
-        if not torch.is_tensor(t):
-            continue
-        out = _api.broadcast_parameters(_to_jax(t), root_rank)
-        with torch.no_grad():
-            t.copy_(_from_jax(out, t))
+    tensors = {name: t for name, t in items if torch.is_tensor(t)}
+    if not tensors:
+        return
+    out = _api.broadcast_parameters(
+        {name: _to_jax(t) for name, t in tensors.items()}, root_rank)
+    with torch.no_grad():
+        for name, t in tensors.items():
+            t.copy_(_from_jax(out[name], t))
 
 
 def broadcast_optimizer_state(optimizer: torch.optim.Optimizer,
                               root_rank: int = 0) -> None:
     """Broadcast optimizer state tensors AND scalar hyper-state
-    (reference: torch/__init__.py:293-409 tensor-izes scalars)."""
+    (reference: torch/__init__.py:293-409 tensor-izes scalars).  Like
+    broadcast_parameters, everything ships in one tree broadcast."""
     sd = optimizer.state_dict()
+    tree = {}
+    for pid, pstate in sd.get("state", {}).items():
+        for k, v in pstate.items():
+            if torch.is_tensor(v):
+                tree[(pid, k)] = _to_jax(v)
+            elif isinstance(v, (int, float)):
+                tree[(pid, k)] = _to_jax(torch.tensor(float(v)))
+    if not tree:
+        return
+    # dict keys must be hashable+sortable for the pytree: encode as strings.
+    enc = {f"{pid}::{k}": v for (pid, k), v in tree.items()}
+    out = _api.broadcast_parameters(enc, root_rank)
     for pid, pstate in sd.get("state", {}).items():
         for k, v in list(pstate.items()):
+            got = out.get(f"{pid}::{k}")
+            if got is None:
+                continue
             if torch.is_tensor(v):
-                out = _api.broadcast_parameters(_to_jax(v), root_rank)
                 with torch.no_grad():
-                    v.copy_(_from_jax(out, v))
+                    v.copy_(_from_jax(got, v))
             elif isinstance(v, (int, float)):
-                t = torch.tensor(float(v))
-                out = _api.broadcast_parameters(_to_jax(t), root_rank)
-                pstate[k] = type(v)(np.asarray(out).item())
+                pstate[k] = type(v)(np.asarray(got).item())
     optimizer.load_state_dict(sd)
 
 

@@ -36,12 +36,16 @@ def restore(path: str, template: Optional[PyTree] = None,
     is broadcast from rank 0 so all workers start bit-identical — the same
     consistency contract the reference gets from broadcast_parameters
     (reference: torch/__init__.py:259-291)."""
-    import jax
-    restored = _ckptr().restore(os.path.abspath(os.path.expanduser(path)))
+    apath = os.path.abspath(os.path.expanduser(path))
     if template is not None:
-        # orbax returns dicts for any pytree; restore the caller's structure.
-        leaves = jax.tree.leaves(restored)
-        restored = jax.tree.unflatten(jax.tree.structure(template), leaves)
+        # Hand orbax the template so it restores directly into the caller's
+        # structure.  (Zipping restored leaves into the template's treedef
+        # would silently permute leaves whenever orbax's container flatten
+        # order differs from the template's — e.g. >=10 tuple entries
+        # restored as string-keyed dicts sort "10" before "2".)
+        restored = _ckptr().restore(apath, item=template)
+    else:
+        restored = _ckptr().restore(apath)
     if broadcast:
         from ..common.api import broadcast_parameters, size
         if size() > 1:

@@ -1,0 +1,90 @@
+"""Train an MNIST-style MLP with the PyTorch plugin.
+
+The torch counterpart of example/jax/train_mnist_byteps.py, mirroring the
+reference's example/pytorch/train_mnist_byteps.py shape: broadcast initial
+state, wrap the optimizer in DistributedOptimizer (gradients are averaged
+across workers through the framework's eager push_pull), train, report.
+
+Uses a synthetic MNIST-like dataset so the example runs hermetically (no
+downloads); swap in torchvision.datasets.MNIST for the real thing.
+
+Run (single worker):
+    python example/torch/train_mnist_torch_byteps.py --epochs 2
+Async PS mode (reference: BYTEPS_ENABLE_ASYNC):
+    BYTEPS_TPU_PS_MODE=1 BYTEPS_ENABLE_ASYNC=1 ... bpslaunch ...
+"""
+
+import argparse
+
+import numpy as np
+import torch
+import torch.nn.functional as F
+
+import byteps_tpu.torch as bps
+
+
+class Net(torch.nn.Module):
+    def __init__(self):
+        super().__init__()
+        self.fc1 = torch.nn.Linear(784, 128)
+        self.fc2 = torch.nn.Linear(128, 10)
+
+    def forward(self, x):
+        return self.fc2(F.relu(self.fc1(x.flatten(1))))
+
+
+def synthetic_mnist(n=4096, seed=0):
+    """Class-conditioned Gaussian blobs in pixel space: learnable, fast.
+
+    The class prototypes come from a FIXED seed so every worker sees the
+    same task; only the per-worker sample draw varies with `seed`.
+    """
+    protos = np.random.RandomState(0).randn(10, 784).astype(np.float32)
+    rng = np.random.RandomState(seed)
+    y = rng.randint(0, 10, size=n)
+    x = protos[y] + 0.5 * rng.randn(n, 784).astype(np.float32)
+    return (torch.from_numpy(x.reshape(n, 1, 28, 28)),
+            torch.from_numpy(y.astype(np.int64)))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--epochs", type=int, default=2)
+    ap.add_argument("--batch-size", type=int, default=256)
+    ap.add_argument("--lr", type=float, default=0.05)
+    args = ap.parse_args()
+
+    bps.init()
+    torch.manual_seed(42 + bps.rank())
+
+    model = Net()
+    opt = torch.optim.SGD(model.parameters(), lr=args.lr)
+    opt = bps.DistributedOptimizer(
+        opt, named_parameters=model.named_parameters())
+
+    # Every worker starts from rank 0's weights (reference pattern).
+    bps.broadcast_parameters(model.state_dict(), root_rank=0)
+
+    x, y = synthetic_mnist(seed=bps.rank())  # each worker gets its shard
+    n = x.shape[0]
+    for epoch in range(args.epochs):
+        perm = torch.randperm(n)
+        total, correct, loss_sum = 0, 0, 0.0
+        for i in range(0, n, args.batch_size):
+            idx = perm[i:i + args.batch_size]
+            xb, yb = x[idx], y[idx]
+            opt.zero_grad()
+            logits = model(xb)
+            loss = F.cross_entropy(logits, yb)
+            loss.backward()
+            opt.step()
+            loss_sum += float(loss) * len(idx)
+            correct += int((logits.argmax(1) == yb).sum())
+            total += len(idx)
+        print(f"rank {bps.rank()}/{bps.size()} epoch {epoch}: "
+              f"loss={loss_sum / total:.4f} acc={correct / total:.3f}")
+    bps.shutdown()
+
+
+if __name__ == "__main__":
+    main()

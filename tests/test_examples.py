@@ -11,7 +11,7 @@ import pytest
 EXAMPLES = os.path.join(os.path.dirname(__file__), "..", "example", "jax")
 
 
-def _run(script, *args, timeout=420):
+def _run(script, *args, timeout=420, directory=None):
     env = dict(os.environ)
     env.update({
         "JAX_PLATFORMS": "cpu",
@@ -19,7 +19,7 @@ def _run(script, *args, timeout=420):
         "PYTHONPATH": os.path.join(os.path.dirname(__file__), ".."),
     })
     r = subprocess.run(
-        [sys.executable, os.path.join(EXAMPLES, script), *args],
+        [sys.executable, os.path.join(directory or EXAMPLES, script), *args],
         env=env, capture_output=True, text=True, timeout=timeout)
     assert r.returncode == 0, f"{script} failed:\n{r.stdout}\n{r.stderr}"
     return r.stdout
@@ -72,3 +72,11 @@ def test_long_context_example():
 def test_cross_barrier_example():
     out = _run("benchmark_cross_barrier_byteps.py")
     assert "cross-barrier:" in out
+
+
+def test_torch_mnist_example():
+    torch_dir = os.path.join(os.path.dirname(__file__), "..", "example",
+                             "torch")
+    out = _run("train_mnist_torch_byteps.py", "--epochs", "1",
+               "--batch-size", "512", directory=torch_dir)
+    assert "acc=" in out

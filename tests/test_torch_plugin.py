@@ -82,3 +82,62 @@ def test_ddp_wrapper(initialized):
     m.synchronize()
     for p in m.module.parameters():
         assert p.grad is not None
+
+
+def test_async_mode_against_ps_server():
+    """enable_async: step() pushes weight deltas, adopts global weights
+    (reference: torch/__init__.py:186-214).  Runs in a subprocess with an
+    async PS server."""
+    import os
+    import socket
+    import struct  # noqa: F401
+    import subprocess
+    import sys
+    import time
+
+    def free_port():
+        s = socket.socket(); s.bind(("127.0.0.1", 0))
+        p = s.getsockname()[1]; s.close(); return p
+
+    port = free_port()
+    env = dict(os.environ)
+    env.update({"DMLC_PS_ROOT_PORT": str(port - 1), "DMLC_NUM_WORKER": "1",
+                "BYTEPS_ENABLE_ASYNC": "1", "JAX_PLATFORMS": "cpu"})
+    srv = subprocess.Popen([sys.executable, "-m", "byteps_tpu.server"],
+                           env=env, stdout=subprocess.DEVNULL,
+                           stderr=subprocess.DEVNULL)
+    try:
+        for _ in range(100):
+            try:
+                socket.create_connection(("127.0.0.1", port), 0.5).close()
+                break
+            except OSError:
+                time.sleep(0.1)
+        code = """
+import numpy as np, torch
+import byteps_tpu.torch as bps
+bps.init()
+torch.manual_seed(0)
+m = torch.nn.Linear(4, 1, bias=False)
+opt = bps.DistributedOptimizer(torch.optim.SGD(m.parameters(), lr=0.1),
+                               named_parameters=m.named_parameters())
+x = torch.eye(4); y = torch.tensor([[3.0], [-2.0], [0.5], [1.5]])
+for _ in range(80):
+    opt.zero_grad()
+    loss = torch.nn.functional.mse_loss(m(x), y)
+    loss.backward()
+    opt.step()
+w = m.weight.detach().numpy().ravel()
+np.testing.assert_allclose(w, [3.0, -2.0, 0.5, 1.5], atol=0.05)
+bps.shutdown()
+print("TORCH_ASYNC_OK")
+"""
+        wenv = dict(env)
+        wenv.update({"BYTEPS_TPU_PS_MODE": "1", "DMLC_NUM_SERVER": "1"})
+        r = subprocess.run([sys.executable, "-c", code], env=wenv,
+                           capture_output=True, text=True, timeout=240)
+        assert r.returncode == 0, r.stderr[-3000:]
+        assert "TORCH_ASYNC_OK" in r.stdout
+    finally:
+        srv.kill()
+        srv.wait()

@@ -48,3 +48,24 @@ def test_prefetch_preserves_order(mesh8):
 def test_synthetic_batches():
     it = D.synthetic_batches(lambda i: i * 2, n=3)
     assert list(it) == [0, 2, 4]
+
+
+def test_checkpoint_restore_preserves_structure_order(bps_initialized, tmp_path):
+    """Tuple-like states with >=10 entries and namedtuples whose field
+    order differs from alphabetical must restore without leaf permutation
+    (orbax restores string-keyed dicts that sort '10' before '2';
+    restoring through item=template avoids re-zipping by flatten order)."""
+    import collections
+    import numpy as np
+    from byteps_tpu.utils import checkpoint
+
+    NT = collections.namedtuple("NT", ["zulu", "alpha"])
+    state = {"t": tuple(np.full(3, i, np.float32) for i in range(12)),
+             "nt": NT(np.ones(2, np.float32), np.zeros(2, np.float32))}
+    p = str(tmp_path / "ck")
+    checkpoint.save(p, state)
+    back = checkpoint.restore(p, template=state)
+    for i in range(12):
+        np.testing.assert_array_equal(back["t"][i], state["t"][i])
+    np.testing.assert_array_equal(back["nt"].zulu, state["nt"].zulu)
+    np.testing.assert_array_equal(back["nt"].alpha, state["nt"].alpha)
