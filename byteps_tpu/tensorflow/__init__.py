@@ -1,0 +1,242 @@
+"""TensorFlow plugin: the reference's TF API surface on the TPU framework.
+
+Mirrors byteps.tensorflow (reference: byteps/tensorflow/__init__.py:40-81,
+110-182, 280-415): `init/shutdown`, `rank/size/local_rank/local_size`,
+`push_pull`, `broadcast_variables`, `broadcast_global_variables`,
+`BroadcastGlobalVariablesHook`, `DistributedOptimizer` (tf.compat.v1),
+`DistributedGradientTape` — so TF training scripts written for the
+reference port by changing the import.
+
+Execution model (same stance as the torch plugin): TF tensors live on
+host; communication rides the framework's eager push_pull (XLA
+collectives across JAX processes, or the PS tier under
+BYTEPS_TPU_PS_MODE).  Inside `tf.function` graphs the communication op is
+a `tf.py_function` boundary — the TPU compute path for TF users is
+tf.function on their side and JAX/XLA on the wire side, stitched at the
+host.  The reference instead registers a custom C++ TF op
+(tensorflow/ops.cc:87-98); a py_function keeps the same graph-insertion
+point without binding against TF's C++ ABI.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Iterable, List, Optional
+
+import numpy as np
+import tensorflow as tf
+
+from ..common import api as _api
+from ..ops.compression import Compression
+
+# Lifecycle / topology re-exports (reference: common/__init__.py:52-139)
+init = _api.init
+shutdown = _api.shutdown
+suspend = _api.suspend
+resume = _api.resume
+rank = _api.rank
+size = _api.size
+local_rank = _api.local_rank
+local_size = _api.local_size
+declare = _api.declare
+get_pushpull_speed = _api.get_pushpull_speed
+
+_name_lock = threading.Lock()
+_name_counter = 0
+
+
+def _auto_name(scope: str, tensor) -> str:
+    """Per-call-site tensor name.  The reference derives it from the TF
+    graph scope (tensorflow/ops.py:109-134).  Inside a tf.function trace a
+    process-wide counter is stable (the graph traces once and replays);
+    in EAGER mode a counter would mint a fresh declared key — and a fresh
+    server-side store — on every call, so an explicit name is required
+    there (same contract as Horovod's eager allreduce)."""
+    global _name_counter
+    tname = getattr(tensor, "name", None) if not hasattr(tensor, "numpy") \
+        else None  # EagerTensor.name raises; symbolic names are stable
+    if tname:
+        return f"{scope}byteps_push_pull_{str(tname).replace(':', '_')}"
+    if tf.executing_eagerly():
+        raise ValueError(
+            "push_pull of an eager tensor requires an explicit name= "
+            "(auto-naming would declare a new key every call)")
+    with _name_lock:
+        _name_counter += 1
+        return f"{scope}byteps_push_pull_{_name_counter}"
+
+
+def push_pull(tensor, scope: str = "", average: bool = True,
+              name: Optional[str] = None, priority: int = 0,
+              compression=Compression.none):
+    """Sum (or average) `tensor` across workers
+    (reference: tensorflow/__init__.py:40-81).
+
+    Works on eager tensors directly and inside tf.function via a
+    py_function boundary.
+    """
+    import jax.numpy as jnp
+
+    if name is None:
+        name = _auto_name(scope, tensor)
+
+    def _eager(t):
+        out = _api.push_pull(jnp.asarray(t.numpy()), name=name,
+                             average=average, priority=priority,
+                             compression=compression)
+        return tf.convert_to_tensor(np.asarray(out), dtype=t.dtype)
+
+    # Eager tensors expose .numpy(); symbolic ones (inside tf.function
+    # traces / functional graphs) don't and take the py_function boundary.
+    if tf.executing_eagerly() and hasattr(tensor, "numpy"):
+        return _eager(tf.convert_to_tensor(tensor))
+    if tf.executing_eagerly() and not tf.is_tensor(tensor):
+        return _eager(tf.convert_to_tensor(tensor))  # ndarray / python list
+    out = tf.py_function(_eager, [tensor], Tout=tensor.dtype)
+    out.set_shape(tensor.shape)
+    return out
+
+
+def broadcast_variables(variables: Iterable[tf.Variable], root_rank: int = 0,
+                        scope: str = "") -> None:
+    """Assign every worker rank `root_rank`'s values
+    (reference: tensorflow/__init__.py:110-130).  All variables travel in
+    ONE tree broadcast — a single host round-trip."""
+    import jax.numpy as jnp
+    del scope
+    vs = list(variables)
+    if not vs:
+        return
+    tree = {str(i): jnp.asarray(v.numpy()) for i, v in enumerate(vs)}
+    out = _api.broadcast_parameters(tree, root_rank)
+    for i, v in enumerate(vs):
+        v.assign(tf.convert_to_tensor(np.asarray(out[str(i)]),
+                                      dtype=v.dtype))
+
+
+def broadcast_global_variables(root_rank: int = 0) -> None:
+    """TF1 global-collection analog (reference:
+    tensorflow/__init__.py:93-108); in TF2 eager there is no globals
+    collection, so this broadcasts tf.compat.v1 global variables when a
+    graph exists and raises otherwise."""
+    gvars = tf.compat.v1.global_variables()
+    if not gvars:
+        raise ValueError(
+            "broadcast_global_variables found no global variables; in TF2 "
+            "use broadcast_variables(model.variables, root_rank)")
+    broadcast_variables(gvars, root_rank)
+
+
+class BroadcastGlobalVariablesHook(tf.compat.v1.train.SessionRunHook):
+    """TF1 MonitoredSession hook that broadcasts global variables once
+    after session creation (reference: tensorflow/__init__.py:133-182)."""
+
+    def __init__(self, root_rank: int = 0, device: str = ""):
+        super().__init__()
+        self.root_rank = root_rank
+        self.bcast_op = None
+        del device  # device pinning is XLA's job here
+
+    def begin(self):
+        gvars = tf.compat.v1.global_variables()
+        self._vars = gvars
+
+    def after_create_session(self, session, coord):
+        del session, coord
+        broadcast_variables(self._vars, self.root_rank)
+
+
+def DistributedOptimizer(optimizer, name: Optional[str] = None,
+                         use_locking: bool = False,
+                         compression=Compression.none,
+                         sparse_as_dense: bool = False,
+                         backward_passes_per_step: int = 1):
+    """Wrap a tf.compat.v1.train.Optimizer so gradients are push_pull-
+    averaged before apply (reference: tensorflow/__init__.py:280-340).
+
+    For Keras 3 optimizers use byteps_tpu.tensorflow.keras.
+    DistributedOptimizer instead.
+    """
+    if not isinstance(optimizer, tf.compat.v1.train.Optimizer):
+        raise TypeError(
+            f"DistributedOptimizer wraps tf.compat.v1.train.Optimizer; got "
+            f"{type(optimizer)} (Keras optimizers: use "
+            "byteps_tpu.tensorflow.keras.DistributedOptimizer)")
+
+    class _Dist(tf.compat.v1.train.Optimizer):
+        def __init__(self):
+            self._opt = optimizer
+            self._compression = compression
+            self._bpps = backward_passes_per_step
+            super().__init__(name=name or
+                             f"Distributed{type(optimizer).__name__}",
+                             use_locking=use_locking)
+
+        def compute_gradients(self, *args, **kwargs):
+            gvs = self._opt.compute_gradients(*args, **kwargs)
+            out = []
+            for g, v in gvs:
+                if g is None:
+                    out.append((g, v))
+                    continue
+                if sparse_as_dense and isinstance(g, tf.IndexedSlices):
+                    g = tf.convert_to_tensor(g)
+                gname = f"Gradient.{v.name.replace(':', '_')}"
+                out.append((push_pull(g, average=True, name=gname,
+                                      compression=self._compression), v))
+            return out
+
+        # Delegate everything apply-side to the wrapped optimizer.
+        def apply_gradients(self, *args, **kwargs):
+            return self._opt.apply_gradients(*args, **kwargs)
+
+        def get_slot(self, *args, **kwargs):
+            return self._opt.get_slot(*args, **kwargs)
+
+        def get_slot_names(self, *args, **kwargs):
+            return self._opt.get_slot_names(*args, **kwargs)
+
+        def variables(self, *args, **kwargs):
+            return self._opt.variables(*args, **kwargs)
+
+    return _Dist()
+
+
+class DistributedGradientTape(object):
+    """Wrap tf.GradientTape so gradient() returns push_pull-averaged
+    gradients (reference: tensorflow/__init__.py:341-415)."""
+
+    def __init__(self, gradtape: tf.GradientTape,
+                 compression=Compression.none,
+                 sparse_as_dense: bool = False):
+        self._tape = gradtape
+        self._compression = compression
+        self._sparse_as_dense = sparse_as_dense
+
+    def __enter__(self):
+        self._tape.__enter__()
+        return self
+
+    def __exit__(self, *exc):
+        return self._tape.__exit__(*exc)
+
+    def __getattr__(self, item):
+        return getattr(self._tape, item)
+
+    def gradient(self, target, sources, output_gradients=None):
+        grads = self._tape.gradient(target, sources,
+                                    output_gradients=output_gradients)
+        flat_sources = tf.nest.flatten(sources)
+        flat = []
+        for i, (g, s) in enumerate(zip(tf.nest.flatten(grads),
+                                       flat_sources)):
+            if g is None:
+                flat.append(None)
+                continue
+            if self._sparse_as_dense and isinstance(g, tf.IndexedSlices):
+                g = tf.convert_to_tensor(g)
+            sname = getattr(s, "name", f"src_{i}").replace(":", "_")
+            flat.append(push_pull(g, average=True,
+                                  name=f"Gradient.{sname}",
+                                  compression=self._compression))
+        return tf.nest.pack_sequence_as(grads, flat)

@@ -158,6 +158,11 @@ class _DistributedOptimizer(torch.optim.Optimizer):
             got = sess.push_pull(dk, p.detach().cpu().numpy(), seed=True)
             with torch.no_grad():
                 p.copy_(_from_jax(got, p))
+        if self._bpps > 1:
+            # Same accumulated-gradient normalization as the sync path.
+            for p in params:
+                if p.grad is not None:
+                    p.grad.div_(self._bpps)
         old = {id(p): p.detach().clone() for p in params}
         loss = self._inner.step(closure)
         # Dispatch every delta through the session's priority-scheduled

@@ -1,0 +1,86 @@
+"""Train an MNIST-style classifier with the TensorFlow/Keras plugin.
+
+TF counterpart of example/jax/train_mnist_byteps.py, mirroring the
+reference's example/tensorflow/tensorflow2_mnist.py +
+example/keras/keras_mnist.py shape: broadcast initial variables, wrap the
+optimizer so gradients are push_pull-averaged across workers, train.
+
+Uses a synthetic MNIST-like dataset so the example runs hermetically.
+
+Run:
+    python example/tensorflow/train_mnist_tf_byteps.py --epochs 1
+"""
+
+import argparse
+
+import numpy as np
+
+import byteps_tpu.tensorflow as bps
+
+
+def synthetic_mnist(n=4096, seed=0):
+    protos = np.random.RandomState(0).randn(10, 784).astype(np.float32)
+    rng = np.random.RandomState(seed)
+    y = rng.randint(0, 10, size=n)
+    x = protos[y] + 0.5 * rng.randn(n, 784).astype(np.float32)
+    return x, y.astype(np.int64)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--epochs", type=int, default=1)
+    ap.add_argument("--batch-size", type=int, default=256)
+    ap.add_argument("--lr", type=float, default=0.05)
+    ap.add_argument("--tape", action="store_true",
+                    help="use DistributedGradientTape instead of Keras fit")
+    args = ap.parse_args()
+
+    bps.init()
+    import tensorflow as tf
+    import keras
+    from byteps_tpu.tensorflow import keras as bps_keras
+
+    keras.utils.set_random_seed(42 + bps.rank())
+    x, y = synthetic_mnist(seed=bps.rank())
+
+    model = keras.Sequential([
+        keras.layers.Input((784,)),
+        keras.layers.Dense(128, activation="relu"),
+        keras.layers.Dense(10),
+    ])
+
+    if args.tape:
+        # Explicit-loop flavor (reference: tensorflow2_mnist.py).
+        opt = keras.optimizers.SGD(args.lr)
+        loss_fn = keras.losses.SparseCategoricalCrossentropy(
+            from_logits=True)
+        bps.broadcast_variables(model.variables, root_rank=0)
+        bs = args.batch_size
+        for epoch in range(args.epochs):
+            for i in range(0, len(x), bs):
+                xb = tf.convert_to_tensor(x[i:i + bs])
+                yb = tf.convert_to_tensor(y[i:i + bs])
+                with bps.DistributedGradientTape(tf.GradientTape()) as tape:
+                    loss = loss_fn(yb, model(xb, training=True))
+                grads = tape.gradient(loss, model.trainable_variables)
+                opt.apply_gradients(zip(grads, model.trainable_variables))
+            print(f"rank {bps.rank()}/{bps.size()} epoch {epoch}: "
+                  f"loss={float(loss):.4f}")
+    else:
+        opt = bps_keras.DistributedOptimizer(keras.optimizers.SGD(args.lr))
+        model.compile(optimizer=opt,
+                      loss=keras.losses.SparseCategoricalCrossentropy(
+                          from_logits=True),
+                      metrics=["accuracy"])
+        hist = model.fit(
+            x, y, batch_size=args.batch_size, epochs=args.epochs, verbose=0,
+            callbacks=[bps_keras.BroadcastGlobalVariablesCallback(0),
+                       bps_keras.MetricAverageCallback()])
+        acc = hist.history["accuracy"][-1]
+        print(f"rank {bps.rank()}/{bps.size()}: "
+              f"loss={hist.history['loss'][-1]:.4f} acc={acc:.3f}")
+    bps.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -80,3 +80,19 @@ def test_torch_mnist_example():
     out = _run("train_mnist_torch_byteps.py", "--epochs", "1",
                "--batch-size", "512", directory=torch_dir)
     assert "acc=" in out
+
+
+def test_tensorflow_mnist_example():
+    tf_dir = os.path.join(os.path.dirname(__file__), "..", "example",
+                          "tensorflow")
+    out = _run("train_mnist_tf_byteps.py", "--epochs", "1",
+               "--batch-size", "512", directory=tf_dir)
+    assert "acc=" in out
+
+
+def test_tensorflow_tape_example():
+    tf_dir = os.path.join(os.path.dirname(__file__), "..", "example",
+                          "tensorflow")
+    out = _run("train_mnist_tf_byteps.py", "--epochs", "1", "--tape",
+               "--batch-size", "512", directory=tf_dir)
+    assert "loss=" in out

@@ -1,0 +1,127 @@
+"""TensorFlow plugin tests, mirroring tests/test_torch_plugin.py
+(single-worker semantics; the communication layer itself is covered by
+the API/PS tests).  Reference parity target:
+byteps/tensorflow/__init__.py:40-81,110-182,280-415 and
+byteps/_keras/__init__.py:33-66."""
+
+import numpy as np
+import pytest
+
+tf = pytest.importorskip("tensorflow")
+keras = pytest.importorskip("keras")
+
+import byteps_tpu.tensorflow as bps_tf  # noqa: E402
+from byteps_tpu.tensorflow import keras as bps_keras  # noqa: E402
+
+
+@pytest.fixture
+def initialized():
+    bps_tf.init()
+    yield
+    bps_tf.shutdown()
+
+
+def test_push_pull_eager(initialized):
+    t = tf.range(6, dtype=tf.float32)
+    out = bps_tf.push_pull(t, average=True, name="tf0")
+    np.testing.assert_allclose(out.numpy(), np.arange(6, dtype=np.float32))
+
+
+def test_push_pull_inside_tf_function(initialized):
+    @tf.function
+    def f(t):
+        return bps_tf.push_pull(t, average=False, name="tf_fn")
+
+    t = tf.ones([8])
+    out = f(t)
+    np.testing.assert_allclose(out.numpy(), np.ones(8))
+    out2 = f(2 * t)  # replay the traced graph
+    np.testing.assert_allclose(out2.numpy(), 2 * np.ones(8))
+
+
+def test_broadcast_variables(initialized):
+    v1 = tf.Variable(tf.ones([4]))
+    v2 = tf.Variable(tf.zeros([2, 2]))
+    bps_tf.broadcast_variables([v1, v2], root_rank=0)
+    np.testing.assert_allclose(v1.numpy(), np.ones(4))
+    np.testing.assert_allclose(v2.numpy(), np.zeros((2, 2)))
+
+
+def test_distributed_gradient_tape_matches_plain(initialized):
+    x = tf.constant([[1.0, 2.0], [3.0, 4.0]])
+    w = tf.Variable([[1.0], [1.0]])
+
+    with tf.GradientTape() as plain:
+        loss = tf.reduce_sum(x @ w)
+    ref = plain.gradient(loss, [w])[0]
+
+    with bps_tf.DistributedGradientTape(tf.GradientTape()) as tape:
+        loss = tf.reduce_sum(x @ w)
+    got = tape.gradient(loss, [w])[0]
+    np.testing.assert_allclose(got.numpy(), ref.numpy())
+
+
+def test_v1_distributed_optimizer(initialized):
+    opt = bps_tf.DistributedOptimizer(
+        tf.compat.v1.train.GradientDescentOptimizer(0.1))
+    w = tf.Variable([1.0, 2.0])
+    gvs = opt.compute_gradients(lambda: tf.reduce_sum(w * w), var_list=[w])
+    assert len(gvs) == 1
+    g, v = gvs[0]
+    np.testing.assert_allclose(g.numpy(), [2.0, 4.0])
+    opt.apply_gradients(gvs)
+    np.testing.assert_allclose(w.numpy(), [0.8, 1.6])
+
+
+def test_keras_distributed_optimizer_matches_plain(initialized):
+    keras.utils.set_random_seed(0)
+
+    def build():
+        m = keras.Sequential([keras.layers.Input((8,)),
+                              keras.layers.Dense(4),
+                              keras.layers.Dense(2)])
+        return m
+
+    x = np.random.RandomState(0).randn(64, 8).astype(np.float32)
+    y = np.random.RandomState(1).randint(0, 2, 64)
+
+    keras.utils.set_random_seed(7)
+    m1 = build()
+    m1.compile(optimizer=keras.optimizers.SGD(0.1),
+               loss=keras.losses.SparseCategoricalCrossentropy(
+                   from_logits=True))
+    m1.fit(x, y, batch_size=32, epochs=1, shuffle=False, verbose=0)
+
+    keras.utils.set_random_seed(7)
+    m2 = build()
+    m2.compile(optimizer=bps_keras.DistributedOptimizer(
+                   keras.optimizers.SGD(0.1)),
+               loss=keras.losses.SparseCategoricalCrossentropy(
+                   from_logits=True))
+    m2.fit(x, y, batch_size=32, epochs=1, shuffle=False, verbose=0)
+
+    # world size 1: distributed averaging is the identity
+    for a, b in zip(m1.get_weights(), m2.get_weights()):
+        np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6)
+
+
+def test_keras_callbacks_smoke(initialized):
+    keras.utils.set_random_seed(0)
+    m = keras.Sequential([keras.layers.Input((4,)),
+                          keras.layers.Dense(2)])
+    m.compile(optimizer=bps_keras.DistributedOptimizer(
+                  keras.optimizers.SGD(0.3)),
+              loss="mse")
+    x = np.random.randn(32, 4).astype(np.float32)
+    y = np.random.randn(32, 2).astype(np.float32)
+    hist = m.fit(x, y, epochs=2, batch_size=16, verbose=0, callbacks=[
+        bps_keras.BroadcastGlobalVariablesCallback(0),
+        bps_keras.MetricAverageCallback(),
+        bps_keras.LearningRateWarmupCallback(warmup_epochs=1,
+                                             steps_per_epoch=2),
+    ])
+    assert np.isfinite(hist.history["loss"][-1])
+    # warmup restored the base lr at train end
+    np.testing.assert_allclose(
+        float(keras.ops.convert_to_numpy(m.optimizer.learning_rate)), 0.3,
+        rtol=1e-6)
