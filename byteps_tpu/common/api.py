@@ -60,6 +60,39 @@ def _require_init():
 # ---------------------------------------------------------------------------
 # Lifecycle (reference: operations.cc:28-119)
 # ---------------------------------------------------------------------------
+def _configure_cpu_collectives() -> None:
+    """Cross-process collectives on the CPU platform need a collectives
+    backend (gloo, compiled into jaxlib); TPU's ICI/DCN needs nothing.  Must
+    run before the first backend creation.  The setting only affects CPU
+    client creation, so it is applied unconditionally — platform
+    autodetection may resolve to cpu without JAX_PLATFORMS ever being set.
+    BYTEPS_TPU_CPU_COLLECTIVES overrides the implementation
+    ("gloo" | "mpi")."""
+    impl = os.environ.get("BYTEPS_TPU_CPU_COLLECTIVES", "gloo").strip()
+    try:
+        jax.config.update("jax_cpu_collectives_implementation", impl)
+    except Exception as e:  # unknown impl name / too-old jax
+        get_logger().warning("could not set cpu collectives impl %r: %s",
+                             impl, e)
+
+
+def _reset_jax_backends() -> None:
+    """Drop cached XLA clients and the api-level topology caches
+    (jax.process_count & co are memoized) so the next backend creation sees
+    the *current* jax.distributed world.  This is what makes elastic resize
+    possible: the reference re-runs ps-lite StartAsync against new DMLC_*
+    envs (reference: operations.cc:107-119); JAX caches its client, so an
+    equivalent re-init requires explicitly forgetting the old backend.
+
+    Raises rather than warns on failure: proceeding with a stale backend
+    would silently keep the old world size — wrong averages or a hang."""
+    from jax._src import xla_bridge as xb
+    xb._clear_backends()
+    jax.clear_caches()
+    from jax._src import util as _jax_util
+    _jax_util.clear_all_caches()
+
+
 def init(lazy: bool = True) -> None:
     """Initialize the framework.
 
@@ -74,6 +107,7 @@ def init(lazy: bool = True) -> None:
     _state.config = cfg
     if cfg.num_worker > 1 and os.environ.get("BYTEPS_TPU_JAX_DIST", "0") == "1":
         # Multi-host: map the reference's scheduler to JAX's coordinator.
+        _configure_cpu_collectives()
         jax.distributed.initialize(
             coordinator_address=f"{cfg.scheduler_uri}:{cfg.scheduler_port}",
             num_processes=cfg.num_worker,
@@ -123,9 +157,25 @@ def suspend() -> None:
 def resume(num_workers: int, num_servers: int = 0) -> None:
     """Elastic resume with a new cluster size.  Re-reads env config and
     re-declares all tensors in original order so key assignment is unchanged
-    (reference: operations.cc:107-119, global.cc:446-451)."""
+    (reference: operations.cc:107-119, global.cc:446-451).
+
+    When the collective tier is in use (BYTEPS_TPU_JAX_DIST=1), the XLA
+    backend is rebuilt for the new world size.  Device arrays created before
+    suspend() belong to the old backend and must be staged through host
+    memory across the resize (np.asarray before suspend, re-feed after
+    resume) — the analog of the reference's requirement that tensors be
+    re-declared against the new ps-lite session.
+    """
+    if _state.initialized:
+        # resume() implies the previous session is over; make that true
+        # before tearing down backends under live arrays.
+        suspend()
     os.environ["DMLC_NUM_WORKER"] = str(num_workers)
     os.environ["DMLC_NUM_SERVER"] = str(num_servers)
+    if os.environ.get("BYTEPS_TPU_JAX_DIST", "0") == "1":
+        # Both grow and shrink need a fresh client: the cached one pins the
+        # previous world's process count and gloo context.
+        _reset_jax_backends()
     core = get_core()
     # The registry is preserved across suspend (the whole point); walk it so
     # any native-side rebuild keeps the original order.

@@ -1,0 +1,204 @@
+"""Multi-process collective-tier worker, driven by tests/test_multiprocess.py.
+
+The reference fakes multi-role clusters on one machine with bpslaunch
+subprocesses (reference: tests/meta_test.py:26-84).  The collective tier's
+analog is N real `jax.distributed` CPU processes: each subprocess runs this
+script with DMLC_WORKER_ID/DMLC_NUM_WORKER + BYTEPS_TPU_JAX_DIST=1 set by the
+parent test, so `bps.init()` takes the exact production multi-host path
+(common/api.py jax.distributed.initialize) and every eager collective runs at
+size() > 1 across real process boundaries.
+
+Results are printed as `RESULT {json}` lines for the parent to assert.
+
+Usage: python mp_worker.py <scenario>   (env carries rank/world/ports)
+"""
+
+import json
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("BYTEPS_LOG_LEVEL", "ERROR")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+import optax  # noqa: E402
+
+import byteps_tpu as bps  # noqa: E402
+from byteps_tpu.common import api as _api  # noqa: E402
+
+
+def emit(**kw):
+    print("RESULT " + json.dumps(kw), flush=True)
+
+
+WID = int(os.environ.get("DMLC_WORKER_ID", "0"))
+
+
+# ---------------------------------------------------------------------------
+# Shared toy model: deterministic MLP regression.
+# ---------------------------------------------------------------------------
+def make_problem(batch: int = 16):
+    rng = np.random.RandomState(0)
+    x = rng.randn(batch, 8).astype(np.float32)
+    w_true = rng.randn(8, 1).astype(np.float32)
+    y = x @ w_true
+    params = {
+        "w1": jnp.asarray(rng.randn(8, 16).astype(np.float32) * 0.3),
+        "b1": jnp.zeros((16,)),
+        "w2": jnp.asarray(rng.randn(16, 1).astype(np.float32) * 0.3),
+    }
+
+    def loss_fn(p, b):
+        xb, yb = b
+        h = jnp.tanh(xb @ p["w1"] + p["b1"])
+        return jnp.mean((h @ p["w2"] - yb) ** 2)
+
+    return params, loss_fn, (jnp.asarray(x), jnp.asarray(y))
+
+
+def run_train_steps(n_steps: int):
+    """Train the toy problem with the production build_train_step over the
+    global mesh (1 device per process here); returns the loss history."""
+    params, loss_fn, batch = make_problem()
+    mesh = bps.make_mesh()
+    opt = bps.DistributedOptimizer(optax.sgd(0.1))
+    step = bps.build_train_step(loss_fn, opt, mesh, donate=False)
+    opt_state = opt.init(params)
+    losses = []
+    for _ in range(n_steps):
+        params, opt_state, loss = step(params, opt_state, batch)
+        losses.append(float(loss))
+    return losses, params
+
+
+def scenario_basic():
+    bps.init()
+    emit(check="topology", rank=bps.rank(), size=bps.size(),
+         process_count=jax.process_count())
+
+    # Eager sum & average across real process boundaries
+    # (api.py _eager_sum_across_processes).
+    x = jnp.full((4,), float(bps.rank() + 1))
+    s = _api.push_pull(x, name="mp.sum", average=False)
+    a = _api.push_pull(x, name="mp.avg", average=True)
+    emit(check="push_pull", sum=np.asarray(s).tolist(),
+         avg=np.asarray(a).tolist())
+
+    # Async handle lifecycle: poll until done, then synchronize.
+    h = _api.push_pull_async(x, name="mp.async", average=False)
+    polled = _api.poll(h)
+    out = _api.synchronize(h)
+    emit(check="async", polled=bool(polled), sum=np.asarray(out).tolist())
+
+    # Broadcast: every worker must end with root-0's values.
+    tree = {"w": jnp.full((3,), float(bps.rank())),
+            "nested": {"b": jnp.full((2,), float(10 * bps.rank() + 1))}}
+    bt = _api.broadcast_parameters(tree, root_rank=0)
+    emit(check="broadcast",
+         w=np.asarray(bt["w"]).tolist(),
+         b=np.asarray(bt["nested"]["b"]).tolist())
+
+    # Optimizer-state broadcast rides the same path with a deeper pytree.
+    opt_state = {"mu": {"layer": jnp.full((2, 2), float(bps.rank()))},
+                 "count": jnp.asarray(float(bps.rank()))}
+    bs = _api.broadcast_optimizer_state(opt_state, root_rank=0)
+    emit(check="broadcast_opt",
+         mu=np.asarray(bs["mu"]["layer"]).ravel().tolist(),
+         count=float(bs["count"]))
+
+    # Telemetry observed the eager traffic above.
+    ts, mbps = bps.get_pushpull_speed()
+    emit(check="speed", ts=float(ts), mbps=float(mbps))
+    bps.shutdown()
+
+
+def scenario_train():
+    bps.init()
+    losses, _ = run_train_steps(5)
+    emit(check="train", rank=bps.rank(), size=bps.size(), losses=losses)
+    bps.shutdown()
+
+
+def scenario_train_solo():
+    # World-1 reference run for loss parity (no jax.distributed): the same
+    # global batch on a 1-device mesh must produce the same loss trajectory
+    # as the 2-process data-parallel run.
+    bps.init()
+    losses, _ = run_train_steps(5)
+    emit(check="train", rank=bps.rank(), size=bps.size(), losses=losses)
+    bps.shutdown()
+
+
+def scenario_elastic_shrink():
+    """World 2 -> suspend -> world 1 (worker 1 departs), keys stable."""
+    bps.init()
+    k_a = bps.declare("elastic.a")
+    k_b = bps.declare("elastic.b")
+    losses2, params = run_train_steps(2)
+    emit(check="phase2", size=bps.size(), keys=[k_a, k_b], losses=losses2)
+
+    # Stage params to host before the backend is torn down: device arrays
+    # belong to the old client (see api.resume docstring).
+    host_params = jax.tree.map(lambda l: np.asarray(l), params)
+    bps.suspend()
+    if WID == 1:
+        emit(check="departed")
+        return
+
+    os.environ["DMLC_PS_ROOT_PORT"] = os.environ["BYTEPS_MP_PORT2"]
+    bps.resume(num_workers=1)
+    # Key stability across resize (reference: global.cc:446-451).
+    emit(check="keys_after", keys=[bps.declare("elastic.a"),
+                                   bps.declare("elastic.b")],
+         size=bps.size(), process_count=jax.process_count())
+
+    # Training continues at world 1 from the staged params.
+    params = jax.tree.map(jnp.asarray, host_params)
+    _, loss_fn, batch = make_problem()
+    mesh = bps.make_mesh()
+    opt = bps.DistributedOptimizer(optax.sgd(0.1))
+    step = bps.build_train_step(loss_fn, opt, mesh, donate=False)
+    opt_state = opt.init(params)
+    cont = []
+    for _ in range(3):
+        params, opt_state, loss = step(params, opt_state, batch)
+        cont.append(float(loss))
+    s = _api.push_pull(jnp.ones(2), name="elastic.post", average=False)
+    emit(check="continued", losses=cont, post_sum=np.asarray(s).tolist())
+    bps.shutdown()
+
+
+def scenario_elastic_grow():
+    """World 1 (both procs solo) -> resume at world 2, keys stable."""
+    os.environ["DMLC_NUM_WORKER"] = "1"
+    bps.init()
+    k_a = bps.declare("elastic.a")
+    if WID == 0:
+        losses1, _ = run_train_steps(2)
+        emit(check="phase1", size=bps.size(), key=k_a, losses=losses1)
+    bps.suspend()
+
+    bps.resume(num_workers=2)  # blocks in initialize until both procs join
+    x = jnp.full((2,), float(bps.rank() + 1))
+    s = _api.push_pull(x, name="grow.sum", average=False)
+    emit(check="grown", size=bps.size(), process_count=jax.process_count(),
+         key=bps.declare("elastic.a"), sum=np.asarray(s).tolist())
+    bps.shutdown()
+
+
+SCENARIOS = {
+    "basic": scenario_basic,
+    "train": scenario_train,
+    "train_solo": scenario_train_solo,
+    "elastic_shrink": scenario_elastic_shrink,
+    "elastic_grow": scenario_elastic_grow,
+}
+
+if __name__ == "__main__":
+    SCENARIOS[sys.argv[1]]()
+    print("WORKER_DONE", flush=True)

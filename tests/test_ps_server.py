@@ -21,12 +21,7 @@ import pytest
 from byteps_tpu.server.client import PSSession, _ServerConn, CMD_SHUTDOWN
 
 
-def _free_port():
-    s = socket.socket()
-    s.bind(("127.0.0.1", 0))
-    port = s.getsockname()[1]
-    s.close()
-    return port
+from testutil import free_port
 
 
 @pytest.fixture
@@ -35,7 +30,7 @@ def ps_server():
     made = []
 
     def start(num_workers=2, schedule=False, async_mode=False):
-        port = _free_port()
+        port = free_port()
         env = dict(os.environ)
         env.update({
             # serve() binds scheduler_port + 1 + server_id
@@ -338,6 +333,54 @@ def test_reconnect_reseeds_round_from_server(ps_server):
     got = s2.push_pull(5, np.full(16, 42.0, np.float32))
     np.testing.assert_array_equal(got, np.full(16, 42.0, np.float32))
     s2.close()
+
+
+def test_worker_restart_mid_training_against_live_servers(ps_server):
+    """Elastic restart in context: two workers run a gradient-descent loop
+    through the live server; worker 1 crashes between rounds and a
+    replacement session rejoins.  The reseed-from-INIT path
+    (client.py _stage_parts round seeding) must land the restarted worker in
+    the server's current round — training continues with correct sums, no
+    stale-round pull (reference demo:
+    example/pytorch/elastic_benchmark_byteps.py:124-133)."""
+    port = ps_server(num_workers=2)
+    key = 11
+    n = 64
+    w = {0: np.full(n, 10.0, np.float32), 1: np.full(n, 10.0, np.float32)}
+    barrier = threading.Barrier(2)
+    sums = {0: [], 1: []}
+
+    def train_rounds(sess, wid, grads):
+        for g in grads:
+            got = sess.push_pull(key, np.full(n, g, np.float32))
+            sums[wid].append(got[0])
+            w[wid] = w[wid] - 0.1 * got / 2.0  # mean of worker grads
+            barrier.wait(timeout=60)
+
+    def worker0():
+        s = _session(port, 0)
+        train_rounds(s, 0, [1.0, 2.0])      # rounds 0-1 with original peer
+        train_rounds(s, 0, [3.0, 4.0])      # rounds 2-3 with restarted peer
+        s.close()
+
+    def worker1():
+        s = _session(port, 1)
+        train_rounds(s, 1, [1.0, 2.0])
+        s.close()                            # "crash" between rounds
+        s2 = _session(port, 1)               # replacement joins live server
+        train_rounds(s2, 1, [3.0, 4.0])
+        s2.close()
+
+    ts = [threading.Thread(target=worker0), threading.Thread(target=worker1)]
+    [t.start() for t in ts]
+    [t.join(timeout=120) for t in ts]
+    assert not any(t.is_alive() for t in ts)
+    # Each round's sum is grad_w0 + grad_w1 = 2*g; a stale-round pull after
+    # the restart would have returned round 1's 4.0 for round 2.
+    np.testing.assert_allclose(sums[0], [2.0, 4.0, 6.0, 8.0])
+    np.testing.assert_allclose(sums[1], [2.0, 4.0, 6.0, 8.0])
+    # Both replicas stayed in lockstep through the restart.
+    np.testing.assert_allclose(w[0], w[1])
 
 
 def test_api_push_pull_via_ps_mode(ps_server):

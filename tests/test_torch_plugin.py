@@ -95,9 +95,7 @@ def test_async_mode_against_ps_server():
     import sys
     import time
 
-    def free_port():
-        s = socket.socket(); s.bind(("127.0.0.1", 0))
-        p = s.getsockname()[1]; s.close(); return p
+    from testutil import free_port
 
     port = free_port()
     env = dict(os.environ)

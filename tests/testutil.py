@@ -1,0 +1,10 @@
+"""Shared test helpers (importable from any test module)."""
+
+import socket
+
+
+def free_port() -> int:
+    """An ephemeral TCP port that was free at bind time."""
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
