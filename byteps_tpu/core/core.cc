@@ -6,9 +6,18 @@
 // native layer keeps only what genuinely belongs on the host: the named-tensor
 // registry with deterministic key assignment, tensor partitioning, key→server
 // placement hashing, the priority ScheduledQueue with credit-based flow
-// control, ReadyTable rendezvous counters, push-pull speed telemetry, and the
-// Chrome-trace timeline recorder.  Exposed as a flat C ABI consumed via
-// ctypes (no pybind11 in this image).
+// control, push-pull speed telemetry, and the Chrome-trace timeline recorder.
+// Exposed as a flat C ABI consumed via ctypes (no pybind11 in this image).
+//
+// Deliberately ABSENT: the reference's ReadyTable (ready_table.{h,cc}).  Its
+// job is rendezvous across the one-process-per-GPU layout — non-root local
+// processes signal readiness over UDS and the root counts signals before
+// driving NCCL/PUSH (reference: communicator.cc:164-207, global.cc:207-235).
+// Here ONE process drives all local chips (in-jit mesh collectives replace
+// the intra-host tier) so there are no local peers to count, and the PS
+// plane's cross-worker rendezvous lives on the server (round tracking /
+// barrier-by-generation in server.cc).  An earlier revision carried an
+// unused port of it; it was removed rather than kept as dead surface.
 //
 // Thread-safety: every public entry point locks the owning object's mutex;
 // objects are opaque handles created/destroyed by the caller.
@@ -273,49 +282,6 @@ BPS_API int64_t bps_queue_pending(void* qp) {
 }
 
 // ---------------------------------------------------------------------------
-// ReadyTable (reference: ready_table.{h,cc}): key -> count of ready signals;
-// a key becomes ready once `count` peers have signalled.
-// ---------------------------------------------------------------------------
-namespace {
-struct ReadyTable {
-  std::mutex mu;
-  std::unordered_map<uint64_t, int32_t> counts;
-  int32_t threshold;
-};
-}  // namespace
-
-BPS_API void* bps_ready_table_create(int32_t threshold) {
-  auto* t = new ReadyTable();
-  t->threshold = threshold;
-  return t;
-}
-
-BPS_API void bps_ready_table_destroy(void* tp) {
-  delete static_cast<ReadyTable*>(tp);
-}
-
-// Adds one signal; returns 1 if the key just became (or already was) ready.
-BPS_API int32_t bps_ready_table_add(void* tp, uint64_t key) {
-  auto* t = static_cast<ReadyTable*>(tp);
-  std::lock_guard<std::mutex> lk(t->mu);
-  int32_t c = ++t->counts[key];
-  return c >= t->threshold ? 1 : 0;
-}
-
-BPS_API int32_t bps_ready_table_is_ready(void* tp, uint64_t key) {
-  auto* t = static_cast<ReadyTable*>(tp);
-  std::lock_guard<std::mutex> lk(t->mu);
-  auto it = t->counts.find(key);
-  return (it != t->counts.end() && it->second >= t->threshold) ? 1 : 0;
-}
-
-BPS_API void bps_ready_table_clear(void* tp, uint64_t key) {
-  auto* t = static_cast<ReadyTable*>(tp);
-  std::lock_guard<std::mutex> lk(t->mu);
-  t->counts.erase(key);
-}
-
-// ---------------------------------------------------------------------------
 // Push-pull speed telemetry (reference: global.cc:712-767): ring buffer of
 // (timestamp, bytes) push events; speed is a moving average over the last
 // `window_us` (reference uses 10 s).
@@ -373,6 +339,12 @@ struct TraceEvent {
   std::string stage;
   int64_t ts_us;
   int64_t dur_us;
+  // Per-partition detail (reference closes one span per partition per
+  // pipeline stage, global.cc:463-579).  key < 0 means "not a partition
+  // event" and the args object is omitted from the dump.
+  int64_t key = -1;
+  int64_t bytes = 0;
+  int32_t priority = 0;
 };
 
 struct Tracer {
@@ -396,6 +368,19 @@ BPS_API void bps_trace_record(const char* name, const char* stage,
   std::lock_guard<std::mutex> lk(g_tracer.mu);
   if (!g_tracer.on) return;
   g_tracer.events.push_back(TraceEvent{name, stage, ts_us, dur_us});
+}
+
+// Per-partition span: one row per partition per stage (QUEUE/PUSH/PULL on
+// the PS plane), carrying the partition key, wire bytes, and priority as
+// Chrome-trace args.
+BPS_API void bps_trace_record_part(const char* name, const char* stage,
+                                   int64_t ts_us, int64_t dur_us,
+                                   int64_t key, int64_t bytes,
+                                   int32_t priority) {
+  std::lock_guard<std::mutex> lk(g_tracer.mu);
+  if (!g_tracer.on) return;
+  g_tracer.events.push_back(
+      TraceEvent{name, stage, ts_us, dur_us, key, bytes, priority});
 }
 
 BPS_API int64_t bps_trace_count() {
@@ -442,9 +427,15 @@ BPS_API int32_t bps_trace_dump(const char* path, int32_t rank) {
     first = false;
     std::fprintf(f,
                  "{\"name\":\"%s\",\"cat\":\"comm\",\"ph\":\"X\",\"ts\":%lld,"
-                 "\"dur\":%lld,\"pid\":%d,\"tid\":\"%s\"}",
+                 "\"dur\":%lld,\"pid\":%d,\"tid\":\"%s\"",
                  json_escape(e.name).c_str(), (long long)e.ts_us,
                  (long long)e.dur_us, rank, json_escape(e.stage).c_str());
+    if (e.key >= 0) {
+      std::fprintf(f,
+                   ",\"args\":{\"key\":%lld,\"bytes\":%lld,\"priority\":%d}",
+                   (long long)e.key, (long long)e.bytes, e.priority);
+    }
+    std::fputs("}", f);
   }
   std::fputs("\n],\"displayTimeUnit\":\"ms\"}\n", f);
   std::fclose(f);

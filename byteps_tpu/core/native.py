@@ -22,6 +22,10 @@ class _CCore:
 
     def __init__(self, lib: ctypes.CDLL):
         self._lib = lib
+        # Python-side mirror of the native tracer's on/off flag: hot paths
+        # (the PS dispatcher) read this attribute instead of crossing the
+        # ctypes boundary and taking the tracer mutex per partition.
+        self.trace_on = False
         L = lib
         L.bps_declare_tensor.argtypes = [ctypes.c_char_p]
         L.bps_declare_tensor.restype = ctypes.c_int32
@@ -63,14 +67,6 @@ class _CCore:
         L.bps_queue_report_finish.argtypes = [ctypes.c_void_p, ctypes.c_int64]
         L.bps_queue_pending.argtypes = [ctypes.c_void_p]
         L.bps_queue_pending.restype = ctypes.c_int64
-        L.bps_ready_table_create.argtypes = [ctypes.c_int32]
-        L.bps_ready_table_create.restype = ctypes.c_void_p
-        L.bps_ready_table_destroy.argtypes = [ctypes.c_void_p]
-        L.bps_ready_table_add.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
-        L.bps_ready_table_add.restype = ctypes.c_int32
-        L.bps_ready_table_is_ready.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
-        L.bps_ready_table_is_ready.restype = ctypes.c_int32
-        L.bps_ready_table_clear.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
         L.bps_telemetry_set_window_us.argtypes = [ctypes.c_int64]
         L.bps_telemetry_record.argtypes = [ctypes.c_int64]
         L.bps_telemetry_speed_mbps.restype = ctypes.c_double
@@ -78,6 +74,9 @@ class _CCore:
         L.bps_trace_now_us.restype = ctypes.c_int64
         L.bps_trace_record.argtypes = [ctypes.c_char_p, ctypes.c_char_p,
                                        ctypes.c_int64, ctypes.c_int64]
+        L.bps_trace_record_part.argtypes = [
+            ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int64, ctypes.c_int64,
+            ctypes.c_int64, ctypes.c_int64, ctypes.c_int32]
         L.bps_trace_count.restype = ctypes.c_int64
         L.bps_trace_dump.argtypes = [ctypes.c_char_p, ctypes.c_int32]
         L.bps_trace_dump.restype = ctypes.c_int32
@@ -129,9 +128,6 @@ class _CCore:
     def queue_create(self, credit_bytes: int = 0) -> "NativeQueue":
         return NativeQueue(self._lib, credit_bytes)
 
-    def ready_table_create(self, threshold: int) -> "NativeReadyTable":
-        return NativeReadyTable(self._lib, threshold)
-
     # -- telemetry --
     def telemetry_record(self, nbytes: int) -> None:
         self._lib.bps_telemetry_record(nbytes)
@@ -147,6 +143,7 @@ class _CCore:
 
     # -- tracing --
     def trace_enable(self, on: bool) -> None:
+        self.trace_on = bool(on)
         self._lib.bps_trace_enable(1 if on else 0)
 
     def trace_now_us(self) -> int:
@@ -155,6 +152,14 @@ class _CCore:
     def trace_record(self, name: str, stage: str, ts_us: int,
                      dur_us: int) -> None:
         self._lib.bps_trace_record(name.encode(), stage.encode(), ts_us, dur_us)
+
+    def trace_record_part(self, name: str, stage: str, ts_us: int,
+                          dur_us: int, key: int, nbytes: int,
+                          priority: int) -> None:
+        """Per-partition span (QUEUE/PUSH/PULL) with key/bytes/priority args
+        (reference: per-partition spans in global.cc:463-579)."""
+        self._lib.bps_trace_record_part(name.encode(), stage.encode(), ts_us,
+                                        dur_us, key, nbytes, priority)
 
     def trace_count(self) -> int:
         return self._lib.bps_trace_count()
@@ -211,27 +216,6 @@ class NativeQueue:
             pass
 
 
-class NativeReadyTable:
-    def __init__(self, lib: ctypes.CDLL, threshold: int):
-        self._lib = lib
-        self._t = lib.bps_ready_table_create(threshold)
-
-    def add(self, key: int) -> bool:
-        return bool(self._lib.bps_ready_table_add(self._t, key))
-
-    def is_ready(self, key: int) -> bool:
-        return bool(self._lib.bps_ready_table_is_ready(self._t, key))
-
-    def clear(self, key: int) -> None:
-        self._lib.bps_ready_table_clear(self._t, key)
-
-    def __del__(self):
-        try:
-            self._lib.bps_ready_table_destroy(self._t)
-        except Exception:
-            pass
-
-
 # ---------------------------------------------------------------------------
 # Pure-Python fallback with identical semantics (used when g++ is unavailable).
 # ---------------------------------------------------------------------------
@@ -278,28 +262,9 @@ class _PyQueue:
             return len(self._tasks)
 
 
-class _PyReadyTable:
-    def __init__(self, threshold):
-        self._threshold = threshold
-        self._counts: dict = {}
-        self._lock = threading.Lock()
-
-    def add(self, key):
-        with self._lock:
-            self._counts[key] = self._counts.get(key, 0) + 1
-            return self._counts[key] >= self._threshold
-
-    def is_ready(self, key):
-        with self._lock:
-            return self._counts.get(key, 0) >= self._threshold
-
-    def clear(self, key):
-        with self._lock:
-            self._counts.pop(key, None)
-
-
 class _PyCore:
     def __init__(self):
+        self.trace_on = False  # same hot-path gate as _CCore
         self._name2key: dict = {}
         self._names: list = []
         self._lock = threading.Lock()
@@ -382,9 +347,6 @@ class _PyCore:
     def queue_create(self, credit_bytes=0):
         return _PyQueue(credit_bytes)
 
-    def ready_table_create(self, threshold):
-        return _PyReadyTable(threshold)
-
     def telemetry_set_window_us(self, us):
         self._tel_window_us = us
 
@@ -404,14 +366,21 @@ class _PyCore:
         self._tel_events.clear()
 
     def trace_enable(self, on):
-        self._trace_on = bool(on)
+        self.trace_on = self._trace_on = bool(on)
 
     def trace_now_us(self):
         return time.monotonic_ns() // 1000
 
     def trace_record(self, name, stage, ts_us, dur_us):
         if self._trace_on:
-            self._trace_events.append((name, stage, ts_us, dur_us))
+            self._trace_events.append((name, stage, ts_us, dur_us, None))
+
+    def trace_record_part(self, name, stage, ts_us, dur_us, key, nbytes,
+                          priority):
+        if self._trace_on:
+            self._trace_events.append(
+                (name, stage, ts_us, dur_us,
+                 {"key": key, "bytes": nbytes, "priority": priority}))
 
     def trace_count(self):
         return len(self._trace_events)
@@ -419,8 +388,9 @@ class _PyCore:
     def trace_dump(self, path, rank):
         import json
         events = [{"name": n, "cat": "comm", "ph": "X", "ts": ts, "dur": d,
-                   "pid": rank, "tid": stage}
-                  for (n, stage, ts, d) in self._trace_events]
+                   "pid": rank, "tid": stage,
+                   **({"args": args} if args else {})}
+                  for (n, stage, ts, d, args) in self._trace_events]
         with open(path, "w") as f:
             json.dump({"traceEvents": events, "displayTimeUnit": "ms"}, f)
         self._trace_events.clear()

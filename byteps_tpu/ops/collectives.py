@@ -183,15 +183,21 @@ def bucketed_tree_all_reduce(
     out_segments: List[List[Optional[jax.Array]]] = [[] for _ in leaves]
     seg_starts: List[List[int]] = [[] for _ in leaves]
     for bi, bucket in enumerate(plan.buckets):
-        parts = [lax.dynamic_slice(flat[li], (start,), (length,))
-                 for (li, start, length) in bucket]
-        buf = jnp.concatenate(parts) if len(parts) > 1 else parts[0]
-        if bucket_transform is not None:
-            buf = bucket_transform(buf, bi)
-        else:
-            buf = all_reduce(buf, axis_name)
-        if average:
-            buf = buf / denom
+        # Named scope per bucket: the in-graph analog of the reference's
+        # per-partition trace spans (global.cc:463-579) — the XLA profiler
+        # attributes each bucket's collective to `byteps.bucket<N>` so the
+        # per-bucket timeline is visible in a jax.profiler trace
+        # (composition documented in docs/timeline.md).
+        with jax.named_scope(f"byteps.bucket{bi}"):
+            parts = [lax.dynamic_slice(flat[li], (start,), (length,))
+                     for (li, start, length) in bucket]
+            buf = jnp.concatenate(parts) if len(parts) > 1 else parts[0]
+            if bucket_transform is not None:
+                buf = bucket_transform(buf, bi)
+            else:
+                buf = all_reduce(buf, axis_name)
+            if average:
+                buf = buf / denom
         off = 0
         for (li, start, length) in bucket:
             out_segments[li].append(lax.dynamic_slice(buf, (off,), (length,)))

@@ -11,8 +11,20 @@ weights.
 
 TPU-native shape: the functional equivalent of the reference's in-place
 `p.data.sub_(old); push_pull(p)` is an explicit trainer object that flattens
-the param pytree once, tracks the last pulled global weights, and exposes
+the param pytree once, tracks the last adopted global weights, and exposes
 one `step(updated_params)` call.
+
+Pipelining: by default the trainer double-buffers — `step()` dispatches the
+new delta and waits only for the *previous* round, never its own, so each
+round's network round-trip overlaps the local compute of the NEXT step
+instead of serializing after it (the eager analog of the reference's
+communication/compute overlap: core_loops.cc pipeline,
+torch/cross_barrier.py).  Because consecutive rounds share partition keys,
+the session's sequential-use guard orders round k+1's wire dispatch after
+round k's pull — the overlap is round-trip-against-compute, not two
+simultaneous wire transfers.  Each pushed delta is the pure local optimizer
+movement, so pipelining never double-counts: the adopted view is
+`global_after_previous_round + own_in_flight_movement`.
 """
 
 from __future__ import annotations
@@ -32,12 +44,16 @@ class AsyncPSTrainer:
         trainer = AsyncPSTrainer(session, params, name="model")
         for batch in data:
             updated = local_sgd_step(trainer.params, batch)  # any local opt
-            trainer.step(updated)          # push delta, pull global weights
-            # trainer.params now holds the global view
+            trainer.step(updated)          # push delta, adopt global view
+            # trainer.params now holds the (possibly 1-round-stale) view
+        final = trainer.finalize()         # drain in-flight, pure global
+
+    `pipeline=False` restores the fully synchronous push→wait→adopt cycle
+    (one round in flight, zero staleness relative to the server).
     """
 
     def __init__(self, session, params: PyTree, name: str = "async_param",
-                 declared_key: Optional[int] = None):
+                 declared_key: Optional[int] = None, pipeline: bool = True):
         import jax
 
         if getattr(session, "server_async", True) is False:
@@ -46,6 +62,7 @@ class AsyncPSTrainer:
                 "BYTEPS_ENABLE_ASYNC=1; against a sync server the weight-"
                 "delta protocol would silently train on deltas")
         self._session = session
+        self._pipeline = pipeline
         self._treedef = jax.tree.structure(params)
         leaves = jax.tree.leaves(params)
         self._shapes = [np.shape(l) for l in leaves]
@@ -56,6 +73,8 @@ class AsyncPSTrainer:
             declared_key = get_core().declare_tensor(f"AsyncParam.{name}")
         self._key = declared_key
         self._flat = self._flatten(params)
+        # Outstanding round: (handle, in-flight movement) — at most one.
+        self._pending = None
         # Seed the server store with the initial weights.  DT_SEED applies
         # only if the key has never been pushed — a late-joining or
         # rejoining worker adopts the live global weights from the pull
@@ -84,13 +103,43 @@ class AsyncPSTrainer:
 
     @property
     def params(self) -> PyTree:
-        """The latest pulled global weights, as the original pytree."""
+        """The current local view (last adopted global + own in-flight
+        movement), as the original pytree."""
         return self._unflatten(self._flat)
 
     def step(self, updated_params: PyTree) -> PyTree:
-        """Push (updated - last_global) delta; pull and adopt global weights."""
+        """Push the local movement (updated - current view) as a delta.
+
+        Pipelined (default): dispatch the new delta, then wait for the
+        PREVIOUS round's pull — which had the whole local compute step that
+        produced `updated_params` to complete, so a step blocks on the
+        network only for whatever round-trip time compute didn't already
+        cover.  The adopted view is `global_after_prev +
+        in_flight_movement`; the in-flight movement is folded in again when
+        its own round is adopted next step, and the server has it already,
+        so nothing is counted twice.
+        """
         new_flat = self._flatten(updated_params)
         delta = new_flat - self._flat
-        self._flat = self._session.push_pull(self._key, delta).astype(
-            np.float32)
+        handle = self._session.push_pull_async(self._key, delta)
+        if not self._pipeline:
+            self._flat = handle.wait().astype(np.float32)
+            return self.params
+        prev, self._pending = self._pending, (handle, delta)
+        if prev is not None:
+            prev_handle, _prev_delta = prev
+            g = prev_handle.wait().astype(np.float32)
+            # g reflects the server *after* our previous round; our newest
+            # movement (delta) is still in flight, so keep it locally.
+            self._flat = g + delta
+        else:
+            self._flat = new_flat
+        return self.params
+
+    def finalize(self, timeout: Optional[float] = 300.0) -> PyTree:
+        """Drain the in-flight round and adopt the pure global weights."""
+        if self._pending is not None:
+            handle, _delta = self._pending
+            self._pending = None
+            self._flat = handle.wait(timeout).astype(np.float32)
         return self.params

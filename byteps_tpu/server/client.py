@@ -215,10 +215,11 @@ class _PartTask:
     common.h:221-264)."""
 
     __slots__ = ("pkey", "payload", "off", "ln", "round", "conn", "handle",
-                 "dtype", "done_evt", "wire_ln", "bidirectional")
+                 "dtype", "done_evt", "wire_ln", "bidirectional",
+                 "label", "priority", "enq_ts", "push_ts", "pull_ts")
 
     def __init__(self, pkey, payload, off, ln, rnd, conn, handle,
-                 dtype=DT_F32, bidirectional=False):
+                 dtype=DT_F32, bidirectional=False, label=""):
         self.pkey = pkey
         self.payload = payload        # wire bytes (raw f32 or compressed)
         self.off = off                # raw byte offset in the tensor
@@ -230,6 +231,14 @@ class _PartTask:
         self.dtype = dtype
         self.bidirectional = bidirectional  # pull leg may arrive compressed
         self.done_evt = threading.Event()  # this partition left _inflight
+        # Per-partition trace spans (reference closes one span per partition
+        # per stage, global.cc:463-579): QUEUE = enq->dispatch,
+        # PUSH = dispatch->ack, PULL = issue->data.
+        self.label = label
+        self.priority = 0
+        self.enq_ts = 0
+        self.push_ts = 0
+        self.pull_ts = 0
 
 
 class PSSession:
@@ -260,6 +269,7 @@ class PSSession:
         self._compressors: Dict[int, object] = {}  # declared_key -> codec
         self._server_load = [0] * len(self.conns)
         self._plans: Dict[Tuple[int, int], list] = {}
+        self._trace_labels: Dict[int, str] = {}
 
         # Dispatcher: native priority ScheduledQueue + credit flow control
         # (reference: scheduled_queue.cc:26-46,136-139).  credit = 0 means
@@ -378,6 +388,12 @@ class PSSession:
                 continue
             if self.record_push_order:
                 self.push_order.append(pkey)
+            core = get_core()
+            if core.trace_on and part.enq_ts:
+                part.push_ts = core.trace_now_us()
+                core.trace_record_part(part.label, "QUEUE", part.enq_ts,
+                                       part.push_ts - part.enq_ts, pkey,
+                                       part.wire_ln, part.priority)
             try:
                 part.conn.send(
                     CMD_PUSH, pkey, part.payload, worker_id=self.worker_id,
@@ -402,6 +418,12 @@ class PSSession:
             part = self._inflight.get(pkey)
         if part is None:
             return
+        core = get_core()
+        if core.trace_on and part.push_ts:
+            part.pull_ts = core.trace_now_us()
+            core.trace_record_part(part.label, "PUSH", part.push_ts,
+                                   part.pull_ts - part.push_ts, pkey,
+                                   part.wire_ln, part.priority)
         try:
             part.conn.send(
                 CMD_PULL, pkey, worker_id=self.worker_id, flags=part.round,
@@ -423,6 +445,11 @@ class PSSession:
                 self._round[pkey] = part.round + 1
         if part is None:
             return
+        core = get_core()
+        if core.trace_on and part.pull_ts:
+            core.trace_record_part(part.label, "PULL", part.pull_ts,
+                                   core.trace_now_us() - part.pull_ts, pkey,
+                                   len(data), part.priority)
         try:
             n = part.ln // 4
             if part.bidirectional and len(data) != part.ln:
@@ -483,10 +510,11 @@ class PSSession:
         mv = memoryview(raw_bytes)
         comp = self._compressors.get(declared_key)
         kw_bytes = comp.kwargs_string().encode() if comp else b""
+        label = self._label(declared_key)
         parts = []
         try:
             self._stage_parts(plan, payload, mv, comp, kw_bytes, handle,
-                              parts, raw, seed)
+                              parts, raw, seed, label)
         except Exception:
             # Roll back partitions already staged in _inflight: leaving them
             # would wedge the key forever (the sequential-use guard waits on
@@ -497,14 +525,28 @@ class PSSession:
                         del self._inflight[p.pkey]
                     p.done_evt.set()
             raise
+        core = get_core()
+        enq = core.trace_now_us() if core.trace_on else 0
         with self._cv:
             for p in parts:
+                p.priority = priority
+                p.enq_ts = enq
                 self._queue.add(p.pkey, priority, p.wire_ln)
             self._cv.notify_all()
         return handle
 
+    def _label(self, declared_key: int) -> str:
+        """Tensor name for trace rows (falls back to the numeric key for
+        sessions driven outside the declare() registry)."""
+        lbl = self._trace_labels.get(declared_key)
+        if lbl is None:
+            name = get_core().declared_name(declared_key)
+            lbl = name if name else f"key_{declared_key}"
+            self._trace_labels[declared_key] = lbl
+        return lbl
+
     def _stage_parts(self, plan, payload, mv, comp, kw_bytes, handle,
-                     parts, raw, seed) -> None:
+                     parts, raw, seed, label="") -> None:
         for pkey, off, ln, conn in plan:
             # BYTEPS_MIN_COMPRESS_BYTES floor: small partitions go raw
             # (reference: operations.cc:362-364).
@@ -539,7 +581,8 @@ class PSSession:
                             pkey, wire_payload, off, ln,
                             self._round.get(pkey, 0), conn, handle,
                             dtype=dtype,
-                            bidirectional=use_comp and comp.bidirectional)
+                            bidirectional=use_comp and comp.bidirectional,
+                            label=f"{label}.part{pkey & 0xFFFF}")
                         self._inflight[pkey] = part
                         parts.append(part)
                         break

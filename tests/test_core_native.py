@@ -105,18 +105,6 @@ def test_scheduled_queue_get_key(core):
     assert q.pending() == 1
 
 
-def test_ready_table_rendezvous(core):
-    # Key ready after `threshold` peer signals (reference: ready_table.h:26-45).
-    t = core.ready_table_create(3)
-    assert not t.add(42)
-    assert not t.add(42)
-    assert t.add(42)
-    assert t.is_ready(42)
-    assert not t.is_ready(7)
-    t.clear(42)
-    assert not t.is_ready(42)
-
-
 def test_telemetry_speed(core):
     core.telemetry_reset()
     core.telemetry_set_window_us(1_000_000)

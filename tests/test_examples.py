@@ -96,3 +96,19 @@ def test_tensorflow_tape_example():
     out = _run("train_mnist_tf_byteps.py", "--epochs", "1", "--tape",
                "--batch-size", "512", directory=tf_dir)
     assert "loss=" in out
+
+
+def test_torch_fp16_example():
+    torch_dir = os.path.join(os.path.dirname(__file__), "..", "example",
+                             "torch")
+    out = _run("train_mnist_fp16_byteps.py", "--steps", "8",
+               directory=torch_dir)
+    assert "fp16 training done" in out
+
+
+def test_tensorflow_mirrored_example():
+    tf_dir = os.path.join(os.path.dirname(__file__), "..", "example",
+                          "tensorflow")
+    out = _run("train_mnist_mirrored_byteps.py", "--epochs", "1",
+               directory=tf_dir)
+    assert "mirrored strategy training done" in out

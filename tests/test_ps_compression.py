@@ -177,7 +177,9 @@ def test_late_joiner_adopts_global_weights(ps_server):
     for _ in range(3):
         w = t1.params["w"]
         t1.step({"w": w + 1.0})  # deltas of +1
-    progressed = t1.params["w"].copy()
+    # Drain the pipelined round so the server deterministically holds all
+    # three deltas before the late joiner reads it.
+    progressed = t1.finalize()["w"].copy()
     assert progressed[0] > 5.0
     # Late joiner with different (zero) initial weights:
     s2 = _sess(port, 1)

@@ -1,0 +1,104 @@
+"""MirroredStrategy analog: cross-replica reduction routes into push_pull
+with chunked packing (reference: byteps/tensorflow/distribute/
+cross_device_ops.py:585-627, 251-296)."""
+
+import numpy as np
+import pytest
+
+tf = pytest.importorskip("tensorflow")
+
+import byteps_tpu.tensorflow as bps_tf  # noqa: E402
+from byteps_tpu.tensorflow.distribute import (  # noqa: E402
+    BytepsCrossDeviceOps, MirroredStrategy)
+
+
+@pytest.fixture(autouse=True)
+def _init():
+    bps_tf.init()
+    yield
+    bps_tf.shutdown()
+
+
+def _tensors():
+    rng = np.random.RandomState(7)
+    return [tf.constant(rng.randn(*s).astype(np.float32))
+            for s in [(4, 3), (10,), (2, 2, 2), (1,), (5, 1)]]
+
+
+@pytest.mark.parametrize("num_packs", [0, 1, 2, 5])
+def test_batch_reduce_matches_per_tensor_push_pull(num_packs):
+    """Packed reduction must equal the unpacked per-tensor push_pull —
+    the fork's contract that packing is a pure transport optimization."""
+    vals = _tensors()
+    xops = BytepsCrossDeviceOps(num_packs=num_packs, scope=f"t{num_packs}")
+    got = xops.batch_reduce("sum", vals)
+    want = [bps_tf.push_pull(v, average=False, name=f"ref.{num_packs}.{i}")
+            for i, v in enumerate(vals)]
+    assert len(got) == len(vals)
+    for g, w, v in zip(got, want, vals):
+        assert g.shape == v.shape and g.dtype == v.dtype
+        np.testing.assert_allclose(g.numpy(), w.numpy(), rtol=1e-6)
+
+
+def test_chunking_matches_reference_split():
+    """First n-1 chunks get len//num_packs tensors, last gets the leftover
+    (reference: cross_device_ops.py:251-296 _make_gradient_chunks)."""
+    xops = BytepsCrossDeviceOps(num_packs=3)
+    chunks = xops._chunks(list(range(8)))  # 8 tensors, 3 packs
+    assert chunks == [[0, 1], [2, 3], [4, 5, 6, 7]]
+    # fewer tensors than packs: no packing
+    assert BytepsCrossDeviceOps(num_packs=5)._chunks([1, 2]) == [[0], [1]]
+    with pytest.raises(ValueError):
+        BytepsCrossDeviceOps(num_packs=-1)
+
+
+def test_strategy_reduce_and_extended():
+    strat = MirroredStrategy(num_packs=2)
+    assert strat.num_replicas_in_sync == 1
+    x = tf.constant([2.0, 4.0])
+    np.testing.assert_allclose(strat.reduce("mean", x).numpy(), [2.0, 4.0])
+    pairs = [(tf.constant([1.0]), None), (tf.constant([3.0, 5.0]), None)]
+    out = strat.extended.batch_reduce_to(tf.distribute.ReduceOp.SUM, pairs)
+    np.testing.assert_allclose(out[0].numpy(), [1.0])
+    np.testing.assert_allclose(out[1].numpy(), [3.0, 5.0])
+
+
+def test_scope_broadcasts_created_variables():
+    strat = MirroredStrategy()
+    with strat.scope():
+        v1 = tf.Variable([1.0, 2.0])
+        v2 = tf.Variable(3.0)
+    assert strat.broadcast_count == 2
+    np.testing.assert_allclose(v1.numpy(), [1.0, 2.0])
+    assert float(v2.numpy()) == 3.0
+
+
+def test_keras_fit_under_strategy_trains():
+    """model.fit composed with the strategy: variables broadcast at
+    creation, gradients reduced through the framework push_pull."""
+    import keras
+
+    rng = np.random.RandomState(0)
+    x = rng.randn(64, 4).astype(np.float32)
+    y = (x @ rng.randn(4, 1).astype(np.float32)).astype(np.float32)
+
+    strat = MirroredStrategy(num_packs=2)
+    with strat.scope():
+        model = keras.Sequential([
+            keras.layers.Input((4,)),
+            keras.layers.Dense(8, activation="tanh"),
+            keras.layers.Dense(1),
+        ])
+        opt = strat.distribute_optimizer(keras.optimizers.SGD(0.1))
+        model.compile(optimizer=opt, loss="mse")
+    assert strat.broadcast_count >= 4  # 2 layers x (kernel + bias)
+    hist = model.fit(x, y, epochs=4, batch_size=16, verbose=0)
+    losses = hist.history["loss"]
+    assert losses[-1] < losses[0]
+
+
+def test_distribute_dataset_shards_by_worker():
+    strat = MirroredStrategy()
+    ds = tf.data.Dataset.range(10)
+    got = [int(v) for v in strat.experimental_distribute_dataset(ds)]
+    assert got == list(range(10))  # world 1: every element

@@ -84,6 +84,100 @@ def test_ddp_wrapper(initialized):
         assert p.grad is not None
 
 
+def test_ddp_auto_sync_without_explicit_synchronize(initialized):
+    """`loss.backward(); opt.step()` must work with no DistributedOptimizer
+    and no manual synchronize(): the last grad hook fires the sync
+    (reference: torch/parallel/distributed.py:235-243)."""
+    torch.manual_seed(0)
+    m = bps_torch.DistributedDataParallel(
+        torch.nn.Sequential(torch.nn.Linear(4, 8), torch.nn.Tanh(),
+                            torch.nn.Linear(8, 1)))
+    opt = torch.optim.SGD(m.parameters(), lr=0.1)
+    x = torch.randn(16, 4)
+    y = x @ torch.randn(4, 1)
+    losses = []
+    for _ in range(5):
+        opt.zero_grad()
+        loss = torch.nn.functional.mse_loss(m(x), y)
+        loss.backward()          # auto-sync fires here, on the last hook
+        opt.step()
+        losses.append(float(loss.detach()))
+    assert m.autosync_count == 5
+    assert losses[-1] < losses[0]
+    # auto_sync=False restores the explicit contract
+    m2 = bps_torch.DistributedDataParallel(torch.nn.Linear(2, 1),
+                                           auto_sync=False)
+    m2(torch.randn(3, 2)).sum().backward()
+    assert m2.autosync_count == 0
+
+
+def test_fp16_master_weight_optimizer_parity(initialized):
+    """Half-precision model + fp32 masters must track an fp32 run within
+    half-precision tolerance (reference: misc/imagenet18/__init__.py:39-330
+    _HalfPrecisionDistributedOptimizer)."""
+    def make_model(dtype):
+        torch.manual_seed(42)
+        m = torch.nn.Sequential(torch.nn.Linear(6, 16), torch.nn.Tanh(),
+                                torch.nn.Linear(16, 1))
+        return m.to(dtype)
+
+    torch.manual_seed(1)
+    x = torch.randn(64, 6)
+    y = x @ torch.randn(6, 1)
+
+    # fp32 reference run
+    m32 = make_model(torch.float32)
+    o32 = torch.optim.SGD(m32.parameters(), lr=0.05)
+    ref_losses = []
+    for _ in range(12):
+        o32.zero_grad()
+        loss = torch.nn.functional.mse_loss(m32(x), y)
+        loss.backward()
+        o32.step()
+        ref_losses.append(float(loss))
+
+    # fp16 model, fp32 masters, static loss scale
+    m16 = make_model(torch.float16)
+    opt = bps_torch.HalfPrecisionDistributedOptimizer(
+        m16, lambda ps: torch.optim.SGD(ps, lr=0.05), loss_scale=1024.0)
+    fp16_losses = []
+    for _ in range(12):
+        opt.zero_grad()
+        loss = torch.nn.functional.mse_loss(m16(x.half()).float(),
+                                            y.float())
+        opt.scale_loss(loss).backward()
+        opt.step()
+        fp16_losses.append(float(loss))
+    assert opt.steps_skipped == 0
+    # Parity within fp16 tolerance, and the run genuinely trains.
+    np.testing.assert_allclose(fp16_losses, ref_losses, rtol=0.05, atol=5e-3)
+    assert fp16_losses[-1] < fp16_losses[0] * 0.5
+    # masters stay fp32, model stays fp16
+    assert all(p.dtype == torch.float32 for p in opt._master_params)
+    assert all(p.dtype == torch.float16 for p in m16.parameters())
+
+
+def test_fp16_dynamic_loss_scale_skips_overflow(initialized):
+    m = torch.nn.Linear(2, 1).to(torch.float16)
+    opt = bps_torch.HalfPrecisionDistributedOptimizer(
+        m, lambda ps: torch.optim.SGD(ps, lr=0.1), loss_scale="dynamic")
+    s0 = opt.loss_scale
+    before = [p.detach().clone() for p in opt._master_params]
+    # Force an overflow: inf gradient
+    for p in m.parameters():
+        p.grad = torch.full_like(p, float("inf"))
+    opt.step()
+    assert opt.steps_skipped == 1
+    assert opt.loss_scale == s0 / 2          # halved on overflow
+    for b, p in zip(before, opt._master_params):  # update skipped
+        assert torch.equal(b, p.detach())
+    # A clean step applies and counts toward growth
+    for p in m.parameters():
+        p.grad = torch.ones_like(p)
+    opt.step()
+    assert opt.steps_skipped == 1
+
+
 def test_async_mode_against_ps_server():
     """enable_async: step() pushes weight deltas, adopts global weights
     (reference: torch/__init__.py:186-214).  Runs in a subprocess with an

@@ -1,0 +1,125 @@
+"""Per-partition trace events (reference: global.cc:463-579 closes one span
+per partition per pipeline stage; docs/timeline.md documents the schema)."""
+
+import json
+import threading
+
+import numpy as np
+import pytest
+
+from byteps_tpu.core.native import get_core
+from byteps_tpu.server.client import PSSession
+
+from test_ps_server import ps_server  # noqa: F401  (fixture reuse)
+
+
+@pytest.fixture
+def tracing(tmp_path):
+    core = get_core()
+    core.trace_enable(True)
+    yield core
+    # flush anything left so later tests start clean
+    core.trace_enable(False)
+    if core.trace_count():
+        core.trace_dump(str(tmp_path / "flush.json"), 0)
+
+
+def _dump(core, tmp_path):
+    path = tmp_path / "comm.json"
+    core.trace_dump(str(path), rank=0)
+    with open(path) as f:
+        return json.load(f)["traceEvents"]
+
+
+def test_ps_partition_spans(ps_server, tracing, tmp_path):  # noqa: F811
+    """A partitioned push_pull emits one QUEUE + PUSH + PULL span per
+    partition, carrying key/bytes/priority args."""
+    port = ps_server(num_workers=1)
+    part_bytes = 4096
+    n = 4 * (part_bytes // 4)  # 4 partitions of f32
+    sess = PSSession(["127.0.0.1"], [port], worker_id=0, num_servers=1,
+                     partition_bytes=part_bytes)
+    # A raw key with no registry entry: label falls back to key_<dk>.
+    # (Must be outside the declared range — the registry persists across
+    # the test session, so a small literal key may own a name by now.)
+    dk = get_core().num_declared() + 777
+    x = np.arange(n, dtype=np.float32)
+    out = sess.push_pull(dk, x, priority=5)
+    np.testing.assert_array_equal(out, x)
+    sess.close()
+
+    events = _dump(tracing, tmp_path)
+    by_stage = {}
+    for e in events:
+        by_stage.setdefault(e["tid"], []).append(e)
+    # one row per partition per stage
+    for stage in ("QUEUE", "PUSH", "PULL"):
+        rows = by_stage.get(stage, [])
+        assert len(rows) == 4, (stage, [e["name"] for e in events])
+        for r in rows:
+            assert r["ph"] == "X" and r["dur"] >= 0
+            assert r["args"]["priority"] == 5
+            assert r["args"]["bytes"] > 0
+        # 4 distinct partition keys, sharing the declared key
+        keys = {r["args"]["key"] for r in rows}
+        assert len(keys) == 4
+        assert {k >> 16 for k in keys} == {dk}
+        assert sorted(r["name"] for r in rows) == [
+            f"key_{dk}.part{i}" for i in range(4)]
+
+
+def test_ps_spans_use_declared_names(ps_server, tracing, tmp_path):  # noqa: F811
+    """Sessions driven through the declare() registry label spans with the
+    tensor's name, as the reference timeline does."""
+    port = ps_server(num_workers=1)
+    core = get_core()
+    dk = core.declare_tensor("Gradient.traced_tensor")
+    sess = PSSession(["127.0.0.1"], [port], worker_id=0, num_servers=1)
+    sess.push_pull(dk, np.ones(8, np.float32))
+    sess.close()
+    events = _dump(tracing, tmp_path)
+    names = {e["name"] for e in events if e["tid"] == "PUSH"}
+    assert names == {"Gradient.traced_tensor.part0"}
+
+
+def test_api_step_window_includes_partition_rows(ps_server, tmp_path,  # noqa: F811
+                                                 monkeypatch):
+    """End-to-end: BYTEPS_TRACE_ON windowing + PS mode dumps a comm.json
+    holding both STEP envelopes and per-partition stage rows."""
+    import subprocess
+    import sys
+    import os
+    port = ps_server(num_workers=1)
+    code = f"""
+import numpy as np, jax.numpy as jnp
+import byteps_tpu as bps
+bps.init()
+for step in range(4):
+    bps.push_pull(jnp.ones(5000), name="g", average=False)
+    bps.mark_step()
+bps.shutdown()
+"""
+    env = dict(os.environ)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "BYTEPS_TPU_PS_MODE": "1",
+        "DMLC_NUM_WORKER": "1",
+        "DMLC_NUM_SERVER": "1",
+        "DMLC_PS_ROOT_PORT": str(port - 1),
+        "BYTEPS_TRACE_ON": "1",
+        "BYTEPS_TRACE_DIR": str(tmp_path),
+        "BYTEPS_TRACE_START_STEP": "1",
+        "BYTEPS_TRACE_END_STEP": "2",
+        "BYTEPS_PARTITION_BYTES": "4096",
+        "BYTEPS_LOG_LEVEL": "ERROR",
+    })
+    r = subprocess.run([sys.executable, "-c", code], env=env,
+                       capture_output=True, text=True, timeout=180)
+    assert r.returncode == 0, r.stderr
+    with open(tmp_path / "0" / "comm.json") as f:
+        events = json.load(f)["traceEvents"]
+    stages = {e["tid"] for e in events}
+    assert "STEP" in stages
+    # 5000 f32 at 4096B partitions -> 5 partitions per traced push_pull
+    pushes = [e for e in events if e["tid"] == "PUSH"]
+    assert len(pushes) >= 5 and all("g.part" in e["name"] for e in pushes)
