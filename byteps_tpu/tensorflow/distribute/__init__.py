@@ -76,18 +76,29 @@ class BytepsCrossDeviceOps:
         out.append(list(range(split, n)))
         return out
 
+    @staticmethod
+    def _static_size(t) -> Optional[int]:
+        """Element count when the static shape is fully defined, else None
+        (dynamic dims appear under tf.function with None in the
+        input_signature / drop_remainder=False datasets)."""
+        if t.shape.rank is None or not t.shape.is_fully_defined():
+            return None
+        return int(np.prod(t.shape)) if t.shape.rank else 1
+
     def reduce(self, reduce_op, value, destinations=None):
         """Reduce one tensor across workers (reference:
         cross_device_ops.py reduce_implementation -> _push_pull)."""
         del destinations  # one replica per process: result lives everywhere
         op = _norm_reduce_op(reduce_op)
-        name = (f"{self._scope}.reduce."
-                f"{int(np.prod(value.shape)) if value.shape else 0}")
+        value = tf.convert_to_tensor(value)
+        n = self._static_size(value)
+        name = f"{self._scope}.reduce.{'dyn' if n is None else n}"
         return push_pull(value, average=(op == "mean"), name=name)
 
     def batch_reduce(self, reduce_op, values: Sequence,
                      destinations=None) -> List:
-        """Reduce a list of tensors, packed into num_packs transfers."""
+        """Reduce a list of tensors, packed into num_packs transfers.
+        Handles dynamic (None) dims by falling back to graph-time sizes."""
         del destinations
         op = _norm_reduce_op(reduce_op)
         values = list(values)
@@ -96,6 +107,7 @@ class BytepsCrossDeviceOps:
         out: List = [None] * len(values)
         for ci, idxs in enumerate(self._chunks(values)):
             tensors = [tf.convert_to_tensor(values[i]) for i in idxs]
+            sizes = [self._static_size(t) for t in tensors]
             if len(tensors) == 1:
                 flatpack = tf.reshape(tensors[0], [-1])
             else:
@@ -103,14 +115,22 @@ class BytepsCrossDeviceOps:
                     [tf.reshape(t, [-1]) for t in tensors], axis=0)
             # Element count in the name keeps keys collision-free across
             # differently-shaped batch_reduce calls (each name declares a
-            # key; PS mode sizes the server store from it).
-            name = f"{self._scope}.pack{ci}.{int(flatpack.shape[0])}"
+            # key; PS mode sizes the server store from it).  Dynamic
+            # shapes cannot carry a count — their packs share one key per
+            # chunk index, so give each a distinct name= if that matters.
+            total = None if any(s is None for s in sizes) else sum(sizes)
+            name = f"{self._scope}.pack{ci}.{'dyn' if total is None else total}"
             reduced = push_pull(flatpack, average=(op == "mean"), name=name)
             off = 0
-            for i, t in zip(idxs, tensors):
-                n = int(np.prod(t.shape)) if t.shape.rank else 1
-                out[i] = tf.reshape(reduced[off:off + n], t.shape)
-                off += n
+            for i, t, n in zip(idxs, tensors, sizes):
+                if n is None:
+                    n = tf.size(t)  # graph-time size
+                    piece = tf.slice(reduced, [off], [n])
+                    out[i] = tf.reshape(piece, tf.shape(t))
+                else:
+                    piece = tf.slice(reduced, [off], [n])
+                    out[i] = tf.reshape(piece, t.shape)
+                off = off + n
         return out
 
 

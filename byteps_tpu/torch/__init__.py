@@ -258,11 +258,17 @@ def broadcast_optimizer_state(optimizer: torch.optim.Optimizer,
 class DistributedDataParallel(torch.nn.Module):
     """DDP wrapper: broadcasts module state at construction, re-broadcasts
     buffers each forward, and — like the reference — fires the gradient
-    synchronization automatically when the LAST backward hook lands
+    synchronization automatically when the backward pass completes
     (reference: torch/parallel/distributed.py:235-243 counts grads per
     backward and synchronizes on the final one), so plain
     `loss.backward(); optimizer.step()` works with no explicit
-    synchronize() and no DistributedOptimizer."""
+    synchronize() and no DistributedOptimizer.
+
+    Use a PLAIN optimizer with auto_sync (the default): combining it with
+    DistributedOptimizer would all-reduce every gradient twice per step —
+    numerically harmless (re-averaging an average) but it doubles the
+    communication bill.  Pass auto_sync=False to manage synchronization
+    yourself or through DistributedOptimizer."""
 
     def __init__(self, module: torch.nn.Module, broadcast_buffers=True,
                  auto_sync: bool = True):
@@ -299,6 +305,11 @@ class DistributedDataParallel(torch.nn.Module):
         self.autosync_count += 1
 
     def forward(self, *args, **kwargs):
+        # A backward that raised after hooks fired leaves the engine's
+        # final-callback queue dropped and the flag stuck; re-arm here so
+        # auto-sync survives a caught exception instead of silently
+        # disabling itself for the rest of training.
+        self._backward_cb_queued = False
         if self.broadcast_buffers and size() > 1:
             broadcast_parameters(dict(self.module.named_buffers()),
                                  root_rank=0)

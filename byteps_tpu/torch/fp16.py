@@ -58,8 +58,8 @@ class HalfPrecisionDistributedOptimizer:
         self._compression = compression
         named = list(named_parameters) if named_parameters is not None \
             else list(model.named_parameters())
-        dups = {n for n in [k for k, _ in named]
-                if [k for k, _ in named].count(n) > 1}
+        from collections import Counter
+        dups = {n for n, c in Counter(k for k, _ in named).items() if c > 1}
         if dups:
             raise ValueError(f"duplicate parameter names: {sorted(dups)}")
         self._half_params: List[torch.Tensor] = [p for _, p in named]
@@ -137,7 +137,11 @@ class HalfPrecisionDistributedOptimizer:
                 if half_p.grad is None:
                     master.grad = None
                     continue
-                g32 = half_p.grad.float().mul_(inv)
+                # copy=True: for params kept in fp32 (norm layers etc.)
+                # .float() would alias p.grad and mul_ would mutate the
+                # model's gradient in place.
+                g32 = half_p.grad.detach().to(dtype=torch.float32,
+                                              copy=True).mul_(inv)
                 if not torch.isfinite(g32).all():
                     overflow = True
                 master.grad = g32

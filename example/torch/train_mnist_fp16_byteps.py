@@ -42,19 +42,28 @@ def main():
     bps.broadcast_fp16_parameters(opt, root_rank=0)
 
     gen = torch.Generator().manual_seed(0)  # same data on every worker
+    # Learnable synthetic task: labels from a fixed random linear probe,
+    # fixed batch (a convergence smoke, like the reference MNIST demos).
+    probe = torch.randn(28 * 28, 10, generator=gen)
+    x = torch.randn(args.batch_size, 28, 28, generator=gen).half()
+    y = (x.float().flatten(1) @ probe).argmax(-1)
+    first_loss = last_loss = None
     for step in range(args.steps):
-        x = torch.randn(args.batch_size, 28, 28, generator=gen).half()
-        y = torch.randint(0, 10, (args.batch_size,), generator=gen)
         opt.zero_grad()
         logits = model(x).float()
         loss = torch.nn.functional.cross_entropy(logits, y)
         opt.scale_loss(loss).backward()
         opt.step()
+        last_loss = float(loss.detach())
+        if first_loss is None:
+            first_loss = last_loss
         if step % 10 == 0 or step == args.steps - 1:
             acc = (logits.argmax(-1) == y).float().mean()
-            print(f"step {step}: loss={float(loss.detach()):.4f} "
+            print(f"step {step}: loss={last_loss:.4f} "
                   f"acc={float(acc):.3f} scale={opt.loss_scale:.0f} "
                   f"skipped={opt.steps_skipped}")
+    if args.steps >= 20:
+        assert last_loss < first_loss, (first_loss, last_loss)
     print("fp16 training done")
     bps.shutdown()
 

@@ -191,12 +191,61 @@ def scenario_elastic_grow():
     bps.shutdown()
 
 
+def scenario_ps():
+    """PS parity mode with two real worker PROCESSES against a live server
+    subprocess (the thread-based PS tests in test_ps_server.py prove the
+    protocol; this proves process isolation end-to-end through bps.init's
+    PS path, api.py init -> PSSession.from_config)."""
+    # BYTEPS_TPU_PS_MODE=1 + DMLC_NUM_SERVER set by the parent; no jax dist.
+    os.environ.pop("BYTEPS_TPU_JAX_DIST", None)
+    bps.init()
+    emit(check="topology", rank=bps.rank(), size=bps.size())
+    x = jnp.full((40000,), float(bps.rank() + 1))  # multiple partitions
+    s = _api.push_pull(x, name="psmp.g", average=False)
+    a = _api.push_pull(x, name="psmp.g2", average=True)
+    emit(check="push_pull", sum=float(np.asarray(s)[0]),
+         avg=float(np.asarray(a)[0]),
+         ok=bool(np.all(np.asarray(s) == np.asarray(s)[0])))
+    ts, mbps = bps.get_pushpull_speed()
+    emit(check="speed", mbps=float(mbps))
+    bps.shutdown()
+
+
+def scenario_tf_strategy():
+    """MirroredStrategy at size()==2: batch_reduce with chunked packing
+    crosses real process boundaries; scope() broadcasts root's variable
+    values to the peer."""
+    bps.init()
+    import tensorflow as tf
+    from byteps_tpu.tensorflow.distribute import MirroredStrategy
+
+    strat = MirroredStrategy(num_packs=2)
+    emit(check="topology", replicas=strat.num_replicas_in_sync,
+         rank=bps.rank())
+    vals = [tf.fill([6], float(bps.rank() + 1)),
+            tf.fill([3], 10.0 * (bps.rank() + 1)),
+            tf.fill([2, 2], 100.0 * (bps.rank() + 1))]
+    out = strat.cross_device_ops.batch_reduce("sum", vals)
+    emit(check="batch_reduce",
+         v0=float(out[0][0]), v1=float(out[1][0]),
+         v2=float(out[2][0][0]))
+    with strat.scope():
+        v = tf.Variable(tf.fill([4], float(bps.rank() * 7 + 1)))
+    emit(check="scope_broadcast", v=float(v[0]),
+         count=strat.broadcast_count)
+    m = strat.reduce("mean", tf.constant([2.0 * (bps.rank() + 1)]))
+    emit(check="reduce_mean", m=float(m[0]))
+    bps.shutdown()
+
+
 SCENARIOS = {
     "basic": scenario_basic,
     "train": scenario_train,
     "train_solo": scenario_train_solo,
     "elastic_shrink": scenario_elastic_shrink,
     "elastic_grow": scenario_elastic_grow,
+    "ps": scenario_ps,
+    "tf_strategy": scenario_tf_strategy,
 }
 
 if __name__ == "__main__":

@@ -119,6 +119,64 @@ def test_elastic_shrink_two_to_one():
     assert r0["continued"]["post_sum"] == [1.0, 1.0]
 
 
+def test_ps_mode_two_worker_processes():
+    """PS parity mode with 2 worker OS processes against a live server
+    subprocess: sums across real process boundaries through the KV tier."""
+    import subprocess
+    import time
+
+    port = free_port()
+    env = dict(os.environ)
+    env.update({"DMLC_PS_ROOT_PORT": str(port - 1), "DMLC_NUM_WORKER": "2",
+                "JAX_PLATFORMS": "cpu", "BYTEPS_LOG_LEVEL": "ERROR"})
+    srv = subprocess.Popen([sys.executable, "-m", "byteps_tpu.server"],
+                           env=env, stdout=subprocess.DEVNULL,
+                           stderr=subprocess.DEVNULL)
+    try:
+        import socket as _socket
+        for _ in range(100):
+            try:
+                _socket.create_connection(("127.0.0.1", port), 0.5).close()
+                break
+            except OSError:
+                time.sleep(0.1)
+        res = _launch("ps", world=2, extra_env={
+            "BYTEPS_TPU_PS_MODE": "1",
+            "DMLC_NUM_SERVER": "1",
+            "DMLC_PS_ROOT_PORT": str(port - 1),
+            "BYTEPS_TPU_JAX_DIST": "0",
+        })
+    finally:
+        srv.kill()
+        srv.wait()
+    for wid in (0, 1):
+        r = _by_check(res[wid])
+        assert r["topology"]["size"] == 2
+        assert r["topology"]["rank"] == wid
+        # sum over workers: 1 + 2 = 3; average = 1.5
+        assert r["push_pull"]["sum"] == 3.0
+        assert r["push_pull"]["avg"] == 1.5
+        assert r["push_pull"]["ok"]
+        assert r["speed"]["mbps"] >= 0.0
+
+
+def test_tf_strategy_two_processes():
+    """The TF MirroredStrategy analog reduces across real process
+    boundaries: batch_reduce sums both workers' tensors, scope() adopts
+    root's variable values on the peer."""
+    pytest.importorskip("tensorflow")
+    res = _launch("tf_strategy", world=2, timeout=300)
+    for wid in (0, 1):
+        r = _by_check(res[wid])
+        assert r["topology"]["replicas"] == 2
+        assert r["batch_reduce"]["v0"] == 3.0       # 1 + 2
+        assert r["batch_reduce"]["v1"] == 30.0      # 10 + 20
+        assert r["batch_reduce"]["v2"] == 300.0     # 100 + 200
+        assert r["scope_broadcast"]["v"] == 1.0     # root 0's value
+        assert r["scope_broadcast"]["count"] == 1
+        assert r["reduce_mean"]["m"] == 3.0         # (2 + 4) / 2
+
+
 def test_elastic_grow_one_to_two():
     res = _launch("elastic_grow", world=2)
     r0 = _by_check(res[0])

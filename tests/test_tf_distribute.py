@@ -52,6 +52,29 @@ def test_chunking_matches_reference_split():
         BytepsCrossDeviceOps(num_packs=-1)
 
 
+def test_batch_reduce_with_dynamic_dims_in_tf_function():
+    """Custom loops under @tf.function can pass tensors whose leading dim
+    is dynamic (None in the input_signature); packing must fall back to
+    graph-time sizes instead of crashing at trace time."""
+    xops = BytepsCrossDeviceOps(num_packs=1, scope="dyn")
+
+    @tf.function(input_signature=[
+        tf.TensorSpec([None, 3], tf.float32),
+        tf.TensorSpec([None], tf.float32)])
+    def reduce_pair(a, b):
+        out = xops.batch_reduce("sum", [a, b])
+        return out[0], out[1]
+
+    a = tf.constant([[1.0, 2.0, 3.0], [4.0, 5.0, 6.0]])
+    b = tf.constant([7.0, 8.0])
+    ra, rb = reduce_pair(a, b)
+    np.testing.assert_allclose(ra.numpy(), a.numpy())
+    np.testing.assert_allclose(rb.numpy(), b.numpy())
+    # retrace with a different dynamic extent still works
+    ra2, _ = reduce_pair(tf.ones([5, 3]), tf.ones([1]))
+    assert ra2.shape == (5, 3)
+
+
 def test_strategy_reduce_and_extended():
     strat = MirroredStrategy(num_packs=2)
     assert strat.num_replicas_in_sync == 1
