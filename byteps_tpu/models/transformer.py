@@ -47,7 +47,7 @@ class TransformerConfig:
     param_dtype: Any = jnp.float32     # master params stay f32
     causal: bool = True                # decoder LM; False = BERT-style encoder
     remat: bool = True                 # per-layer rematerialisation
-    attn_impl: str = "dense"           # "dense" | "ring" (sp-sharded)
+    attn_impl: str = "dense"           # "dense" | "flash" | "ring" (sp)
 
     @property
     def head_dim(self) -> int:
@@ -170,6 +170,27 @@ def dense_attention(q, k, v, causal: bool):
     return jnp.einsum("bhqk,bhkd->bhqd", probs, v)
 
 
+def flash_attention_fn(q, k, v, causal: bool):
+    """Adapter: [B, H, S, Dh] heads-layout -> the Pallas flash-attention
+    kernel's [BH, S, Dh] layout, with automatic fallback to dense attention
+    when the shape doesn't meet the kernel's tiling constraints (S must
+    divide the 128-row blocks; Dh a multiple of 8)."""
+    B, H, S, Dh = q.shape
+    block = 128 if S % 128 == 0 else (64 if S % 64 == 0 else 0)
+    if block == 0 or Dh % 8:
+        return dense_attention(q, k, v, causal)
+    from ..ops.flash_attention import flash_attention
+
+    def fold(t):
+        return t.reshape(B * H, S, Dh)
+    out = flash_attention(fold(q), fold(k), fold(v), causal, None,
+                          block, block)
+    return out.reshape(B, H, S, Dh)
+
+
+_ATTN_IMPLS = {"dense": dense_attention, "flash": flash_attention_fn}
+
+
 def _block(x, lp, cfg: TransformerConfig, attn_fn):
     """One transformer block.  x: [B, S, D]; lp: this layer's param slice."""
     dt = cfg.dtype
@@ -207,7 +228,17 @@ def forward(params: PyTree, tokens: jax.Array, cfg: TransformerConfig,
     attention; ring attention (ops/ring_attention.py) slots in when the
     sequence is sharded over 'sp'.
     """
-    attn_fn = attn_fn or dense_attention
+    if attn_fn is None:
+        if cfg.attn_impl not in _ATTN_IMPLS:
+            # "ring"/"ulysses" need a mesh-bound fn; anything else is a
+            # typo — silently running dense would hide the config error
+            # (and the S x S memory blow-up the user tried to avoid).
+            raise ValueError(
+                f"attn_impl={cfg.attn_impl!r} needs an explicit attn_fn "
+                f"(ring/Ulysses: ops.ring_attention.make_ring_attn_fn / "
+                f"make_ulysses_attn_fn); built-ins: "
+                f"{sorted(_ATTN_IMPLS)}")
+        attn_fn = _ATTN_IMPLS[cfg.attn_impl]
     dt = cfg.dtype
     B, S = tokens.shape
     x = params["embed"].astype(dt)[tokens]

@@ -85,20 +85,32 @@ class BytepsCrossDeviceOps:
             return None
         return int(np.prod(t.shape)) if t.shape.rank else 1
 
-    def reduce(self, reduce_op, value, destinations=None):
+    def reduce(self, reduce_op, value, destinations=None,
+               name: Optional[str] = None):
         """Reduce one tensor across workers (reference:
-        cross_device_ops.py reduce_implementation -> _push_pull)."""
+        cross_device_ops.py reduce_implementation -> _push_pull).
+
+        `name` keys the communication (and, in PS mode, the server store);
+        required for distinct call sites with dynamic shapes — see
+        batch_reduce."""
         del destinations  # one replica per process: result lives everywhere
         op = _norm_reduce_op(reduce_op)
         value = tf.convert_to_tensor(value)
         n = self._static_size(value)
-        name = f"{self._scope}.reduce.{'dyn' if n is None else n}"
+        name = name or f"{self._scope}.reduce.{'dyn' if n is None else n}"
         return push_pull(value, average=(op == "mean"), name=name)
 
     def batch_reduce(self, reduce_op, values: Sequence,
-                     destinations=None) -> List:
+                     destinations=None, name: Optional[str] = None) -> List:
         """Reduce a list of tensors, packed into num_packs transfers.
-        Handles dynamic (None) dims by falling back to graph-time sizes."""
+        Handles dynamic (None) dims by falling back to graph-time sizes.
+
+        Auto-derived pack names carry the total element count so
+        differently-shaped call sites get distinct keys; with DYNAMIC dims
+        the count is unknown at trace time, so two call sites whose
+        dynamic packs differ in byte size would collide on one key — in PS
+        mode that re-INITs the server store per size change and can fail
+        a concurrent pull.  Pass a distinct `name` per call site there."""
         del destinations
         op = _norm_reduce_op(reduce_op)
         values = list(values)
@@ -108,29 +120,29 @@ class BytepsCrossDeviceOps:
         for ci, idxs in enumerate(self._chunks(values)):
             tensors = [tf.convert_to_tensor(values[i]) for i in idxs]
             sizes = [self._static_size(t) for t in tensors]
+            total = None if any(s is None for s in sizes) else sum(sizes)
             if len(tensors) == 1:
                 flatpack = tf.reshape(tensors[0], [-1])
             else:
                 flatpack = tf.concat(
                     [tf.reshape(t, [-1]) for t in tensors], axis=0)
-            # Element count in the name keeps keys collision-free across
-            # differently-shaped batch_reduce calls (each name declares a
-            # key; PS mode sizes the server store from it).  Dynamic
-            # shapes cannot carry a count — their packs share one key per
-            # chunk index, so give each a distinct name= if that matters.
-            total = None if any(s is None for s in sizes) else sum(sizes)
-            name = f"{self._scope}.pack{ci}.{'dyn' if total is None else total}"
-            reduced = push_pull(flatpack, average=(op == "mean"), name=name)
+            pack_name = (f"{name}.pack{ci}" if name else
+                         f"{self._scope}.pack{ci}."
+                         f"{'dyn' if total is None else total}")
+            reduced = push_pull(flatpack, average=(op == "mean"),
+                                name=pack_name)
             off = 0
             for i, t, n in zip(idxs, tensors, sizes):
                 if n is None:
-                    n = tf.size(t)  # graph-time size
-                    piece = tf.slice(reduced, [off], [n])
-                    out[i] = tf.reshape(piece, tf.shape(t))
+                    piece = tf.slice(reduced, [off], [tf.size(t)])
+                    piece = tf.reshape(piece, tf.shape(t))
+                    piece.set_shape(t.shape)  # keep known static dims
+                    out[i] = piece
+                    off = off + tf.size(t)
                 else:
-                    piece = tf.slice(reduced, [off], [n])
-                    out[i] = tf.reshape(piece, t.shape)
-                off = off + n
+                    out[i] = tf.reshape(tf.slice(reduced, [off], [n]),
+                                        t.shape)
+                    off = off + n
         return out
 
 
