@@ -75,6 +75,7 @@ def test_cross_barrier_example():
 
 
 def test_torch_mnist_example():
+    pytest.importorskip("torch")
     torch_dir = os.path.join(os.path.dirname(__file__), "..", "example",
                              "torch")
     out = _run("train_mnist_torch_byteps.py", "--epochs", "1",
@@ -83,6 +84,7 @@ def test_torch_mnist_example():
 
 
 def test_tensorflow_mnist_example():
+    pytest.importorskip("tensorflow")
     tf_dir = os.path.join(os.path.dirname(__file__), "..", "example",
                           "tensorflow")
     out = _run("train_mnist_tf_byteps.py", "--epochs", "1",
@@ -91,6 +93,7 @@ def test_tensorflow_mnist_example():
 
 
 def test_tensorflow_tape_example():
+    pytest.importorskip("tensorflow")
     tf_dir = os.path.join(os.path.dirname(__file__), "..", "example",
                           "tensorflow")
     out = _run("train_mnist_tf_byteps.py", "--epochs", "1", "--tape",
@@ -99,6 +102,7 @@ def test_tensorflow_tape_example():
 
 
 def test_torch_fp16_example():
+    pytest.importorskip("torch")
     torch_dir = os.path.join(os.path.dirname(__file__), "..", "example",
                              "torch")
     out = _run("train_mnist_fp16_byteps.py", "--steps", "8",
@@ -107,6 +111,7 @@ def test_torch_fp16_example():
 
 
 def test_tensorflow_mirrored_example():
+    pytest.importorskip("tensorflow")
     tf_dir = os.path.join(os.path.dirname(__file__), "..", "example",
                           "tensorflow")
     out = _run("train_mnist_mirrored_byteps.py", "--epochs", "1",

@@ -104,3 +104,63 @@ def test_transformer_end_to_end_parity():
     for a, b in zip(jax.tree.leaves(gf), jax.tree.leaves(gd)):
         np.testing.assert_allclose(np.asarray(a, np.float32),
                                    np.asarray(b, np.float32), atol=5e-3)
+
+
+def test_flash_under_shard_map():
+    """The production path shards batch*heads over dp; the kernel must
+    trace inside shard_map with split leading dims."""
+    import byteps_tpu as bps
+    from jax.sharding import PartitionSpec as P
+
+    mesh = bps.make_mesh()
+    rng = np.random.RandomState(4)
+    q, k, v = (_rand(rng, 16, 128, 64) for _ in range(3))
+
+    def f(q, k, v):
+        return flash_attention(q, k, v, True, None, 64, 64, True)
+
+    sm = jax.jit(jax.shard_map(f, mesh=mesh,
+                               in_specs=(P("dp"), P("dp"), P("dp")),
+                               out_specs=P("dp"), check_vma=False))
+    out = sm(q, k, v)
+    want = dense_attention(q[:, None], k[:, None], v[:, None], True)[:, 0]
+    np.testing.assert_allclose(np.asarray(out), np.asarray(want),
+                               atol=2e-5, rtol=1e-4)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_streaming_path_parity(causal):
+    """The 3D-grid streaming path (used beyond the VMEM budget, where CI
+    sizes never land) must match resident and dense bit-for-bit."""
+    rng = np.random.RandomState(5)
+    q, k, v = (_rand(rng, 2, 256, 64) for _ in range(3))
+    tgt = _rand(rng, 2, 256, 64)
+    stream = flash_attention(q, k, v, causal, None, 64, 64, True, True)
+    resident = flash_attention(q, k, v, causal, None, 64, 64, True, False)
+    np.testing.assert_allclose(np.asarray(stream), np.asarray(resident),
+                               atol=1e-6)
+    np.testing.assert_allclose(np.asarray(stream),
+                               np.asarray(_ref(q, k, v, causal)),
+                               atol=2e-5, rtol=1e-4)
+
+    def loss(stream_flag):
+        def f(q, k, v):
+            return jnp.sum((flash_attention(
+                q, k, v, causal, None, 64, 64, True, stream_flag)
+                - tgt) ** 2)
+        return f
+
+    gs = jax.grad(loss(True), (0, 1, 2))(q, k, v)
+    gr = jax.grad(loss(False), (0, 1, 2))(q, k, v)
+    for a, b in zip(gs, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+
+
+def test_streaming_autoselect_threshold():
+    from byteps_tpu.ops.flash_attention import _use_streaming
+    small = jnp.zeros((1, 256, 64), jnp.bfloat16)     # 32KB: resident
+    big = jnp.zeros((1, 32768, 64), jnp.bfloat16)     # 8MB: streaming
+    assert not _use_streaming(small, None)
+    assert _use_streaming(big, None)
+    assert _use_streaming(small, True)                # explicit override
+    assert not _use_streaming(big, False)

@@ -335,6 +335,31 @@ def test_reconnect_reseeds_round_from_server(ps_server):
     s2.close()
 
 
+def test_server_crash_propagates_error_to_waiters(ps_server):
+    """A server death mid-training must fail the worker loudly (pending
+    futures resolve with ConnectionError via _fail_pending), not hang it —
+    the failure-detection contract a training job needs to restart."""
+    port = ps_server(num_workers=1)
+    s = _session(port, 0)
+    x = np.ones(64, np.float32)
+    np.testing.assert_allclose(s.push_pull(21, x), x)  # healthy round
+    # Kill the server out from under the session.
+    conn = _ServerConn("127.0.0.1", port)
+    conn.send(CMD_SHUTDOWN, worker_id=0)
+    deadline = time.time() + 15
+    while time.time() < deadline:
+        try:
+            socket.create_connection(("127.0.0.1", port), 0.3).close()
+            time.sleep(0.1)
+        except OSError:
+            break
+    with pytest.raises((ConnectionError, TimeoutError, RuntimeError)):
+        # either the INIT/push send fails or the pull future is failed
+        s.push_pull(21, x)
+    s.close()
+    conn.close()
+
+
 def test_worker_restart_mid_training_against_live_servers(ps_server):
     """Elastic restart in context: two workers run a gradient-descent loop
     through the live server; worker 1 crashes between rounds and a
