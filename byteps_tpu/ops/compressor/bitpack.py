@@ -1,0 +1,186 @@
+"""Sign-bit packing as a Pallas TPU kernel (with a bit-identical jnp path).
+
+XLA lowers naive minor-axis bit packing (reshape(-1, 8) + weighted sum)
+poorly on TPU: the 8-wide minor dim forces cross-lane relayouts — 6.2 ms
+per 64 MB round-trip on v5e (~22 GB/s effective), 4x off the elementwise
+floor measured on the same chip (1.3 ms).  The fix is a layout the VPU
+likes: view the flat input as (S, 32, 128) — a free, row-major-preserving
+reshape — and pack the 32 sign bits of each lane column across the
+SUBLANE axis into one uint32 lane (a sublane reduction, no lane crossing
+at all).  Measured 64 MB round-trips: 1.53 ms as a Pallas kernel (the
+default on TPU), 1.67 ms for the same format lowered by XLA (the jnp
+fallback) — i.e. the layout is most of the win and the kernel keeps the
+op at the memory-bound floor.
+
+Wire format (internal to the collective plane; the PS tier's byte codec
+lives in server/wire.py and is unchanged): uint32 words[ceil(n/4096)*128]
+where element i of the zero-padded input contributes bit `(i//128) % 32`
+of word `(i//4096)*128 + i%128`.  The jnp fallback implements the same
+format so CPU tests and TPU runs interoperate bit-for-bit.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+LANES = 128
+SUBLANES = 32
+GRAN = LANES * SUBLANES          # 4096 elements per (32, 128) tile
+_MAX_BS = 32                     # max tiles per grid step (512KB f32)
+
+
+def _block_tiles(s: int) -> int:
+    """Tiles per grid block: the whole array when it fits one block
+    (block == array satisfies the TPU tiling rule at any size), else a
+    power-of-two divisor >= 8 (guaranteed because _num_tiles rounds tile
+    counts above _MAX_BS up to a multiple of 8 — the uint32 words output
+    needs its second-minor block dim 8-divisible)."""
+    import math
+    return s if s <= _MAX_BS else math.gcd(s, _MAX_BS)
+
+
+def _resolve_impl(impl: Optional[str]) -> str:
+    """None -> pallas on TPU, jnp elsewhere.  Explicit: "pallas" (compiled),
+    "interpret" (pallas interpreter, for tests), "jnp"."""
+    if impl is not None:
+        return impl
+    return "pallas" if jax.default_backend() == "tpu" else "jnp"
+
+
+def _num_tiles(n: int) -> int:
+    t = -(-n // GRAN)
+    if t > _MAX_BS and t % 8:
+        t += 8 - t % 8  # see _block_tiles; <= 7/33 overhead, only past 32
+    return t
+
+
+def _padded_len(n: int) -> int:
+    return _num_tiles(n) * GRAN
+
+
+def words_len(n: int) -> int:
+    """Length of the packed uint32 array for an n-element input.
+
+    One (32, 128) tile packs 4096 elements into 128 words, so inputs
+    below 4096 elements pay a 512-byte wire floor, and tile counts above
+    32 round up to a multiple of 8 (<= 21% overhead, worst at 33 tiles).
+    Gradient buckets on the collective plane are partition-sized (<= 4MB,
+    typically >= tens of tiles) where both effects are noise; tiny
+    buckets are cheaper uncompressed — callers gate on size (the PS tier
+    does via BYTEPS_MIN_COMPRESS_BYTES)."""
+    return _padded_len(n) // SUBLANES
+
+
+# ---------------------------------------------------------------------------
+# Pallas kernels
+# ---------------------------------------------------------------------------
+def _pack_kernel(x_ref, w_ref):
+    x = x_ref[:]                                  # (BS, 32, 128) f32
+    bits = (x < 0).astype(jnp.uint32)
+    row = jax.lax.broadcasted_iota(jnp.uint32, x.shape, 1)
+    # Accumulate as int32 (unsigned reductions are unsupported in Mosaic);
+    # bit positions are disjoint so the two's-complement sum is exact, and
+    # the bitcast restores the uint32 view.
+    acc = jnp.sum(jax.lax.bitcast_convert_type(bits << row, jnp.int32),
+                  axis=1)
+    w_ref[:] = jax.lax.bitcast_convert_type(acc, jnp.uint32)
+
+
+def _unpack_kernel(w_ref, s_ref):
+    w = w_ref[:]                                  # (BS, 128) u32
+    shape = (w.shape[0], SUBLANES, LANES)
+    row = jax.lax.broadcasted_iota(jnp.uint32, shape, 1)
+    bits = (w[:, None, :] >> row) & jnp.uint32(1)
+    # uint32 -> f32 casts are unsupported in Mosaic; the 0/1 payload is
+    # identical through an int32 view.
+    bits_i = jax.lax.bitcast_convert_type(bits, jnp.int32)
+    # sign: bit 0 -> +1, bit 1 -> -1
+    s_ref[:] = 1.0 - 2.0 * bits_i.astype(jnp.float32)
+
+
+def _pack_pallas(x3, interpret):
+    s = x3.shape[0]
+    bs = _block_tiles(s)
+    return pl.pallas_call(
+        _pack_kernel,
+        grid=(s // bs,),
+        in_specs=[pl.BlockSpec((bs, SUBLANES, LANES), lambda i: (i, 0, 0),
+                               memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec((bs, LANES), lambda i: (i, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((s, LANES), jnp.uint32),
+        interpret=interpret,
+    )(x3)
+
+
+def _unpack_pallas(w2, interpret):
+    s = w2.shape[0]
+    bs = _block_tiles(s)
+    return pl.pallas_call(
+        _unpack_kernel,
+        grid=(s // bs,),
+        in_specs=[pl.BlockSpec((bs, LANES), lambda i: (i, 0),
+                               memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec((bs, SUBLANES, LANES), lambda i: (i, 0, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((s, SUBLANES, LANES), jnp.float32),
+        interpret=interpret,
+    )(w2)
+
+
+# ---------------------------------------------------------------------------
+# jnp fallback, bit-identical wire format
+# ---------------------------------------------------------------------------
+def _pack_jnp(x3):
+    bits = (x3 < 0).astype(jnp.uint32)
+    row = jnp.arange(SUBLANES, dtype=jnp.uint32)[None, :, None]
+    acc = jnp.sum(jax.lax.bitcast_convert_type(bits << row, jnp.int32),
+                  axis=1)
+    return jax.lax.bitcast_convert_type(acc, jnp.uint32)
+
+
+def _unpack_jnp(w2):
+    row = jnp.arange(SUBLANES, dtype=jnp.uint32)[None, :, None]
+    bits = (w2[:, None, :] >> row) & jnp.uint32(1)
+    return 1.0 - 2.0 * bits.astype(jnp.float32)
+
+
+# ---------------------------------------------------------------------------
+# Public API
+# ---------------------------------------------------------------------------
+def pack_signs(x: jax.Array, impl: Optional[str] = None) -> jax.Array:
+    """f32[n] -> uint32[words_len(n)] of sign bits (1 = negative)."""
+    impl = _resolve_impl(impl)
+    n = x.size
+    if n == 0:
+        return jnp.zeros((0,), jnp.uint32)
+    pad = _padded_len(n) - n
+    xf = x.astype(jnp.float32).ravel()
+    if pad:
+        # Padding with zeros: sign bit 0, reconstructed as +1 then sliced
+        # away by unpack_signs.
+        xf = jnp.concatenate([xf, jnp.zeros((pad,), jnp.float32)])
+    x3 = xf.reshape(-1, SUBLANES, LANES)
+    if impl == "jnp":
+        return _pack_jnp(x3).ravel()
+    return _pack_pallas(x3, impl == "interpret").ravel()
+
+
+def unpack_signs(words: jax.Array, n: int,
+                 impl: Optional[str] = None) -> jax.Array:
+    """uint32[words_len(n)] -> f32[n] of +-1 signs."""
+    impl = _resolve_impl(impl)
+    if n == 0:
+        return jnp.zeros((0,), jnp.float32)
+    w2 = words.reshape(-1, LANES)
+    if impl == "jnp":
+        out = _unpack_jnp(w2)
+    else:
+        out = _unpack_pallas(w2, impl == "interpret")
+    return out.ravel()[:n]

@@ -22,7 +22,7 @@ import jax
 import jax.numpy as jnp
 
 from .base import (InterCompressor, Payload, State, rng_uniform, seed_state)
-from .onebit import _pack_bits, _unpack_bits
+from .bitpack import pack_signs, unpack_signs, words_len
 
 
 class DitheringCompressor(InterCompressor):
@@ -73,24 +73,20 @@ class DitheringCompressor(InterCompressor):
                          0.0)
         u, rng = rng_uniform(state["rng"][:n])
         level = (j + (u < p_up)).astype(jnp.uint8)
-        pad = (-n) % 8
-        signbits = (x < 0).astype(jnp.uint8)
-        if pad:
-            signbits = jnp.concatenate(
-                [signbits, jnp.zeros((pad,), jnp.uint8)])
         new_state = {"rng": state["rng"].at[:n].set(rng)}
-        return ({"level": level, "signs": _pack_bits(signbits),
+        # Sign stream rides the sublane-packed bitpack wire (Pallas on
+        # TPU; see ops/compressor/bitpack.py).
+        return ({"level": level, "signs": pack_signs(x),
                  "norm": norm[None]}, new_state)
 
     def decompress(self, payload: Payload, n: int,
                    dtype=jnp.float32) -> jax.Array:
         levels = self._levels()
         mag = levels[payload["level"].astype(jnp.int32)]
-        signs = _unpack_bits(payload["signs"])[:n]
-        sign = 1.0 - 2.0 * signs.astype(jnp.float32)
+        sign = unpack_signs(payload["signs"], n)      # +-1 f32
         return (sign * mag * payload["norm"][0]).astype(dtype)
 
     def payload_shapes(self, n: int, dtype=jnp.float32):
         return {"level": ((n,), jnp.uint8),
-                "signs": (((n + 7) // 8,), jnp.uint8),
+                "signs": ((words_len(n),), jnp.uint32),
                 "norm": ((1,), jnp.float32)}

@@ -7,9 +7,12 @@ so the reconstruction is `scale * sign(x)` instead of `±1`.  Bidirectional:
 the merged gradient is re-compressed before the pull leg, as the reference
 server does.
 
-TPU-native wire format: a uint8 array of ceil(n/8) bytes (sign bits) plus a
-single f32 scale.  Packing is a reshape + dot with powers of two — one small
-matmul, no scalar loops, so it vectorises on the VPU/MXU.
+TPU-native wire format: a uint32 array of sign-bit words in the sublane-
+packed layout of ops/compressor/bitpack.py (a Pallas kernel on TPU, 4x
+the throughput of byte-wise packing; see that module's header for the
+measured numbers) plus a single f32 scale.  This wire format is internal
+to the collective plane; the PS tier's byte codec (server/wire.py,
+bit-matched to the C++ server) is separate and unchanged.
 """
 
 from __future__ import annotations
@@ -20,19 +23,7 @@ import jax
 import jax.numpy as jnp
 
 from .base import InterCompressor, Payload, State
-
-
-def _pack_bits(bits: jax.Array) -> jax.Array:
-    """bits: [n] in {0,1} (n % 8 == 0) -> uint8 [n/8]; bit i is LSB-first."""
-    b = bits.reshape(-1, 8).astype(jnp.uint8)
-    weights = (jnp.uint8(1) << jnp.arange(8, dtype=jnp.uint8))
-    return (b * weights).sum(axis=1).astype(jnp.uint8)
-
-
-def _unpack_bits(packed: jax.Array) -> jax.Array:
-    """uint8 [m] -> [m*8] in {0,1}, LSB-first."""
-    shifts = jnp.arange(8, dtype=jnp.uint8)
-    return ((packed[:, None] >> shifts) & jnp.uint8(1)).reshape(-1)
+from .bitpack import pack_signs, unpack_signs, words_len
 
 
 class OnebitCompressor(InterCompressor):
@@ -44,26 +35,20 @@ class OnebitCompressor(InterCompressor):
 
     def compress(self, buf: jax.Array, state: State) -> Tuple[Payload, State]:
         n = buf.size
-        pad = (-n) % 8
-        x = buf.astype(jnp.float32)
-        if pad:
-            x = jnp.concatenate([x, jnp.zeros((pad,), jnp.float32)])
         # sign bit: 1 where x < 0 (zero counts as +, matching sign(0)=+1
         # reconstruction below).
-        bits = (x < 0).astype(jnp.uint8)
-        packed = _pack_bits(bits)
+        words = pack_signs(buf)
         if self.scaled:
             scale = jnp.abs(buf.astype(jnp.float32)).sum() / jnp.maximum(n, 1)
         else:
             scale = jnp.ones((), jnp.float32)
-        return {"bits": packed, "scale": scale[None]}, state
+        return {"bits": words, "scale": scale[None]}, state
 
     def decompress(self, payload: Payload, n: int,
                    dtype=jnp.float32) -> jax.Array:
-        bits = _unpack_bits(payload["bits"])[:n]
-        sign = 1.0 - 2.0 * bits.astype(jnp.float32)   # 0 -> +1, 1 -> -1
+        sign = unpack_signs(payload["bits"], n)       # +-1 f32
         return (sign * payload["scale"][0]).astype(dtype)
 
     def payload_shapes(self, n: int, dtype=jnp.float32):
-        return {"bits": (((n + 7) // 8,), jnp.uint8),
+        return {"bits": ((words_len(n),), jnp.uint32),
                 "scale": ((1,), jnp.float32)}

@@ -109,7 +109,12 @@ def test_onebit_unscaled(grad):
 
 def test_onebit_ratio(grad):
     comp = C.OnebitCompressor()
-    assert comp.payload_bytes(1024) == 1024 // 8 + 4  # 32:1 + scale
+    # 32:1 + scale at the wire's 4096-element tile granularity; sub-tile
+    # tensors pay the 512B tile floor (gradient buckets are partition-
+    # sized, where the floor is noise — see bitpack.words_len).
+    assert comp.payload_bytes(4096) == 4096 // 8 + 4
+    assert comp.payload_bytes(64 * 4096) == 64 * 4096 // 8 + 4
+    assert comp.payload_bytes(100) == 512 + 4  # tile floor
 
 
 def test_topk_matches_numpy(grad):
