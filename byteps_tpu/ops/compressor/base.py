@@ -57,9 +57,13 @@ class InterCompressor:
         raise NotImplementedError
 
     def payload_bytes(self, n: int, dtype=jnp.float32) -> int:
-        """Wire bytes for an n-element bucket (for telemetry/ratio checks)."""
+        """Wire bytes for an n-element bucket (for telemetry/ratio checks
+        and the expansion gate in reduce.py).  Pure host math: shapes are
+        static, and this must stay traceable-context-safe (it runs inside
+        shard_map traces)."""
+        import math
         shapes = self.payload_shapes(n, dtype)
-        return sum(int(jnp.prod(jnp.asarray(s))) * jnp.dtype(d).itemsize
+        return sum(math.prod(int(x) for x in s) * jnp.dtype(d).itemsize
                    for s, d in shapes.values())
 
     def payload_shapes(self, n: int, dtype=jnp.float32) -> Dict[str, tuple]:

@@ -125,6 +125,19 @@ def compressed_tree_all_reduce(
     new_worker, new_server, reduced = [], [], []
     for bi, buf in enumerate(buckets):
         n = int(buf.size)
+        if compressor.payload_bytes(n) >= n * buf.dtype.itemsize:
+            # Compression would EXPAND this bucket (wire-format floors:
+            # e.g. the sign stream's 512B tile, bitpack.words_len) — ship
+            # it raw, the analog of the PS tier's min-compress gate
+            # (server/client.py BYTEPS_MIN_COMPRESS_BYTES).
+            summed = collectives.all_reduce(buf, axis_name)
+            if average:
+                summed = summed / world
+            reduced.append(summed)
+            new_worker.append(state["worker"][bi])
+            if two_way and state["server"] is not None:
+                new_server.append(state["server"][bi])
+            continue
         payload, wst = compressor.compress(buf, state["worker"][bi])
         new_worker.append(wst)
         # push: everyone ships its payload to everyone (the TPU "server").

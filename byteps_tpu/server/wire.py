@@ -1,12 +1,16 @@
 """Worker-side codecs for compressed PS payloads.
 
-numpy implementations of the compressor wire formats, bit-identical to both
-the JAX compressors (byteps_tpu/ops/compressor/*) and the C++ server codec
-(core/server.cc `namespace codec`), so a compressed push_pull through the
-server tier reproduces the in-collective-plane requantization exactly
-(reference: the server's decompress-sum-recompress engine,
-server/server.cc:86-207, fed by kwargs from the init push,
-operations.cc:396-408).
+numpy implementations of the PS-tier wire formats, bit-identical to the
+C++ server codec (core/server.cc `namespace codec`), so a compressed
+push_pull through the server tier reproduces the server's
+decompress-sum-recompress exactly (reference: server/server.cc:86-207,
+fed by kwargs from the init push, operations.cc:396-408).
+
+This byte codec is the PS plane's contract and is independent of the
+collective plane's on-device formats: the JAX compressors pack sign bits
+in the uint32 sublane layout of ops/compressor/bitpack.py (a Pallas
+kernel), while this wire keeps LSB-first uint8 bytes — payloads from the
+two planes are NOT interchangeable.
 
 Wire layout (little-endian):
     u8 comp_id | u32 n_elems | body
@@ -31,8 +35,8 @@ _NAMES = {"onebit": COMP_ONEBIT, "topk": COMP_TOPK,
 
 
 def _pack_bits(bits: np.ndarray) -> np.ndarray:
-    """bits [n] in {0,1} -> uint8 [ceil(n/8)], LSB-first (matches
-    ops/compressor/onebit._pack_bits and the C++ codec)."""
+    """bits [n] in {0,1} -> uint8 [ceil(n/8)], LSB-first (matches the C++
+    server codec)."""
     return np.packbits(bits.astype(np.uint8), bitorder="little")
 
 

@@ -22,12 +22,22 @@ def _ckptr():
 
 
 def save(path: str, state: PyTree, force: bool = True) -> None:
-    """Write `state` (any pytree of arrays) to `path` from rank 0."""
+    """Write `state` (any pytree of arrays) to `path`.
+
+    Under a live `jax.distributed` cluster EVERY process must call this
+    (orbax coordinates the write internally with global barriers; a
+    rank-0-only call would deadlock the barrier).  Outside it — env-based
+    clusters like PS mode, where processes share storage but not a JAX
+    coordinator — only rank 0 writes."""
+    import jax
+    apath = os.path.abspath(os.path.expanduser(path))
+    if jax.process_count() > 1:
+        _ckptr().save(apath, state, force=force)
+        return
     from ..common.api import rank
     if rank() != 0:
         return
-    _ckptr().save(os.path.abspath(os.path.expanduser(path)), state,
-                  force=force)
+    _ckptr().save(apath, state, force=force)
 
 
 def restore(path: str, template: Optional[PyTree] = None,

@@ -191,6 +191,45 @@ def scenario_elastic_grow():
     bps.shutdown()
 
 
+def scenario_elastic_checkpoint():
+    """Checkpoint/restore composed with elastic resize: save at world 2,
+    shrink, restore at world 1, keep training — the failure-recovery flow
+    a real job uses (checkpoint is this build's addition; the reference
+    leaves persistence to the framework, SURVEY §5)."""
+    from byteps_tpu.utils import checkpoint as ckpt
+
+    path = os.environ["BYTEPS_MP_CKPT"]
+    bps.init()
+    losses2, params = run_train_steps(2)
+    host = jax.tree.map(lambda l: np.asarray(l), params)
+    ckpt.save(path, host)          # all ranks call; orbax coordinates
+    checksum = float(sum(np.abs(l).sum() for l in jax.tree.leaves(host)))
+    emit(check="saved", size=bps.size(), checksum=checksum,
+         losses=losses2)
+    bps.suspend()
+    if WID == 1:
+        emit(check="departed")
+        return
+
+    os.environ["DMLC_PS_ROOT_PORT"] = os.environ["BYTEPS_MP_PORT2"]
+    bps.resume(num_workers=1)
+    restored = ckpt.restore(path, template=host)
+    rsum = float(sum(np.abs(np.asarray(l)).sum()
+                     for l in jax.tree.leaves(restored)))
+    params = jax.tree.map(jnp.asarray, restored)
+    _, loss_fn, batch = make_problem()
+    mesh = bps.make_mesh()
+    opt = bps.DistributedOptimizer(optax.sgd(0.1))
+    step = bps.build_train_step(loss_fn, opt, mesh, donate=False)
+    opt_state = opt.init(params)
+    cont = []
+    for _ in range(2):
+        params, opt_state, loss = step(params, opt_state, batch)
+        cont.append(float(loss))
+    emit(check="restored", checksum=rsum, losses=cont, size=bps.size())
+    bps.shutdown()
+
+
 def scenario_ps():
     """PS parity mode with two real worker PROCESSES against a live server
     subprocess (the thread-based PS tests in test_ps_server.py prove the
@@ -244,6 +283,7 @@ SCENARIOS = {
     "train_solo": scenario_train_solo,
     "elastic_shrink": scenario_elastic_shrink,
     "elastic_grow": scenario_elastic_grow,
+    "elastic_checkpoint": scenario_elastic_checkpoint,
     "ps": scenario_ps,
     "tf_strategy": scenario_tf_strategy,
 }

@@ -251,7 +251,10 @@ def test_compressed_allreduce_identical_inputs(mesh8):
 def test_compressed_allreduce_onebit_two_way(mesh8):
     """Bidirectional onebit: the pulled value is requantized — every element
     has magnitude == mean(|sum|) and the sign of the summed signs."""
-    tree = {"w": jnp.asarray(np.random.RandomState(2).randn(64), jnp.float32)}
+    # 4096 elements: above the expansion gate (reduce.py ships smaller
+    # buckets raw, where no requantization happens).
+    tree = {"w": jnp.asarray(np.random.RandomState(2).randn(4096),
+                             jnp.float32)}
     comp = C.OnebitCompressor(scaled=True)
     out, _ = _run_compressed_allreduce(tree, comp, mesh8, average=False)
     w = np.asarray(out["w"])
@@ -337,3 +340,28 @@ def test_set_lr_scale():
     # other leaves untouched
     np.testing.assert_array_equal(np.asarray(st2["opt"][0]["error"]),
                                   np.zeros(16, np.float32))
+
+
+def test_tiny_buckets_skip_expanding_compression(mesh8):
+    """A bucket whose compressed payload would EXCEED its raw bytes (the
+    sign stream's 512B tile floor) must ship raw — compression is a
+    bandwidth optimization, never an expansion."""
+    comp = C.OnebitCompressor()
+    n = 100  # 400B raw; onebit wire floor is 516B
+    assert comp.payload_bytes(n) > n * 4
+    tree = {"w": jnp.linspace(-1.0, 1.0, n)}
+    from byteps_tpu.ops.compressor.reduce import (
+        compressed_tree_all_reduce, init_compression_state)
+    import jax as _jax
+    from jax.sharding import PartitionSpec as P
+
+    def f(t):
+        out, _ = compressed_tree_all_reduce(t, comp, average=False)
+        return out
+
+    sm = _jax.jit(_jax.shard_map(f, mesh=mesh8, in_specs=(P(),),
+                                 out_specs=P(), check_vma=False))
+    out = sm(tree)
+    # raw path: exact sum (no sign quantization error at all)
+    np.testing.assert_allclose(np.asarray(out["w"]),
+                               8 * np.asarray(tree["w"]), rtol=1e-6)

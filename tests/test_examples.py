@@ -117,3 +117,9 @@ def test_tensorflow_mirrored_example():
     out = _run("train_mnist_mirrored_byteps.py", "--epochs", "1",
                directory=tf_dir)
     assert "mirrored strategy training done" in out
+
+
+def test_long_context_flash_example():
+    out = _run("train_long_context.py", "--attn", "flash",
+               "--seq-len", "256", "--steps", "2")
+    assert "flash" in out and "step 1" in out

@@ -119,6 +119,24 @@ def test_elastic_shrink_two_to_one():
     assert r0["continued"]["post_sum"] == [1.0, 1.0]
 
 
+def test_checkpoint_through_elastic_shrink(tmp_path):
+    """Save at world 2, shrink to 1, restore, keep training: the restored
+    params are bit-identical (checksums match) and the continued loss
+    keeps descending from where the world-2 run left off."""
+    res = _launch("elastic_checkpoint", world=2,
+                  extra_env={"BYTEPS_MP_CKPT": str(tmp_path / "ck")})
+    r0 = _by_check(res[0])
+    r1 = _by_check(res[1])
+    assert "departed" in r1
+    assert r0["saved"]["size"] == 2
+    assert r0["restored"]["size"] == 1
+    assert r0["restored"]["checksum"] == pytest.approx(
+        r0["saved"]["checksum"], rel=1e-6)
+    # training continued from the checkpoint, not from scratch
+    assert r0["restored"]["losses"][0] < r0["saved"]["losses"][0]
+    assert r0["restored"]["losses"][-1] <= r0["restored"]["losses"][0]
+
+
 def test_ps_mode_two_worker_processes():
     """PS parity mode with 2 worker OS processes against a live server
     subprocess: sums across real process boundaries through the KV tier."""
