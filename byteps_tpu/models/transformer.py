@@ -170,14 +170,22 @@ def dense_attention(q, k, v, causal: bool):
     return jnp.einsum("bhqk,bhkd->bhqd", probs, v)
 
 
-def flash_attention_fn(q, k, v, causal: bool):
+def flash_attention_fn(q, k, v, causal: bool, strict: bool = False):
     """Adapter: [B, H, S, Dh] heads-layout -> the Pallas flash-attention
     kernel's [BH, S, Dh] layout, with automatic fallback to dense attention
     when the shape doesn't meet the kernel's tiling constraints (S must
-    divide the 128-row blocks; Dh a multiple of 8)."""
+    divide into 64- or 128-row blocks; Dh a multiple of 8).  strict=True
+    raises instead of falling back — for callers where silent dense
+    attention would materialize S x S logits at a length chosen precisely
+    to avoid that (e.g. Ulysses long-context)."""
     B, H, S, Dh = q.shape
     block = 128 if S % 128 == 0 else (64 if S % 64 == 0 else 0)
     if block == 0 or Dh % 8:
+        if strict:
+            raise ValueError(
+                f"flash attention needs seq_len divisible by 64 (got {S}) "
+                f"and head_dim a multiple of 8 (got {Dh}); pad the "
+                f"sequence or drop to attn='dense' explicitly")
         return dense_attention(q, k, v, causal)
     from ..ops.flash_attention import flash_attention
 

@@ -135,8 +135,12 @@ def compressed_tree_all_reduce(
                 summed = summed / world
             reduced.append(summed)
             new_worker.append(state["worker"][bi])
-            if two_way and state["server"] is not None:
-                new_server.append(state["server"][bi])
+            if two_way:
+                # Keep server-state alignment with the compressed path,
+                # which appends one entry per bucket whenever two_way.
+                new_server.append(state["server"][bi]
+                                  if state["server"] is not None
+                                  else srv.init_state(n))
             continue
         payload, wst = compressor.compress(buf, state["worker"][bi])
         new_worker.append(wst)

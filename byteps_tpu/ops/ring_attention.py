@@ -144,13 +144,33 @@ def ulysses_attention_shard(q, k, v, causal: bool, axis_name: str = "sp",
     return heads_to_seq(out)
 
 
-def make_ulysses_attn_fn(mesh: Mesh, axis_name: str = "sp"):
-    """Ulysses counterpart of make_ring_attn_fn."""
+def make_ulysses_attn_fn(mesh: Mesh, axis_name: str = "sp", attn="dense"):
+    """Ulysses counterpart of make_ring_attn_fn.
+
+    `attn` picks the per-shard attention over the full (gathered) sequence:
+    "dense", "flash" (the Pallas kernel — Ulysses hands each shard the
+    WHOLE sequence for a head subset, so the S x S logits the kernel
+    avoids grow with total context, making this the natural pairing for
+    long-context sp), or any callable (q, k, v, causal)."""
     spec = P(None, None, axis_name, None)
+    if callable(attn):
+        inner = attn
+    else:
+        from ..models import transformer as _tfm
+        if attn not in _tfm._ATTN_IMPLS:
+            raise ValueError(
+                f"attn must be a callable or one of "
+                f"{sorted(_tfm._ATTN_IMPLS)}; got {attn!r}")
+        inner = _tfm._ATTN_IMPLS[attn]
+        if attn == "flash":
+            # An explicit flash request at gathered-sequence length must
+            # not silently degrade to dense (that materializes the S x S
+            # logits this pairing exists to avoid).
+            inner = functools.partial(_tfm.flash_attention_fn, strict=True)
 
     def attn_fn(q, k, v, causal):
         f = functools.partial(ulysses_attention_shard, causal=causal,
-                              axis_name=axis_name)
+                              axis_name=axis_name, attn=inner)
         return jax.shard_map(f, mesh=mesh, in_specs=(spec, spec, spec),
                              out_specs=spec, check_vma=False)(q, k, v)
     return attn_fn

@@ -92,3 +92,32 @@ def test_ulysses_rejects_bad_head_count():
         f = functools.partial(ra.ulysses_attention_shard, causal=False)
         jax.shard_map(f, mesh=mesh, in_specs=(spec,) * 3,
                       out_specs=spec, check_vma=False)(q, k, v)
+
+
+def test_ulysses_with_flash_inner():
+    """Ulysses + flash over a REAL 8-way sp mesh: the all-to-all reshards
+    seq->heads (each shard holds 1 head x full sequence), the Pallas
+    kernel runs the gathered-sequence attention, and the result matches
+    unsharded dense attention."""
+    from byteps_tpu.models.transformer import dense_attention
+    from byteps_tpu.ops.ring_attention import make_ulysses_attn_fn
+
+    mesh = _mesh_sp()
+    rng = np.random.RandomState(11)
+    B, H, S, D = 2, 8, 256, 32
+    q, k, v = (jnp.asarray(rng.randn(B, H, S, D).astype(np.float32))
+               for _ in range(3))
+    for causal in (False, True):
+        want = dense_attention(q, k, v, causal)
+        flash_fn = make_ulysses_attn_fn(mesh, attn="flash")
+        got = flash_fn(q, k, v, causal)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   atol=2e-5, rtol=1e-4)
+    with pytest.raises(ValueError, match="dense"):
+        make_ulysses_attn_fn(mesh, attn="nope")
+    # Explicit flash must refuse shapes it cannot tile rather than
+    # silently materializing the gathered S x S logits as dense.
+    strict_fn = make_ulysses_attn_fn(mesh, attn="flash")
+    bad = jnp.zeros((1, 8, 8 * 100, 32), jnp.float32)  # S/n=100 -> S=800?
+    with pytest.raises(ValueError, match="divisible by 64"):
+        strict_fn(bad, bad, bad, False)
