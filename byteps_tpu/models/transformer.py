@@ -47,6 +47,12 @@ class TransformerConfig:
     param_dtype: Any = jnp.float32     # master params stay f32
     causal: bool = True                # decoder LM; False = BERT-style encoder
     remat: bool = True                 # per-layer rematerialisation
+    # What the per-layer checkpoint may keep: "none" saves only layer
+    # inputs (max recompute, min HBM); "dots" saves matmul outputs
+    # (skips re-running the MXU work in backward — the usual best
+    # FLOPs/HBM trade on TPU); "dots_no_batch" additionally drops
+    # batch-dim-carrying dots.
+    remat_policy: str = "none"         # "none" | "dots" | "dots_no_batch"
     attn_impl: str = "dense"           # "dense" | "flash" | "ring" (sp)
 
     @property
@@ -256,7 +262,19 @@ def forward(params: PyTree, tokens: jax.Array, cfg: TransformerConfig,
         y = _block(carry, lp, cfg, attn_fn)
         return y, None
 
-    step = jax.checkpoint(body) if cfg.remat else body
+    if cfg.remat:
+        policies = {
+            "none": None,
+            "dots": jax.checkpoint_policies.checkpoint_dots,
+            "dots_no_batch":
+                jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+        }
+        if cfg.remat_policy not in policies:
+            raise ValueError(f"remat_policy={cfg.remat_policy!r}; "
+                             f"options: {sorted(policies)}")
+        step = jax.checkpoint(body, policy=policies[cfg.remat_policy])
+    else:
+        step = body
     x, _ = lax.scan(step, x, params["layers"])
     x = _layer_norm(x, params["ln_f_scale"], params["ln_f_bias"])
     # Weight-tied readout against the embedding (keeps the big vocab matmul

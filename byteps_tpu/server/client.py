@@ -47,13 +47,18 @@ DT_F32, DT_RAW, DT_COMPRESSED, DT_SEED = 0, 1, 2, 3
 class _Future:
     """Completion slot for one outstanding request."""
 
-    __slots__ = ("event", "data", "error", "callback")
+    __slots__ = ("event", "data", "error", "callback", "sink")
 
-    def __init__(self, callback: Optional[Callable] = None):
+    def __init__(self, callback: Optional[Callable] = None,
+                 sink: Optional[memoryview] = None):
         self.event = None if callback else threading.Event()
         self.data: bytes = b""
         self.error: Optional[Exception] = None
         self.callback = callback
+        # Optional preallocated destination: a response whose payload length
+        # matches len(sink) is received straight into it (no intermediate
+        # buffer — the ZPull-into-shm stance, reference core_loops.cc:582-616).
+        self.sink = sink
 
     def resolve(self, data: bytes, error: Optional[Exception]) -> None:
         self.data, self.error = data, error
@@ -93,8 +98,9 @@ class _ServerConn:
 
     def send(self, cmd: int, key: int = 0, payload: bytes = b"",
              worker_id: int = 0, dtype: int = 0, flags: int = 0,
-             callback: Optional[Callable] = None) -> _Future:
-        fut = _Future(callback)
+             callback: Optional[Callable] = None,
+             sink: Optional[memoryview] = None) -> _Future:
+        fut = _Future(callback, sink)
         with self._pending_lock:
             if self._closed:
                 raise ConnectionError("PS connection closed")
@@ -105,7 +111,15 @@ class _ServerConn:
                         len(payload))
         try:
             with self.lock:
-                self.sock.sendall(hdr + bytes(payload))
+                if len(payload) >= 65536:
+                    # Zero-copy send for data partitions: memoryview goes
+                    # straight to the socket (the reference's ZPush
+                    # zero-copy SArray stance, core_loops.cc:564-569);
+                    # concatenating would copy ~4MB per partition twice.
+                    self.sock.sendall(hdr)
+                    self.sock.sendall(payload)
+                else:
+                    self.sock.sendall(hdr + bytes(payload))
         except OSError as e:
             with self._pending_lock:
                 self._pending.pop(req_id, None)
@@ -131,9 +145,15 @@ class _ServerConn:
             while True:
                 buf = self._recv_exact(_RESP.size)
                 status, req_id, rkey, length = _RESP.unpack(buf)
-                data = self._recv_exact(length) if length else b""
                 with self._pending_lock:
                     fut = self._pending.pop(req_id, None)
+                if (fut is not None and fut.sink is not None and status == 0
+                        and length == len(fut.sink)):
+                    # Matched sink: payload lands in the caller's buffer.
+                    self._recv_into(fut.sink)
+                    data = fut.sink
+                else:
+                    data = self._recv_exact(length) if length else b""
                 if fut is None:
                     continue  # response for a cancelled request
                 err = (RuntimeError(f"PS server error for key {rkey}")
@@ -155,15 +175,22 @@ class _ServerConn:
             except Exception:
                 pass
 
-    def _recv_exact(self, n: int) -> bytes:
-        chunks = []
-        while n > 0:
-            c = self.sock.recv(n)
-            if not c:
+    def _recv_exact(self, n: int):
+        # recv_into a single preallocated buffer: no per-chunk allocation
+        # and no join copy (a 4MB partition pull is one buffer, filled in
+        # place).  Callers treat the result as a read-only byte buffer.
+        buf = bytearray(n)
+        self._recv_into(memoryview(buf))
+        return buf
+
+    def _recv_into(self, view: memoryview) -> None:
+        n = len(view)
+        got = 0
+        while got < n:
+            r = self.sock.recv_into(view[got:], n - got)
+            if r == 0:
                 raise ConnectionError("PS server closed connection")
-            chunks.append(c)
-            n -= len(c)
-        return b"".join(chunks)
+            got += r
 
     def close(self):
         try:
@@ -425,8 +452,17 @@ class PSSession:
                                    part.pull_ts - part.push_ts, pkey,
                                    part.wire_ln, part.priority)
         try:
+            # Non-compressed pulls land straight in the output buffer (the
+            # receiver matches on length); bidirectional compressed pulls
+            # come back re-encoded at a different length and take the
+            # allocating path + wire_decode.
+            sink = None
+            if not part.bidirectional:
+                sink = memoryview(part.handle.out).cast("B")[
+                    part.off:part.off + part.ln]
             part.conn.send(
                 CMD_PULL, pkey, worker_id=self.worker_id, flags=part.round,
+                sink=sink,
                 callback=lambda data, err, pkey=pkey:
                     self._on_pull(pkey, data, err))
         except ConnectionError as e:
@@ -452,19 +488,24 @@ class PSSession:
                                    len(data), part.priority)
         try:
             n = part.ln // 4
-            if part.bidirectional and len(data) != part.ln:
-                # Bidirectional compressor: the merged buffer came back
-                # re-compressed; decode it (reference: worker DECOMPRESS
-                # stage, core_loops.cc:618-646).
-                from .wire import decode as wire_decode
-                got = wire_decode(bytes(data), n)
+            if isinstance(data, memoryview):
+                # Sink path: the receiver already landed the payload in
+                # part.handle.out (length-matched) — nothing to copy.
+                pass
             else:
-                got = np.frombuffer(data, np.float32)
-            if got.size != n:
-                raise ValueError(
-                    f"PS pull size mismatch for key {pkey}: "
-                    f"got {got.size} f32, want {n}")
-            part.handle.out[part.off // 4:part.off // 4 + n] = got
+                if part.bidirectional and len(data) != part.ln:
+                    # Bidirectional compressor: the merged buffer came back
+                    # re-compressed; decode it (reference: worker DECOMPRESS
+                    # stage, core_loops.cc:618-646).
+                    from .wire import decode as wire_decode
+                    got = wire_decode(bytes(data), n)
+                else:
+                    got = np.frombuffer(data, np.float32)
+                if got.size != n:
+                    raise ValueError(
+                        f"PS pull size mismatch for key {pkey}: "
+                        f"got {got.size} f32, want {n}")
+                part.handle.out[part.off // 4:part.off // 4 + n] = got
             part.handle._part_done()
         except Exception as e:
             part.handle._part_done(e)
@@ -496,6 +537,12 @@ class PSSession:
                         seed: bool = False) -> PSHandle:
         """Partitioned, priority-scheduled asynchronous push_pull.
 
+        ZERO-COPY CONTRACT: when `tensor` is already a contiguous float32
+        buffer, partitions are wire views of the caller's memory (the
+        reference's ZPush zero-copy SArray semantics) — the caller must
+        not mutate it until the returned handle completes.  Non-f32 or
+        non-contiguous inputs are converted (snapshotted) first.
+
         raw=True pushes last-write-wins bytes instead of f32-summed values.
         seed=True (async servers only) writes the store ONLY if the key has
         never been pushed — idempotent initial-weight seeding that cannot
@@ -503,11 +550,15 @@ class PSSession:
         """
         arr = np.asarray(tensor)
         payload = np.ascontiguousarray(arr, dtype=np.float32).ravel()
-        raw_bytes = payload.tobytes()
-        plan = self._plan(declared_key, len(raw_bytes))
+        # Zero-copy wire: partitions are sent as memoryview slices of the
+        # caller's buffer (no tobytes snapshot) — the reference's ZPush
+        # contract: the tensor must not be mutated until the handle
+        # completes.  The sequential-use guard in _stage_parts already
+        # serializes re-pushes of the same key.
+        plan = self._plan(declared_key, payload.nbytes)
         handle = PSHandle(arr.shape, arr.dtype, len(plan),
-                          np.zeros(len(raw_bytes) // 4, np.float32))
-        mv = memoryview(raw_bytes)
+                          np.zeros(payload.nbytes // 4, np.float32))
+        mv = memoryview(payload).cast("B")
         comp = self._compressors.get(declared_key)
         kw_bytes = comp.kwargs_string().encode() if comp else b""
         label = self._label(declared_key)

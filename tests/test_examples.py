@@ -12,9 +12,8 @@ EXAMPLES = os.path.join(os.path.dirname(__file__), "..", "example", "jax")
 
 
 def _run(script, *args, timeout=420, directory=None):
-    env = dict(os.environ)
-    env.update({
-        "JAX_PLATFORMS": "cpu",
+    from testutil import cpu_env
+    env = cpu_env({
         "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
         "PYTHONPATH": os.path.join(os.path.dirname(__file__), ".."),
     })

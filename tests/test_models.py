@@ -48,6 +48,24 @@ def test_transformer_remat_matches_no_remat():
         np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-5)
 
 
+def test_transformer_remat_policies_match():
+    # Selective checkpoint policies change what backward recomputes, never
+    # the values; gradients must match the no-remat baseline bit-for-tol.
+    cfg_n = tfm.get_config("tiny", remat=False, dtype=jnp.float32)
+    params = tfm.init_params(jax.random.key(1), cfg_n)
+    toks = jax.random.randint(jax.random.key(2), (2, 12), 0, cfg_n.vocab_size)
+    g_n = jax.grad(tfm.loss_fn)(params, (toks, toks), cfg_n)
+    for pol in ("dots", "dots_no_batch"):
+        cfg_p = tfm.get_config("tiny", remat=True, remat_policy=pol,
+                               dtype=jnp.float32)
+        g_p = jax.grad(tfm.loss_fn)(params, (toks, toks), cfg_p)
+        for a, b in zip(jax.tree.leaves(g_p), jax.tree.leaves(g_n)):
+            np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-5)
+    with pytest.raises(ValueError):
+        tfm.forward(params, toks,
+                    tfm.get_config("tiny", remat_policy="bogus"))
+
+
 def test_transformer_dp_training_loss_decreases(mesh8):
     cfg = tfm.get_config("tiny", dtype=jnp.float32)
     params = tfm.init_params(jax.random.key(0), cfg)

@@ -16,7 +16,7 @@ import sys
 import numpy as np
 import pytest
 
-from testutil import free_port
+from testutil import cpu_env, free_port
 
 WORKER = os.path.join(os.path.dirname(__file__), "mp_worker.py")
 
@@ -26,12 +26,11 @@ def _launch(scenario, world, timeout=180, extra_env=None):
     port, port2 = free_port(), free_port()
     procs = []
     for wid in range(world):
-        env = dict(os.environ)
+        env = cpu_env()
         env.pop("XLA_FLAGS", None)  # 1 device per process, no virtual 8
         repo_root = os.path.dirname(os.path.dirname(os.path.abspath(WORKER)))
         env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
         env.update({
-            "JAX_PLATFORMS": "cpu",
             "DMLC_NUM_WORKER": str(world),
             "DMLC_WORKER_ID": str(wid),
             "DMLC_PS_ROOT_URI": "127.0.0.1",
@@ -144,9 +143,8 @@ def test_ps_mode_two_worker_processes():
     import time
 
     port = free_port()
-    env = dict(os.environ)
-    env.update({"DMLC_PS_ROOT_PORT": str(port - 1), "DMLC_NUM_WORKER": "2",
-                "JAX_PLATFORMS": "cpu", "BYTEPS_LOG_LEVEL": "ERROR"})
+    env = cpu_env({"DMLC_PS_ROOT_PORT": str(port - 1),
+                   "DMLC_NUM_WORKER": "2", "BYTEPS_LOG_LEVEL": "ERROR"})
     srv = subprocess.Popen([sys.executable, "-m", "byteps_tpu.server"],
                            env=env, stdout=subprocess.DEVNULL,
                            stderr=subprocess.DEVNULL)

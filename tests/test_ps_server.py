@@ -21,7 +21,7 @@ import pytest
 from byteps_tpu.server.client import PSSession, _ServerConn, CMD_SHUTDOWN
 
 
-from testutil import free_port
+from testutil import cpu_env, free_port
 
 
 @pytest.fixture
@@ -31,8 +31,7 @@ def ps_server():
 
     def start(num_workers=2, schedule=False, async_mode=False):
         port = free_port()
-        env = dict(os.environ)
-        env.update({
+        env = cpu_env({
             # serve() binds scheduler_port + 1 + server_id
             "DMLC_PS_ROOT_PORT": str(port - 1),
             "DMLC_NUM_WORKER": str(num_workers),
@@ -428,9 +427,7 @@ np.testing.assert_array_equal(np.asarray(out2),
 bps.shutdown()
 print("PS_API_OK")
 """
-    env = dict(os.environ)
-    env.update({
-        "JAX_PLATFORMS": "cpu",
+    env = cpu_env({
         "BYTEPS_TPU_PS_MODE": "1",
         "DMLC_NUM_WORKER": "1",
         "DMLC_NUM_SERVER": "1",

@@ -189,12 +189,11 @@ def test_async_mode_against_ps_server():
     import sys
     import time
 
-    from testutil import free_port
+    from testutil import cpu_env, free_port
 
     port = free_port()
-    env = dict(os.environ)
-    env.update({"DMLC_PS_ROOT_PORT": str(port - 1), "DMLC_NUM_WORKER": "1",
-                "BYTEPS_ENABLE_ASYNC": "1", "JAX_PLATFORMS": "cpu"})
+    env = cpu_env({"DMLC_PS_ROOT_PORT": str(port - 1),
+                   "DMLC_NUM_WORKER": "1", "BYTEPS_ENABLE_ASYNC": "1"})
     srv = subprocess.Popen([sys.executable, "-m", "byteps_tpu.server"],
                            env=env, stdout=subprocess.DEVNULL,
                            stderr=subprocess.DEVNULL)

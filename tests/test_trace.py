@@ -99,9 +99,8 @@ for step in range(4):
     bps.mark_step()
 bps.shutdown()
 """
-    env = dict(os.environ)
-    env.update({
-        "JAX_PLATFORMS": "cpu",
+    from testutil import cpu_env
+    env = cpu_env({
         "BYTEPS_TPU_PS_MODE": "1",
         "DMLC_NUM_WORKER": "1",
         "DMLC_NUM_SERVER": "1",

@@ -1,5 +1,6 @@
 """Shared test helpers (importable from any test module)."""
 
+import os
 import socket
 
 
@@ -8,3 +9,23 @@ def free_port() -> int:
     with socket.socket() as s:
         s.bind(("127.0.0.1", 0))
         return s.getsockname()[1]
+
+
+def cpu_env(extra=None):
+    """Subprocess environment hermetically pinned to the CPU backend.
+
+    Setting JAX_PLATFORMS=cpu alone is NOT enough on TPU-attached hosts:
+    site hooks that register an external PJRT plugin (gated on their own
+    env vars, e.g. PALLAS_AXON_POOL_IPS) force the platform selection back
+    to the accelerator, and the subprocess then blocks on real-device
+    initialization inside what is meant to be a pure-CPU test.  Strip the
+    gating vars so the plugin never registers, then pin CPU.
+    """
+    env = dict(os.environ)
+    for k in list(env):
+        if k.startswith(("PALLAS_AXON", "AXON_")):
+            env.pop(k)
+    env["JAX_PLATFORMS"] = "cpu"
+    if extra:
+        env.update(extra)
+    return env
