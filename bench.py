@@ -306,22 +306,18 @@ def bench_ps():
         srv.close()
         return 2 * nbytes * reps / dt / 1e9
 
+    from byteps_tpu.utils.hermetic import cpu_subprocess_env
+
+    # The server binds root_port + 1 + server_id; only the data port is
+    # ever bound here (no scheduler process), so probe THAT one free and
+    # derive the root port from it.
     with socket.socket() as sk:
         sk.bind(("127.0.0.1", 0))
-        port = sk.getsockname()[1] + 1  # serve() binds root_port + 1 + id
-
-    env = dict(os.environ)
-    # Hermetic CPU child: strip site-hook PJRT plugin gates (they force the
-    # platform back to the accelerator and block the server on real-device
-    # init; see tests/testutil.cpu_env for the long-form rationale).
-    for k in list(env):
-        if k.startswith(("PALLAS_AXON", "AXON_")):
-            env.pop(k)
-    env.update({
+        port = sk.getsockname()[1]      # the server's data port
+    env = cpu_subprocess_env({
         "DMLC_PS_ROOT_PORT": str(port - 1),
         "DMLC_NUM_WORKER": "1",
         "BYTEPS_SERVER_ENGINE_THREAD": "4",
-        "JAX_PLATFORMS": "cpu",
     })
     proc = subprocess.Popen([sys.executable, "-m", "byteps_tpu.server"],
                             env=env, stdout=subprocess.DEVNULL,

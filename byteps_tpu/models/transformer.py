@@ -18,7 +18,11 @@ encoder/decoder LM designed for the MXU —
     the pipeline-stage axis for 'pp').
 
 Configs mirror the reference benchmark suite: bert_base/bert_large
-(README.md:38-46) plus tiny variants for tests.
+(README.md:38-46) plus tiny variants for tests, and a llama-class decoder
+family (RMSNorm + SwiGLU + RoPE + grouped-query attention, no biases) via
+the norm/act/pos/num_kv_heads/use_bias knobs — the modern-LLM block on the
+same stacked-scan machinery, so TP specs, pipeline stacking, remat, and
+the flash/ring attention registry all apply unchanged.
 """
 
 from __future__ import annotations
@@ -46,6 +50,14 @@ class TransformerConfig:
     dtype: Any = jnp.bfloat16          # activation/compute dtype (MXU-native)
     param_dtype: Any = jnp.float32     # master params stay f32
     causal: bool = True                # decoder LM; False = BERT-style encoder
+    # Modern-LLM (llama-class) architecture knobs.  Defaults reproduce the
+    # classic BERT/GPT block exactly (same param tree, same math).
+    norm: str = "layernorm"            # "layernorm" | "rmsnorm"
+    act: str = "gelu"                  # "gelu" | "swiglu"
+    pos: str = "learned"               # "learned" | "rope"
+    rope_theta: float = 10000.0
+    num_kv_heads: Optional[int] = None  # GQA/MQA: < num_heads; None = MHA
+    use_bias: bool = True              # llama-class blocks drop biases
     remat: bool = True                 # per-layer rematerialisation
     # What the per-layer checkpoint may keep: "none" saves only layer
     # inputs (max recompute, min HBM); "dots" saves matmul outputs
@@ -55,9 +67,31 @@ class TransformerConfig:
     remat_policy: str = "none"         # "none" | "dots" | "dots_no_batch"
     attn_impl: str = "dense"           # "dense" | "flash" | "ring" (sp)
 
+    def __post_init__(self):
+        if self.d_model % self.num_heads:
+            raise ValueError(f"d_model={self.d_model} not divisible by "
+                             f"num_heads={self.num_heads}")
+        if self.num_kv_heads is not None:
+            if self.num_kv_heads < 1:
+                raise ValueError("num_kv_heads must be >= 1 (or None for "
+                                 "full multi-head attention)")
+            if self.num_heads % self.num_kv_heads:
+                raise ValueError(
+                    f"num_heads={self.num_heads} not divisible by "
+                    f"num_kv_heads={self.num_kv_heads} (GQA shares each kv "
+                    f"head across an integer group of query heads)")
+        if self.pos == "rope" and self.head_dim % 2:
+            raise ValueError(f"pos='rope' needs an even head_dim "
+                             f"(got {self.head_dim})")
+
     @property
     def head_dim(self) -> int:
         return self.d_model // self.num_heads
+
+    @property
+    def kv_heads(self) -> int:
+        return (self.num_kv_heads if self.num_kv_heads is not None
+                else self.num_heads)
 
 
 # Benchmark-suite configs (reference README.md:38-46 benchmarks BERT-large;
@@ -73,6 +107,16 @@ CONFIGS: Dict[str, TransformerConfig] = {
                                    d_ff=3072, causal=True),
     "gpt_medium": TransformerConfig(num_layers=24, d_model=1024, num_heads=16,
                                     d_ff=4096, causal=True),
+    # Llama-class decoder (RMSNorm + SwiGLU + RoPE + GQA, no biases) — the
+    # modern-LLM block shape, at two scales.
+    "llama_tiny": TransformerConfig(vocab_size=1024, num_layers=2, d_model=64,
+                                    num_heads=4, num_kv_heads=2, d_ff=160,
+                                    max_seq_len=128, norm="rmsnorm",
+                                    act="swiglu", pos="rope", use_bias=False),
+    "llama_1b": TransformerConfig(vocab_size=32768, num_layers=16,
+                                  d_model=2048, num_heads=32, num_kv_heads=8,
+                                  d_ff=5504, max_seq_len=2048, norm="rmsnorm",
+                                  act="swiglu", pos="rope", use_bias=False),
 }
 
 
@@ -92,6 +136,8 @@ def init_params(rng: jax.Array, cfg: TransformerConfig) -> PyTree:
         return (jax.random.normal(key, shape, dt) / jnp.sqrt(fan_in)).astype(dt)
 
     L, D, F = cfg.num_layers, cfg.d_model, cfg.d_ff
+    Dh, Hkv = cfg.head_dim, cfg.kv_heads
+    qkv_cols = (cfg.num_heads + 2 * Hkv) * Dh
     lkeys = jax.random.split(k_layers, 6)
 
     def stack(key, shape, fan_in):
@@ -99,34 +145,47 @@ def init_params(rng: jax.Array, cfg: TransformerConfig) -> PyTree:
         return jnp.stack([dense_init(k, shape, fan_in) for k in ks])
 
     layers = {
-        "qkv_w": stack(lkeys[0], (D, 3 * D), D),
-        "attn_out_w": stack(lkeys[1], (D, D), D),
+        "qkv_w": stack(lkeys[0], (D, qkv_cols), D),
+        "attn_out_w": stack(lkeys[1], (cfg.num_heads * Dh, D),
+                            cfg.num_heads * Dh),
         "mlp_in_w": stack(lkeys[2], (D, F), D),
         "mlp_out_w": stack(lkeys[3], (F, D), F),
         "ln1_scale": jnp.ones((L, D), dt),
-        "ln1_bias": jnp.zeros((L, D), dt),
         "ln2_scale": jnp.ones((L, D), dt),
-        "ln2_bias": jnp.zeros((L, D), dt),
-        "qkv_b": jnp.zeros((L, 3 * D), dt),
-        "attn_out_b": jnp.zeros((L, D), dt),
-        "mlp_in_b": jnp.zeros((L, F), dt),
-        "mlp_out_b": jnp.zeros((L, D), dt),
     }
-    return {
+    if cfg.act == "swiglu":
+        layers["mlp_gate_w"] = stack(lkeys[4], (D, F), D)
+    if cfg.use_bias:
+        layers.update({
+            "ln1_bias": jnp.zeros((L, D), dt),
+            "ln2_bias": jnp.zeros((L, D), dt),
+            "qkv_b": jnp.zeros((L, qkv_cols), dt),
+            "attn_out_b": jnp.zeros((L, D), dt),
+            "mlp_in_b": jnp.zeros((L, F), dt),
+            "mlp_out_b": jnp.zeros((L, D), dt),
+        })
+    out = {
         "embed": dense_init(k_emb, (cfg.vocab_size, D), D),
-        "pos_embed": (jax.random.normal(k_pos, (cfg.max_seq_len, D), dt)
-                      * 0.02).astype(dt),
         "layers": layers,
         "ln_f_scale": jnp.ones((D,), dt),
-        "ln_f_bias": jnp.zeros((D,), dt),
     }
+    if cfg.pos == "learned":
+        out["pos_embed"] = (jax.random.normal(k_pos, (cfg.max_seq_len, D), dt)
+                            * 0.02).astype(dt)
+    if cfg.use_bias:
+        out["ln_f_bias"] = jnp.zeros((D,), dt)
+    return out
 
 
 def param_specs(cfg: TransformerConfig, tp_axis: str = "tp",
                 pp_axis: Optional[str] = None) -> PyTree:
     """PartitionSpec tree for Megatron-style TP (column/row split) with the
-    stacked layer axis optionally sharded over the pipeline axis."""
-    del cfg
+    stacked layer axis optionally sharded over the pipeline axis.
+
+    Mirrors init_params' conditional keys (GQA/SwiGLU/no-bias/rope).  The
+    GQA qkv layout ([q | k | v] flat columns) is a GSPMD hint, not a
+    manual shard index — XLA reshards around the head split as needed.
+    """
     pp = pp_axis  # leading stacked-layer dim
     layers = {
         "qkv_w": P(pp, None, tp_axis),
@@ -134,21 +193,29 @@ def param_specs(cfg: TransformerConfig, tp_axis: str = "tp",
         "mlp_in_w": P(pp, None, tp_axis),
         "mlp_out_w": P(pp, tp_axis, None),
         "ln1_scale": P(pp, None),
-        "ln1_bias": P(pp, None),
         "ln2_scale": P(pp, None),
-        "ln2_bias": P(pp, None),
-        "qkv_b": P(pp, tp_axis),
-        "attn_out_b": P(pp, None),
-        "mlp_in_b": P(pp, tp_axis),
-        "mlp_out_b": P(pp, None),
     }
-    return {
+    if cfg.act == "swiglu":
+        layers["mlp_gate_w"] = P(pp, None, tp_axis)
+    if cfg.use_bias:
+        layers.update({
+            "ln1_bias": P(pp, None),
+            "ln2_bias": P(pp, None),
+            "qkv_b": P(pp, tp_axis),
+            "attn_out_b": P(pp, None),
+            "mlp_in_b": P(pp, tp_axis),
+            "mlp_out_b": P(pp, None),
+        })
+    out = {
         "embed": P(None, None),
-        "pos_embed": P(None, None),
         "layers": layers,
         "ln_f_scale": P(None),
-        "ln_f_bias": P(None),
     }
+    if cfg.pos == "learned":
+        out["pos_embed"] = P(None, None)
+    if cfg.use_bias:
+        out["ln_f_bias"] = P(None)
+    return out
 
 
 # ---------------------------------------------------------------------------
@@ -159,8 +226,36 @@ def _layer_norm(x, scale, bias, eps=1e-5):
     mu = x32.mean(-1, keepdims=True)
     var = x32.var(-1, keepdims=True)
     y = (x32 - mu) * jax.lax.rsqrt(var + eps)
-    return (y * scale.astype(jnp.float32)
-            + bias.astype(jnp.float32)).astype(x.dtype)
+    y = y * scale.astype(jnp.float32)
+    if bias is not None:
+        y = y + bias.astype(jnp.float32)
+    return y.astype(x.dtype)
+
+
+def _rms_norm(x, scale, bias, eps=1e-6):
+    """RMSNorm (no mean subtraction; llama-class blocks pass bias=None)."""
+    x32 = x.astype(jnp.float32)
+    y = x32 * jax.lax.rsqrt((x32 * x32).mean(-1, keepdims=True) + eps)
+    y = y * scale.astype(jnp.float32)
+    if bias is not None:
+        y = y + bias.astype(jnp.float32)
+    return y.astype(x.dtype)
+
+
+_NORMS = {"layernorm": _layer_norm, "rmsnorm": _rms_norm}
+
+
+def _rope(x, theta: float):
+    """Rotary position embedding on [B, H, S, Dh] (half-split layout)."""
+    B, H, S, Dh = x.shape
+    half = Dh // 2
+    freqs = theta ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
+    angles = jnp.arange(S, dtype=jnp.float32)[:, None] * freqs[None, :]
+    cos = jnp.cos(angles).astype(x.dtype)   # [S, half]
+    sin = jnp.sin(angles).astype(x.dtype)
+    x1, x2 = x[..., :half], x[..., half:]
+    return jnp.concatenate([x1 * cos - x2 * sin,
+                            x2 * cos + x1 * sin], axis=-1)
 
 
 def dense_attention(q, k, v, causal: bool):
@@ -209,27 +304,49 @@ def _block(x, lp, cfg: TransformerConfig, attn_fn):
     """One transformer block.  x: [B, S, D]; lp: this layer's param slice."""
     dt = cfg.dtype
     B, S, D = x.shape
-    H, Dh = cfg.num_heads, cfg.head_dim
+    H, Hkv, Dh = cfg.num_heads, cfg.kv_heads, cfg.head_dim
+    norm = _NORMS[cfg.norm]
 
-    h = _layer_norm(x, lp["ln1_scale"], lp["ln1_bias"])
-    qkv = jnp.einsum("bsd,de->bse", h, lp["qkv_w"].astype(dt)) \
-        + lp["qkv_b"].astype(dt)
-    q, k, v = jnp.split(qkv, 3, axis=-1)
+    def bias(name):
+        return lp[name].astype(dt) if name in lp else None
+
+    def add_bias(t, name):
+        b = bias(name)
+        return t if b is None else t + b
+
+    h = norm(x, lp["ln1_scale"], bias("ln1_bias"))
+    qkv = add_bias(jnp.einsum("bsd,de->bse", h, lp["qkv_w"].astype(dt)),
+                   "qkv_b")
+    q, k, v = jnp.split(qkv, [H * Dh, (H + Hkv) * Dh], axis=-1)
 
     def heads(t):
         return t.reshape(B, S, -1, Dh).transpose(0, 2, 1, 3)
-    attn = attn_fn(heads(q), heads(k), heads(v), cfg.causal)
+    q, k, v = heads(q), heads(k), heads(v)
+    if cfg.pos == "rope":
+        q, k = _rope(q, cfg.rope_theta), _rope(k, cfg.rope_theta)
+    if Hkv != H:
+        # GQA: each query-head group shares one kv head — expand for the
+        # attention kernel (the bandwidth saving is in params/KV-cache,
+        # not this training-time broadcast).
+        k = jnp.repeat(k, H // Hkv, axis=1)
+        v = jnp.repeat(v, H // Hkv, axis=1)
+    attn = attn_fn(q, k, v, cfg.causal)
     attn = attn.transpose(0, 2, 1, 3).reshape(B, S, -1)
-    attn = jnp.einsum("bse,ed->bsd", attn, lp["attn_out_w"].astype(dt)) \
-        + lp["attn_out_b"].astype(dt)
+    attn = add_bias(
+        jnp.einsum("bse,ed->bsd", attn, lp["attn_out_w"].astype(dt)),
+        "attn_out_b")
     x = x + attn
 
-    h = _layer_norm(x, lp["ln2_scale"], lp["ln2_bias"])
-    h = jnp.einsum("bsd,df->bsf", h, lp["mlp_in_w"].astype(dt)) \
-        + lp["mlp_in_b"].astype(dt)
-    h = jax.nn.gelu(h)
-    h = jnp.einsum("bsf,fd->bsd", h, lp["mlp_out_w"].astype(dt)) \
-        + lp["mlp_out_b"].astype(dt)
+    h = norm(x, lp["ln2_scale"], bias("ln2_bias"))
+    up = add_bias(jnp.einsum("bsd,df->bsf", h, lp["mlp_in_w"].astype(dt)),
+                  "mlp_in_b")
+    if cfg.act == "swiglu":
+        gate = jnp.einsum("bsd,df->bsf", h, lp["mlp_gate_w"].astype(dt))
+        h = jax.nn.silu(gate) * up
+    else:
+        h = jax.nn.gelu(up)
+    h = add_bias(jnp.einsum("bsf,fd->bsd", h, lp["mlp_out_w"].astype(dt)),
+                 "mlp_out_b")
     return x + h
 
 
@@ -256,7 +373,8 @@ def forward(params: PyTree, tokens: jax.Array, cfg: TransformerConfig,
     dt = cfg.dtype
     B, S = tokens.shape
     x = params["embed"].astype(dt)[tokens]
-    x = x + params["pos_embed"].astype(dt)[:S]
+    if cfg.pos == "learned":
+        x = x + params["pos_embed"].astype(dt)[:S]
 
     def body(carry, lp):
         y = _block(carry, lp, cfg, attn_fn)
@@ -276,7 +394,7 @@ def forward(params: PyTree, tokens: jax.Array, cfg: TransformerConfig,
     else:
         step = body
     x, _ = lax.scan(step, x, params["layers"])
-    x = _layer_norm(x, params["ln_f_scale"], params["ln_f_bias"])
+    x = _NORMS[cfg.norm](x, params["ln_f_scale"], params.get("ln_f_bias"))
     # Weight-tied readout against the embedding (keeps the big vocab matmul
     # on the MXU once, not twice).
     logits = jnp.einsum("bsd,vd->bsv", x.astype(jnp.float32),
@@ -300,9 +418,11 @@ def num_params(params: PyTree) -> int:
 
 def flops_per_token(cfg: TransformerConfig) -> float:
     """Approximate training FLOPs/token (6N rule + attention)."""
-    n = (cfg.num_layers * (3 * cfg.d_model * cfg.d_model * 3      # qkv
-                           + cfg.d_model * cfg.d_model            # attn out
-                           + 2 * cfg.d_model * cfg.d_ff)          # mlp
+    qkv_cols = (cfg.num_heads + 2 * cfg.kv_heads) * cfg.head_dim
+    mlp_mats = 3 if cfg.act == "swiglu" else 2
+    n = (cfg.num_layers * (cfg.d_model * qkv_cols                 # qkv
+                           + cfg.num_heads * cfg.head_dim * cfg.d_model
+                           + mlp_mats * cfg.d_model * cfg.d_ff)   # mlp
          + cfg.vocab_size * cfg.d_model)
     attn = cfg.num_layers * 2 * cfg.max_seq_len * cfg.d_model
     return 6.0 * (n + attn)

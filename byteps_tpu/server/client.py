@@ -145,8 +145,12 @@ class _ServerConn:
             while True:
                 buf = self._recv_exact(_RESP.size)
                 status, req_id, rkey, length = _RESP.unpack(buf)
+                # Peek (don't pop) while the payload is still on the wire:
+                # a connection failure mid-payload must leave the future in
+                # _pending so _fail_pending resolves it immediately, not
+                # after the handle's timeout.
                 with self._pending_lock:
-                    fut = self._pending.pop(req_id, None)
+                    fut = self._pending.get(req_id)
                 if (fut is not None and fut.sink is not None and status == 0
                         and length == len(fut.sink)):
                     # Matched sink: payload lands in the caller's buffer.
@@ -154,6 +158,8 @@ class _ServerConn:
                     data = fut.sink
                 else:
                     data = self._recv_exact(length) if length else b""
+                with self._pending_lock:
+                    fut = self._pending.pop(req_id, None)
                 if fut is None:
                     continue  # response for a cancelled request
                 err = (RuntimeError(f"PS server error for key {rkey}")

@@ -1,0 +1,45 @@
+"""Hermetic CPU subprocess environments.
+
+On TPU-attached hosts, setting JAX_PLATFORMS=cpu is NOT enough to keep a
+child process off the accelerator: site hooks that register an external
+PJRT plugin (gated on their own env vars, e.g. PALLAS_AXON_POOL_IPS)
+force the platform selection back to the device, and a pure-CPU child
+then blocks on real-device initialization — indefinitely, if the device
+tunnel is unhealthy.  The gate vars must be stripped in the PARENT when
+building the child's env; in-process deletion after interpreter startup
+is too late (the site hook has already run).
+
+Single source of truth for the gate-variable list; used by
+tests/testutil.cpu_env, __graft_entry__.virtual_cpu_env, and
+bench.bench_ps.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, Optional
+
+# Env-var prefixes that gate accelerator-grabbing site hooks.
+_ACCEL_GATE_PREFIXES = ("PALLAS_AXON", "AXON_")
+
+# Direct TPU discovery vars.
+_TPU_VARS = ("TPU_NAME", "TPU_WORKER_ID", "CLOUD_TPU_TASK_ID")
+
+
+def strip_accelerator_gates(env: Dict[str, str]) -> Dict[str, str]:
+    """Remove accelerator-hook gate vars from `env`, in place; returns it."""
+    for k in list(env):
+        if k.startswith(_ACCEL_GATE_PREFIXES) or k in _TPU_VARS:
+            env.pop(k)
+    return env
+
+
+def cpu_subprocess_env(extra: Optional[Dict[str, str]] = None,
+                       base: Optional[Dict[str, str]] = None
+                       ) -> Dict[str, str]:
+    """A copy of `base` (default os.environ) hermetically pinned to CPU."""
+    env = strip_accelerator_gates(dict(os.environ if base is None else base))
+    env["JAX_PLATFORMS"] = "cpu"
+    if extra:
+        env.update(extra)
+    return env

@@ -37,6 +37,96 @@ def test_transformer_causality():
     assert not np.allclose(l1[0, 7], l2[0, 7])
 
 
+def test_llama_block_forward_and_causality():
+    """Llama-class config (RMSNorm + SwiGLU + RoPE + GQA, no biases):
+    shapes, finiteness, causal masking, and the conditional param tree."""
+    cfg = tfm.get_config("llama_tiny", remat=False, dtype=jnp.float32)
+    params = tfm.init_params(jax.random.key(0), cfg)
+    lp = params["layers"]
+    assert "mlp_gate_w" in lp and "qkv_b" not in lp and "ln1_bias" not in lp
+    assert "pos_embed" not in params
+    qkv_cols = (cfg.num_heads + 2 * cfg.kv_heads) * cfg.head_dim
+    assert lp["qkv_w"].shape == (cfg.num_layers, cfg.d_model, qkv_cols)
+
+    t1 = jnp.zeros((1, 8), jnp.int32)
+    t2 = t1.at[0, 7].set(5)
+    l1 = tfm.forward(params, t1, cfg)
+    assert l1.shape == (1, 8, cfg.vocab_size) and np.isfinite(l1).all()
+    l2 = tfm.forward(params, t2, cfg)
+    np.testing.assert_allclose(l1[0, :7], l2[0, :7], rtol=1e-5, atol=1e-5)
+    assert not np.allclose(l1[0, 7], l2[0, 7])
+
+
+def test_llama_config_validation():
+    with pytest.raises(ValueError):   # non-integer GQA group
+        tfm.get_config("llama_tiny", num_heads=6, num_kv_heads=4,
+                       d_model=96)
+    with pytest.raises(ValueError):   # 0 must not silently mean MHA
+        tfm.get_config("llama_tiny", num_kv_heads=0)
+    with pytest.raises(ValueError):   # rope needs even head_dim
+        tfm.get_config("llama_tiny", d_model=60, num_heads=4,
+                       num_kv_heads=2)
+    with pytest.raises(ValueError):   # d_model % num_heads
+        tfm.get_config("tiny", d_model=65)
+
+
+def test_llama_rope_rotation_properties():
+    """RoPE is a pure rotation: position 0 is the identity, norms are
+    preserved at every position, and distinct positions rotate the same
+    vector differently."""
+    x = jax.random.normal(jax.random.key(3), (1, 2, 6, 8), jnp.float32)
+    y = tfm._rope(x, theta=10000.0)
+    np.testing.assert_allclose(y[:, :, 0], x[:, :, 0], rtol=1e-6)  # pos 0
+    np.testing.assert_allclose(np.linalg.norm(y, axis=-1),
+                               np.linalg.norm(x, axis=-1), rtol=1e-5)
+    same_vec = jnp.broadcast_to(x[:, :, :1], x.shape)
+    r = tfm._rope(same_vec, theta=10000.0)
+    assert not np.allclose(r[0, 0, 1], r[0, 0, 4], atol=1e-5)
+    # relative-position property: q.k dot depends only on distance
+    q = tfm._rope(same_vec, 10000.0)
+    dots = jnp.einsum("bhsd,bhtd->bhst", q, q)[0, 0]
+    np.testing.assert_allclose(np.diag(dots, k=1)[0], np.diag(dots, k=1)[3],
+                               rtol=1e-5)
+
+
+def test_llama_gqa_matches_mha_when_kv_heads_equal():
+    """num_kv_heads == num_heads degenerates to standard MHA bit-for-tol
+    (same param tree shapes, repeat() becomes identity)."""
+    base = tfm.get_config("llama_tiny", remat=False, dtype=jnp.float32)
+    cfg_g = tfm.get_config("llama_tiny", remat=False, dtype=jnp.float32,
+                           num_kv_heads=base.num_heads)
+    params = tfm.init_params(jax.random.key(4), cfg_g)
+    toks = jax.random.randint(jax.random.key(5), (2, 12), 0, base.vocab_size)
+    l_explicit = tfm.forward(params, toks, cfg_g)
+    cfg_none = tfm.get_config("llama_tiny", remat=False, dtype=jnp.float32,
+                              num_kv_heads=None)
+    l_none = tfm.forward(params, toks, cfg_none)
+    np.testing.assert_allclose(l_explicit, l_none, rtol=1e-6, atol=1e-6)
+
+
+def test_llama_training_loss_decreases(mesh8):
+    cfg = tfm.get_config("llama_tiny")
+    params = tfm.init_params(jax.random.key(0), cfg)
+    toks, tgts = tfm.synthetic_batch(jax.random.key(1), 16, 32, cfg)
+    opt = bps.DistributedOptimizer(optax.adam(1e-3))
+    step = bps.build_train_step(lambda p, b: tfm.loss_fn(p, b, cfg), opt,
+                                mesh8)
+    s = opt.init(params)
+    losses = []
+    for _ in range(6):
+        params, s, loss = step(params, s, (toks, tgts))
+        losses.append(float(loss))
+    assert losses[-1] < losses[0], losses
+
+
+def test_llama_param_specs_tree_matches_params():
+    cfg = tfm.get_config("llama_tiny")
+    params = tfm.init_params(jax.random.key(0), cfg)
+    specs = tfm.param_specs(cfg)
+    assert jax.tree.structure(params) == jax.tree.structure(
+        specs, is_leaf=lambda x: isinstance(x, jax.sharding.PartitionSpec))
+
+
 def test_transformer_remat_matches_no_remat():
     cfg_r = tfm.get_config("tiny", remat=True, dtype=jnp.float32)
     cfg_n = tfm.get_config("tiny", remat=False, dtype=jnp.float32)

@@ -12,20 +12,7 @@ def free_port() -> int:
 
 
 def cpu_env(extra=None):
-    """Subprocess environment hermetically pinned to the CPU backend.
-
-    Setting JAX_PLATFORMS=cpu alone is NOT enough on TPU-attached hosts:
-    site hooks that register an external PJRT plugin (gated on their own
-    env vars, e.g. PALLAS_AXON_POOL_IPS) force the platform selection back
-    to the accelerator, and the subprocess then blocks on real-device
-    initialization inside what is meant to be a pure-CPU test.  Strip the
-    gating vars so the plugin never registers, then pin CPU.
-    """
-    env = dict(os.environ)
-    for k in list(env):
-        if k.startswith(("PALLAS_AXON", "AXON_")):
-            env.pop(k)
-    env["JAX_PLATFORMS"] = "cpu"
-    if extra:
-        env.update(extra)
-    return env
+    """Subprocess environment hermetically pinned to the CPU backend
+    (see byteps_tpu.utils.hermetic for why JAX_PLATFORMS alone fails)."""
+    from byteps_tpu.utils.hermetic import cpu_subprocess_env
+    return cpu_subprocess_env(extra)
