@@ -68,6 +68,13 @@ class TransformerConfig:
     attn_impl: str = "dense"           # "dense" | "flash" | "ring" (sp)
 
     def __post_init__(self):
+        for field, val, allowed in (
+                ("norm", self.norm, ("layernorm", "rmsnorm")),
+                ("act", self.act, ("gelu", "swiglu")),
+                ("pos", self.pos, ("learned", "rope"))):
+            if val not in allowed:
+                # A typo here must not silently drop positions/gating.
+                raise ValueError(f"{field}={val!r}; options: {allowed}")
         if self.d_model % self.num_heads:
             raise ValueError(f"d_model={self.d_model} not divisible by "
                              f"num_heads={self.num_heads}")

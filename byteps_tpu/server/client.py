@@ -145,21 +145,31 @@ class _ServerConn:
             while True:
                 buf = self._recv_exact(_RESP.size)
                 status, req_id, rkey, length = _RESP.unpack(buf)
-                # Peek (don't pop) while the payload is still on the wire:
-                # a connection failure mid-payload must leave the future in
-                # _pending so _fail_pending resolves it immediately, not
-                # after the handle's timeout.
-                with self._pending_lock:
-                    fut = self._pending.get(req_id)
-                if (fut is not None and fut.sink is not None and status == 0
-                        and length == len(fut.sink)):
-                    # Matched sink: payload lands in the caller's buffer.
-                    self._recv_into(fut.sink)
-                    data = fut.sink
-                else:
-                    data = self._recv_exact(length) if length else b""
+                # Pop BEFORE the payload read: this thread owns the future
+                # (and its sink buffer) exclusively, so a concurrent
+                # _fail_pending can neither resolve it mid-write nor race a
+                # retry into the same sink.  The except arm below resolves
+                # it if the connection dies mid-payload — no orphaning.
                 with self._pending_lock:
                     fut = self._pending.pop(req_id, None)
+                try:
+                    if (fut is not None and fut.sink is not None
+                            and status == 0 and length == len(fut.sink)):
+                        # Matched sink: payload lands in the caller's buffer.
+                        self._recv_into(fut.sink)
+                        data = fut.sink
+                    else:
+                        data = self._recv_exact(length) if length else b""
+                except (ConnectionError, OSError) as e:
+                    if fut is not None:
+                        try:
+                            fut.resolve(
+                                b"", ConnectionError(f"PS connection lost "
+                                                     f"mid-payload: {e}"))
+                        except Exception:
+                            get_logger().exception(
+                                "PS completion callback failed")
+                    raise
                 if fut is None:
                     continue  # response for a cancelled request
                 err = (RuntimeError(f"PS server error for key {rkey}")

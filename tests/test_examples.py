@@ -62,6 +62,11 @@ def test_hybrid_example():
     assert "step 1:" in out
 
 
+def test_llama_example():
+    out = _run("train_llama_byteps.py", "--steps", "6", "--tp", "2")
+    assert "improved=True" in out
+
+
 def test_long_context_example():
     out = _run("train_long_context.py", "--sp", "8", "--seq-len", "256",
                "--steps", "2")

@@ -68,6 +68,9 @@ def test_llama_config_validation():
                        num_kv_heads=2)
     with pytest.raises(ValueError):   # d_model % num_heads
         tfm.get_config("tiny", d_model=65)
+    for field in ("norm", "act", "pos"):  # enum typos must not silently
+        with pytest.raises(ValueError):   # drop positions/gating
+            tfm.get_config("llama_tiny", **{field: "bogus"})
 
 
 def test_llama_rope_rotation_properties():
