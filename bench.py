@@ -365,6 +365,48 @@ def bench_ps():
         proc.wait()
 
 
+def _init_backend_or_die(timeout_s: float) -> None:
+    """Initialize the JAX backend with a deadline.
+
+    A wedged device tunnel makes jax.devices() block forever; a bench that
+    hangs reports nothing.  Probe the backend on a daemon thread and emit
+    an honest JSON error line (then exit nonzero) if it never comes up.
+    """
+    import threading
+
+    done = threading.Event()
+    info = {}
+
+    def probe():
+        try:
+            import jax
+            info["devices"] = len(jax.devices())
+        except Exception as e:  # backend init failure is also a result
+            info["error"] = repr(e)
+        done.set()
+
+    threading.Thread(target=probe, daemon=True).start()
+    if not done.wait(timeout_s):
+        print(json.dumps({
+            "metric": "bench_backend_init",
+            "value": 0.0,
+            "unit": "error",
+            "vs_baseline": 0.0,
+            "detail": {"error": f"JAX backend init did not complete within "
+                                f"{timeout_s:.0f}s (device tunnel wedged?)"},
+        }), flush=True)
+        os._exit(3)
+    if "error" in info:
+        print(json.dumps({
+            "metric": "bench_backend_init",
+            "value": 0.0,
+            "unit": "error",
+            "vs_baseline": 0.0,
+            "detail": {"error": info["error"]},
+        }), flush=True)
+        os._exit(3)
+
+
 def main():
     if os.environ.get("BENCH_FORCE_CPU", "0") == "1":
         flags = os.environ.get("XLA_FLAGS", "")
@@ -374,10 +416,14 @@ def main():
         import jax
         jax.config.update("jax_platforms", "cpu")
     if os.environ.get("BENCH_MACHINERY", "0") == "1":
+        _init_backend_or_die(float(os.environ.get("BENCH_INIT_TIMEOUT",
+                                                  "600")))
         bench_machinery()
     elif os.environ.get("BENCH_PS", "0") == "1":
-        bench_ps()
+        bench_ps()           # host-only: no device backend involved
     else:
+        _init_backend_or_die(float(os.environ.get("BENCH_INIT_TIMEOUT",
+                                                  "600")))
         bench_flagship()
 
 

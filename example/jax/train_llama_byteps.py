@@ -38,17 +38,25 @@ def main():
     cfg = tfm.get_config(args.model, attn_impl=args.attn)
     params = tfm.init_params(jax.random.key(0), cfg)
 
-    n_dev = jax.device_count()
-    dp = max(1, n_dev // args.tp)
-    mesh = bps.make_mesh(dp=dp, tp=args.tp)
-    if args.tp > 1:
-        params = sharded.shard_params(params, mesh,
-                                      tfm.param_specs(cfg))
+    # dp defaults to "the remaining devices" inside make_mesh.
+    mesh = bps.make_mesh(tp=args.tp)
 
-    opt = bps.DistributedOptimizer(optax.adamw(3e-3))
-    step = bps.build_train_step(lambda p, b: tfm.loss_fn(p, b, cfg),
-                                opt, mesh)
-    opt_state = opt.init(params)
+    def loss_f(p, b):
+        return tfm.loss_fn(p, b, cfg)
+
+    if args.tp > 1:
+        # GSPMD path: params stay column/row-sharded over 'tp' end to end
+        # (build_train_step's shard_map replicates params — wrong tool
+        # for TP).
+        specs = tfm.param_specs(cfg)
+        params = sharded.shard_params(params, mesh, specs)
+        raw_opt = optax.adamw(3e-3)
+        step = bps.build_sharded_train_step(loss_f, raw_opt, mesh, specs)
+        opt_state = raw_opt.init(params)
+    else:
+        opt = bps.DistributedOptimizer(optax.adamw(3e-3))
+        step = bps.build_train_step(loss_f, opt, mesh)
+        opt_state = opt.init(params)
 
     toks, tgts = tfm.synthetic_batch(jax.random.key(1), args.batch_size,
                                      args.seq_len, cfg)
