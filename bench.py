@@ -72,10 +72,20 @@ def bench_flagship():
     from byteps_tpu.models import transformer as tfm
 
     on_tpu = jax.devices()[0].platform == "tpu"
-    small = os.environ.get("BENCH_SMALL", "0") == "1" or not on_tpu
+    alt_model = os.environ.get("BENCH_MODEL", "")
+    # An explicit BENCH_MODEL is honored on any backend (llama_tiny is
+    # CPU-feasible); only the implicit off-TPU fallback forces tiny.
+    small = (os.environ.get("BENCH_SMALL", "0") == "1"
+             or (not on_tpu and not alt_model))
     if small:
         cfg = tfm.get_config("tiny", causal=True)
         batch, seq, steps = 8 * max(1, jax.device_count()), 128, 5
+    elif alt_model:
+        # Bench any named config (e.g. BENCH_MODEL=llama_1b for the
+        # modern-LLM block) at its native sequence length.
+        cfg = tfm.get_config(alt_model, causal=True)
+        seq = min(cfg.max_seq_len, 2048)
+        batch, steps = 8 * jax.device_count(), 10
     else:
         # Full BERT-large geometry (reference benchmark: README.md:38-46),
         # causal-LM objective, bf16 activations, per-layer remat.  Batch 48
@@ -139,9 +149,9 @@ def bench_flagship():
     tps_per_chip = fw_tps / n_dev
     peak = _peak_flops(jax.devices()[0])
     mfu = (6.0 * n_params * tps_per_chip / peak) if peak else 0.0
+    model_name = ("tiny" if small else (alt_model or "bert_large"))
     print(json.dumps({
-        "metric": "bert_large_dp_scaling_efficiency" if not small
-        else "tiny_dp_scaling_efficiency",
+        "metric": f"{model_name}_dp_scaling_efficiency",
         "value": round(efficiency, 4),
         "unit": "fraction_of_ideal",
         "vs_baseline": round(efficiency / 0.90, 4),
@@ -155,7 +165,7 @@ def bench_flagship():
             "donate": True,
             "devices": n_dev,
             "batch": batch, "seq": seq,
-            "model": "bert_large" if not small else "tiny",
+            "model": model_name,
         },
     }))
 
@@ -332,7 +342,9 @@ def bench_ps():
                 if proc.poll() is not None or time.time() > deadline:
                     raise RuntimeError("PS server did not come up")
                 time.sleep(0.1)
-        sess = PSSession(["127.0.0.1"], [port], worker_id=0, num_servers=1)
+        sess = PSSession(["127.0.0.1"], [port], worker_id=0, num_servers=1,
+                         wire_conns=int(os.environ.get(
+                             "BYTEPS_TPU_WIRE_CONNS", "2")))
         x = np.random.default_rng(0).standard_normal(
             16 << 20, dtype=np.float32)            # 64 MB
         sess.push_pull(1, x)                       # init push + warm path

@@ -62,6 +62,7 @@ class Config:
     # ---- communication tuning (reference: global.cc:42-43,134-144) ----
     partition_bytes: int = 4 * 1024 * 1024   # BYTEPS_PARTITION_BYTES
     min_compress_bytes: int = 65536          # BYTEPS_MIN_COMPRESS_BYTES
+    wire_conns: int = 2                      # BYTEPS_TPU_WIRE_CONNS
     scheduling_credit: int = 0               # BYTEPS_SCHEDULING_CREDIT (0 = off)
     server_engine_threads: int = 4           # BYTEPS_SERVER_ENGINE_THREAD
     server_enable_schedule: bool = False     # BYTEPS_SERVER_ENABLE_SCHEDULE
@@ -108,6 +109,7 @@ class Config:
             force_distributed=_env_bool("BYTEPS_FORCE_DISTRIBUTED"),
             partition_bytes=_env_int("BYTEPS_PARTITION_BYTES", 4 * 1024 * 1024),
             min_compress_bytes=_env_int("BYTEPS_MIN_COMPRESS_BYTES", 65536),
+            wire_conns=_env_int("BYTEPS_TPU_WIRE_CONNS", 2),
             scheduling_credit=_env_int("BYTEPS_SCHEDULING_CREDIT", 0),
             server_engine_threads=_env_int("BYTEPS_SERVER_ENGINE_THREAD", 4),
             server_enable_schedule=_env_bool("BYTEPS_SERVER_ENABLE_SCHEDULE"),

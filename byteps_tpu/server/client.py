@@ -297,7 +297,8 @@ class PSSession:
                  num_servers: int, hash_fn: str = "djb2",
                  partition_bytes: int = 4 * 1024 * 1024,
                  scheduling_credit: int = 0,
-                 min_compress_bytes: int = 65536):
+                 min_compress_bytes: int = 65536,
+                 wire_conns: int = 2):
         self.worker_id = worker_id
         self.num_servers = max(1, num_servers)
         self.hash_fn = hash_fn
@@ -307,6 +308,15 @@ class PSSession:
         # operations.cc:362-364).
         self.min_compress_bytes = min_compress_bytes
         self.conns = [_ServerConn(h, p) for h, p in zip(hosts, ports)]
+        # Optional extra data connections per server: partitions stripe
+        # across them, splitting the send-lock and receive-thread work
+        # over more sockets (the reference gets the same effect from
+        # ps-lite's per-connection threads).  Control traffic
+        # (barrier/hello/shutdown) stays on the primary.
+        wc = max(1, wire_conns)
+        self._data_conns = [
+            [c] + [_ServerConn(h, p) for _ in range(wc - 1)]
+            for c, (h, p) in zip(self.conns, zip(hosts, ports))]
         self._inited: Dict[int, tuple] = {}     # pkey -> (length, kwargs)
         self._round: Dict[int, int] = {}        # pkey -> next round index
         self._compressors: Dict[int, object] = {}  # declared_key -> codec
@@ -366,7 +376,8 @@ class PSSession:
         return cls(hosts, ports, cfg.worker_id, n, cfg.key_hash_fn,
                    partition_bytes=cfg.partition_bytes,
                    scheduling_credit=cfg.scheduling_credit,
-                   min_compress_bytes=cfg.min_compress_bytes)
+                   min_compress_bytes=cfg.min_compress_bytes,
+                   wire_conns=cfg.wire_conns)
 
     def register_compressor(self, declared_key: int, kwargs: dict) -> None:
         """Register an inter-node compressor for a tensor's PS traffic.
@@ -396,11 +407,19 @@ class PSSession:
         core = get_core()
         bounds = core.partition_bounds(nbytes, self.partition_bytes)
         plan = []
+        # Stripe by each server's own partition count, not the global
+        # index: placement can correlate with idx (e.g. hash_fn=naive has
+        # a fixed idx residue per server), which would pin every partition
+        # of a server to one socket.
+        per_srv_count = [0] * len(self.conns)
         for idx, (off, ln) in enumerate(bounds):
             pkey = core.encode_key(declared_key, idx)
             srv = core.key_to_server(pkey, len(self.conns), self.hash_fn)
             self._server_load[srv] += ln
-            plan.append((pkey, off, ln, self.conns[srv]))
+            pool = self._data_conns[srv]
+            plan.append((pkey, off, ln,
+                         pool[per_srv_count[srv] % len(pool)]))
+            per_srv_count[srv] += 1
         self._plans[(declared_key, nbytes)] = plan
         total = sum(self._server_load) or 1
         get_logger().debug(
@@ -677,5 +696,6 @@ class PSSession:
             self._closed = True
             self._cv.notify_all()
         self._dispatcher.join(timeout=10)
-        for c in self.conns:
-            c.close()
+        for pool in self._data_conns:
+            for c in pool:
+                c.close()
