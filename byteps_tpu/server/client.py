@@ -314,9 +314,22 @@ class PSSession:
         # ps-lite's per-connection threads).  Control traffic
         # (barrier/hello/shutdown) stays on the primary.
         wc = max(1, wire_conns)
-        self._data_conns = [
-            [c] + [_ServerConn(h, p) for _ in range(wc - 1)]
-            for c, (h, p) in zip(self.conns, zip(hosts, ports))]
+        self._data_conns = [[c] for c in self.conns]
+        try:
+            for pool, (h, p) in zip(self._data_conns, zip(hosts, ports)):
+                for _ in range(wc - 1):
+                    pool.append(_ServerConn(h, p))
+        except Exception:
+            # A partial connect failure must not leak the sockets and
+            # receiver threads already created.
+            for pool in self._data_conns:
+                for c in pool:
+                    c.close()
+            raise
+        # Per-server round-robin cursor, persistent across plans: a
+        # per-plan counter would pin every single-partition tensor (the
+        # common case for DL gradients) to the primary socket.
+        self._conn_rr = [0] * len(self.conns)
         self._inited: Dict[int, tuple] = {}     # pkey -> (length, kwargs)
         self._round: Dict[int, int] = {}        # pkey -> next round index
         self._compressors: Dict[int, object] = {}  # declared_key -> codec
@@ -407,19 +420,19 @@ class PSSession:
         core = get_core()
         bounds = core.partition_bounds(nbytes, self.partition_bytes)
         plan = []
-        # Stripe by each server's own partition count, not the global
-        # index: placement can correlate with idx (e.g. hash_fn=naive has
-        # a fixed idx residue per server), which would pin every partition
-        # of a server to one socket.
-        per_srv_count = [0] * len(self.conns)
+        # Stripe by a per-server cursor that persists across plans (in
+        # self._conn_rr): a global-index stripe degenerates when placement
+        # correlates with index (hash_fn=naive), and a per-plan counter
+        # pins every single-partition tensor to the primary socket.  Plans
+        # are cached, so each partition's conn assignment is stable.
         for idx, (off, ln) in enumerate(bounds):
             pkey = core.encode_key(declared_key, idx)
             srv = core.key_to_server(pkey, len(self.conns), self.hash_fn)
             self._server_load[srv] += ln
             pool = self._data_conns[srv]
             plan.append((pkey, off, ln,
-                         pool[per_srv_count[srv] % len(pool)]))
-            per_srv_count[srv] += 1
+                         pool[self._conn_rr[srv] % len(pool)]))
+            self._conn_rr[srv] += 1
         self._plans[(declared_key, nbytes)] = plan
         total = sum(self._server_load) or 1
         get_logger().debug(

@@ -263,6 +263,23 @@ def test_large_tensor_partitioned_across_servers(ps_server):
     np.testing.assert_array_equal(out[1], expect)
 
 
+def test_wire_conns_stripe_partitions(ps_server):
+    """With wire_conns=2, a multi-partition tensor's data must stripe over
+    both sockets of each server — for EVERY placement hash (a global-index
+    stripe degenerates under hash_fn=naive, whose server assignment has a
+    fixed index residue)."""
+    port = ps_server(num_workers=1)
+    for hash_fn in ("naive", "djb2"):
+        s = PSSession(["127.0.0.1"], [port], worker_id=0, num_servers=1,
+                      hash_fn=hash_fn, partition_bytes=65536, wire_conns=2)
+        data = np.arange(8 * 65536 // 4, dtype=np.float32)
+        plan = s._plan(3, data.nbytes)
+        conns_used = {id(c) for (_, _, _, c) in plan}
+        assert len(conns_used) == 2, f"no striping under hash_fn={hash_fn}"
+        np.testing.assert_array_equal(s.push_pull(3, data), data)
+        s.close()
+
+
 def test_priority_scheduling_with_credit(ps_server):
     """With a constrained credit, queued partitions must dispatch in
     (priority desc, key asc) order: a high-priority tensor enqueued after a
