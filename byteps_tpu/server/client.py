@@ -307,29 +307,50 @@ class PSSession:
         # BYTEPS_MIN_COMPRESS_BYTES floor (reference: global.cc:43,
         # operations.cc:362-364).
         self.min_compress_bytes = min_compress_bytes
-        self.conns = [_ServerConn(h, p) for h, p in zip(hosts, ports)]
-        # Optional extra data connections per server: partitions stripe
-        # across them, splitting the send-lock and receive-thread work
-        # over more sockets (the reference gets the same effect from
-        # ps-lite's per-connection threads).  Control traffic
-        # (barrier/hello/shutdown) stays on the primary.
-        wc = max(1, wire_conns)
-        self._data_conns = [[c] for c in self.conns]
+        # Any failure before __init__ returns (a connect, the dispatcher,
+        # the HELLO mode check) must tear down every socket and receiver
+        # thread already created — the caller gets an exception, not a
+        # session, so nothing else can ever close them.
+        self.conns: List[_ServerConn] = []
+        self._data_conns: List[List[_ServerConn]] = []
         try:
-            for pool, (h, p) in zip(self._data_conns, zip(hosts, ports)):
-                for _ in range(wc - 1):
-                    pool.append(_ServerConn(h, p))
+            self._init_connections(hosts, ports, max(1, wire_conns))
+            self._init_state(scheduling_credit)
+            self._hello_mode_check(worker_id)
         except Exception:
-            # A partial connect failure must not leak the sockets and
-            # receiver threads already created.
-            for pool in self._data_conns:
-                for c in pool:
-                    c.close()
+            self._abort_init()
             raise
+
+    def _init_connections(self, hosts, ports, wire_conns: int) -> None:
+        """Primary conn per server + optional extra data connections.
+
+        Partitions stripe across a server's pool, splitting the send-lock
+        and receive-thread work over more sockets (the reference gets the
+        same effect from ps-lite's per-connection threads).  Control
+        traffic (barrier/hello/shutdown) stays on the primary."""
+        for h, p in zip(hosts, ports):
+            c = _ServerConn(h, p)
+            self.conns.append(c)
+            self._data_conns.append([c])
+        for pool, (h, p) in zip(self._data_conns, zip(hosts, ports)):
+            for _ in range(wire_conns - 1):
+                pool.append(_ServerConn(h, p))
         # Per-server round-robin cursor, persistent across plans: a
         # per-plan counter would pin every single-partition tensor (the
         # common case for DL gradients) to the primary socket.
         self._conn_rr = [0] * len(self.conns)
+
+    def _abort_init(self) -> None:
+        if getattr(self, "_dispatcher", None) is not None:
+            with self._cv:
+                self._closed = True
+                self._cv.notify_all()
+            self._dispatcher.join(timeout=5)
+        for pool in self._data_conns:
+            for c in pool:
+                c.close()
+
+    def _init_state(self, scheduling_credit: int) -> None:
         self._inited: Dict[int, tuple] = {}     # pkey -> (length, kwargs)
         self._round: Dict[int, int] = {}        # pkey -> next round index
         self._compressors: Dict[int, object] = {}  # declared_key -> codec
@@ -357,6 +378,7 @@ class PSSession:
             target=self._dispatch_loop, daemon=True, name="bps-ps-dispatch")
         self._dispatcher.start()
 
+    def _hello_mode_check(self, worker_id: int) -> None:
         # HELLO returns the server's mode flags (u8 async | u8 schedule).
         # All servers must agree — a mixed fleet silently corrupts training
         # (partitions on a sync server would round-SUM async deltas).

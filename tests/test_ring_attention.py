@@ -94,6 +94,26 @@ def test_ulysses_rejects_bad_head_count():
                       out_specs=spec, check_vma=False)(q, k, v)
 
 
+def test_ulysses_with_llama_gqa_block():
+    """Composition: a llama-class model (GQA + RoPE + SwiGLU) forwards
+    through Ulysses sequence parallelism.  GQA expands kv heads to the
+    full head count before the attn_fn runs, so the sp head-split sees a
+    uniform head axis; logits must match the plain dense run."""
+    from byteps_tpu.models import transformer as tfm
+    from byteps_tpu.ops.ring_attention import make_ulysses_attn_fn
+
+    mesh = _mesh_sp()
+    cfg = tfm.get_config("llama_tiny", remat=False, dtype=jnp.float32,
+                         num_heads=8, num_kv_heads=2, d_model=64)
+    params = tfm.init_params(jax.random.key(0), cfg)
+    toks = jax.random.randint(jax.random.key(1), (2, 32), 0, cfg.vocab_size)
+    want = tfm.forward(params, toks, cfg)
+    got = tfm.forward(params, toks, cfg,
+                      attn_fn=make_ulysses_attn_fn(mesh))
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=2e-4, rtol=1e-4)
+
+
 def test_ulysses_with_flash_inner():
     """Ulysses + flash over a REAL 8-way sp mesh: the all-to-all reshards
     seq->heads (each shard holds 1 head x full sequence), the Pallas

@@ -22,6 +22,18 @@ def test_checkpoint_roundtrip(tmp_path, bps_initialized):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
 
+def test_async_checkpoint_roundtrip(tmp_path, bps_initialized):
+    state = {"w": jnp.arange(12.0).reshape(3, 4), "step": jnp.asarray(3)}
+    path = str(tmp_path / "actkpt")
+    saver = ckpt.AsyncSaver()
+    saver.save(path, state)        # returns before the write completes
+    saver.wait()                   # now durable
+    restored = ckpt.restore(path, template=state)
+    for a, b in zip(jax.tree.leaves(restored), jax.tree.leaves(state)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    saver.close()
+
+
 def test_latest_step_dir(tmp_path):
     assert ckpt.latest_step_dir(str(tmp_path)) is None
     for s in (10, 2, 300):
