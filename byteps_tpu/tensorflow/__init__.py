@@ -111,6 +111,66 @@ def push_pull(tensor, scope: str = "", average: bool = True,
     return out
 
 
+def push_pull_group(tensors, names, average: bool = True,
+                    compression=Compression.none):
+    """Sum/average a LIST of tensors across workers with ONE host
+    boundary.
+
+    The per-tensor `push_pull` pays a TF->JAX->TF crossing per gradient
+    (the documented py_function trade-off); gradient lists are the common
+    case, so this batches the whole list through one py_function call and
+    dispatches all tensors asynchronously inside it (priority=-i, the
+    reference's gradient ordering, mxnet/__init__.py:325-343) before
+    synchronizing.  `None` entries pass through.
+    """
+    import jax.numpy as jnp
+
+    idx = [i for i, t in enumerate(tensors) if t is not None]
+    if not idx:
+        return list(tensors)
+    live = [tensors[i] for i in idx]
+    live_names = [names[i] for i in idx]
+
+    def _eager_group(*ts):
+        handles = []
+        try:
+            for i, (t, n) in enumerate(zip(ts, live_names)):
+                handles.append(_api.push_pull_async(
+                    jnp.asarray(t.numpy()), name=n, average=average,
+                    priority=-i, compression=compression))
+            return [tf.convert_to_tensor(np.asarray(_api.synchronize(h)),
+                                         dtype=t.dtype)
+                    for h, t in zip(handles, ts)]
+        except Exception:
+            # A failure mid-list must not orphan already-dispatched
+            # handles (they pin buffers until synchronized).  Drain them
+            # best-effort, then surface the original error.
+            for h in handles:
+                try:
+                    _api.synchronize(h)
+                except Exception:
+                    pass
+            raise
+
+    if tf.executing_eagerly():
+        conv = [tf.convert_to_tensor(t) for t in live]
+        live = conv
+        eager_ok = all(hasattr(t, "numpy") for t in conv)
+    else:
+        eager_ok = False
+    if eager_ok:
+        outs = _eager_group(*live)
+    else:
+        outs = tf.py_function(_eager_group, live,
+                              Tout=[t.dtype for t in live])
+        for o, t in zip(outs, live):
+            o.set_shape(t.shape)
+    merged = list(tensors)
+    for i, o in zip(idx, outs):
+        merged[i] = o
+    return merged
+
+
 def broadcast_variables(variables: Iterable[tf.Variable], root_rank: int = 0,
                         scope: str = "") -> None:
     """Assign every worker rank `root_rank`'s values
@@ -188,17 +248,16 @@ def DistributedOptimizer(optimizer, name: Optional[str] = None,
 
         def compute_gradients(self, *args, **kwargs):
             gvs = self._opt.compute_gradients(*args, **kwargs)
-            out = []
+            grads, names = [], []
             for g, v in gvs:
-                if g is None:
-                    out.append((g, v))
-                    continue
-                if sparse_as_dense and isinstance(g, tf.IndexedSlices):
+                if g is not None and sparse_as_dense \
+                        and isinstance(g, tf.IndexedSlices):
                     g = tf.convert_to_tensor(g)
-                gname = f"Gradient.{v.name.replace(':', '_')}"
-                out.append((push_pull(g, average=True, name=gname,
-                                      compression=self._compression), v))
-            return out
+                grads.append(g)
+                names.append(f"Gradient.{v.name.replace(':', '_')}")
+            merged = push_pull_group(grads, names, average=True,
+                                     compression=self._compression)
+            return [(m, v) for m, (_, v) in zip(merged, gvs)]
 
         # Delegate everything apply-side to the wrapped optimizer.
         def apply_gradients(self, *args, **kwargs):
@@ -241,16 +300,15 @@ class DistributedGradientTape(object):
         grads = self._tape.gradient(target, sources,
                                     output_gradients=output_gradients)
         flat_sources = tf.nest.flatten(sources)
-        flat = []
+        flat, names = [], []
         for i, (g, s) in enumerate(zip(tf.nest.flatten(grads),
                                        flat_sources)):
-            if g is None:
-                flat.append(None)
-                continue
-            if self._sparse_as_dense and isinstance(g, tf.IndexedSlices):
+            if g is not None and self._sparse_as_dense \
+                    and isinstance(g, tf.IndexedSlices):
                 g = tf.convert_to_tensor(g)
+            flat.append(g)
             sname = getattr(s, "name", f"src_{i}").replace(":", "_")
-            flat.append(push_pull(g, average=True,
-                                  name=f"Gradient.{sname}",
-                                  compression=self._compression))
-        return tf.nest.pack_sequence_as(grads, flat)
+            names.append(f"Gradient.{sname}")
+        merged = push_pull_group(flat, names, average=True,
+                                 compression=self._compression)
+        return tf.nest.pack_sequence_as(grads, merged)

@@ -16,7 +16,7 @@ from typing import Optional
 import keras
 import numpy as np
 
-from .. import push_pull, broadcast_variables
+from .. import push_pull, push_pull_group, broadcast_variables
 from ...common import api as _api
 from ...ops.compression import Compression
 
@@ -40,19 +40,19 @@ def DistributedOptimizer(optimizer: keras.optimizers.Optimizer,
 
         def apply_gradients(self, grads_and_vars, *args, **kwargs):
             gvs = list(grads_and_vars)
-            synced = []
+            grads, names = [], []
             for i, (g, v) in enumerate(gvs):
-                if g is None:
-                    synced.append((g, v))
-                    continue
+                grads.append(g)
                 # Keras-3 variable .name is NOT unique ("kernel"/"bias" on
                 # every Dense); .path is ("sequential/dense_1/kernel").
                 vname = (getattr(v, "path", None)
                          or getattr(v, "name", None) or f"var_{i}")
-                g = push_pull(g, average=True,
-                              name=f"Gradient.{str(vname).replace(':', '_')}",
-                              compression=self._bps_compression)
-                synced.append((g, v))
+                names.append(
+                    f"Gradient.{str(vname).replace(':', '_')}")
+            # One host boundary for the whole gradient list.
+            merged = push_pull_group(grads, names, average=True,
+                                     compression=self._bps_compression)
+            synced = [(m, v) for m, (_, v) in zip(merged, gvs)]
             return super().apply_gradients(synced, *args, **kwargs)
 
     _Distributed.__name__ = "Distributed" + cls.__name__

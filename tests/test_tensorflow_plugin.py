@@ -39,6 +39,29 @@ def test_push_pull_inside_tf_function(initialized):
     np.testing.assert_allclose(out2.numpy(), 2 * np.ones(8))
 
 
+def test_push_pull_group_matches_single(initialized):
+    """One host boundary for a gradient list: results must equal the
+    per-tensor path, None entries pass through, and it must work both
+    eagerly and inside tf.function."""
+    ts = [tf.range(4, dtype=tf.float32), None, tf.ones([2, 3]) * 2.0]
+    names = ["grp_a", "grp_x", "grp_b"]
+    out = bps_tf.push_pull_group(ts, names, average=True)
+    assert out[1] is None
+    np.testing.assert_allclose(out[0].numpy(),
+                               np.arange(4, dtype=np.float32))
+    np.testing.assert_allclose(out[2].numpy(), 2 * np.ones((2, 3)))
+
+    @tf.function
+    def f(a, b):
+        r = bps_tf.push_pull_group([a, b], ["grp_fa", "grp_fb"],
+                                   average=False)
+        return r[0], r[1]
+
+    a, b = f(tf.ones([3]), tf.fill([2], 5.0))
+    np.testing.assert_allclose(a.numpy(), np.ones(3))
+    np.testing.assert_allclose(b.numpy(), np.full(2, 5.0))
+
+
 def test_broadcast_variables(initialized):
     v1 = tf.Variable(tf.ones([4]))
     v2 = tf.Variable(tf.zeros([2, 2]))
