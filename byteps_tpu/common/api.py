@@ -114,6 +114,8 @@ def init(lazy: bool = True) -> None:
             process_id=cfg.worker_id,
         )
         _state.jax_dist_initialized = True
+    from .logging import set_level
+    set_level(cfg.log_level)   # honor a refreshed level on init/resume
     core = get_core()
     if cfg.trace_on:
         core.trace_enable(True)

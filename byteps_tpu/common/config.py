@@ -88,7 +88,6 @@ class Config:
     mesh_ep: int = 1                     # BYTEPS_TPU_MESH_EP
     # Hierarchical reduce: devices per ICI island when spanning DCN.
     ici_size: int = 0                    # BYTEPS_TPU_ICI_SIZE (0 = all local)
-    cross_barrier: bool = False          # BYTEPS_CROSS_BARRIER
     # PS parity mode: route push_pull through the host KV server tier
     # instead of XLA collectives (reference default path).
     ps_mode: bool = False                # BYTEPS_TPU_PS_MODE
@@ -127,7 +126,6 @@ class Config:
             mesh_pp=_env_int("BYTEPS_TPU_MESH_PP", 1),
             mesh_ep=_env_int("BYTEPS_TPU_MESH_EP", 1),
             ici_size=_env_int("BYTEPS_TPU_ICI_SIZE", 0),
-            cross_barrier=_env_bool("BYTEPS_CROSS_BARRIER"),
             ps_mode=_env_bool("BYTEPS_TPU_PS_MODE"),
         )
 

@@ -26,13 +26,23 @@ _LEVELS = {
 _logger: logging.Logger | None = None
 
 
+def _resolve(name: str) -> int:
+    """Level name (TRACE..FATAL) -> numeric level; unknown -> WARNING."""
+    return _LEVELS.get(str(name).upper(), logging.WARNING)
+
+
+def set_level(name: str) -> None:
+    """Apply a level name to the logger — lets init()/resume() honor a
+    refreshed Config.log_level, not just the env at first-logger-creation
+    time."""
+    get_logger().setLevel(_resolve(name))
+
+
 def get_logger() -> logging.Logger:
     global _logger
     if _logger is None:
         lg = logging.getLogger("byteps_tpu")
-        level = _LEVELS.get(os.environ.get("BYTEPS_LOG_LEVEL", "WARNING").upper(),
-                            logging.WARNING)
-        lg.setLevel(level)
+        lg.setLevel(_resolve(os.environ.get("BYTEPS_LOG_LEVEL", "WARNING")))
         if not lg.handlers:
             h = logging.StreamHandler(sys.stderr)
             h.setFormatter(logging.Formatter(

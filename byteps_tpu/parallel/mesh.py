@@ -62,14 +62,18 @@ def make_mesh(dp: int = 0, tp: int = 1, sp: int = 1, pp: int = 1, ep: int = 1,
     return Mesh(mesh_devs, AXIS_ORDER)
 
 
-def make_hierarchical_mesh(ici_size: int,
+def make_hierarchical_mesh(ici_size: Optional[int] = None,
                            devices: Optional[Sequence] = None) -> Mesh:
     """Two-level DP mesh ('dcn_dp', 'ici_dp') for hierarchical reduction.
 
     `ici_size` devices per ICI island; islands are connected over DCN.  The
     reference analog: GPUs under one PCIe switch reduce via NCCL, roots push
     over the network (reference: docs/architecture.md:26-33).
+    None reads BYTEPS_TPU_ICI_SIZE (0 = all devices local, one island).
     """
+    if ici_size is None:
+        from ..common.config import get_config
+        ici_size = get_config().ici_size
     devs = list(devices) if devices is not None else jax.devices()
     n = len(devs)
     if ici_size <= 0:
