@@ -31,7 +31,7 @@ import jax
 import jax.numpy as jnp
 
 from .config import Config, get_config
-from .logging import get_logger
+from .logging import get_logger, set_level
 from ..core.native import get_core
 
 PyTree = Any
@@ -114,7 +114,6 @@ def init(lazy: bool = True) -> None:
             process_id=cfg.worker_id,
         )
         _state.jax_dist_initialized = True
-    from .logging import set_level
     set_level(cfg.log_level)   # honor a refreshed level on init/resume
     core = get_core()
     if cfg.trace_on:

@@ -85,6 +85,19 @@ def test_eager_collectives_two_processes():
         assert r["speed"]["mbps"] >= 0.0
 
 
+def test_eager_collectives_three_processes():
+    """size() > 2: the eager tier must generalize beyond pairs (sum over
+    ranks 1+2+3, broadcast from root among three)."""
+    res = _launch("basic", world=3)
+    for wid in (0, 1, 2):
+        r = _by_check(res[wid])
+        assert r["topology"]["size"] == 3
+        assert r["push_pull"]["sum"] == [6.0] * 4
+        assert r["push_pull"]["avg"] == [2.0] * 4
+        assert r["async"]["sum"] == [6.0] * 4
+        assert r["broadcast"]["w"] == [0.0] * 3
+
+
 def test_train_step_loss_parity_with_single_process():
     """2-process DP training must track the single-process trajectory: the
     sum of per-shard gradients over half-batches equals the full-batch
