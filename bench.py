@@ -64,6 +64,12 @@ def _param_count(params) -> int:
     return sum(int(l.size) for l in jax.tree.leaves(params))
 
 
+def _note() -> dict:
+    """Provenance note for the detail payload (set by the CPU fallback)."""
+    n = os.environ.get("BENCH_NOTE")
+    return {"note": n} if n else {}
+
+
 def bench_flagship():
     import jax
     import optax
@@ -90,11 +96,18 @@ def bench_flagship():
         # Full BERT-large geometry (reference benchmark: README.md:38-46),
         # causal-LM objective, bf16 activations, per-layer remat.  Batch 48
         # per chip saturates the v5e MXU (measured: 16->48 is +15% tokens/s,
-        # 48->64 is flat); full remat beats the dots-saveable policies here
-        # (saving dot outputs at this size spills HBM before it saves FLOPs).
-        cfg = tfm.get_config("bert_large", causal=True, vocab_size=32768,
-                             max_seq_len=512)
-        batch, seq, steps = 48 * jax.device_count(), 512, 10
+        # 48->64 is flat).  Round-4 defaults from the on-TPU sweep:
+        # streamed LM-head cross-entropy (the full f32 logits were 3.2 GB
+        # of HBM traffic) + flash attention; each knob env-overridable for
+        # re-tuning (BENCH_CE_CHUNK=0 / BENCH_ATTN=dense /
+        # BENCH_REMAT_POLICY=dots restore the alternatives).
+        cfg = tfm.get_config(
+            "bert_large", causal=True, vocab_size=32768, max_seq_len=512,
+            ce_chunk_rows=int(os.environ.get("BENCH_CE_CHUNK", "2048")),
+            remat_policy=os.environ.get("BENCH_REMAT_POLICY", "none"),
+            attn_impl=os.environ.get("BENCH_ATTN", "flash"))
+        batch = int(os.environ.get("BENCH_BATCH", "48")) * jax.device_count()
+        seq, steps = 512, 10
 
     mesh = bps.make_mesh()  # all devices on dp
     params = tfm.init_params(jax.random.key(0), cfg)
@@ -166,6 +179,10 @@ def bench_flagship():
             "devices": n_dev,
             "batch": batch, "seq": seq,
             "model": model_name,
+            "ce_chunk_rows": cfg.ce_chunk_rows,
+            "attn_impl": cfg.attn_impl,
+            "remat_policy": cfg.remat_policy,
+            **_note(),
         },
     }))
 
@@ -246,6 +263,7 @@ def bench_machinery():
             "mixed": mixed,
             "devices": n_dev,
             "ici_size": ici,
+            **_note(),
         },
     }))
 
@@ -377,12 +395,55 @@ def bench_ps():
         proc.wait()
 
 
-def _init_backend_or_die(timeout_s: float) -> None:
-    """Initialize the JAX backend with a deadline.
+def _probe_backend_subprocess(deadline: float) -> str:
+    """Poll backend availability in SHORT-LIVED subprocesses until deadline.
 
-    A wedged device tunnel makes jax.devices() block forever; a bench that
-    hangs reports nothing.  Probe the backend on a daemon thread and emit
-    an honest JSON error line (then exit nonzero) if it never comes up.
+    A wedged device tunnel makes jax.devices() block forever, and a
+    transiently-held chip (another process finishing up) makes it raise —
+    both must not cost this process its ability to report.  Each probe is a
+    fresh interpreter killed at a short per-attempt timeout: a block is
+    contained (killed child, no parent state), a raise is retried until the
+    chip frees up.  Returns "" on success or the last error string.
+    """
+    import subprocess
+    import sys
+
+    last_err = "no probe attempted"
+    while time.time() < deadline:
+        per_try = min(90.0, max(15.0, deadline - time.time()))
+        try:
+            proc = subprocess.run(
+                [sys.executable, "-c",
+                 "import jax; print(len(jax.devices()))"],
+                capture_output=True, text=True, timeout=per_try)
+        except subprocess.TimeoutExpired:
+            last_err = (f"backend init probe blocked >{per_try:.0f}s "
+                        f"(device tunnel wedged?)")
+            continue
+        if proc.returncode == 0:
+            return ""
+        last_err = proc.stderr.strip()[-500:] or f"probe rc={proc.returncode}"
+        time.sleep(2.0)
+    return last_err
+
+
+def _error_record(err: str) -> None:
+    print(json.dumps({
+        "metric": "bench_backend_init",
+        "value": 0.0,
+        "unit": "error",
+        "vs_baseline": 0.0,
+        "detail": {"error": err},
+    }), flush=True)
+
+
+def _init_inprocess(timeout_s: float) -> str:
+    """Watchdog the actual in-process backend init (daemon-thread deadline).
+
+    The subprocess pre-probe seeing a free chip does not guarantee THIS
+    process's init succeeds (another process can grab the chip in between,
+    or the tunnel can wedge).  Returns "" on success or an error string —
+    the caller decides whether to fall back.
     """
     import threading
 
@@ -399,43 +460,86 @@ def _init_backend_or_die(timeout_s: float) -> None:
 
     threading.Thread(target=probe, daemon=True).start()
     if not done.wait(timeout_s):
-        print(json.dumps({
-            "metric": "bench_backend_init",
-            "value": 0.0,
-            "unit": "error",
-            "vs_baseline": 0.0,
-            "detail": {"error": f"JAX backend init did not complete within "
-                                f"{timeout_s:.0f}s (device tunnel wedged?)"},
-        }), flush=True)
+        return (f"JAX backend init did not complete within {timeout_s:.0f}s "
+                f"despite a healthy pre-probe")
+    return info.get("error", "")
+
+
+def _init_backend_or_fallback(timeout_s: float) -> None:
+    """Make sure a backend comes up — or re-exec a hermetic CPU fallback.
+
+    Round-3 postmortem: BENCH_r03 recorded only an error because the one
+    in-process probe hit a busy/wedged tunnel.  Now: (1) retry cheap
+    subprocess probes until the deadline so a transiently-held chip is
+    ridden out; (2) if the device never appears (or is snatched between
+    probe and init), re-run this bench in a hermetic CPU child (small
+    model) so the driver still records a real measurement, honestly
+    labelled — the bench must produce a number regardless of tunnel state.
+    """
+    if os.environ.get("BENCH_CPU_FALLBACK_CHILD", "0") == "1":
+        # We ARE the fallback child.  The env pins JAX_PLATFORMS=cpu, but
+        # site platform plugins can override the env var — pin the config
+        # knob too (same recipe as the dryrun child in __graft_entry__).
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        return
+    if os.environ.get("BENCH_FORCE_CPU", "0") == "1":
+        return  # main() already pinned this process to CPU; no device probe
+    err = _probe_backend_subprocess(time.time() + timeout_s)
+    if not err:
+        err = _init_inprocess(120.0)
+        if not err:
+            return
+    import subprocess
+    import sys
+
+    from byteps_tpu.utils.hermetic import (cpu_subprocess_env,
+                                           force_host_device_count)
+
+    # Flagship fallback: ONE virtual CPU device, matching the real bench's
+    # single-chip shape (8 devices time-slicing one core would turn the
+    # efficiency ratio into an oversubscription artifact) — and force the
+    # small model (a BENCH_MODEL the driver set for TPU would be infeasible
+    # on CPU).  Machinery fallback: keep 8 devices — its metric compares
+    # collective strategies over a real mesh axis and is meaningless on 1.
+    machinery = os.environ.get("BENCH_MACHINERY", "0") == "1"
+    env = cpu_subprocess_env({
+        "BENCH_CPU_FALLBACK_CHILD": "1",
+        "BENCH_NOTE": f"cpu-fallback: device backend unavailable ({err})",
+    })
+    env.pop("BENCH_MODEL", None)
+    if not machinery:
+        env["BENCH_SMALL"] = "1"
+    force_host_device_count(env, 8 if machinery else 1)
+    try:
+        proc = subprocess.run([sys.executable, os.path.abspath(__file__)],
+                              env=env, timeout=1800)
+        rc = proc.returncode
+    except subprocess.TimeoutExpired:
+        _error_record("cpu-fallback bench child exceeded 1800s")
         os._exit(3)
-    if "error" in info:
-        print(json.dumps({
-            "metric": "bench_backend_init",
-            "value": 0.0,
-            "unit": "error",
-            "vs_baseline": 0.0,
-            "detail": {"error": info["error"]},
-        }), flush=True)
-        os._exit(3)
+    if rc != 0:
+        _error_record(f"cpu-fallback bench child failed (rc={rc})")
+    os._exit(rc)
 
 
 def main():
     if os.environ.get("BENCH_FORCE_CPU", "0") == "1":
-        flags = os.environ.get("XLA_FLAGS", "")
-        if "xla_force_host_platform_device_count" not in flags:
-            os.environ["XLA_FLAGS"] = (
-                flags + " --xla_force_host_platform_device_count=8").strip()
+        from byteps_tpu.utils.hermetic import force_host_device_count
+        if ("xla_force_host_platform_device_count"
+                not in os.environ.get("XLA_FLAGS", "")):
+            force_host_device_count(os.environ, 8)  # keep a user-set count
         import jax
         jax.config.update("jax_platforms", "cpu")
     if os.environ.get("BENCH_MACHINERY", "0") == "1":
-        _init_backend_or_die(float(os.environ.get("BENCH_INIT_TIMEOUT",
-                                                  "600")))
+        _init_backend_or_fallback(float(os.environ.get("BENCH_INIT_TIMEOUT",
+                                                       "480")))
         bench_machinery()
     elif os.environ.get("BENCH_PS", "0") == "1":
         bench_ps()           # host-only: no device backend involved
     else:
-        _init_backend_or_die(float(os.environ.get("BENCH_INIT_TIMEOUT",
-                                                  "600")))
+        _init_backend_or_fallback(float(os.environ.get("BENCH_INIT_TIMEOUT",
+                                                       "480")))
         bench_flagship()
 
 

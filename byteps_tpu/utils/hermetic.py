@@ -43,3 +43,18 @@ def cpu_subprocess_env(extra: Optional[Dict[str, str]] = None,
     if extra:
         env.update(extra)
     return env
+
+
+def force_host_device_count(env: Dict[str, str], n: int) -> Dict[str, str]:
+    """Pin XLA_FLAGS in `env` to exactly `n` virtual host devices, in place.
+
+    Replaces any existing --xla_force_host_platform_device_count flag
+    (appending blindly would leave two copies and XLA honors the first).
+    """
+    import re
+
+    flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "",
+                   env.get("XLA_FLAGS", ""))
+    env["XLA_FLAGS"] = (
+        flags + f" --xla_force_host_platform_device_count={n}").strip()
+    return env

@@ -53,6 +53,29 @@ def test_dryrun_multichip_self_provisions_from_single_device():
     assert "SELF_PROVISION_OK" in proc.stdout
 
 
+def test_dryrun_never_touches_parent_backend():
+    # Round-3 postmortem: with a wedged device tunnel, parent-side
+    # jax.devices() BLOCKS (it does not raise), so the driver killed the
+    # dryrun at its timeout (MULTICHIP_r03 rc=124).  The gate must never
+    # import jax in the parent at all.  Poison the parent's jax import
+    # (sys.modules[name]=None makes `import jax` raise) and assert the
+    # dryrun still completes via its hermetic CPU child, which imports the
+    # real jax from a fresh interpreter.
+    code = (
+        "import sys; sys.modules['jax'] = None; "
+        "import importlib.util; "
+        f"spec = importlib.util.spec_from_file_location('ge', {ENTRY!r}); "
+        "m = importlib.util.module_from_spec(spec); "
+        "spec.loader.exec_module(m); "
+        "m.dryrun_multichip(2); print('WEDGE_PROOF_OK')"
+    )
+    proc = subprocess.run([sys.executable, "-c", code],
+                          env=_clean_env(), capture_output=True, text=True,
+                          timeout=1200)
+    assert proc.returncode == 0, proc.stderr[-4000:]
+    assert "WEDGE_PROOF_OK" in proc.stdout
+
+
 def test_entry_compiles_single_device():
     code = (
         "import jax; jax.config.update('jax_platforms', 'cpu'); "
