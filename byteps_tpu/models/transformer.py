@@ -66,6 +66,13 @@ class TransformerConfig:
     # batch-dim-carrying dots.
     remat_policy: str = "none"         # "none" | "dots" | "dots_no_batch"
     attn_impl: str = "dense"           # "dense" | "flash" | "ring" (sp)
+    # Fused LM-head cross-entropy: > 0 streams the readout matmul + softmax
+    # in row chunks of this size so the [B*S, vocab] logits are never
+    # materialized (forward OR backward — each chunk is rematerialised).
+    # 0 = classic path through full logits.  At bert_large bench scale the
+    # full f32 logits are 3.2 GB and their HBM traffic is the largest
+    # non-matmul cost in the step (round-3 profiling).
+    ce_chunk_rows: int = 0
 
     def __post_init__(self):
         for field, val, allowed in (
@@ -90,6 +97,9 @@ class TransformerConfig:
         if self.pos == "rope" and self.head_dim % 2:
             raise ValueError(f"pos='rope' needs an even head_dim "
                              f"(got {self.head_dim})")
+        if self.ce_chunk_rows < 0:
+            raise ValueError(f"ce_chunk_rows={self.ce_chunk_rows} must be "
+                             f">= 0 (0 = unfused full-logits path)")
 
     @property
     def head_dim(self) -> int:
@@ -357,9 +367,9 @@ def _block(x, lp, cfg: TransformerConfig, attn_fn):
     return x + h
 
 
-def forward(params: PyTree, tokens: jax.Array, cfg: TransformerConfig,
-            attn_fn=None) -> jax.Array:
-    """tokens [B, S] int32 -> logits [B, S, vocab].
+def forward_hidden(params: PyTree, tokens: jax.Array, cfg: TransformerConfig,
+                   attn_fn=None) -> jax.Array:
+    """tokens [B, S] int32 -> final hidden states [B, S, D] (post ln_f).
 
     Layers run under `lax.scan` over the stacked params; each step is
     optionally rematerialised.  `attn_fn(q,k,v,causal)` defaults to dense
@@ -401,18 +411,84 @@ def forward(params: PyTree, tokens: jax.Array, cfg: TransformerConfig,
     else:
         step = body
     x, _ = lax.scan(step, x, params["layers"])
-    x = _NORMS[cfg.norm](x, params["ln_f_scale"], params.get("ln_f_bias"))
-    # Weight-tied readout against the embedding (keeps the big vocab matmul
-    # on the MXU once, not twice).
-    logits = jnp.einsum("bsd,vd->bsv", x.astype(jnp.float32),
-                        params["embed"].astype(jnp.float32))
-    return logits
+    return _NORMS[cfg.norm](x, params["ln_f_scale"], params.get("ln_f_bias"))
+
+
+def forward(params: PyTree, tokens: jax.Array, cfg: TransformerConfig,
+            attn_fn=None) -> jax.Array:
+    """tokens [B, S] int32 -> logits [B, S, vocab] (f32).
+
+    Weight-tied readout against the embedding (keeps the big vocab matmul
+    on the MXU once, not twice), computed in the activation dtype with f32
+    accumulation — the MXU-native form; an all-f32 matmul would run in
+    multi-pass emulation on TPU.
+    """
+    x = forward_hidden(params, tokens, cfg, attn_fn=attn_fn)
+    return jnp.einsum("bsd,vd->bsv", x,
+                      params["embed"].astype(x.dtype),
+                      preferred_element_type=jnp.float32)
+
+
+def _fused_lm_loss(x: jax.Array, embed: jax.Array, targets: jax.Array,
+                   chunk_rows: int) -> jax.Array:
+    """Streamed weight-tied LM cross-entropy: sum of per-row NLL without
+    ever materializing the full [B*S, vocab] logits.
+
+    Rows are processed in `chunk_rows`-sized chunks under `lax.scan`; each
+    chunk computes its logits (activation-dtype matmul, f32 accumulation),
+    reduces them to logsumexp + target logit, and is wrapped in
+    `jax.checkpoint` so the backward pass recomputes the chunk logits
+    instead of saving them.  Peak logits memory drops from O(B*S*V) to
+    O(chunk_rows*V) in both passes; the matmul work is unchanged and stays
+    MXU-shaped.  (Reference analog: BytePS's whole pitch is removing
+    non-compute bottlenecks from the training step — docs/performance.md;
+    here the bottleneck is HBM traffic rather than network.)
+    """
+    B, S, D = x.shape
+    N = B * S
+    C = min(chunk_rows, N)
+    xs = x.reshape(N, D)
+    ts = targets.reshape(N)
+    pad = (-N) % C
+    if pad:
+        xs = jnp.concatenate([xs, jnp.zeros((pad, D), xs.dtype)])
+        ts = jnp.concatenate([ts, jnp.zeros((pad,), ts.dtype)])
+    w = jnp.concatenate([jnp.ones((N,), jnp.float32),
+                         jnp.zeros((pad,), jnp.float32)])
+    nc = (N + pad) // C
+    emb = embed.astype(x.dtype)
+
+    def chunk_nll_sum(xc, tc, wc):
+        logits = jnp.einsum("cd,vd->cv", xc, emb,
+                            preferred_element_type=jnp.float32)
+        lse = jax.nn.logsumexp(logits, axis=-1)
+        tgt = jnp.take_along_axis(logits, tc[:, None], axis=1)[:, 0]
+        return ((lse - tgt) * wc).sum()
+
+    chunk_nll_sum = jax.checkpoint(chunk_nll_sum)
+
+    def body(acc, args):
+        return acc + chunk_nll_sum(*args), None
+
+    total, _ = lax.scan(body, jnp.zeros((), jnp.float32),
+                        (xs.reshape(nc, C, D), ts.reshape(nc, C),
+                         w.reshape(nc, C)))
+    return total / N
 
 
 def loss_fn(params: PyTree, batch: Tuple[jax.Array, jax.Array],
             cfg: TransformerConfig, attn_fn=None) -> jax.Array:
-    """Cross-entropy LM loss.  batch = (tokens [B,S], targets [B,S])."""
+    """Cross-entropy LM loss.  batch = (tokens [B,S], targets [B,S]).
+
+    With cfg.ce_chunk_rows > 0 the LM head is streamed (see _fused_lm_loss);
+    otherwise the classic full-logits log_softmax path runs.  Both compute
+    the same value up to f32 reduction order.
+    """
     tokens, targets = batch
+    if cfg.ce_chunk_rows:
+        x = forward_hidden(params, tokens, cfg, attn_fn=attn_fn)
+        return _fused_lm_loss(x, params["embed"], targets,
+                              cfg.ce_chunk_rows)
     logits = forward(params, tokens, cfg, attn_fn=attn_fn)
     logp = jax.nn.log_softmax(logits, axis=-1)
     nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
