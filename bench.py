@@ -336,30 +336,55 @@ def bench_ps():
 
     from byteps_tpu.utils.hermetic import cpu_subprocess_env
 
-    # The server binds root_port + 1 + server_id; only the data port is
-    # ever bound here (no scheduler process), so probe THAT one free and
-    # derive the root port from it.
-    with socket.socket() as sk:
-        sk.bind(("127.0.0.1", 0))
-        port = sk.getsockname()[1]      # the server's data port
-    env = cpu_subprocess_env({
-        "DMLC_PS_ROOT_PORT": str(port - 1),
-        "DMLC_NUM_WORKER": "1",
-        "BYTEPS_SERVER_ENGINE_THREAD": "4",
-    })
-    proc = subprocess.Popen([sys.executable, "-m", "byteps_tpu.server"],
-                            env=env, stdout=subprocess.DEVNULL,
-                            stderr=subprocess.DEVNULL)
+    def boot_server():
+        """Start the PS server on a freshly-probed free port, retrying on a
+        new port if another process snatches it (bind/close-then-launch is
+        inherently TOCTOU on a busy host)."""
+        for _ in range(4):
+            # The server binds root_port + 1 + server_id; only the data
+            # port is ever bound here (no scheduler process), so probe THAT
+            # one free and derive the root port from it.
+            with socket.socket() as sk:
+                sk.bind(("127.0.0.1", 0))
+                port = sk.getsockname()[1]      # the server's data port
+            env = cpu_subprocess_env({
+                "DMLC_PS_ROOT_PORT": str(port - 1),
+                "DMLC_NUM_WORKER": "1",
+                "BYTEPS_SERVER_ENGINE_THREAD": "4",
+            })
+            import tempfile
+            errf = tempfile.TemporaryFile(mode="w+")
+            proc = subprocess.Popen(
+                [sys.executable, "-m", "byteps_tpu.server"],
+                env=env, stdout=subprocess.DEVNULL, stderr=errf)
+            deadline = time.time() + 30
+            while True:
+                try:
+                    socket.create_connection(
+                        ("127.0.0.1", port), 0.5).close()
+                    return proc, port
+                except OSError:
+                    if proc.poll() is not None:
+                        # Only an actual bind conflict is worth a retry on
+                        # a fresh port; any other startup death (import
+                        # error, missing native lib) must surface.
+                        errf.seek(0)
+                        stderr = errf.read()[-500:]
+                        errf.close()
+                        if "in use" not in stderr.lower():
+                            raise RuntimeError(
+                                f"PS server died at startup "
+                                f"(rc={proc.returncode}): {stderr}")
+                        break           # lost the port race — retry fresh
+                    if time.time() > deadline:
+                        proc.kill()
+                        proc.wait()
+                        raise RuntimeError("PS server did not come up")
+                    time.sleep(0.1)
+        raise RuntimeError("PS server lost the port race 4 times")
+
+    proc, port = boot_server()
     try:
-        deadline = time.time() + 30
-        while True:
-            try:
-                socket.create_connection(("127.0.0.1", port), 0.5).close()
-                break
-            except OSError:
-                if proc.poll() is not None or time.time() > deadline:
-                    raise RuntimeError("PS server did not come up")
-                time.sleep(0.1)
         sess = PSSession(["127.0.0.1"], [port], worker_id=0, num_servers=1,
                          wire_conns=int(os.environ.get(
                              "BYTEPS_TPU_WIRE_CONNS", "2")))

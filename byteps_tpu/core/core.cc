@@ -255,12 +255,16 @@ BPS_API int64_t bps_queue_get(void* qp, uint64_t* out_key,
 }
 
 // Pops the task with a specific key (signal-directed dequeue, reference:
-// scheduled_queue.cc:165-190).
+// scheduled_queue.cc:165-190).  Applies the same credit-eligibility check
+// as bps_queue_get: a task larger than the remaining credit stays queued
+// and -1 is returned — subtracting unconditionally would drive the credit
+// negative and stall bps_queue_get until enough finishes were reported.
 BPS_API int64_t bps_queue_get_key(void* qp, uint64_t key) {
   auto* q = static_cast<ScheduledQueue*>(qp);
   std::lock_guard<std::mutex> lk(q->mu);
   for (auto it = q->tasks.begin(); it != q->tasks.end(); ++it) {
     if (it->key == key) {
+      if (q->credit_enabled && it->nbytes > q->credit) return -1;
       int64_t n = it->nbytes;
       if (q->credit_enabled) q->credit -= n;
       q->tasks.erase(it);

@@ -246,6 +246,10 @@ class _PyQueue:
         with self._lock:
             for i, (k, p, n) in enumerate(self._tasks):
                 if k == key:
+                    # Same eligibility check as get(): an oversized task
+                    # stays queued instead of driving the credit negative.
+                    if self._credit_enabled and n > self._credit:
+                        return None
                     self._tasks.pop(i)
                     if self._credit_enabled:
                         self._credit -= n

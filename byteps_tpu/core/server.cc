@@ -630,7 +630,20 @@ class Server {
   void HandlePull(Task& t) {
     KeyState& ks = StateFor(t.key);
     // t.flags = the round (mod 2^16) the worker just pushed; its result is
-    // ready once that round has been published.
+    // ready once that round has been published.  The 16-bit compare (the
+    // wire header carries u16 flags) aliases only if a worker's pull were
+    // exactly 65,536 rounds stale — unreachable by protocol: the client's
+    // sequential-use guard (client.py _stage_parts) serializes rounds per
+    // key, so a pull's round is always completed_round or
+    // completed_round - 1.  Asserted rather than assumed: a client that
+    // violated the invariant would otherwise silently wait or read a
+    // whole-epoch-stale buffer.
+    uint16_t cur = static_cast<uint16_t>(ks.completed_round & 0xFFFF);
+    uint16_t prev = static_cast<uint16_t>((ks.completed_round - 1) & 0xFFFF);
+    if (!async_ && t.flags != cur && t.flags != prev) {
+      Respond(t.conn, kError, t.req_id, t.key, nullptr, 0);
+      return;
+    }
     bool ready = async_ ||
         (ks.completed_round & 0xFFFF) != t.flags;
     if (ready) {

@@ -263,16 +263,21 @@ _NORMS = {"layernorm": _layer_norm, "rmsnorm": _rms_norm}
 
 
 def _rope(x, theta: float):
-    """Rotary position embedding on [B, H, S, Dh] (half-split layout)."""
+    """Rotary position embedding on [B, H, S, Dh] (half-split layout).
+
+    The rotation runs in float32: at positions near max_seq_len, bf16
+    cos/sin (~3 significant digits) visibly degrade the rotation, so cast
+    back to the compute dtype only after rotating (standard practice)."""
     B, H, S, Dh = x.shape
     half = Dh // 2
     freqs = theta ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
     angles = jnp.arange(S, dtype=jnp.float32)[:, None] * freqs[None, :]
-    cos = jnp.cos(angles).astype(x.dtype)   # [S, half]
-    sin = jnp.sin(angles).astype(x.dtype)
-    x1, x2 = x[..., :half], x[..., half:]
+    cos = jnp.cos(angles)                   # [S, half], f32
+    sin = jnp.sin(angles)
+    x32 = x.astype(jnp.float32)
+    x1, x2 = x32[..., :half], x32[..., half:]
     return jnp.concatenate([x1 * cos - x2 * sin,
-                            x2 * cos + x1 * sin], axis=-1)
+                            x2 * cos + x1 * sin], axis=-1).astype(x.dtype)
 
 
 def dense_attention(q, k, v, causal: bool):

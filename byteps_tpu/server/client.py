@@ -356,6 +356,10 @@ class PSSession:
         self._compressors: Dict[int, object] = {}  # declared_key -> codec
         self._server_load = [0] * len(self.conns)
         self._plans: Dict[Tuple[int, int], list] = {}
+        # _plan's read-modify-write of _plans/_conn_rr/_server_load must be
+        # atomic: two threads planning concurrently would double-count
+        # server load and cache divergent stripe assignments.
+        self._plan_lock = threading.Lock()
         self._trace_labels: Dict[int, str] = {}
 
         # Dispatcher: native priority ScheduledQueue + credit flow control
@@ -436,27 +440,29 @@ class PSSession:
         accumulated per-server load logged like the reference's placement
         summary (reference: global.cc:643-692, 675-682).
         """
-        cached = self._plans.get((declared_key, nbytes))
-        if cached is not None:
-            return cached
-        core = get_core()
-        bounds = core.partition_bounds(nbytes, self.partition_bytes)
-        plan = []
-        # Stripe by a per-server cursor that persists across plans (in
-        # self._conn_rr): a global-index stripe degenerates when placement
-        # correlates with index (hash_fn=naive), and a per-plan counter
-        # pins every single-partition tensor to the primary socket.  Plans
-        # are cached, so each partition's conn assignment is stable.
-        for idx, (off, ln) in enumerate(bounds):
-            pkey = core.encode_key(declared_key, idx)
-            srv = core.key_to_server(pkey, len(self.conns), self.hash_fn)
-            self._server_load[srv] += ln
-            pool = self._data_conns[srv]
-            plan.append((pkey, off, ln,
-                         pool[self._conn_rr[srv] % len(pool)]))
-            self._conn_rr[srv] += 1
-        self._plans[(declared_key, nbytes)] = plan
-        total = sum(self._server_load) or 1
+        with self._plan_lock:
+            cached = self._plans.get((declared_key, nbytes))
+            if cached is not None:
+                return cached
+            core = get_core()
+            bounds = core.partition_bounds(nbytes, self.partition_bytes)
+            plan = []
+            # Stripe by a per-server cursor that persists across plans (in
+            # self._conn_rr): a global-index stripe degenerates when
+            # placement correlates with index (hash_fn=naive), and a
+            # per-plan counter pins every single-partition tensor to the
+            # primary socket.  Plans are cached, so each partition's conn
+            # assignment is stable.
+            for idx, (off, ln) in enumerate(bounds):
+                pkey = core.encode_key(declared_key, idx)
+                srv = core.key_to_server(pkey, len(self.conns), self.hash_fn)
+                self._server_load[srv] += ln
+                pool = self._data_conns[srv]
+                plan.append((pkey, off, ln,
+                             pool[self._conn_rr[srv] % len(pool)]))
+                self._conn_rr[srv] += 1
+            self._plans[(declared_key, nbytes)] = plan
+            total = sum(self._server_load) or 1
         get_logger().debug(
             "PS placement: tensor key=%d parts=%d; server load %s",
             declared_key, len(plan),
@@ -604,7 +610,7 @@ class PSSession:
     # -- public API ---------------------------------------------------------
     def push_pull_async(self, declared_key: int, tensor,
                         priority: int = 0, raw: bool = False,
-                        seed: bool = False) -> PSHandle:
+                        seed: bool = False, copy: bool = False) -> PSHandle:
         """Partitioned, priority-scheduled asynchronous push_pull.
 
         ZERO-COPY CONTRACT: when `tensor` is already a contiguous float32
@@ -612,6 +618,9 @@ class PSSession:
         reference's ZPush zero-copy SArray semantics) — the caller must
         not mutate it until the returned handle completes.  Non-f32 or
         non-contiguous inputs are converted (snapshotted) first.
+        copy=True restores the old snapshot semantics unconditionally for
+        callers that need to keep mutating the buffer after dispatch
+        (documented in docs/migration.md "wire semantics").
 
         raw=True pushes last-write-wins bytes instead of f32-summed values.
         seed=True (async servers only) writes the store ONLY if the key has
@@ -620,6 +629,10 @@ class PSSession:
         """
         arr = np.asarray(tensor)
         payload = np.ascontiguousarray(arr, dtype=np.float32).ravel()
+        if copy and np.may_share_memory(payload, arr):
+            # Snapshot only when the wire view would alias the caller's
+            # memory — the non-f32/non-contiguous path already copied.
+            payload = payload.copy()
         # Zero-copy wire: partitions are sent as memoryview slices of the
         # caller's buffer (no tobytes snapshot) — the reference's ZPush
         # contract: the tensor must not be mutated until the handle

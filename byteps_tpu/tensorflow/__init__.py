@@ -152,13 +152,11 @@ def push_pull_group(tensors, names, average: bool = True,
                     pass
             raise
 
+    # Eager tensors always expose .numpy() after convert_to_tensor, so the
+    # eager mode calls _eager_group directly; py_function is the non-eager
+    # trace boundary only (mirrors single-tensor push_pull's split).
     if tf.executing_eagerly():
-        conv = [tf.convert_to_tensor(t) for t in live]
-        live = conv
-        eager_ok = all(hasattr(t, "numpy") for t in conv)
-    else:
-        eager_ok = False
-    if eager_ok:
+        live = [tf.convert_to_tensor(t) for t in live]
         outs = _eager_group(*live)
     else:
         outs = tf.py_function(_eager_group, live,

@@ -105,6 +105,26 @@ def test_scheduled_queue_get_key(core):
     assert q.pending() == 1
 
 
+def test_scheduled_queue_get_key_respects_credit(core):
+    # get_key must apply the same credit-eligibility check as get():
+    # popping an oversized task would drive the credit negative and stall
+    # every later get() until enough finishes were reported.
+    q = core.queue_create(credit_bytes=150)
+    q.add(key=1, priority=0, nbytes=100)
+    q.add(key=2, priority=0, nbytes=100)
+    assert q.get_key(1) == 100      # 100 in flight, 50 credit left
+    assert q.get_key(2) is None     # 100b exceeds remaining credit
+    assert q.pending() == 1         # ...and the task stays queued
+    q.report_finish(100)
+    assert q.get_key(2) == 100
+    q.report_finish(100)
+    # A small eligible task still pops while a big one is queued.
+    q.add(key=3, priority=0, nbytes=1000)
+    q.add(key=4, priority=0, nbytes=10)
+    assert q.get_key(3) is None
+    assert q.get_key(4) == 10
+
+
 def test_telemetry_speed(core):
     core.telemetry_reset()
     core.telemetry_set_window_us(1_000_000)

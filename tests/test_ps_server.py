@@ -203,6 +203,24 @@ def test_dedup_within_round(ps_server):
     np.testing.assert_allclose(out["w1"], 2 * a)
 
 
+def test_pull_with_impossible_round_rejected(ps_server):
+    """The pull round compare is 16-bit on the wire (u16 flags); the server
+    asserts the sequential-use invariant (pull round == completed_round or
+    completed_round - 1) instead of silently pending on an aliased round
+    65,536 stale (core/server.cc HandlePull)."""
+    port = ps_server(num_workers=1)
+    a = np.ones(8, np.float32)
+    s = _session(port, 0)
+    s.conns[0].request(1, 5, struct.pack("<Q", a.nbytes), worker_id=0)
+    s.conns[0].request(2, 5, a.tobytes(), worker_id=0)      # push round 0
+    got = np.frombuffer(
+        s.conns[0].request(3, 5, worker_id=0, flags=0), np.float32)
+    np.testing.assert_allclose(got, a)
+    with pytest.raises(RuntimeError, match="server error"):
+        s.conns[0].request(3, 5, worker_id=0, flags=1234)
+    s.close()
+
+
 def test_shutdown_terminates_server(ps_server):
     """SHUTDOWN must stop the server even with another idle connection open
     (readers blocked in recv are unblocked by the half-close)."""
