@@ -16,7 +16,7 @@ from .common.api import (
     init, shutdown, suspend, resume,
     rank, size, local_rank, local_size,
     declare, declared_key, register_compressor, get_ps_session,
-    push_pull, push_pull_async, synchronize, poll,
+    push_pull, push_pull_async, push_pull_tree, synchronize, poll,
     broadcast_parameters, broadcast_optimizer_state,
     get_pushpull_speed, mark_step, current_step,
 )
@@ -53,7 +53,8 @@ __all__ = [
     "init", "shutdown", "suspend", "resume",
     "rank", "size", "local_rank", "local_size",
     "declare", "declared_key", "register_compressor", "get_ps_session",
-    "push_pull", "push_pull_async", "synchronize", "poll", "AsyncPSTrainer",
+    "push_pull", "push_pull_async", "push_pull_tree", "synchronize",
+    "poll", "AsyncPSTrainer",
     "broadcast_parameters", "broadcast_optimizer_state",
     "get_pushpull_speed", "mark_step", "current_step",
     "Compression", "collectives",

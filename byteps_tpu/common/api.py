@@ -22,6 +22,7 @@ synchronous handle API plays for torch.
 from __future__ import annotations
 
 import dataclasses
+import functools
 import os
 import threading
 import time
@@ -29,6 +30,7 @@ from typing import Any, Dict, Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from .config import Config, get_config
 from .logging import get_logger, set_level
@@ -262,9 +264,41 @@ def get_ps_session():
 # Eager push_pull (reference: torch/ops.py:157-236)
 # ---------------------------------------------------------------------------
 def _eager_sum_across_processes(x: jax.Array) -> jax.Array:
-    from jax.experimental import multihost_utils
-    gathered = multihost_utils.process_allgather(x)
-    return gathered.sum(axis=0)
+    """True all-reduce across worker processes.
+
+    One device per process carries the payload on a 1-D mesh; summing the
+    process-sharded axis into a replicated output makes XLA emit an
+    AllReduce — O(bytes) on the wire instead of the O(world*bytes) of a
+    process_allgather + local sum, and one host crossing total (reference
+    analog: the reference never gathers either — workers exchange exactly
+    one summed copy through the PS tier, server.cc SUM_RECV).
+    """
+    x = jnp.asarray(x)
+    devs, sharded, replicated, reduce_fn = _allreduce_plumbing(
+        tuple(jax.devices()))
+    shard = jax.device_put(x[None], devs[jax.process_index()])
+    g = jax.make_array_from_single_device_arrays(
+        (len(devs),) + x.shape, sharded, [shard])
+    return jnp.asarray(reduce_fn(g).addressable_data(0))
+
+
+@functools.lru_cache(maxsize=8)
+def _allreduce_plumbing(all_devices: tuple):
+    """Mesh + jitted sum-reduction for the eager all-reduce, cached per
+    device set — a fresh lambda per call would miss jax.jit's cache (keyed
+    on function identity) and retrace every eager push_pull."""
+    from jax.sharding import Mesh, NamedSharding
+    from jax.sharding import PartitionSpec as P
+
+    by_proc: dict = {}
+    for d in all_devices:
+        by_proc.setdefault(d.process_index, d)
+    devs = [by_proc[i] for i in sorted(by_proc)]
+    mesh = Mesh(np.array(devs), ("w",))
+    sharded = NamedSharding(mesh, P("w"))
+    replicated = NamedSharding(mesh, P())
+    reduce_fn = jax.jit(lambda a: a.sum(axis=0), out_shardings=replicated)
+    return devs, sharded, replicated, reduce_fn
 
 
 def push_pull(tensor: jax.Array, name: Optional[str] = None,
@@ -278,6 +312,45 @@ def push_pull(tensor: jax.Array, name: Optional[str] = None,
     h = push_pull_async(tensor, name=name, average=average, priority=priority,
                         compression=compression)
     return synchronize(h)
+
+
+def push_pull_tree(tree: PyTree, name: Optional[str] = None,
+                   average: bool = True, compression=None) -> PyTree:
+    """Sum/average EVERY leaf of a pytree across workers in one batched
+    collective — a single host crossing and a single wire transfer.
+
+    The eager plugins' gradient lists ride this (reference analog: DDP
+    gradient batching, torch/parallel/distributed.py:235-243; per-tensor
+    eager push_pull pays one crossing per gradient).  Leaves are flattened
+    into one f32 vector, reduced through push_pull (so PS partitioning,
+    compression, telemetry, and tracing all apply), then split back to the
+    original shapes/dtypes.
+    """
+    _require_init()
+    leaves, treedef = jax.tree.flatten(tree)
+    if not leaves:
+        return tree
+    leaves = [jnp.asarray(l) for l in leaves]
+    metas = [(l.shape, l.dtype, int(l.size)) for l in leaves]
+    flat = (jnp.concatenate([l.ravel().astype(jnp.float32) for l in leaves])
+            if len(leaves) > 1 else leaves[0].ravel().astype(jnp.float32))
+    if name is None:
+        # Key the batch by its structure + leaf signature so every worker
+        # maps the same gradient set to the same declared key, and distinct
+        # sets (partial backwards, several optimizers with same-shaped
+        # params) get distinct keys/PS buffers.
+        import hashlib
+        sig = hashlib.md5(
+            (str(treedef) + "|".join(f"{s}:{d}" for s, d, _ in metas))
+            .encode()).hexdigest()[:12]
+        name = f"byteps_tpu.tree.{sig}"
+    out = jnp.asarray(push_pull(flat, name=name, average=average,
+                                compression=compression))
+    outs, o = [], 0
+    for shp, dt, n in metas:
+        outs.append(out[o:o + n].reshape(shp).astype(dt))
+        o += n
+    return jax.tree.unflatten(treedef, outs)
 
 
 def push_pull_async(tensor: jax.Array, name: Optional[str] = None,

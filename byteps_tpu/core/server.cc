@@ -83,7 +83,11 @@ enum WireDtype : uint8_t {
 //   topk(2):      u32 k | i32 idx[k] | f32 val[k]
 //   randomk(3):   u32 k | i32 idx[k] | f32 val[k]
 //   dithering(4): u8 flags(bit0=natural) | u8 s | f32 norm
-//                 | u8 level[n] | u8 signs[ceil(n/8)]
+//                 | level bitstream [ceil(n*b/8)] | u8 signs[ceil(n/8)]
+//                 (b = ceil(log2(s+1)); levels packed LSB-first at b bits —
+//                 dense like the reference's Elias-delta wire,
+//                 compressor/impl/dithering.cc:51-120, but fixed-width so
+//                 decode stays a flat loop)
 // ---------------------------------------------------------------------------
 namespace codec {
 
@@ -151,14 +155,24 @@ inline bool Decompress(const std::vector<char>& payload,
       if (!r.Take(&flags, 1) || !r.Take(&s, 1) || !r.Take(&norm, 4))
         return false;
       if (s == 0) return false;
+      // Levels ride an LSB-first bitstream at b = ceil(log2(s+1)) bits per
+      // element (bit-matched to server/wire.py _pack_levels).
+      int b = 0;
+      for (unsigned v = s; v; v >>= 1) ++b;
+      size_t lvlbytes = (static_cast<size_t>(n) * b + 7) / 8;
       size_t signbytes = (n + 7) / 8;
-      if (r.left < n + signbytes) return false;
-      const unsigned char* level =
+      if (r.left < lvlbytes + signbytes) return false;
+      const unsigned char* stream =
           reinterpret_cast<const unsigned char*>(r.p);
-      const unsigned char* signs = level + n;
+      const unsigned char* signs = stream + lvlbytes;
       bool natural = (flags & 1) != 0;
       for (uint32_t i = 0; i < n; ++i) {
-        int j = level[i];
+        size_t pos = static_cast<size_t>(i) * b;
+        int j = 0;
+        for (int t = 0; t < b; ++t) {
+          size_t bitpos = pos + t;
+          j |= ((stream[bitpos >> 3] >> (bitpos & 7)) & 1) << t;
+        }
         float mag;
         if (natural)
           mag = j == 0 ? 0.0f

@@ -18,7 +18,14 @@ Wire layout (little-endian):
     topk(2):      u32 k | i32 idx[k] | f32 val[k]
     randomk(3):   u32 k | i32 idx[k] | f32 val[k]
     dithering(4): u8 flags(bit0=natural) | u8 s | f32 norm
-                  | u8 level[n] | u8 signs[ceil(n/8)]
+                  | level bitstream [ceil(n*b/8)] | u8 signs[ceil(n/8)]
+                  where b = ceil(log2(s+1)); levels are packed LSB-first at
+                  b bits each.  The on-device (JAX) plane keeps fixed-width
+                  u8 levels — vector-friendly — while the host-side wire
+                  packs densely: s=15 ships 4+1 bits/elem, within the
+                  reference's Elias-delta budget (reference:
+                  compressor/impl/dithering.cc:51-120) without
+                  variable-length decode.
 """
 
 from __future__ import annotations
@@ -42,6 +49,26 @@ def _pack_bits(bits: np.ndarray) -> np.ndarray:
 
 def _unpack_bits(packed: np.ndarray, n: int) -> np.ndarray:
     return np.unpackbits(packed, bitorder="little")[:n]
+
+
+def _level_bits(s: int) -> int:
+    """Bits per level on the wire: ceil(log2(s+1)) for values 0..s."""
+    return max(1, int(s).bit_length())
+
+
+def _pack_levels(level: np.ndarray, s: int) -> np.ndarray:
+    """uint8 levels [n] (each <= s) -> LSB-first bitstream at b bits each."""
+    b = _level_bits(s)
+    bits = ((level[:, None].astype(np.uint8)
+             >> np.arange(b, dtype=np.uint8)) & 1)
+    return np.packbits(bits.ravel(), bitorder="little")
+
+
+def _unpack_levels(packed: np.ndarray, n: int, s: int) -> np.ndarray:
+    b = _level_bits(s)
+    raw = np.unpackbits(packed, bitorder="little",
+                        count=n * b).reshape(n, b).astype(np.int32)
+    return (raw << np.arange(b, dtype=np.int32)).sum(axis=1)
 
 
 def _xorshift32(x: np.ndarray) -> np.ndarray:
@@ -88,11 +115,23 @@ class WireCompressor:
         if ctype in ("topk", "randomk") and self.k <= 0:
             raise ValueError(f"{ctype} requires k > 0")
         self.bidirectional = ctype == "onebit"
+        # Worker-side vanilla error feedback (reference:
+        # error_feedback.cc:22-34: grad += e; c = Compress(grad);
+        # e = grad - Decompress(c)), per partition key.  The server never
+        # applies EF — it only sees the already-corrected payloads.
+        ef = (kwargs.get("ef") or kwargs.get("ef_type")
+              or kwargs.get("byteps_error_feedback_type"))
+        if ef and ef not in ("vanilla", "true", "1"):
+            raise ValueError(f"unknown error-feedback type {ef!r}")
+        self.ef = bool(ef)
+        self._err: Dict[int, np.ndarray] = {}
         self._rng: Dict[int, np.ndarray] = {}  # per-partition-key PRNG lanes
 
     def kwargs_string(self) -> str:
         """Canonical "k=v,k=v" form sent in the INIT payload."""
         kw = {"compressor": self.name}
+        if self.ef:
+            kw["ef"] = "vanilla"
         if self.name == "onebit":
             kw["onebit_scaling"] = "1" if self.scaled else "0"
         if self.name in ("topk", "randomk"):
@@ -107,6 +146,16 @@ class WireCompressor:
     # -- encode -------------------------------------------------------------
     def encode(self, pkey: int, x: np.ndarray) -> bytes:
         x = np.ascontiguousarray(x, np.float32)
+        if not self.ef:
+            return self._encode_raw(pkey, x)
+        e = self._err.get(pkey)
+        if e is not None and e.size == x.size:
+            x = x + e
+        blob = self._encode_raw(pkey, x)
+        self._err[pkey] = x - decode(blob, x.size)
+        return blob
+
+    def _encode_raw(self, pkey: int, x: np.ndarray) -> bytes:
         n = x.size
         hdr = struct.pack("<BI", self.comp_id, n)
         if self.comp_id == COMP_ONEBIT:
@@ -152,7 +201,8 @@ class WireCompressor:
         level = (j + (u < p_up)).astype(np.uint8)
         flags = 1 if self.partition == "natural" else 0
         return (hdr + struct.pack("<BBf", flags, s, np.float32(norm))
-                + level.tobytes() + _pack_bits(x < 0).tobytes())
+                + _pack_levels(level, s).tobytes()
+                + _pack_bits(x < 0).tobytes())
 
     def _levels(self) -> np.ndarray:
         s = self.s
@@ -183,9 +233,12 @@ def decode(data: bytes, n: int) -> np.ndarray:
         return out
     if comp == COMP_DITHERING:
         flags, s, norm = struct.unpack_from("<BBf", body, 0)
-        level = np.frombuffer(body[6:6 + n], np.uint8).astype(np.int32)
+        lvlbytes = (n * _level_bits(s) + 7) // 8
+        level = _unpack_levels(
+            np.frombuffer(body[6:6 + lvlbytes], np.uint8), n, s)
         signs = _unpack_bits(
-            np.frombuffer(body[6 + n:6 + n + (n + 7) // 8], np.uint8), n)
+            np.frombuffer(body[6 + lvlbytes:6 + lvlbytes + (n + 7) // 8],
+                          np.uint8), n)
         if flags & 1:
             mag = np.where(level == 0, 0.0,
                            2.0 ** (level.astype(np.float32) - s))

@@ -118,10 +118,10 @@ def push_pull_group(tensors, names, average: bool = True,
 
     The per-tensor `push_pull` pays a TF->JAX->TF crossing per gradient
     (the documented py_function trade-off); gradient lists are the common
-    case, so this batches the whole list through one py_function call and
-    dispatches all tensors asynchronously inside it (priority=-i, the
-    reference's gradient ordering, mxnet/__init__.py:325-343) before
-    synchronizing.  `None` entries pass through.
+    case, so this batches the whole list through one py_function call AND
+    one batched collective (api.push_pull_tree — the reference's DDP
+    gradient-batching stance, torch/parallel/distributed.py:235-243).
+    `None` entries pass through.
     """
     import jax.numpy as jnp
 
@@ -132,25 +132,15 @@ def push_pull_group(tensors, names, average: bool = True,
     live_names = [names[i] for i in idx]
 
     def _eager_group(*ts):
-        handles = []
-        try:
-            for i, (t, n) in enumerate(zip(ts, live_names)):
-                handles.append(_api.push_pull_async(
-                    jnp.asarray(t.numpy()), name=n, average=average,
-                    priority=-i, compression=compression))
-            return [tf.convert_to_tensor(np.asarray(_api.synchronize(h)),
-                                         dtype=t.dtype)
-                    for h, t in zip(handles, ts)]
-        except Exception:
-            # A failure mid-list must not orphan already-dispatched
-            # handles (they pin buffers until synchronized).  Drain them
-            # best-effort, then surface the original error.
-            for h in handles:
-                try:
-                    _api.synchronize(h)
-                except Exception:
-                    pass
-            raise
+        # One batched collective for the whole list (api.push_pull_tree):
+        # a single wire transfer replaces the per-tensor dispatch loop, so
+        # there are no partially-dispatched handles to drain on error.
+        tree = {n: jnp.asarray(t.numpy())
+                for t, n in zip(ts, live_names)}
+        out = _api.push_pull_tree(tree, average=average,
+                                  compression=compression)
+        return [tf.convert_to_tensor(np.asarray(out[n]), dtype=t.dtype)
+                for t, n in zip(ts, live_names)]
 
     # Eager tensors always expose .numpy() after convert_to_tensor, so the
     # eager mode calls _eager_group directly; py_function is the non-eager

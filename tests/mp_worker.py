@@ -250,6 +250,43 @@ def scenario_ps():
     bps.shutdown()
 
 
+def scenario_torch_grads():
+    """Torch eager gradient path at world 2: the optimizer's step() must
+    average the whole gradient list in ONE batched collective (one declared
+    key for the batch, not one per parameter) and land the averaged values
+    back in p.grad before the inner step."""
+    bps.init()
+    import torch
+    import byteps_tpu.torch as bpt
+    from byteps_tpu.core.native import get_core
+
+    torch.manual_seed(0)
+    model = torch.nn.Sequential(torch.nn.Linear(4, 8), torch.nn.Linear(8, 2))
+    inner = torch.optim.SGD(model.parameters(), lr=0.0)  # step must not move
+    opt = bpt.DistributedOptimizer(
+        inner, named_parameters=model.named_parameters())
+    # Deterministic per-rank gradients: rank r, param i -> (r+1)*(i+1).
+    params = [p for g in opt.param_groups for p in g["params"]]
+    for i, p in enumerate(params):
+        p.grad = torch.full_like(p, float((bps.rank() + 1) * (i + 1)))
+    declared_before = get_core().num_declared()
+    opt.step()
+    declared_after = get_core().num_declared()
+    # world 2: averaged grad = (1 + 2)/2 * (i+1) = 1.5*(i+1)
+    got = [float(p.grad.flatten()[0]) for p in params]
+    emit(check="torch_grads", size=bps.size(), got=got,
+         new_keys=declared_after - declared_before,
+         n_params=len(params))
+
+    # DDP auto-sync rides the same batched path.
+    ddp = bpt.DistributedDataParallel(model)
+    x = torch.full((3, 4), float(bps.rank() + 1))
+    ddp(x).sum().backward()
+    gsum = float(sum(p.grad.abs().sum() for p in model.parameters()))
+    emit(check="torch_ddp", autosync=ddp.autosync_count, grad_abs_sum=gsum)
+    bps.shutdown()
+
+
 def scenario_tf_strategy():
     """MirroredStrategy at size()==2: batch_reduce with chunked packing
     crosses real process boundaries; scope() broadcasts root's variable
@@ -285,6 +322,7 @@ SCENARIOS = {
     "elastic_grow": scenario_elastic_grow,
     "elastic_checkpoint": scenario_elastic_checkpoint,
     "ps": scenario_ps,
+    "torch_grads": scenario_torch_grads,
     "tf_strategy": scenario_tf_strategy,
 }
 

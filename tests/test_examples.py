@@ -8,6 +8,9 @@ import sys
 
 import pytest
 
+# subprocess example smoke-runs dominate suite wall-time (CI fast lane: -m 'not slow')
+pytestmark = pytest.mark.slow
+
 EXAMPLES = os.path.join(os.path.dirname(__file__), "..", "example", "jax")
 
 

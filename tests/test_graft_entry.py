@@ -10,9 +10,13 @@ cluster on one machine the same way).
 
 import importlib.util
 import os
+import pytest
 import re
 import subprocess
 import sys
+
+# full multichip dryruns take minutes each (CI fast lane: -m 'not slow')
+pytestmark = pytest.mark.slow
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 ENTRY = os.path.join(REPO, "__graft_entry__.py")

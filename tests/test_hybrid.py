@@ -14,6 +14,9 @@ import pytest
 import byteps_tpu as bps
 from byteps_tpu.models import hybrid
 
+# 5-axis hybrid mesh compiles take minutes (CI fast lane: -m 'not slow')
+pytestmark = pytest.mark.slow
+
 
 CFG = hybrid.HybridConfig(vocab_size=64, num_layers=4, d_model=16,
                           num_heads=4, d_ff=32, max_seq_len=32)

@@ -18,6 +18,9 @@ import pytest
 
 from testutil import cpu_env, free_port
 
+# real multi-process jax.distributed worlds (CI fast lane: -m 'not slow')
+pytestmark = pytest.mark.slow
+
 WORKER = os.path.join(os.path.dirname(__file__), "mp_worker.py")
 
 
@@ -187,6 +190,27 @@ def test_ps_mode_two_worker_processes():
         assert r["push_pull"]["avg"] == 1.5
         assert r["push_pull"]["ok"]
         assert r["speed"]["mbps"] >= 0.0
+
+
+def test_torch_batched_gradients_two_processes():
+    """The torch plugin's step() must average gradients across real worker
+    processes through ONE batched collective: a single new declared key for
+    the whole gradient list (not one per parameter), averaged values in
+    p.grad afterwards, and DDP auto-sync riding the same path."""
+    pytest.importorskip("torch")
+    res = _launch("torch_grads", world=2, timeout=300)
+    for wid in (0, 1):
+        r = _by_check(res[wid])
+        tg = r["torch_grads"]
+        assert tg["size"] == 2
+        # averaged: (1+2)/2 * (i+1)
+        assert tg["got"] == [1.5 * (i + 1) for i in range(tg["n_params"])]
+        # one key for the whole batch — the batching contract
+        assert tg["new_keys"] == 1, tg
+        assert r["torch_ddp"]["autosync"] == 1
+    # DDP-averaged grads identical on both ranks
+    assert (res[0] and _by_check(res[0])["torch_ddp"]["grad_abs_sum"]
+            == _by_check(res[1])["torch_ddp"]["grad_abs_sum"])
 
 
 def test_tf_strategy_two_processes():

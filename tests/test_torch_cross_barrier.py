@@ -1,0 +1,204 @@
+"""Torch cross-barrier tests.
+
+The decisive reference behavior (byteps/torch/cross_barrier.py:28-231):
+per-parameter updates are applied the moment each gradient's push_pull
+completes, and the NEXT step's forward starts for early layers while late
+gradients are still in flight — communication crosses the step barrier.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+import torch
+
+import byteps_tpu as bps
+import byteps_tpu.torch as bpt
+
+
+def _model(seed=0):
+    torch.manual_seed(seed)
+    return torch.nn.Sequential(
+        torch.nn.Linear(6, 12), torch.nn.Tanh(),
+        torch.nn.Linear(12, 12), torch.nn.Tanh(),
+        torch.nn.Linear(12, 1))
+
+
+def _data():
+    torch.manual_seed(42)
+    x = torch.randn(16, 6)
+    y = x.sum(dim=1, keepdim=True)
+    return x, y
+
+
+@pytest.mark.parametrize("opt_cls,kw", [
+    (torch.optim.SGD, dict(lr=0.05, momentum=0.9)),
+    (torch.optim.Adam, dict(lr=0.01)),
+    (torch.optim.RMSprop, dict(lr=0.005)),
+    (torch.optim.AdamW, dict(lr=0.01)),   # beyond the reference's 3
+])
+def test_cross_barrier_matches_vanilla_optimizer(bps_initialized, opt_cls,
+                                                 kw):
+    """At world 1 the averaged gradient equals the local gradient, so a
+    cross-barrier run must track the vanilla optimizer bit-close — the
+    per-param application changes WHEN updates happen, never their math."""
+    x, y = _data()
+
+    ref = _model()
+    ref_opt = opt_cls(ref.parameters(), **kw)
+    for _ in range(4):
+        ref_opt.zero_grad()
+        torch.nn.functional.mse_loss(ref(x), y).backward()
+        ref_opt.step()
+
+    m = _model()
+    cb = bpt.CrossBarrier(m, opt_cls(m.parameters(), **kw),
+                          named_parameters=m.named_parameters())
+    try:
+        for _ in range(4):
+            torch.nn.functional.mse_loss(m(x), y).backward()
+            cb.step()
+        cb.synchronize()
+    finally:
+        cb.close()
+    for (n, a), (_, b) in zip(ref.named_parameters(), m.named_parameters()):
+        np.testing.assert_allclose(a.detach().numpy(), b.detach().numpy(),
+                                   rtol=1e-5, atol=1e-6, err_msg=n)
+
+
+def test_cross_barrier_overlaps_forward_with_pending_sync(bps_initialized):
+    """Step N+1's forward must START (enter the input layer) while step N's
+    LAST-layer gradient is still in flight — the barrier-crossing contract
+    (reference: cross_barrier.py:188-222 forward pre-hooks + poller).  The
+    injected comm keeps the final Linear's sync pending behind a gate; the
+    input layer's update completes normally, so the next forward's first
+    pre-hook passes while the gated sync is outstanding."""
+    events = []
+    gate = threading.Event()
+    slow_name = "CrossBarrier.Gradient.4.weight"  # last Linear's weight
+
+    def dispatch(p, name):
+        return (p, name)
+
+    def poll(handle):
+        _, name = handle
+        return name != slow_name or gate.is_set()
+
+    def wait(handle):
+        _, name = handle
+        if name == slow_name:
+            events.append(("slow_sync_done", time.monotonic()))
+        # world 1: the "averaged" gradient is the local gradient, as-is.
+
+    m = _model()
+    cb = bpt.CrossBarrier(m, torch.optim.SGD(m.parameters(), lr=0.01),
+                          named_parameters=m.named_parameters(),
+                          comm=(dispatch, wait, poll))
+    # Record when forward actually enters the first layer.
+    m[0].register_forward_pre_hook(
+        lambda mod, inp: events.append(("fwd_layer0", time.monotonic())))
+    x, y = _data()
+    try:
+        torch.nn.functional.mse_loss(m(x), y).backward()
+        events.clear()                      # ignore step-0 forward
+        cb.step()
+        fwd = threading.Thread(
+            target=lambda: torch.nn.functional.mse_loss(m(x), y))
+        fwd.start()
+        # Forward must reach layer 0 while the last layer's sync sleeps.
+        deadline = time.time() + 10
+        while not any(e[0] == "fwd_layer0" for e in events):
+            assert time.time() < deadline, "forward never started"
+            time.sleep(0.01)
+        assert not any(e[0] == "slow_sync_done" for e in events)
+        gate.set()                          # let the pending sync finish
+        fwd.join(timeout=30)
+        assert not fwd.is_alive(), "forward deadlocked on the slow layer"
+    finally:
+        gate.set()
+        cb.close()
+    order = [e[0] for e in sorted(events, key=lambda e: e[1])]
+    assert order.index("fwd_layer0") < order.index("slow_sync_done"), order
+
+
+def test_cross_barrier_sees_live_lr_schedule(bps_initialized):
+    """LR schedulers mutate the inner optimizer's param_groups; the
+    per-param updates must re-read them — a construction-time snapshot
+    would silently freeze the schedule."""
+    x, y = _data()
+    ref = _model()
+    inner_ref = torch.optim.SGD(ref.parameters(), lr=0.1)
+    sched_ref = torch.optim.lr_scheduler.StepLR(inner_ref, step_size=1,
+                                                gamma=0.5)
+    for _ in range(3):
+        inner_ref.zero_grad()
+        torch.nn.functional.mse_loss(ref(x), y).backward()
+        inner_ref.step()
+        sched_ref.step()
+
+    m = _model()
+    inner = torch.optim.SGD(m.parameters(), lr=0.1)
+    cb = bpt.CrossBarrier(m, inner, named_parameters=m.named_parameters())
+    sched = torch.optim.lr_scheduler.StepLR(inner, step_size=1, gamma=0.5)
+    try:
+        for _ in range(3):
+            torch.nn.functional.mse_loss(m(x), y).backward()
+            cb.step()
+            cb.synchronize()   # all updates applied before the LR changes
+            sched.step()
+    finally:
+        cb.close()
+    for (n, a), (_, b) in zip(ref.named_parameters(), m.named_parameters()):
+        np.testing.assert_allclose(a.detach().numpy(), b.detach().numpy(),
+                                   rtol=1e-5, atol=1e-6, err_msg=n)
+
+
+def test_cross_barrier_close_detaches_hooks(bps_initialized):
+    """After close() the model must train normally with a plain optimizer:
+    the wrapper's backward hooks and forward pre-hooks are removed, so
+    nothing dispatches into the dead queue or blocks forward."""
+    x, y = _data()
+    m = _model()
+    cb = bpt.CrossBarrier(m, torch.optim.SGD(m.parameters(), lr=0.01),
+                          named_parameters=m.named_parameters())
+    torch.nn.functional.mse_loss(m(x), y).backward()
+    cb.step()
+    cb.close()
+    plain = torch.optim.SGD(m.parameters(), lr=0.01)
+    for _ in range(2):           # would deadlock if pre-hooks survived
+        plain.zero_grad()
+        torch.nn.functional.mse_loss(m(x), y).backward()
+        plain.step()
+
+
+def test_cross_barrier_accumulation(bps_initialized):
+    """backward_passes_per_step=2 dispatches every second backward with the
+    accumulated gradient halved — matching a vanilla optimizer stepping on
+    the mean of two backwards' gradients."""
+    x, y = _data()
+    ref = _model()
+    ref_opt = torch.optim.SGD(ref.parameters(), lr=0.1)
+    for _ in range(2):
+        ref_opt.zero_grad()
+        torch.nn.functional.mse_loss(ref(x), y).backward()
+        torch.nn.functional.mse_loss(ref(x), y).backward()
+        for p in ref.parameters():
+            p.grad.div_(2)
+        ref_opt.step()
+
+    m = _model()
+    cb = bpt.CrossBarrier(m, torch.optim.SGD(m.parameters(), lr=0.1),
+                          named_parameters=m.named_parameters(),
+                          backward_passes_per_step=2)
+    try:
+        for _ in range(2):
+            torch.nn.functional.mse_loss(m(x), y).backward()
+            torch.nn.functional.mse_loss(m(x), y).backward()
+            cb.step()
+        cb.synchronize()
+    finally:
+        cb.close()
+    for (n, a), (_, b) in zip(ref.named_parameters(), m.named_parameters()):
+        np.testing.assert_allclose(a.detach().numpy(), b.detach().numpy(),
+                                   rtol=1e-5, atol=1e-6, err_msg=n)
