@@ -315,16 +315,28 @@ def push_pull(tensor: jax.Array, name: Optional[str] = None,
 
 
 def push_pull_tree(tree: PyTree, name: Optional[str] = None,
-                   average: bool = True, compression=None) -> PyTree:
+                   average: bool = True, compression=None,
+                   leaf_names=None) -> PyTree:
     """Sum/average EVERY leaf of a pytree across workers in one batched
     collective — a single host crossing and a single wire transfer.
 
     The eager plugins' gradient lists ride this (reference analog: DDP
     gradient batching, torch/parallel/distributed.py:235-243; per-tensor
-    eager push_pull pays one crossing per gradient).  Leaves are flattened
-    into one f32 vector, reduced through push_pull (so PS partitioning,
-    compression, telemetry, and tracing all apply), then split back to the
-    original shapes/dtypes.
+    eager push_pull pays one crossing per gradient).  Floating leaves are
+    flattened into one f32 vector, reduced through push_pull (so PS
+    partitioning, compression, telemetry, and tracing all apply), then
+    split back to the original shapes/dtypes.
+
+    Two classes of leaves are deliberately NOT batched:
+      - non-floating leaves (ints, bools): an f32 round-trip corrupts
+        values above 2^24 and truncates averages — they ride individual
+        exact push_pulls;
+      - leaves whose `leaf_names[i]` has a PS wire compressor registered
+        (register_compressor): folding them into the batch key would
+        silently drop the user's compression config — they keep their own
+        named push_pull so the compressed wire still applies.
+    `leaf_names` aligns with the FLATTENED leaf order (for a dict tree:
+    sorted keys).
     """
     _require_init()
     leaves, treedef = jax.tree.flatten(tree)
@@ -332,24 +344,49 @@ def push_pull_tree(tree: PyTree, name: Optional[str] = None,
         return tree
     leaves = [jnp.asarray(l) for l in leaves]
     metas = [(l.shape, l.dtype, int(l.size)) for l in leaves]
-    flat = (jnp.concatenate([l.ravel().astype(jnp.float32) for l in leaves])
-            if len(leaves) > 1 else leaves[0].ravel().astype(jnp.float32))
-    if name is None:
-        # Key the batch by its structure + leaf signature so every worker
-        # maps the same gradient set to the same declared key, and distinct
-        # sets (partial backwards, several optimizers with same-shaped
-        # params) get distinct keys/PS buffers.
-        import hashlib
-        sig = hashlib.md5(
-            (str(treedef) + "|".join(f"{s}:{d}" for s, d, _ in metas))
-            .encode()).hexdigest()[:12]
-        name = f"byteps_tpu.tree.{sig}"
-    out = jnp.asarray(push_pull(flat, name=name, average=average,
-                                compression=compression))
-    outs, o = [], 0
-    for shp, dt, n in metas:
-        outs.append(out[o:o + n].reshape(shp).astype(dt))
-        o += n
+
+    compressed_keys = (set(_state.ps_session._compressors)
+                       if _state.ps_session is not None else set())
+
+    def separate(i, l) -> bool:
+        if not jnp.issubdtype(l.dtype, jnp.floating):
+            return True
+        if compressed_keys and leaf_names is not None:
+            return get_core().get_declared_key(
+                str(leaf_names[i])) in compressed_keys
+        return False
+
+    sep_idx = [i for i, l in enumerate(leaves) if separate(i, l)]
+    batch_idx = [i for i in range(len(leaves)) if i not in set(sep_idx)]
+
+    outs: list = [None] * len(leaves)
+    for i in sep_idx:
+        nm = str(leaf_names[i]) if leaf_names is not None else None
+        outs[i] = jnp.asarray(
+            push_pull(leaves[i], name=nm, average=average,
+                      compression=compression)).astype(metas[i][1])
+    if batch_idx:
+        flat = (jnp.concatenate([leaves[i].ravel().astype(jnp.float32)
+                                 for i in batch_idx])
+                if len(batch_idx) > 1
+                else leaves[batch_idx[0]].ravel().astype(jnp.float32))
+        if name is None:
+            # Key the batch by its structure + leaf signature so every
+            # worker maps the same gradient set to the same declared key,
+            # and distinct sets (partial backwards, several optimizers
+            # with same-shaped params) get distinct keys/PS buffers.
+            import hashlib
+            sig = hashlib.md5(
+                (str(treedef) + "|".join(f"{s}:{d}" for s, d, _ in metas))
+                .encode()).hexdigest()[:12]
+            name = f"byteps_tpu.tree.{sig}"
+        out = jnp.asarray(push_pull(flat, name=name, average=average,
+                                    compression=compression))
+        o = 0
+        for i in batch_idx:
+            shp, dt, n = metas[i]
+            outs[i] = out[o:o + n].reshape(shp).astype(dt)
+            o += n
     return jax.tree.unflatten(treedef, outs)
 
 

@@ -67,6 +67,10 @@ class HybridConfig:
     aux_loss_weight: float = 0.0
     dtype: Any = jnp.float32
     causal: bool = True
+    #: > 0 streams the LM-head cross-entropy in row chunks of this size so
+    #: the [B*S, vocab] logits are never materialized (see
+    #: transformer.fused_nll_sum); 0 = full-logits path.
+    ce_chunk_rows: int = 0
 
     @property
     def head_dim(self):
@@ -245,16 +249,25 @@ def build_hybrid_train_step(
             x, aux = run(local_layers, x)
 
         x = _ln(x, params["ln_f_scale"], params["ln_f_bias"])
-        logits = jnp.einsum("bsd,vd->bsv", x.astype(jnp.float32),
-                            params["embed"])
-        logp = jax.nn.log_softmax(logits, axis=-1)
-        nll = -jnp.take_along_axis(logp, targets[..., None], -1)[..., 0]
+        if cfg.ce_chunk_rows:
+            # Streamed LM head: per-chunk logits + logsumexp under
+            # scan+checkpoint, never materializing [B*S, V] (same fused
+            # path as the flagship model, transformer.fused_nll_sum).
+            from .transformer import fused_nll_sum
+            nll_sum = fused_nll_sum(x, params["embed"].astype(x.dtype),
+                                    targets, cfg.ce_chunk_rows)
+        else:
+            logits = jnp.einsum("bsd,vd->bsv", x.astype(jnp.float32),
+                                params["embed"])
+            logp = jax.nn.log_softmax(logits, axis=-1)
+            nll = -jnp.take_along_axis(logp, targets[..., None], -1)[..., 0]
+            nll_sum = nll.sum()
         # Normalize by the GLOBAL token count; mask to the last pp stage so
         # psum over pp double-counts neither the head path nor the input
         # path of the shared embedding.
         denom = (B * lax.axis_size("dp") * lax.axis_size("ep")
                  * S * lax.axis_size("sp"))
-        loss = nll.sum() / denom
+        loss = nll_sum / denom
         # Mask the token loss to the last pp stage so psum over pp
         # double-counts neither the head path nor the input path of the
         # shared embedding.  The aux term stays UNmasked: each pp rank owns

@@ -434,10 +434,12 @@ def forward(params: PyTree, tokens: jax.Array, cfg: TransformerConfig,
                       preferred_element_type=jnp.float32)
 
 
-def _fused_lm_loss(x: jax.Array, embed: jax.Array, targets: jax.Array,
-                   chunk_rows: int) -> jax.Array:
-    """Streamed weight-tied LM cross-entropy: sum of per-row NLL without
-    ever materializing the full [B*S, vocab] logits.
+def fused_nll_sum(x: jax.Array, embed: jax.Array, targets: jax.Array,
+                  chunk_rows: int) -> jax.Array:
+    """Streamed weight-tied LM cross-entropy: SUM of per-row NLL without
+    ever materializing the full [B*S, vocab] logits.  (Callers divide by
+    their own token count — the hybrid shard_map step normalizes by the
+    GLOBAL count across mesh axes.)
 
     Rows are processed in `chunk_rows`-sized chunks under `lax.scan`; each
     chunk computes its logits (activation-dtype matmul, f32 accumulation),
@@ -478,7 +480,7 @@ def _fused_lm_loss(x: jax.Array, embed: jax.Array, targets: jax.Array,
     total, _ = lax.scan(body, jnp.zeros((), jnp.float32),
                         (xs.reshape(nc, C, D), ts.reshape(nc, C),
                          w.reshape(nc, C)))
-    return total / N
+    return total
 
 
 def loss_fn(params: PyTree, batch: Tuple[jax.Array, jax.Array],
@@ -492,8 +494,8 @@ def loss_fn(params: PyTree, batch: Tuple[jax.Array, jax.Array],
     tokens, targets = batch
     if cfg.ce_chunk_rows:
         x = forward_hidden(params, tokens, cfg, attn_fn=attn_fn)
-        return _fused_lm_loss(x, params["embed"], targets,
-                              cfg.ce_chunk_rows)
+        return fused_nll_sum(x, params["embed"], targets,
+                             cfg.ce_chunk_rows) / targets.size
     logits = forward(params, tokens, cfg, attn_fn=attn_fn)
     logp = jax.nn.log_softmax(logits, axis=-1)
     nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]

@@ -135,12 +135,16 @@ def push_pull_group(tensors, names, average: bool = True,
         # One batched collective for the whole list (api.push_pull_tree):
         # a single wire transfer replaces the per-tensor dispatch loop, so
         # there are no partially-dispatched handles to drain on error.
-        tree = {n: jnp.asarray(t.numpy())
-                for t, n in zip(ts, live_names)}
-        out = _api.push_pull_tree(tree, average=average,
-                                  compression=compression)
-        return [tf.convert_to_tensor(np.asarray(out[n]), dtype=t.dtype)
-                for t, n in zip(ts, live_names)]
+        # The tree is a LIST (not a name-keyed dict): duplicate entries in
+        # `names` must stay independent tensors, not collapse to one key.
+        import hashlib
+        tree = [jnp.asarray(t.numpy()) for t in ts]
+        sig = hashlib.md5("|".join(live_names).encode()).hexdigest()[:12]
+        out = _api.push_pull_tree(tree, name=f"byteps_tpu.tf_group.{sig}",
+                                  average=average, compression=compression,
+                                  leaf_names=live_names)
+        return [tf.convert_to_tensor(np.asarray(o), dtype=t.dtype)
+                for o, t in zip(out, ts)]
 
     # Eager tensors always expose .numpy() after convert_to_tensor, so the
     # eager mode calls _eager_group directly; py_function is the non-eager

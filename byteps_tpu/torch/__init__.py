@@ -133,7 +133,8 @@ class _DistributedOptimizer(torch.optim.Optimizer):
                 by_name[name] = p.grad
         if grads:
             out = _api.push_pull_tree(grads, average=True,
-                                      compression=self._compression)
+                                      compression=self._compression,
+                                      leaf_names=sorted(grads))
             with torch.no_grad():
                 for name, g in by_name.items():
                     g.copy_(_from_jax(out[name], g))
@@ -331,7 +332,8 @@ class DistributedDataParallel(torch.nn.Module):
             return
         # One batched collective for the whole list (see
         # _DistributedOptimizer.step).
-        out = _api.push_pull_tree(grads, average=True)
+        out = _api.push_pull_tree(grads, average=True,
+                                  leaf_names=sorted(grads))
         with torch.no_grad():
             for n, p in self.module.named_parameters():
                 key = f"DDP.Gradient.{n}"

@@ -148,7 +148,10 @@ class _CrossBarrierOptimizer:
                 continue
             if not done:                 # still in flight: lock stays held
                 self._sync_events.put(item)
-                _time.sleep(0.001)       # don't hot-spin a lone pending item
+                if self._sync_events.qsize() <= 1:
+                    # Only yield when this pending item is alone — completed
+                    # handles queued behind it must not eat the sleep.
+                    _time.sleep(0.001)
                 continue
             try:
                 self._wait(handle)       # averaged grad lands in p.grad
@@ -227,15 +230,19 @@ class _CrossBarrierOptimizer:
         """Drain, stop the poller, and DETACH every hook this wrapper
         installed — a backward after close() would otherwise dispatch into
         a dead queue, leave its lock held forever, and deadlock the next
-        forward on the still-installed pre-hook."""
+        forward on the still-installed pre-hook.  Teardown runs even when
+        the drain re-raises a recorded comm error (close() must never be a
+        half-done no-op on retry)."""
         if not self._closed:
             self._closed = True
-            self.synchronize()
-            for h in self._hook_handles:
-                h.remove()
-            self._hook_handles.clear()
-            self._sync_events.put(None)
-            self._poller.join(timeout=10)
+            try:
+                self.synchronize()
+            finally:
+                for h in self._hook_handles:
+                    h.remove()
+                self._hook_handles.clear()
+                self._sync_events.put(None)
+                self._poller.join(timeout=10)
 
 
 def CrossBarrier(model: torch.nn.Module,

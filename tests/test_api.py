@@ -37,6 +37,35 @@ def test_eager_push_pull_identity_single_worker(bps_initialized):
     np.testing.assert_allclose(np.asarray(out), np.asarray(x))
 
 
+def test_push_pull_tree_batches_and_preserves_dtypes(bps_initialized):
+    bps = bps_initialized
+    tree = {"a": jnp.arange(6, dtype=jnp.float32).reshape(2, 3),
+            "b": jnp.ones((4,), jnp.bfloat16),
+            # above 2^24: an f32 round-trip would land on 20_000_000
+            "steps": jnp.array([20_000_001], jnp.int32)}
+    out = bps.push_pull_tree(tree, average=False,
+                             leaf_names=sorted(tree))
+    assert out["a"].dtype == jnp.float32
+    assert out["b"].dtype == jnp.bfloat16
+    assert out["steps"].dtype == jnp.int32
+    np.testing.assert_allclose(np.asarray(out["a"]), np.asarray(tree["a"]))
+    # integer leaves must NOT ride the f32 batch — exact at any magnitude
+    assert int(out["steps"][0]) == 20_000_001
+
+
+def test_tf_push_pull_group_duplicate_names_stay_independent(
+        bps_initialized):
+    tf = pytest.importorskip("tensorflow")
+    import byteps_tpu.tensorflow as bps_tf
+    a = tf.fill([4], 2.0)
+    b = tf.fill([4], 5.0)
+    out = bps_tf.push_pull_group([a, b], ["dup", "dup"], average=True)
+    # world 1: each tensor reduces to itself; a dict-keyed batch would
+    # collapse both onto one entry and return b's value twice
+    np.testing.assert_allclose(out[0].numpy(), a.numpy())
+    np.testing.assert_allclose(out[1].numpy(), b.numpy())
+
+
 def test_eager_push_pull_fp16_compression(bps_initialized):
     bps = bps_initialized
     x = jnp.linspace(-2, 2, 64, dtype=jnp.float32)

@@ -60,6 +60,19 @@ def test_parallel_axes_match_single_device(axes):
     np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-5)
 
 
+def test_fused_ce_matches_dense_across_axes():
+    """ce_chunk_rows > 0 must not change the hybrid loss trajectory —
+    across sharded axes AND against the full-logits path (the streamed LM
+    head composes with pp masking and the global-token normalization)."""
+    import dataclasses
+    cfg_f = dataclasses.replace(CFG, ce_chunk_rows=16)
+    ref, _ = _run(CFG, dict(dp=1, devices=jax.devices()[:1]))
+    fused_solo, _ = _run(cfg_f, dict(dp=1, devices=jax.devices()[:1]))
+    np.testing.assert_allclose(fused_solo, ref, rtol=2e-4, atol=2e-5)
+    fused_mp, _ = _run(cfg_f, dict(pp=2, dp=2, tp=2), num_microbatches=2)
+    np.testing.assert_allclose(fused_mp, ref, rtol=2e-4, atol=2e-5)
+
+
 @pytest.mark.parametrize("mb", [2, 4])
 def test_pipeline_matches_single_device(mb):
     ref, _ = _run(CFG, dict(dp=1, devices=jax.devices()[:1]),
