@@ -96,16 +96,17 @@ def bench_flagship():
         # Full BERT-large geometry (reference benchmark: README.md:38-46),
         # causal-LM objective, bf16 activations, per-layer remat.  Batch 48
         # per chip saturates the v5e MXU (measured: 16->48 is +15% tokens/s,
-        # 48->64 is flat).  Round-4 defaults from the on-TPU sweep:
-        # streamed LM-head cross-entropy (the full f32 logits were 3.2 GB
-        # of HBM traffic) + flash attention; each knob env-overridable for
-        # re-tuning (BENCH_CE_CHUNK=0 / BENCH_ATTN=dense /
-        # BENCH_REMAT_POLICY=dots restore the alternatives).
+        # 48->64 is flat).  Round 4 adds the streamed LM-head cross-entropy
+        # (the full f32 logits were 3.2 GB of HBM traffic — the largest
+        # non-matmul cost).  Attention stays dense at seq 512: the flash
+        # kernel measured 0.91x dense here (docs/performance.md) — it wins
+        # beyond ~1-2k seq.  Each knob env-overridable for on-TPU sweeps:
+        # BENCH_CE_CHUNK=0 / BENCH_ATTN=flash / BENCH_REMAT_POLICY=dots.
         cfg = tfm.get_config(
             "bert_large", causal=True, vocab_size=32768, max_seq_len=512,
             ce_chunk_rows=int(os.environ.get("BENCH_CE_CHUNK", "2048")),
             remat_policy=os.environ.get("BENCH_REMAT_POLICY", "none"),
-            attn_impl=os.environ.get("BENCH_ATTN", "flash"))
+            attn_impl=os.environ.get("BENCH_ATTN", "dense"))
         batch = int(os.environ.get("BENCH_BATCH", "48")) * jax.device_count()
         seq, steps = 512, 10
 

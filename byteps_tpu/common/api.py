@@ -359,27 +359,37 @@ def push_pull_tree(tree: PyTree, name: Optional[str] = None,
     sep_idx = [i for i, l in enumerate(leaves) if separate(i, l)]
     batch_idx = [i for i in range(len(leaves)) if i not in set(sep_idx)]
 
+    if name is None:
+        # Key the batch by its structure + leaf signature so every worker
+        # maps the same gradient set to the same declared key, and distinct
+        # sets (partial backwards, several optimizers with same-shaped
+        # params) get distinct keys/PS buffers.
+        import hashlib
+        sig = hashlib.md5(
+            (str(treedef) + "|".join(f"{s}:{d}" for s, d, _ in metas))
+            .encode()).hexdigest()[:12]
+        name = f"byteps_tpu.tree.{sig}"
+
     outs: list = [None] * len(leaves)
     for i in sep_idx:
-        nm = str(leaf_names[i]) if leaf_names is not None else None
+        # Stable per-leaf name (explicit, or derived from the batch name +
+        # leaf index) — an unnamed push would auto-declare a FRESH key on
+        # every call and grow the registry unboundedly.
+        nm = (str(leaf_names[i]) if leaf_names is not None
+              else f"{name}.leaf{i}")
+        # Non-float leaves are separated precisely for exactness: a lossy
+        # intra-node cast (fp16) would corrupt them worse than the f32
+        # batch they were pulled out of.
+        comp = (compression
+                if jnp.issubdtype(metas[i][1], jnp.floating) else None)
         outs[i] = jnp.asarray(
             push_pull(leaves[i], name=nm, average=average,
-                      compression=compression)).astype(metas[i][1])
+                      compression=comp)).astype(metas[i][1])
     if batch_idx:
         flat = (jnp.concatenate([leaves[i].ravel().astype(jnp.float32)
                                  for i in batch_idx])
                 if len(batch_idx) > 1
                 else leaves[batch_idx[0]].ravel().astype(jnp.float32))
-        if name is None:
-            # Key the batch by its structure + leaf signature so every
-            # worker maps the same gradient set to the same declared key,
-            # and distinct sets (partial backwards, several optimizers
-            # with same-shaped params) get distinct keys/PS buffers.
-            import hashlib
-            sig = hashlib.md5(
-                (str(treedef) + "|".join(f"{s}:{d}" for s, d, _ in metas))
-                .encode()).hexdigest()[:12]
-            name = f"byteps_tpu.tree.{sig}"
         out = jnp.asarray(push_pull(flat, name=name, average=average,
                                     compression=compression))
         o = 0

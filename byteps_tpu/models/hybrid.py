@@ -254,8 +254,8 @@ def build_hybrid_train_step(
             # scan+checkpoint, never materializing [B*S, V] (same fused
             # path as the flagship model, transformer.fused_nll_sum).
             from .transformer import fused_nll_sum
-            nll_sum = fused_nll_sum(x, params["embed"].astype(x.dtype),
-                                    targets, cfg.ce_chunk_rows)
+            nll_sum = fused_nll_sum(x, params["embed"], targets,
+                                    cfg.ce_chunk_rows)
         else:
             logits = jnp.einsum("bsd,vd->bsv", x.astype(jnp.float32),
                                 params["embed"])

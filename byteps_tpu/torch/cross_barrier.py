@@ -135,6 +135,7 @@ class _CrossBarrierOptimizer:
         the updates (and forward locks) of gradients that completed after
         it."""
         import time as _time
+        stall_marker = None   # first requeued item of a no-progress cycle
         while True:
             item = self._sync_events.get()
             if item is None:
@@ -148,11 +149,16 @@ class _CrossBarrierOptimizer:
                 continue
             if not done:                 # still in flight: lock stays held
                 self._sync_events.put(item)
-                if self._sync_events.qsize() <= 1:
-                    # Only yield when this pending item is alone — completed
-                    # handles queued behind it must not eat the sleep.
+                if stall_marker is None:
+                    stall_marker = item
+                elif stall_marker is item:
+                    # A full pass over the queue completed nothing — yield.
+                    # (Sleeping per requeue would delay completed handles
+                    # queued behind a pending one; never sleeping would
+                    # hot-spin a core for the whole comm latency.)
                     _time.sleep(0.001)
                 continue
+            stall_marker = None          # progress: reset the cycle marker
             try:
                 self._wait(handle)       # averaged grad lands in p.grad
                 self._apply_update(p)

@@ -1,0 +1,75 @@
+"""Cross-barrier benchmark for the torch plugin.
+
+Torch counterpart of the reference's
+example/pytorch/benchmark_cross_barrier_byteps.py: train the same
+synthetic model with the plain DistributedOptimizer (global sync barrier
+before step()) and with CrossBarrier (per-parameter updates applied as
+each gradient's push_pull completes; the next forward blocks per LAYER),
+and report steps/sec for both.  On a real multi-worker wire the gap is
+the communication time hidden behind the next step's forward
+(reference: docs/cross-barrier.md, ByteScheduler).
+
+Run:
+    python example/torch/benchmark_cross_barrier_byteps.py --steps 30
+"""
+
+import argparse
+import time
+
+import torch
+import torch.nn.functional as F
+
+import byteps_tpu.torch as bps
+
+
+def make_model(width: int, depth: int) -> torch.nn.Module:
+    layers = [torch.nn.Linear(width, width), torch.nn.ReLU()] * depth
+    return torch.nn.Sequential(*layers, torch.nn.Linear(width, 10))
+
+
+def run(steps: int, width: int, depth: int, cross_barrier: bool) -> float:
+    torch.manual_seed(0)
+    model = make_model(width, depth)
+    inner = torch.optim.SGD(model.parameters(), lr=0.01)
+    if cross_barrier:
+        opt = bps.CrossBarrier(model, inner,
+                               named_parameters=model.named_parameters())
+    else:
+        opt = bps.DistributedOptimizer(
+            inner, named_parameters=model.named_parameters())
+    x = torch.randn(64, width)
+    y = torch.randint(0, 10, (64,))
+    # warmup (first dispatch declares keys / compiles)
+    F.cross_entropy(model(x), y).backward()
+    opt.step()
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        if not cross_barrier:
+            opt.zero_grad()
+        F.cross_entropy(model(x), y).backward()
+        opt.step()
+    if cross_barrier:
+        opt.synchronize()   # drain before the clock stops
+        opt.close()
+    dt = time.perf_counter() - t0
+    return steps / dt
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=30)
+    ap.add_argument("--width", type=int, default=256)
+    ap.add_argument("--depth", type=int, default=6)
+    args = ap.parse_args()
+
+    bps.init()
+    base = run(args.steps, args.width, args.depth, cross_barrier=False)
+    xb = run(args.steps, args.width, args.depth, cross_barrier=True)
+    print(f"rank {bps.rank()}/{bps.size()}: "
+          f"baseline {base:.1f} steps/s, cross-barrier {xb:.1f} steps/s "
+          f"({xb / base:.2f}x)")
+    bps.shutdown()
+
+
+if __name__ == "__main__":
+    main()

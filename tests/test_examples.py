@@ -81,6 +81,15 @@ def test_cross_barrier_example():
     assert "cross-barrier:" in out
 
 
+def test_torch_cross_barrier_example():
+    pytest.importorskip("torch")
+    torch_dir = os.path.join(os.path.dirname(__file__), "..", "example",
+                             "torch")
+    out = _run("benchmark_cross_barrier_byteps.py", "--steps", "5",
+               "--width", "64", "--depth", "2", directory=torch_dir)
+    assert "cross-barrier" in out
+
+
 def test_torch_mnist_example():
     pytest.importorskip("torch")
     torch_dir = os.path.join(os.path.dirname(__file__), "..", "example",

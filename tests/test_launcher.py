@@ -37,6 +37,53 @@ def test_launch_worker_role_runs_command(tmp_path):
     assert out.read_text() == "ran"
 
 
+def test_launch_joint_role_runs_server_beside_worker(tmp_path):
+    """DMLC_ROLE=joint (the mixed-mode recipe, docs/running.md) must start
+    the KV server on this host AND run the training command, then tear the
+    server down when training exits."""
+    import socket
+    import time
+
+    from testutil import cpu_env, free_port
+
+    port = free_port()
+    out = tmp_path / "out.txt"
+    env = cpu_env({
+        "DMLC_ROLE": "joint",
+        "DMLC_PS_ROOT_PORT": str(port - 1),
+        "DMLC_NUM_WORKER": "1",
+        "BYTEPS_LOG_LEVEL": "ERROR",
+    })
+    probe = (
+        "import socket, time, sys\n"
+        "deadline = time.time() + 30\n"
+        "while time.time() < deadline:\n"
+        "    try:\n"
+        f"        socket.create_connection(('127.0.0.1', {port}), 0.5)"
+        ".close()\n"
+        f"        open(r'{out}', 'w').write('server-up')\n"
+        "        sys.exit(0)\n"
+        "    except OSError:\n"
+        "        time.sleep(0.1)\n"
+        "sys.exit(1)\n")
+    rc = subprocess.call(
+        [sys.executable, "-m", "byteps_tpu.launcher.launch",
+         sys.executable, "-c", probe], env=env, timeout=120)
+    assert rc == 0
+    assert out.read_text() == "server-up"  # trainer saw the live server
+    # server terminated with the trainer
+    deadline = time.time() + 15
+    down = False
+    while time.time() < deadline:
+        try:
+            socket.create_connection(("127.0.0.1", port), 0.3).close()
+            time.sleep(0.2)
+        except OSError:
+            down = True
+            break
+    assert down, "joint-role server still alive after trainer exit"
+
+
 def test_launch_no_command_fails():
     env = dict(os.environ)
     env["DMLC_ROLE"] = "worker"
