@@ -263,6 +263,12 @@ struct KeyState {
   bool bidirectional = false;  // recompress merged buffer on the pull leg
   bool onebit_scaled = true;
   bool round_compressed = false;  // any push this round arrived compressed
+  bool server_ef = false;      // vanilla error feedback on the recompress
+                               // leg — carried across rounds (reference:
+                               // the server registry layers EF too,
+                               // skipping only momentum,
+                               // compressor_registry.cc:39-56)
+  std::vector<float> ef_err;   // requantization error, one slot per elem
   std::vector<PendingPull> pending;
   std::atomic<uint64_t> push_count{0};  // total pushes (schedule priority);
                                         // atomic: written by engine, read
@@ -534,6 +540,8 @@ class Server {
             ks.kwargs.find("compressor=onebit") != std::string::npos;
         ks.onebit_scaled =
             ks.kwargs.find("onebit_scaling=0") == std::string::npos;
+        ks.server_ef =
+            ks.kwargs.find("ef=vanilla") != std::string::npos;
       }
     }
     if (ks.store.size() != n) {
@@ -618,10 +626,29 @@ class Server {
       // ALL_RECV: publish the completed round and start a fresh merge.
       // Bidirectional compressors re-compress the merged buffer for the
       // pull leg (reference: impl/onebit bidirectional, server engine).
-      if (ks.round_compressed && ks.bidirectional)
+      if (ks.round_compressed && ks.bidirectional) {
+        size_t ne = ks.store.size() / 4;
+        float* s = reinterpret_cast<float*>(ks.store.data());
+        if (ks.server_ef) {
+          // Vanilla EF on the requantization: fold last round's error into
+          // the merged gradient before compressing (the store is a fresh
+          // COPY_FIRST merge every round, so the in-place add is safe).
+          if (ks.ef_err.size() != ne) ks.ef_err.assign(ne, 0.0f);
+          for (size_t i = 0; i < ne; ++i) s[i] += ks.ef_err[i];
+        }
         codec::CompressOnebit(ks.store, ks.onebit_scaled, &ks.out);
-      else
+        if (ks.server_ef) {
+          // The decoded onebit value is just +-scale with the sign bit
+          // taken from the corrected gradient — compute the error inline
+          // instead of a full decompress round-trip + allocation.
+          float scale = 1.0f;
+          std::memcpy(&scale, ks.out.data() + 5, 4);
+          for (size_t i = 0; i < ne; ++i)
+            ks.ef_err[i] = s[i] - (s[i] < 0.0f ? -scale : scale);
+        }
+      } else {
         ks.out = ks.store;
+      }
       ks.completed_round++;
       ks.seen.clear();
       ks.round_compressed = false;

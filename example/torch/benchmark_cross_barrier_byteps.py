@@ -23,7 +23,8 @@ import byteps_tpu.torch as bps
 
 
 def make_model(width: int, depth: int) -> torch.nn.Module:
-    layers = [torch.nn.Linear(width, width), torch.nn.ReLU()] * depth
+    layers = [l for _ in range(depth)
+              for l in (torch.nn.Linear(width, width), torch.nn.ReLU())]
     return torch.nn.Sequential(*layers, torch.nn.Linear(width, 10))
 
 

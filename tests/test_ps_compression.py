@@ -211,8 +211,11 @@ def test_soak_4workers_2servers_schedule_compression_restart(ps_server):
     # Replay: per worker a WireCompressor replica evolves the same EF state
     # (worker 2's resets at the restart); per round per partition the
     # server decompress-sums all four pushes and requantizes (onebit is
-    # bidirectional).
+    # bidirectional) WITH its own vanilla EF on the requantization error —
+    # the ef=vanilla kwargs enable EF on both legs, like the reference
+    # registry (worker: momentum+EF, server: EF only).
     sims = {w: wire.WireCompressor(kw) for w in range(4)}
+    srv_err: dict = {}   # per-partition server requantization error
     step = 1024 // 4
     for r in range(rounds):
         if r == restart_after:
@@ -223,8 +226,11 @@ def test_soak_4workers_2servers_schedule_compression_restart(ps_server):
             for w in range(4):
                 sl = grads[(w, r)][off:off + step]
                 merged += wire.decode(sims[w].encode(off, sl), sl.size)
+            corrected = merged + srv_err.get(off, 0.0)
             req = wire.WireCompressor({"compressor": "onebit"})
-            expect.append(wire.decode(req.encode(off, merged), merged.size))
+            got = wire.decode(req.encode(off, corrected), corrected.size)
+            srv_err[off] = corrected - got
+            expect.append(got)
         want = np.concatenate(expect)
         for w in range(4):
             np.testing.assert_allclose(
