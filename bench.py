@@ -83,13 +83,16 @@ def bench_flagship():
     # CPU-feasible); only the implicit off-TPU fallback forces tiny.
     small = (os.environ.get("BENCH_SMALL", "0") == "1"
              or (not on_tpu and not alt_model))
+    ce_chunk = int(os.environ.get("BENCH_CE_CHUNK", "2048"))
     if small:
         cfg = tfm.get_config("tiny", causal=True)
         batch, seq, steps = 8 * max(1, jax.device_count()), 128, 5
     elif alt_model:
         # Bench any named config (e.g. BENCH_MODEL=llama_1b for the
-        # modern-LLM block) at its native sequence length.
-        cfg = tfm.get_config(alt_model, causal=True)
+        # modern-LLM block) at its native sequence length.  The streamed
+        # LM head applies here too (llama_1b's full logits at seq 2048
+        # would be 2.1 GB of f32 HBM traffic).
+        cfg = tfm.get_config(alt_model, causal=True, ce_chunk_rows=ce_chunk)
         seq = min(cfg.max_seq_len, 2048)
         batch, steps = 8 * jax.device_count(), 10
     else:
@@ -104,7 +107,7 @@ def bench_flagship():
         # BENCH_CE_CHUNK=0 / BENCH_ATTN=flash / BENCH_REMAT_POLICY=dots.
         cfg = tfm.get_config(
             "bert_large", causal=True, vocab_size=32768, max_seq_len=512,
-            ce_chunk_rows=int(os.environ.get("BENCH_CE_CHUNK", "2048")),
+            ce_chunk_rows=ce_chunk,
             remat_policy=os.environ.get("BENCH_REMAT_POLICY", "none"),
             attn_impl=os.environ.get("BENCH_ATTN", "dense"))
         batch = int(os.environ.get("BENCH_BATCH", "48")) * jax.device_count()

@@ -177,6 +177,25 @@ def test_fused_ce_matches_dense_loss_and_grads():
             np.testing.assert_allclose(a, b, rtol=2e-4, atol=1e-5)
 
 
+def test_fused_ce_lowering_never_materializes_full_logits():
+    """Structural guard at bench geometry (1 layer): the fused path's
+    lowered HLO must contain chunk-sized logits buffers only — the full
+    [B*S, vocab] f32 tensor (3.2 GB at bench scale) must not appear in
+    forward OR backward."""
+    cfg = tfm.get_config("bert_large", num_layers=1, causal=True,
+                         vocab_size=32768, max_seq_len=512,
+                         ce_chunk_rows=2048)
+    params = tfm.init_params(jax.random.key(0), cfg)
+    toks = jnp.zeros((8, 512), jnp.int32)   # N = 4096 rows
+
+    txt = jax.jit(jax.value_and_grad(
+        lambda p: tfm.loss_fn(p, (toks, toks), cfg))).lower(params).as_text()
+    assert "tensor<2048x32768xf32>" in txt       # per-chunk logits
+    assert "tensor<4096x32768xf32>" not in txt   # flattened full logits
+    assert "tensor<8x512x32768xf32>" not in txt  # unflattened full logits
+    assert "tensor<2x2048x32768xf32>" not in txt  # stacked chunk residuals
+
+
 def test_fused_ce_trains(mesh8):
     """End-to-end: the fused-CE config trains under the DP train step."""
     cfg = tfm.get_config("tiny", ce_chunk_rows=64)
