@@ -445,7 +445,10 @@ def fused_nll_sum(x: jax.Array, embed: jax.Array, targets: jax.Array,
     chunk computes its logits (activation-dtype matmul, f32 accumulation),
     reduces them to logsumexp + target logit, and is wrapped in
     `jax.checkpoint` so the backward pass recomputes the chunk logits
-    instead of saving them.  Peak logits memory drops from O(B*S*V) to
+    instead of saving them.  Meant to run on per-shard (local) inputs —
+    build_train_step's shard_map and the hybrid step both satisfy this; a
+    GSPMD (jit-sharded) caller whose batch axis is sharded should expect
+    the partitioner to move data across shards for the chunked scan.  Peak logits memory drops from O(B*S*V) to
     O(chunk_rows*V) in both passes; the matmul work is unchanged and stays
     MXU-shaped.  (Reference analog: BytePS's whole pitch is removing
     non-compute bottlenecks from the training step — docs/performance.md;

@@ -475,3 +475,45 @@ print("PS_API_OK")
                           capture_output=True, text=True, timeout=180)
     assert proc.returncode == 0, proc.stderr[-3000:]
     assert "PS_API_OK" in proc.stdout
+
+
+def test_push_pull_tree_preserves_wire_compression(ps_server):
+    """In PS mode, a tree leaf whose name has a registered wire compressor
+    must NOT be folded into the batched key (that would silently bypass
+    the user's compression): it rides its own named push_pull through the
+    compressed wire — the result is the onebit requantization, not the
+    exact value — while unregistered leaves batch exactly."""
+    port = ps_server(num_workers=1)
+    code = """
+import numpy as np, jax.numpy as jnp
+import byteps_tpu as bps
+from byteps_tpu.server import wire
+bps.init()
+bps.register_compressor("comp.g", {"compressor": "onebit"})
+g = jnp.asarray(np.linspace(-2.0, 3.0, 4096, dtype=np.float32))
+tree = {"comp.g": g, "plain.h": jnp.full((64,), 7.0, jnp.float32)}
+out = bps.push_pull_tree(tree, average=False, leaf_names=sorted(tree))
+# compressed leaf: one-worker onebit round-trip = sign * mean|g| (twice:
+# worker push + server bidirectional requantize keep the same values)
+wc = wire.WireCompressor({"compressor": "onebit"})
+want = wire.decode(wc.encode(0, np.asarray(g)), g.size)
+want = wire.decode(wc.encode(0, want), want.size)
+np.testing.assert_allclose(np.asarray(out["comp.g"]), want, rtol=1e-6)
+assert not np.allclose(np.asarray(out["comp.g"]), np.asarray(g))
+# plain leaf: exact through the batched path
+np.testing.assert_array_equal(np.asarray(out["plain.h"]),
+                              np.full((64,), 7.0, np.float32))
+bps.shutdown()
+print("TREE_COMP_OK")
+"""
+    env = cpu_env({
+        "BYTEPS_TPU_PS_MODE": "1",
+        "DMLC_NUM_WORKER": "1",
+        "DMLC_NUM_SERVER": "1",
+        "DMLC_PS_ROOT_PORT": str(port - 1),
+        "BYTEPS_MIN_COMPRESS_BYTES": "0",
+    })
+    proc = subprocess.run([sys.executable, "-c", code], env=env,
+                          capture_output=True, text=True, timeout=180)
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    assert "TREE_COMP_OK" in proc.stdout
