@@ -8,10 +8,12 @@ quantizer is unbiased, and ship sign+level.
 
 Wire-format redesign for TPU (flagged in SURVEY §7): the reference packs
 levels with Elias-delta variable-length bitstreams — hostile to vector
-units.  This build uses fixed-width uint8 levels (s <= 127) + packed sign
-bits + the norm scalar: shape-static, fully vectorised, same accuracy
-contract (the quantizer itself is identical and unbiased; only the
-entropy-coding stage differs).
+units.  This build packs levels FIXED-WIDTH at b = ceil(log2(s+1)) bits
+into uint32 words (sublane layout, ops/compressor/bitpack.pack_levels) +
+packed sign bits + the norm scalar: shape-static, fully vectorised, same
+accuracy contract (the quantizer itself is identical and unbiased; only
+the entropy-coding stage differs), and within ~1.3x of the Elias-delta
+wire density for typical gradients (s=15: 4+1 bits/elem).
 """
 
 from __future__ import annotations
@@ -22,7 +24,8 @@ import jax
 import jax.numpy as jnp
 
 from .base import (InterCompressor, Payload, State, rng_uniform, seed_state)
-from .bitpack import pack_signs, unpack_signs, words_len
+from .bitpack import (level_words_len, pack_levels, pack_signs,
+                      unpack_levels, unpack_signs, words_len)
 
 
 class DitheringCompressor(InterCompressor):
@@ -75,18 +78,20 @@ class DitheringCompressor(InterCompressor):
         level = (j + (u < p_up)).astype(jnp.uint8)
         new_state = {"rng": state["rng"].at[:n].set(rng)}
         # Sign stream rides the sublane-packed bitpack wire (Pallas on
-        # TPU; see ops/compressor/bitpack.py).
-        return ({"level": level, "signs": pack_signs(x),
+        # TPU); levels pack fixed-width at ceil(log2(s+1)) bits in the
+        # same sublane layout (bitpack.pack_levels).
+        return ({"level_words": pack_levels(level, self.s),
+                 "signs": pack_signs(x),
                  "norm": norm[None]}, new_state)
 
     def decompress(self, payload: Payload, n: int,
                    dtype=jnp.float32) -> jax.Array:
         levels = self._levels()
-        mag = levels[payload["level"].astype(jnp.int32)]
+        mag = levels[unpack_levels(payload["level_words"], n, self.s)]
         sign = unpack_signs(payload["signs"], n)      # +-1 f32
         return (sign * mag * payload["norm"][0]).astype(dtype)
 
     def payload_shapes(self, n: int, dtype=jnp.float32):
-        return {"level": ((n,), jnp.uint8),
+        return {"level_words": ((level_words_len(n, self.s),), jnp.uint32),
                 "signs": ((words_len(n),), jnp.uint32),
                 "norm": ((1,), jnp.float32)}

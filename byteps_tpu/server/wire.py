@@ -20,10 +20,12 @@ Wire layout (little-endian):
     dithering(4): u8 flags(bit0=natural) | u8 s | f32 norm
                   | level bitstream [ceil(n*b/8)] | u8 signs[ceil(n/8)]
                   where b = ceil(log2(s+1)); levels are packed LSB-first at
-                  b bits each.  The on-device (JAX) plane keeps fixed-width
-                  u8 levels — vector-friendly — while the host-side wire
-                  packs densely: s=15 ships 4+1 bits/elem, within the
-                  reference's Elias-delta budget (reference:
+                  b bits each, byte-contiguous.  (The on-device JAX plane
+                  also bit-packs levels, but into sublane-layout uint32
+                  words at 32//b levels per word — bitpack.pack_levels —
+                  so the two planes' level streams are NOT interchangeable,
+                  like the sign streams.)  s=15 ships 4+1 bits/elem here,
+                  within the reference's Elias-delta budget (reference:
                   compressor/impl/dithering.cc:51-120) without
                   variable-length decode.
 """

@@ -74,3 +74,34 @@ def test_onebit_uses_bitpack_wire():
     np.testing.assert_allclose(
         np.asarray(out),
         np.where(np.asarray(x) < 0, -scale, scale), rtol=1e-6)
+
+
+@pytest.mark.parametrize("s,n", [(1, 4096), (7, 5000), (15, 4096 * 2 + 17),
+                                 (127, 1000)])
+def test_pack_levels_roundtrip_and_density(s, n):
+    import math
+    rng = np.random.RandomState(s)
+    level = jnp.asarray(rng.randint(0, s + 1, size=n).astype(np.uint8))
+    words = bp.pack_levels(level, s)
+    assert words.dtype == jnp.uint32
+    assert words.shape == (bp.level_words_len(n, s),)
+    got = bp.unpack_levels(words, n, s)
+    np.testing.assert_array_equal(np.asarray(got),
+                                  np.asarray(level).astype(np.int32))
+    # density: 32/(32//b) bits per element (exactly b when b | 32) plus at
+    # most one 128-lane word tile of padding
+    b = bp.level_bits(s)
+    k = 32 // b
+    assert words.size * 32 <= (n + k * 128) * (32 / k)
+
+
+def test_dithering_payload_is_bit_packed():
+    from byteps_tpu.ops.compressor.dithering import DitheringCompressor
+    comp = DitheringCompressor(s=15)
+    n = 4096
+    x = jnp.asarray(np.random.RandomState(1).randn(n).astype(np.float32))
+    payload, _ = comp.compress(x, comp.init_state(n))
+    # 4 bits/level at s=15: the level stream is n/2 bytes, not n
+    assert payload["level_words"].size * 4 == n // 2
+    out = comp.decompress(payload, n)
+    assert np.isfinite(np.asarray(out)).all()
