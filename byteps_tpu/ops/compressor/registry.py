@@ -70,6 +70,28 @@ def _get_bool(kw: dict, name: str, default: bool) -> bool:
     return bool(v)
 
 
+def parse_ef(kw: dict) -> bool:
+    """Shared EF-kwargs parse (JAX registry + PS wire must accept the
+    exact same strings — a divergence would make a config valid on one
+    plane and a ValueError on the other)."""
+    ef = (kw.get("ef") or kw.get("ef_type")
+          or kw.get("byteps_error_feedback_type"))
+    if ef and ef not in ("vanilla", "true", "1"):
+        raise ValueError(f"unknown error-feedback type {ef!r}")
+    return bool(ef)
+
+
+def parse_momentum(kw: dict) -> float:
+    """Shared momentum-kwargs parse; returns mu (0.0 = momentum off)."""
+    mom = (kw.get("momentum") or kw.get("momentum_type")
+           or kw.get("byteps_momentum_type"))
+    if not mom:
+        return 0.0
+    if mom not in ("nesterov", "true", "1"):
+        raise ValueError(f"unknown momentum type {mom!r}")
+    return float(kw.get("momentum_mu", kw.get("byteps_momentum_mu", 0.9)))
+
+
 def create(kwargs: dict, server: bool = False) -> InterCompressor:
     """Build the layered compressor from string kwargs.
 
@@ -87,19 +109,11 @@ def create(kwargs: dict, server: bool = False) -> InterCompressor:
             f"unknown compressor {ctype!r}; known: {sorted(_FACTORIES)}")
     comp = _FACTORIES[ctype](kw)
 
-    ef = (kw.get("ef") or kw.get("ef_type")
-          or kw.get("byteps_error_feedback_type"))
-    if ef:
-        if ef not in ("vanilla", "true", "1"):
-            raise ValueError(f"unknown error-feedback type {ef!r}")
+    if parse_ef(kw):
         comp = ErrorFeedback(comp)
 
-    mom = (kw.get("momentum") or kw.get("momentum_type")
-           or kw.get("byteps_momentum_type"))
-    if mom and not server:
-        if mom not in ("nesterov", "true", "1"):
-            raise ValueError(f"unknown momentum type {mom!r}")
-        mu = float(kw.get("momentum_mu", kw.get("byteps_momentum_mu", 0.9)))
+    mu = parse_momentum(kw)
+    if mu and not server:
         comp = NesterovMomentum(comp, mu=mu)
     return comp
 

@@ -120,13 +120,20 @@ class WireCompressor:
         # Worker-side vanilla error feedback (reference:
         # error_feedback.cc:22-34: grad += e; c = Compress(grad);
         # e = grad - Decompress(c)), per partition key.  The server never
-        # applies EF — it only sees the already-corrected payloads.
-        ef = (kwargs.get("ef") or kwargs.get("ef_type")
-              or kwargs.get("byteps_error_feedback_type"))
-        if ef and ef not in ("vanilla", "true", "1"):
-            raise ValueError(f"unknown error-feedback type {ef!r}")
-        self.ef = bool(ef)
+        # applies EF to PUSHES — it only sees corrected payloads (it does
+        # run EF on its own recompress leg, core/server.cc ALL_RECV).
+        from ..ops.compressor.registry import parse_ef, parse_momentum
+        self.ef = parse_ef(kwargs)
         self._err: Dict[int, np.ndarray] = {}
+        # Worker-side Nesterov momentum, applied BEFORE EF + compression
+        # (reference layering momentum -> ef -> compressor,
+        # compressor_registry.cc:39-56; momentum.cc:20-31: m = mu*m + g;
+        # g += mu*m).  Worker-only — the kwargs still ship to the server,
+        # which ignores momentum like the reference's server registry.
+        # Shared parse with the JAX-plane registry so both planes accept
+        # the exact same kwargs strings.
+        self.momentum_mu = parse_momentum(kwargs)
+        self._mom: Dict[int, np.ndarray] = {}
         self._rng: Dict[int, np.ndarray] = {}  # per-partition-key PRNG lanes
 
     def kwargs_string(self) -> str:
@@ -134,6 +141,9 @@ class WireCompressor:
         kw = {"compressor": self.name}
         if self.ef:
             kw["ef"] = "vanilla"
+        if self.momentum_mu:
+            kw["momentum"] = "nesterov"
+            kw["momentum_mu"] = repr(self.momentum_mu)
         if self.name == "onebit":
             kw["onebit_scaling"] = "1" if self.scaled else "0"
         if self.name in ("topk", "randomk"):
@@ -148,6 +158,14 @@ class WireCompressor:
     # -- encode -------------------------------------------------------------
     def encode(self, pkey: int, x: np.ndarray) -> bytes:
         x = np.ascontiguousarray(x, np.float32)
+        if self.momentum_mu:
+            # m = mu*m + g; g += mu*m (Nesterov) — before EF, matching the
+            # reference layering and the JAX plane's NesterovMomentum.
+            m = self._mom.get(pkey)
+            m = (self.momentum_mu * m + x) if m is not None \
+                and m.size == x.size else x.copy()
+            self._mom[pkey] = m
+            x = x + self.momentum_mu * m
         if not self.ef:
             return self._encode_raw(pkey, x)
         e = self._err.get(pkey)

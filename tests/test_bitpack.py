@@ -79,7 +79,6 @@ def test_onebit_uses_bitpack_wire():
 @pytest.mark.parametrize("s,n", [(1, 4096), (7, 5000), (15, 4096 * 2 + 17),
                                  (127, 1000)])
 def test_pack_levels_roundtrip_and_density(s, n):
-    import math
     rng = np.random.RandomState(s)
     level = jnp.asarray(rng.randint(0, s + 1, size=n).astype(np.uint8))
     words = bp.pack_levels(level, s)

@@ -74,6 +74,53 @@ def test_wire_codec_matches_jax_compressors():
     assert (got != 0).sum() == 32
 
 
+def test_wire_momentum_ef_layering_matches_reference_order():
+    """momentum -> EF -> compressor on the wire client, the reference
+    registry's layering (compressor_registry.cc:39-56; momentum.cc:20-31:
+    m = mu*m + g; g += mu*m) — replayed by hand over three rounds."""
+    kw = {"compressor": "onebit", "ef": "vanilla",
+          "momentum": "nesterov", "momentum_mu": "0.9"}
+    wc = wire.WireCompressor(kw)
+    assert "momentum=nesterov" in wc.kwargs_string()
+    rng = np.random.RandomState(5)
+    n = 256
+    m = np.zeros(n, np.float32)
+    e = np.zeros(n, np.float32)
+    for _ in range(3):
+        g = rng.randn(n).astype(np.float32)
+        blob = wc.encode(9, g)
+        m = np.float32(0.9) * m + g
+        gg = (g + np.float32(0.9) * m) + e
+        ref = wire.WireCompressor({"compressor": "onebit"})
+        want = wire.decode(ref.encode(0, gg), n)
+        e = gg - want
+        np.testing.assert_array_equal(wire.decode(blob, n), want)
+
+
+def test_momentum_onebit_through_server(ps_server):
+    """Full plumbing: momentum+EF+onebit kwargs ship to the server at
+    INIT; the server applies its EF but ignores momentum (worker-only,
+    like the reference's server registry), so the pull equals the
+    requantized momentum-corrected gradient."""
+    port = ps_server(num_workers=1)
+    kw = {"compressor": "onebit", "ef": "vanilla", "momentum": "nesterov"}
+    s = _sess(port, 0, partition_bytes=1 << 20)  # single partition
+    s.register_compressor(6, kw)
+    rng = np.random.RandomState(9)
+    sim = wire.WireCompressor(kw)
+    srv_err = np.zeros(512, np.float32)
+    for _ in range(3):
+        g = rng.randn(512).astype(np.float32)
+        got = s.push_pull(6, g)
+        pushed = wire.decode(sim.encode(0, g), g.size)
+        corrected = pushed + srv_err
+        req = wire.WireCompressor({"compressor": "onebit"})
+        want = wire.decode(req.encode(0, corrected), corrected.size)
+        srv_err = corrected - want
+        np.testing.assert_allclose(got, want, rtol=1e-6)
+    s.close()
+
+
 def test_dithering_wire_density_vs_elias_delta():
     """The dithering wire packs levels at ceil(log2(s+1)) bits; on a
     representative gradient its size must be within 1.3x of what the
