@@ -62,6 +62,10 @@ namespace bps_server {
 enum Cmd : uint8_t {
   kHello = 0, kInit = 1, kPush = 2, kPull = 3, kBarrier = 4,
   kShutdown = 5, kPing = 6,
+  kLrScale = 7,  // f32 payload: one-shot rescale of the server-side EF
+                 // error on every key (the reference's lr.s mechanism for
+                 // the server-side VanillaErrorFeedback; rank 0 sends it
+                 // once per LR change)
 };
 enum Status : uint8_t { kOk = 0, kError = 1 };
 enum WireDtype : uint8_t {
@@ -444,6 +448,28 @@ class Server {
         case kPing:
           Respond(conn, kOk, h.req_id, h.key, nullptr, 0);
           break;
+        case kLrScale: {
+          // Fan out to every engine: per-key state is engine-owned, so
+          // each engine rescales the ef_err of the keys assigned to it.
+          // Highest priority so (under scheduling) the rescale runs ahead
+          // of queued pushes; callers apply LR changes between steps.
+          for (int i = 0; i < engine_threads_; ++i) {
+            Task t;
+            t.cmd = h.cmd;
+            t.dtype = 0;
+            t.flags = 0;
+            t.req_id = h.req_id;
+            t.worker_id = h.worker_id;
+            t.key = 0;
+            t.payload = payload;  // copy per engine
+            t.conn = nullptr;     // the reader already acks
+            t.seq = seq_.fetch_add(1);
+            t.priority = UINT64_MAX;
+            queues_[i].Push(std::move(t));
+          }
+          Respond(conn, kOk, h.req_id, h.key, nullptr, 0);
+          break;
+        }
         case kBarrier:
           HandleBarrier(conn, h.req_id, h.key);
           break;
@@ -508,8 +534,25 @@ class Server {
         case kInit: HandleInit(t); break;
         case kPush: HandlePush(t); break;
         case kPull: HandlePull(t); break;
+        case kLrScale: HandleLrScale(t, idx); break;
         default: Respond(t.conn, kError, t.req_id, t.key, nullptr, 0);
       }
+    }
+  }
+
+  void HandleLrScale(Task& t, int idx) {
+    if (t.payload.size() < 4) return;
+    float scale = 1.0f;
+    std::memcpy(&scale, t.payload.data(), 4);
+    std::vector<uint64_t> keys;
+    {
+      std::lock_guard<std::mutex> lk(assign_mu_);
+      for (auto& kv : key_engine_)
+        if (kv.second == idx) keys.push_back(kv.first);
+    }
+    for (uint64_t k : keys) {
+      KeyState& ks = StateFor(k);
+      for (auto& e : ks.ef_err) e *= scale;
     }
   }
 

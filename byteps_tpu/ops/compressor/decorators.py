@@ -10,10 +10,15 @@ Both are `InterCompressor` wrappers whose extra buffers live in the
 functional `state`, replacing the reference's mutable `_error`/`_mom`
 members.  The vanilla-EF learning-rate rescale (the reference reads an
 mmap'd `lr.s` file written by the MXNet trainer,
-impl/vanilla_error_feedback.cc) becomes an explicit `lr_scale` entry in the
-state: when the training LR changes, call `set_lr_scale(opt_state,
-new_lr / prev_lr)` on the optimizer state between steps — no file I/O in
-the hot path.  With a constant LR the default 1.0 is already correct.
+impl/vanilla_error_feedback.cc: `grad += (pre_lr/cur_lr) * error;
+pre_lr = cur_lr`) becomes an explicit `lr_scale` entry in the state: when
+the training LR changes, call `set_lr_scale(opt_state,
+prev_lr / new_lr)` on the optimizer state between steps — no file I/O in
+the hot path.  The scale is consumed by the NEXT compress and resets to
+1.0, exactly the reference's one-shot `pre_lr = cur_lr`; with a constant
+LR the default 1.0 is already correct.  (The ratio is prev/new: the
+pending update `lr_prev * e` keeps its magnitude under the new LR when
+the carried error becomes `(lr_prev/lr_new) * e`.)
 """
 
 from __future__ import annotations
@@ -41,13 +46,15 @@ class ErrorFeedback(InterCompressor):
                 "lr_scale": jnp.ones((), jnp.float32)}
 
     def compress(self, buf: jax.Array, state: State) -> Tuple[Payload, State]:
-        # reference: UpdateGradient = grad += scaled error
+        # reference: UpdateGradient = grad += (pre_lr/cur_lr) * error
         corrected = buf.astype(jnp.float32) + state["lr_scale"] * state["error"]
         payload, inner_state = self.inner.compress(corrected, state["inner"])
         # reference: UpdateError = e = grad - Decompress(c)
         err = corrected - self.inner.decompress(payload, corrected.size)
+        # One-shot, like the reference's `pre_lr = cur_lr`: the scale must
+        # not keep multiplying every subsequent round's fresh error.
         return payload, {"inner": inner_state, "error": err,
-                         "lr_scale": state["lr_scale"]}
+                         "lr_scale": jnp.ones_like(state["lr_scale"])}
 
     def decompress(self, payload: Payload, n: int,
                    dtype=jnp.float32) -> jax.Array:
@@ -58,18 +65,21 @@ class ErrorFeedback(InterCompressor):
 
 
 def set_lr_scale(state: State, scale) -> State:
-    """Refresh every ErrorFeedback `lr_scale` entry in `state` (any pytree —
-    typically the whole optax opt_state) to `scale` = new_lr / prev_lr, the
-    reference's vanilla-EF LR-ratio rescale
-    (reference: impl/vanilla_error_feedback.cc, mxnet/__init__.py:326-331).
+    """Multiply every ErrorFeedback `lr_scale` entry in `state` (any pytree
+    — typically the whole optax opt_state) by `scale` = prev_lr / new_lr,
+    the reference's vanilla-EF LR-ratio rescale, consumed once by the next
+    compress (reference: impl/vanilla_error_feedback.cc `pre_lr/cur_lr`,
+    mxnet/__init__.py:326-331).  Multiplicative so consecutive calls with
+    no compress in between (e.g. a schedule boundary coinciding with a
+    skipped step) compose to r1*r2 — the same semantics as the wire and
+    server planes, which multiply the stored error directly.
     """
     from jax.tree_util import DictKey, tree_map_with_path
 
     def f(path, leaf):
         if any(isinstance(k, DictKey) and k.key == "lr_scale"
                for k in path):
-            return jnp.broadcast_to(
-                jnp.asarray(scale, jnp.float32), leaf.shape)
+            return leaf * jnp.asarray(scale, jnp.float32)
         return leaf
     return tree_map_with_path(f, state)
 

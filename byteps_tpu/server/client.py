@@ -38,7 +38,7 @@ _REQ = struct.Struct("<BBHIIQQ")   # cmd dtype flags req_id worker_id key len
 _RESP = struct.Struct("<BIQQ")     # status req_id key len
 
 CMD_HELLO, CMD_INIT, CMD_PUSH, CMD_PULL, CMD_BARRIER, CMD_SHUTDOWN, \
-    CMD_PING = range(7)
+    CMD_PING, CMD_LR_SCALE = range(8)
 
 # dtype byte on the wire (server.cc WireDtype)
 DT_F32, DT_RAW, DT_COMPRESSED, DT_SEED = 0, 1, 2, 3
@@ -417,6 +417,24 @@ class PSSession:
                    scheduling_credit=cfg.scheduling_credit,
                    min_compress_bytes=cfg.min_compress_bytes,
                    wire_conns=cfg.wire_conns)
+
+    def set_lr_scale(self, scale: float) -> None:
+        """One-shot EF-error rescale after a learning-rate change;
+        `scale` = prev_lr / new_lr (reference `lr.s` mechanism; see
+        WireCompressor.set_lr_scale).
+
+        Covers BOTH EF legs: the local worker-side errors, and — from
+        worker 0 only, so N workers don't compound the rescale N times —
+        the servers' recompress-leg errors via CMD_LR_SCALE.  Call between
+        steps on every worker (each owns its local errors).
+        """
+        for comp in self._compressors.values():
+            comp.set_lr_scale(scale)
+        if self.worker_id == 0:
+            payload = struct.pack("<f", float(scale))
+            for c in self.conns:
+                c.request(CMD_LR_SCALE, 0, payload,
+                          worker_id=self.worker_id)
 
     def register_compressor(self, declared_key: int, kwargs: dict) -> None:
         """Register an inter-node compressor for a tensor's PS traffic.

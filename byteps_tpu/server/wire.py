@@ -33,6 +33,7 @@ Wire layout (little-endian):
 from __future__ import annotations
 
 import struct
+import threading
 from typing import Dict, Optional, Tuple
 
 import numpy as np
@@ -98,7 +99,8 @@ class WireCompressor:
     """
 
     def __init__(self, kwargs: Dict[str, str]):
-        from ..ops.compressor.registry import _get, _get_bool  # shared parse
+        from ..ops.compressor.registry import (  # shared parse
+            _get, _get_bool, parse_ef, parse_momentum)
         ctype = (kwargs.get("compressor") or kwargs.get("compressor_type")
                  or kwargs.get("byteps_compressor_type"))
         if ctype not in _NAMES:
@@ -122,9 +124,12 @@ class WireCompressor:
         # e = grad - Decompress(c)), per partition key.  The server never
         # applies EF to PUSHES — it only sees corrected payloads (it does
         # run EF on its own recompress leg, core/server.cc ALL_RECV).
-        from ..ops.compressor.registry import parse_ef, parse_momentum
         self.ef = parse_ef(kwargs)
         self._err: Dict[int, np.ndarray] = {}
+        # Guards _err/_mom against concurrent encoders (different
+        # partition keys push from multiple threads) and set_lr_scale's
+        # iteration.
+        self._state_lock = threading.Lock()
         # Worker-side Nesterov momentum, applied BEFORE EF + compression
         # (reference layering momentum -> ef -> compressor,
         # compressor_registry.cc:39-56; momentum.cc:20-31: m = mu*m + g;
@@ -135,6 +140,19 @@ class WireCompressor:
         self.momentum_mu = parse_momentum(kwargs)
         self._mom: Dict[int, np.ndarray] = {}
         self._rng: Dict[int, np.ndarray] = {}  # per-partition-key PRNG lanes
+
+    def set_lr_scale(self, scale: float) -> None:
+        """Rescale the carried EF error once when the learning rate
+        changes — the reference's `lr.s` mechanism as an explicit API.
+        `scale` = prev_lr / new_lr (reference:
+        impl/vanilla_error_feedback.cc applies `pre_lr/cur_lr` then sets
+        `pre_lr = cur_lr`; multiplying the stored error once is the same
+        one-shot semantics, matching the JAX plane's
+        ops.compressor.set_lr_scale)."""
+        s = np.float32(scale)
+        with self._state_lock:
+            for k in self._err:
+                self._err[k] = self._err[k] * s
 
     def kwargs_string(self) -> str:
         """Canonical "k=v,k=v" form sent in the INIT payload."""
@@ -158,22 +176,31 @@ class WireCompressor:
     # -- encode -------------------------------------------------------------
     def encode(self, pkey: int, x: np.ndarray) -> bytes:
         x = np.ascontiguousarray(x, np.float32)
-        if self.momentum_mu:
-            # m = mu*m + g; g += mu*m (Nesterov) — before EF, matching the
-            # reference layering and the JAX plane's NesterovMomentum.
-            m = self._mom.get(pkey)
-            m = (self.momentum_mu * m + x) if m is not None \
-                and m.size == x.size else x.copy()
-            self._mom[pkey] = m
-            x = x + self.momentum_mu * m
-        if not self.ef:
+        if not (self.momentum_mu or self.ef):
             return self._encode_raw(pkey, x)
-        e = self._err.get(pkey)
-        if e is not None and e.size == x.size:
-            x = x + e
-        blob = self._encode_raw(pkey, x)
-        self._err[pkey] = x - decode(blob, x.size)
-        return blob
+        # One lock across the whole stateful read-correct-write: a
+        # set_lr_scale landing between the EF read and the error store
+        # would otherwise be silently overwritten by an error computed
+        # from the unscaled value.  Concurrent encodes on one
+        # WireCompressor are same-tensor re-pushes (one codec per declared
+        # key), which the session's sequential-use guard serializes anyway.
+        with self._state_lock:
+            if self.momentum_mu:
+                # m = mu*m + g; g += mu*m (Nesterov) — before EF, matching
+                # the reference layering and the JAX NesterovMomentum.
+                m = self._mom.get(pkey)
+                m = (self.momentum_mu * m + x) if m is not None \
+                    and m.size == x.size else x.copy()
+                self._mom[pkey] = m
+                x = x + self.momentum_mu * m
+            if not self.ef:
+                return self._encode_raw(pkey, x)
+            e = self._err.get(pkey)
+            if e is not None and e.size == x.size:
+                x = x + e
+            blob = self._encode_raw(pkey, x)
+            self._err[pkey] = x - decode(blob, x.size)
+            return blob
 
     def _encode_raw(self, pkey: int, x: np.ndarray) -> bytes:
         n = x.size

@@ -363,6 +363,26 @@ def test_set_lr_scale():
                                   np.zeros(16, np.float32))
 
 
+def test_ef_lr_scale_is_one_shot():
+    """The reference applies pre_lr/cur_lr ONCE then sets pre_lr = cur_lr
+    (vanilla_error_feedback.cc UpdateGradient); the lr_scale entry must be
+    consumed by one compress and reset to 1, never keep multiplying every
+    later round's fresh error."""
+    comp = C.ErrorFeedback(C.TopkCompressor(k=2))
+    g = jnp.asarray(np.linspace(-1, 1, 8).astype(np.float32))
+    st = comp.init_state(8)
+    _, st = comp.compress(g, st)             # error now nonzero
+    err = np.asarray(st["error"])
+    assert float(np.abs(err).sum()) > 0
+    st = C.set_lr_scale(st, 2.0)
+    payload, st = comp.compress(g, st)       # applies 2*e once
+    corrected = np.asarray(g) + 2.0 * err
+    want_err = corrected - np.asarray(comp.decompress(payload, 8))
+    np.testing.assert_allclose(np.asarray(st["error"]), want_err,
+                               rtol=1e-6)
+    assert float(st["lr_scale"]) == 1.0      # consumed (pre_lr = cur_lr)
+
+
 def test_tiny_buckets_skip_expanding_compression(mesh8):
     """A bucket whose compressed payload would EXCEED its raw bytes (the
     sign stream's 512B tile floor) must ship raw — compression is a

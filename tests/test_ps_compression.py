@@ -121,6 +121,55 @@ def test_momentum_onebit_through_server(ps_server):
     s.close()
 
 
+def test_server_ef_lr_rescale_through_wire(ps_server):
+    """CMD_LR_SCALE rescales the SERVER's recompress-leg EF error once:
+    after two rounds (server error nonzero) and a set_lr_scale(0.5) from
+    worker 0, round three must match a replay whose server error was
+    halved exactly once."""
+    port = ps_server(num_workers=1)
+    kw = {"compressor": "onebit", "ef": "vanilla"}
+    s = _sess(port, 0, partition_bytes=1 << 20)
+    s.register_compressor(7, kw)
+    rng = np.random.RandomState(13)
+    sim = wire.WireCompressor(kw)
+    srv_err = np.zeros(256, np.float32)
+    grads = [rng.randn(256).astype(np.float32) for _ in range(3)]
+
+    def expect(g, err):
+        pushed = wire.decode(sim.encode(0, g), g.size)
+        corrected = pushed + err
+        req = wire.WireCompressor({"compressor": "onebit"})
+        got = wire.decode(req.encode(0, corrected), corrected.size)
+        return got, corrected - got
+
+    for r, g in enumerate(grads):
+        if r == 2:
+            s.set_lr_scale(0.5)     # local errors AND the server's
+            sim.set_lr_scale(0.5)
+            srv_err = srv_err * np.float32(0.5)
+        got = s.push_pull(7, g)
+        want, srv_err = expect(g, srv_err)
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-7)
+    s.close()
+
+
+def test_wire_ef_lr_rescale():
+    """set_lr_scale rescales the carried error, the reference's lr.s
+    contract: after scale s, the next push's correction uses s*e."""
+    kw = {"compressor": "onebit", "ef": "vanilla"}
+    wc = wire.WireCompressor(kw)
+    rng = np.random.RandomState(2)
+    g1 = rng.randn(128).astype(np.float32)
+    blob1 = wc.encode(4, g1)
+    e1 = g1 - wire.decode(blob1, g1.size)
+    wc.set_lr_scale(0.5)
+    g2 = rng.randn(128).astype(np.float32)
+    blob2 = wc.encode(4, g2)
+    ref = wire.WireCompressor({"compressor": "onebit"})
+    want = wire.decode(ref.encode(0, g2 + np.float32(0.5) * e1), g2.size)
+    np.testing.assert_array_equal(wire.decode(blob2, g2.size), want)
+
+
 def test_dithering_wire_density_vs_elias_delta():
     """The dithering wire packs levels at ceil(log2(s+1)) bits; on a
     representative gradient its size must be within 1.3x of what the
