@@ -519,18 +519,19 @@ def _init_backend_or_fallback(timeout_s: float) -> None:
         err = _init_inprocess(120.0)
         if not err:
             return
-    import subprocess
-    import sys
+    _cpu_last_resort(err)
 
+
+def _cpu_fallback_env(err: str) -> dict:
+    """Hermetic CPU child env: ONE virtual device, matching the real
+    bench's single-chip shape (8 devices time-slicing one core would turn
+    the efficiency ratio into an oversubscription artifact) — and the
+    small model forced (a BENCH_MODEL the driver set for TPU would be
+    infeasible on CPU).  Machinery mode keeps 8 devices — its metric
+    compares collective strategies over a real mesh axis."""
     from byteps_tpu.utils.hermetic import (cpu_subprocess_env,
                                            force_host_device_count)
 
-    # Flagship fallback: ONE virtual CPU device, matching the real bench's
-    # single-chip shape (8 devices time-slicing one core would turn the
-    # efficiency ratio into an oversubscription artifact) — and force the
-    # small model (a BENCH_MODEL the driver set for TPU would be infeasible
-    # on CPU).  Machinery fallback: keep 8 devices — its metric compares
-    # collective strategies over a real mesh axis and is meaningless on 1.
     machinery = os.environ.get("BENCH_MACHINERY", "0") == "1"
     env = cpu_subprocess_env({
         "BENCH_CPU_FALLBACK_CHILD": "1",
@@ -540,16 +541,41 @@ def _init_backend_or_fallback(timeout_s: float) -> None:
     if not machinery:
         env["BENCH_SMALL"] = "1"
     force_host_device_count(env, 8 if machinery else 1)
+    return env
+
+
+def _run_bench_child(env: dict, timeout: float) -> tuple:
+    """Run this bench script in a subprocess; (rc, captured stdout).
+
+    Stdout is captured so the PARENT controls what the driver sees —
+    exactly one JSON line per run even when a child half-emits before
+    dying.  The child's stderr tail is forwarded to our stderr for
+    debuggability.  A timeout kills the child (rc=124)."""
+    import subprocess
+    import sys
+
     try:
         proc = subprocess.run([sys.executable, os.path.abspath(__file__)],
-                              env=env, timeout=1800)
-        rc = proc.returncode
-    except subprocess.TimeoutExpired:
-        _error_record("cpu-fallback bench child exceeded 1800s")
-        os._exit(3)
-    if rc != 0:
-        _error_record(f"cpu-fallback bench child failed (rc={rc})")
-    os._exit(rc)
+                              env=env, timeout=timeout,
+                              capture_output=True, text=True)
+        rc, out, errtxt = proc.returncode, proc.stdout, proc.stderr
+    except subprocess.TimeoutExpired as e:
+        def _s(b):
+            return (b.decode(errors="replace")
+                    if isinstance(b, bytes) else (b or ""))
+        rc, out, errtxt = 124, _s(e.stdout), _s(e.stderr)
+    if errtxt:
+        sys.stderr.write(errtxt[-3000:])
+        sys.stderr.flush()
+    return rc, out
+
+
+def _emit_child_result(rc: int, out: str) -> None:
+    """Print the child's JSON line and exit 0 on success; return otherwise
+    so the caller can try the next recovery step."""
+    if rc == 0 and out.strip():
+        print(out.strip().splitlines()[-1], flush=True)
+        os._exit(0)
 
 
 def main():
@@ -566,10 +592,65 @@ def main():
         bench_machinery()
     elif os.environ.get("BENCH_PS", "0") == "1":
         bench_ps()           # host-only: no device backend involved
-    else:
-        _init_backend_or_fallback(float(os.environ.get("BENCH_INIT_TIMEOUT",
-                                                       "480")))
+    elif (os.environ.get("BENCH_EXEC_CHILD", "0") == "1"
+          or os.environ.get("BENCH_FORCE_CPU", "0") == "1"):
+        # Execution child (or explicit local CPU mode): actually run the
+        # bench; failures propagate as a nonzero rc for the parent.
+        if os.environ.get("BENCH_CPU_FALLBACK_CHILD", "0") == "1":
+            import jax
+            jax.config.update("jax_platforms", "cpu")
         bench_flagship()
+    else:
+        _flagship_orchestrate()
+
+
+def _cpu_last_resort(reason: str) -> None:
+    """Final recovery step: a hermetic CPU child, honestly labelled.  The
+    bench must produce a number regardless of tunnel state — this is the
+    round-3 postmortem guarantee.  Never returns."""
+    env = _cpu_fallback_env(reason)
+    env["BENCH_EXEC_CHILD"] = "1"
+    rc, out = _run_bench_child(env, timeout=1800)
+    _emit_child_result(rc, out)
+    _error_record(f"cpu-fallback bench child failed (rc={rc}): "
+                  f"{out.strip()[-200:]}")
+    os._exit(3)
+
+
+def _flagship_orchestrate() -> None:
+    """Drive the flagship bench from a backend-free parent.
+
+    The parent NEVER initializes a device backend: each attempt runs in a
+    disposable child, so a failed attempt releases the chip and the next
+    child can grab it (an in-process init would hold the TPU's exclusive
+    per-process lock across the retry).  Recovery ladder: device bench ->
+    conservative-config device bench (skipped when the first attempt
+    TIMED OUT — a wedge would just wedge again) -> hermetic CPU child.
+    Contract for the driver: exactly one JSON line; rc=0 iff it is a real
+    measurement, rc=3 with an error record otherwise.
+    """
+    timeout_s = float(os.environ.get("BENCH_INIT_TIMEOUT", "480"))
+    err = _probe_backend_subprocess(time.time() + timeout_s)
+    if err:
+        _cpu_last_resort(err)
+
+    env = dict(os.environ)
+    env["BENCH_EXEC_CHILD"] = "1"
+    rc, out = _run_bench_child(env, timeout=1500)
+    _emit_child_result(rc, out)
+    if rc != 124:
+        # Fast failure (not a wedge): one retry with the conservative
+        # config (classic full-logits CE, dense attention, full remat) in
+        # case a newer tuned default misbehaves on the real chip.
+        env.update({"BENCH_CE_CHUNK": "0", "BENCH_ATTN": "dense",
+                    "BENCH_REMAT_POLICY": "none",
+                    "BENCH_NOTE": ("conservative-retry: default config "
+                                   f"failed in child (rc={rc})")})
+        rc, out = _run_bench_child(env, timeout=1200)
+        _emit_child_result(rc, out)
+    # Device attempts exhausted (wedged after a healthy probe, or both
+    # configs failed): still record a real number.
+    _cpu_last_resort(f"device bench attempts failed (last rc={rc})")
 
 
 if __name__ == "__main__":
