@@ -519,23 +519,25 @@ def _init_backend_or_fallback(timeout_s: float) -> None:
         err = _init_inprocess(120.0)
         if not err:
             return
-    _cpu_last_resort(err)
+    _cpu_last_resort(f"device backend unavailable ({err})")
 
 
-def _cpu_fallback_env(err: str) -> dict:
+def _cpu_fallback_env(reason: str) -> dict:
     """Hermetic CPU child env: ONE virtual device, matching the real
     bench's single-chip shape (8 devices time-slicing one core would turn
     the efficiency ratio into an oversubscription artifact) — and the
     small model forced (a BENCH_MODEL the driver set for TPU would be
     infeasible on CPU).  Machinery mode keeps 8 devices — its metric
-    compares collective strategies over a real mesh axis."""
+    compares collective strategies over a real mesh axis.  `reason` must
+    say WHY the fallback ran (tunnel outage vs device-side bench failure
+    — the note is the record's provenance label)."""
     from byteps_tpu.utils.hermetic import (cpu_subprocess_env,
                                            force_host_device_count)
 
     machinery = os.environ.get("BENCH_MACHINERY", "0") == "1"
     env = cpu_subprocess_env({
         "BENCH_CPU_FALLBACK_CHILD": "1",
-        "BENCH_NOTE": f"cpu-fallback: device backend unavailable ({err})",
+        "BENCH_NOTE": f"cpu-fallback: {reason}",
     })
     env.pop("BENCH_MODEL", None)
     if not machinery:
@@ -604,13 +606,13 @@ def main():
         _flagship_orchestrate()
 
 
-def _cpu_last_resort(reason: str) -> None:
+def _cpu_last_resort(reason: str, timeout: float = 1800.0) -> None:
     """Final recovery step: a hermetic CPU child, honestly labelled.  The
     bench must produce a number regardless of tunnel state — this is the
     round-3 postmortem guarantee.  Never returns."""
     env = _cpu_fallback_env(reason)
     env["BENCH_EXEC_CHILD"] = "1"
-    rc, out = _run_bench_child(env, timeout=1800)
+    rc, out = _run_bench_child(env, timeout=timeout)
     _emit_child_result(rc, out)
     _error_record(f"cpu-fallback bench child failed (rc={rc}): "
                   f"{out.strip()[-200:]}")
@@ -626,17 +628,29 @@ def _flagship_orchestrate() -> None:
     per-process lock across the retry).  Recovery ladder: device bench ->
     conservative-config device bench (skipped when the first attempt
     TIMED OUT — a wedge would just wedge again) -> hermetic CPU child.
-    Contract for the driver: exactly one JSON line; rc=0 iff it is a real
+    The whole ladder fits BENCH_TOTAL_BUDGET seconds (default 2200, within
+    the previous probe+fallback bound, so an external driver timeout tuned
+    to the old behavior still sees the guaranteed JSON line).  Contract
+    for the driver: exactly one JSON line; rc=0 iff it is a real
     measurement, rc=3 with an error record otherwise.
     """
-    timeout_s = float(os.environ.get("BENCH_INIT_TIMEOUT", "480"))
+    budget = float(os.environ.get("BENCH_TOTAL_BUDGET", "2200"))
+    deadline = time.time() + budget
+    cpu_reserve = 700.0   # always leave room for the guaranteed CPU rung
+
+    def remaining(reserve: float) -> float:
+        return max(60.0, deadline - time.time() - reserve)
+
+    timeout_s = min(float(os.environ.get("BENCH_INIT_TIMEOUT", "480")),
+                    remaining(cpu_reserve + 600))
     err = _probe_backend_subprocess(time.time() + timeout_s)
     if err:
-        _cpu_last_resort(err)
+        _cpu_last_resort(f"device backend unavailable ({err})",
+                         timeout=remaining(0))
 
     env = dict(os.environ)
     env["BENCH_EXEC_CHILD"] = "1"
-    rc, out = _run_bench_child(env, timeout=1500)
+    rc, out = _run_bench_child(env, timeout=remaining(cpu_reserve + 400))
     _emit_child_result(rc, out)
     if rc != 124:
         # Fast failure (not a wedge): one retry with the conservative
@@ -646,11 +660,12 @@ def _flagship_orchestrate() -> None:
                     "BENCH_REMAT_POLICY": "none",
                     "BENCH_NOTE": ("conservative-retry: default config "
                                    f"failed in child (rc={rc})")})
-        rc, out = _run_bench_child(env, timeout=1200)
+        rc, out = _run_bench_child(env, timeout=remaining(cpu_reserve))
         _emit_child_result(rc, out)
     # Device attempts exhausted (wedged after a healthy probe, or both
     # configs failed): still record a real number.
-    _cpu_last_resort(f"device bench attempts failed (last rc={rc})")
+    _cpu_last_resort(f"device bench attempts failed (last rc={rc})",
+                     timeout=remaining(0))
 
 
 if __name__ == "__main__":

@@ -72,6 +72,32 @@ def warmup_schedule(base_lr: float, warmup_steps: int,
     return optax.join_schedules([ramp, after], [warmup_steps])
 
 
+class EFLRScaleCallback(Callback):
+    """Keep ErrorFeedback's carried error consistent with a changing
+    learning rate: call `on_step` each step; when the schedule's LR
+    changes it applies the reference's one-shot `prev_lr/new_lr` rescale
+    to every EF state inside the optimizer state
+    (ops.compressor.set_lr_scale; reference: the lr.s mmap written by the
+    MXNet trainer, impl/vanilla_error_feedback.cc,
+    mxnet/__init__.py:326-331 — here the schedule is known in-process, so
+    no file plumbing).
+
+        opt_state = cb.on_step(step, opt_state)   # before the train step
+    """
+
+    def __init__(self, schedule: optax.Schedule):
+        self.schedule = schedule
+        self._prev: Optional[float] = None
+
+    def on_step(self, step: int, opt_state: PyTree) -> PyTree:
+        from .ops.compressor import set_lr_scale
+        lr = float(self.schedule(step))
+        if self._prev is not None and lr > 0 and lr != self._prev:
+            opt_state = set_lr_scale(opt_state, self._prev / lr)
+        self._prev = lr
+        return opt_state
+
+
 def scaled_lr(base_lr: float, size: Optional[int] = None) -> float:
     """Linear LR scaling by world size (the reference multiplies lr by
     hvd.size() in its examples)."""

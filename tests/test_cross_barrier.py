@@ -70,6 +70,25 @@ def test_warmup_schedule():
     assert float(sched2(11)) == pytest.approx(0.25)
 
 
+def test_ef_lr_scale_callback():
+    """On an LR change the callback applies the one-shot prev/new rescale
+    to every EF lr_scale entry in the optimizer state; constant-LR steps
+    leave the state untouched."""
+    from byteps_tpu.ops import compressor as C
+    comp = C.ErrorFeedback(C.TopkCompressor(k=2))
+    opt_state = {"comp": comp.init_state(8)}
+    sched = optax.piecewise_constant_schedule(
+        1.0, {2: 0.5})   # lr: 1.0, 1.0, 0.5, 0.5...
+    cb = callbacks.EFLRScaleCallback(sched)
+    opt_state = cb.on_step(0, opt_state)
+    opt_state = cb.on_step(1, opt_state)
+    assert float(opt_state["comp"]["lr_scale"]) == 1.0   # no change yet
+    opt_state = cb.on_step(2, opt_state)                 # 1.0 -> 0.5
+    assert float(opt_state["comp"]["lr_scale"]) == 2.0   # prev/new
+    opt_state = cb.on_step(3, opt_state)
+    assert float(opt_state["comp"]["lr_scale"]) == 2.0   # constant after
+
+
 def test_broadcast_callback(bps_initialized):
     cb = callbacks.BroadcastGlobalVariablesCallback(0)
     state = {"w": jnp.ones(3)}
