@@ -92,7 +92,12 @@ class EFLRScaleCallback(Callback):
     def on_step(self, step: int, opt_state: PyTree) -> PyTree:
         from .ops.compressor import set_lr_scale
         lr = float(self.schedule(step))
-        if self._prev is not None and lr > 0 and lr != self._prev:
+        # Both endpoints must be positive: warmup schedules commonly start
+        # at lr=0, and a 0/new_lr scale would zero the carried EF error
+        # (permanently — the scale one-shot resets after the next
+        # compress) instead of rescaling it.
+        if (self._prev is not None and self._prev > 0 and lr > 0
+                and lr != self._prev):
             opt_state = set_lr_scale(opt_state, self._prev / lr)
         self._prev = lr
         return opt_state

@@ -61,10 +61,14 @@ class TransformerConfig:
     remat: bool = True                 # per-layer rematerialisation
     # What the per-layer checkpoint may keep: "none" saves only layer
     # inputs (max recompute, min HBM); "dots" saves matmul outputs
-    # (skips re-running the MXU work in backward — the usual best
-    # FLOPs/HBM trade on TPU); "dots_no_batch" additionally drops
-    # batch-dim-carrying dots.
-    remat_policy: str = "none"         # "none" | "dots" | "dots_no_batch"
+    # (skips re-running the MXU work in backward but keeps the O(S²) and
+    # O(4D) tensors — OOMs first at large batch); "dots_no_batch" drops
+    # batch-dim-carrying dots; "proj" saves only the O(B·S·D) projection
+    # outputs (qkv / attn ctx+proj / ffn down) and recomputes attention
+    # logits + FFN-up in backward — fits where "dots" OOMs at large
+    # batch while skipping most of full remat's recompute
+    # (measurements: docs/performance.md).
+    remat_policy: str = "none"    # "none" | "dots" | "dots_no_batch" | "proj"
     attn_impl: str = "dense"           # "dense" | "flash" | "ring" (sp)
     # Fused LM-head cross-entropy: > 0 streams the readout matmul + softmax
     # in row chunks of this size so the [B*S, vocab] logits are never
@@ -322,6 +326,17 @@ def flash_attention_fn(q, k, v, causal: bool, strict: bool = False):
 _ATTN_IMPLS = {"dense": dense_attention, "flash": flash_attention_fn}
 
 
+def _ckpt_name(x, name: str):
+    """Tag an intermediate for name-based remat policies.
+
+    A no-op unless the enclosing `jax.checkpoint` uses a name-aware policy
+    (remat_policy="proj" below); then the tagged tensors are the ONLY ones
+    saved and everything else is recomputed in backward.
+    """
+    from jax import ad_checkpoint
+    return ad_checkpoint.checkpoint_name(x, name)
+
+
 def _block(x, lp, cfg: TransformerConfig, attn_fn):
     """One transformer block.  x: [B, S, D]; lp: this layer's param slice."""
     dt = cfg.dtype
@@ -337,8 +352,9 @@ def _block(x, lp, cfg: TransformerConfig, attn_fn):
         return t if b is None else t + b
 
     h = norm(x, lp["ln1_scale"], bias("ln1_bias"))
-    qkv = add_bias(jnp.einsum("bsd,de->bse", h, lp["qkv_w"].astype(dt)),
-                   "qkv_b")
+    qkv = _ckpt_name(
+        add_bias(jnp.einsum("bsd,de->bse", h, lp["qkv_w"].astype(dt)),
+                 "qkv_b"), "qkv")
     q, k, v = jnp.split(qkv, [H * Dh, (H + Hkv) * Dh], axis=-1)
 
     def heads(t):
@@ -353,10 +369,11 @@ def _block(x, lp, cfg: TransformerConfig, attn_fn):
         k = jnp.repeat(k, H // Hkv, axis=1)
         v = jnp.repeat(v, H // Hkv, axis=1)
     attn = attn_fn(q, k, v, cfg.causal)
-    attn = attn.transpose(0, 2, 1, 3).reshape(B, S, -1)
-    attn = add_bias(
+    attn = _ckpt_name(attn.transpose(0, 2, 1, 3).reshape(B, S, -1),
+                      "attn_ctx")
+    attn = _ckpt_name(add_bias(
         jnp.einsum("bse,ed->bsd", attn, lp["attn_out_w"].astype(dt)),
-        "attn_out_b")
+        "attn_out_b"), "attn_proj")
     x = x + attn
 
     h = norm(x, lp["ln2_scale"], bias("ln2_bias"))
@@ -367,8 +384,9 @@ def _block(x, lp, cfg: TransformerConfig, attn_fn):
         h = jax.nn.silu(gate) * up
     else:
         h = jax.nn.gelu(up)
-    h = add_bias(jnp.einsum("bsf,fd->bsd", h, lp["mlp_out_w"].astype(dt)),
-                 "mlp_out_b")
+    h = _ckpt_name(
+        add_bias(jnp.einsum("bsf,fd->bsd", h, lp["mlp_out_w"].astype(dt)),
+                 "mlp_out_b"), "ffn_out")
     return x + h
 
 
@@ -408,6 +426,17 @@ def forward_hidden(params: PyTree, tokens: jax.Array, cfg: TransformerConfig,
             "dots": jax.checkpoint_policies.checkpoint_dots,
             "dots_no_batch":
                 jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+            # Selective "minimal" remat (the transformer sweet spot): save
+            # only the model-dim projection outputs — qkv, attention
+            # context/projection, ffn down — which are O(B·S·D), and
+            # recompute the expensive-to-store pieces (S x S attention
+            # logits/probs, the 4D-wide FFN up + activation, the f32 norm
+            # intermediates) in backward.  vs full remat ("none") this
+            # skips re-running ~2/3 of the matmul FLOPs; vs "dots" it
+            # avoids saving the O(B·H·S²) and O(B·S·4D) tensors that blow
+            # HBM at large batch.
+            "proj": jax.checkpoint_policies.save_only_these_names(
+                "qkv", "attn_ctx", "attn_proj", "ffn_out"),
         }
         if cfg.remat_policy not in policies:
             raise ValueError(f"remat_policy={cfg.remat_policy!r}; "

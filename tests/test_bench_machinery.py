@@ -53,6 +53,7 @@ def _run_bench():
     return json.loads(r.stdout.strip().splitlines()[-1])
 
 
+@pytest.mark.slow
 def test_machinery_bench_bucketed_beats_naive():
     """Wall-clock: bucketed >= naive in the small-leaves regime.  Retries
     absorb CPU timing noise (observed band ~1.05-1.17x on an idle virtual

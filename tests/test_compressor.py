@@ -151,6 +151,7 @@ def test_dithering_matches_numpy(grad, partition, normalize):
     np.testing.assert_allclose(np.asarray(out), expect, rtol=1e-5, atol=1e-7)
 
 
+@pytest.mark.slow
 def test_dithering_unbiased():
     """Stochastic rounding must be unbiased in expectation."""
     comp = C.DitheringCompressor(s=4, seed=11)

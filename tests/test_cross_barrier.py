@@ -89,6 +89,21 @@ def test_ef_lr_scale_callback():
     assert float(opt_state["comp"]["lr_scale"]) == 2.0   # constant after
 
 
+def test_ef_lr_scale_callback_zero_warmup():
+    """A schedule that starts at lr=0 (standard warmup) must NOT produce a
+    0/new_lr rescale — that would zero the carried EF error permanently."""
+    from byteps_tpu.ops import compressor as C
+    comp = C.ErrorFeedback(C.TopkCompressor(k=2))
+    opt_state = {"comp": comp.init_state(8)}
+    sched = optax.linear_schedule(0.0, 1.0, 4)   # lr: 0, .25, .5, .75, 1
+    cb = callbacks.EFLRScaleCallback(sched)
+    opt_state = cb.on_step(0, opt_state)         # lr=0 recorded
+    opt_state = cb.on_step(1, opt_state)         # 0 -> 0.25: must skip
+    assert float(opt_state["comp"]["lr_scale"]) == 1.0
+    opt_state = cb.on_step(2, opt_state)         # 0.25 -> 0.5: rescale
+    assert float(opt_state["comp"]["lr_scale"]) == pytest.approx(0.5)
+
+
 def test_broadcast_callback(bps_initialized):
     cb = callbacks.BroadcastGlobalVariablesCallback(0)
     state = {"w": jnp.ones(3)}

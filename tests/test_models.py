@@ -148,7 +148,7 @@ def test_transformer_remat_policies_match():
     params = tfm.init_params(jax.random.key(1), cfg_n)
     toks = jax.random.randint(jax.random.key(2), (2, 12), 0, cfg_n.vocab_size)
     g_n = jax.grad(tfm.loss_fn)(params, (toks, toks), cfg_n)
-    for pol in ("dots", "dots_no_batch"):
+    for pol in ("dots", "dots_no_batch", "proj"):
         cfg_p = tfm.get_config("tiny", remat=True, remat_policy=pol,
                                dtype=jnp.float32)
         g_p = jax.grad(tfm.loss_fn)(params, (toks, toks), cfg_p)
@@ -238,6 +238,7 @@ def test_cnn_forward(name, num_classes):
     assert jnp.isfinite(logits).all()
 
 
+@pytest.mark.slow
 def test_resnet_dp_training_step(mesh8):
     model = models.create_cnn("resnet18", num_classes=10)
     x = jnp.ones((8, 32, 32, 3))
