@@ -24,6 +24,9 @@ Modes:
   BENCH_PS=1         PS wire goodput through the real C++ server over
                      loopback TCP (reference analog: the ps-lite transport
                      benchmark in .travis.yml:29-34)
+  BENCH_CNN=<name>   image-model throughput (resnet50 / vgg16 ...), fp32 —
+                     the reference's other headline rows (reference:
+                     docs/performance.md:5-26); BENCH_CNN_BATCH per chip
   BENCH_SMALL=1      shrink the model for quick local runs
   BENCH_FORCE_CPU=1  8 virtual CPU devices
 
@@ -68,6 +71,24 @@ def _note() -> dict:
     """Provenance note for the detail payload (set by the CPU fallback)."""
     n = os.environ.get("BENCH_NOTE")
     return {"note": n} if n else {}
+
+
+def _time_steps(fn, params, opt_state, batch, n, per_step):
+    """Shared timing harness: warmup+compile step, then n timed steps.
+
+    `fn(params, opt_state, batch) -> (params, opt_state, loss)`; returns
+    units/sec where one step advances `per_step` units (tokens, images).
+    The `float(loss)` every step is a HARD device sync — async runtimes
+    (and the axon relay, where block_until_ready does not force chained
+    execution) otherwise report dispatch rate, not execution rate.
+    """
+    params, opt_state, loss = fn(params, opt_state, batch)
+    float(loss)  # warmup + compile
+    t0 = time.perf_counter()
+    for _ in range(n):
+        params, opt_state, loss = fn(params, opt_state, batch)
+        float(loss)
+    return n * per_step / (time.perf_counter() - t0)
 
 
 def bench_flagship():
@@ -121,16 +142,6 @@ def bench_flagship():
     def loss_fn(p, b):
         return tfm.loss_fn(p, b, cfg)
 
-    def time_steps(step, params, opt_state, n):
-        params, opt_state, loss = step(params, opt_state, (toks, tgts))
-        float(loss)  # warmup + compile
-        t0 = time.perf_counter()
-        for _ in range(n):
-            params, opt_state, loss = step(params, opt_state, (toks, tgts))
-            float(loss)  # per-step sync: async runtimes may otherwise report
-            # dispatch rate, not execution rate
-        return n * batch * seq / (time.perf_counter() - t0)
-
     # Framework path: DistributedOptimizer (bucketed priority all-reduce),
     # donated buffers — the deployment configuration.  Donation consumes
     # the input arrays, so the framework path runs on its own copies and
@@ -138,15 +149,14 @@ def bench_flagship():
     import jax.numpy as jnp
     opt = bps.DistributedOptimizer(optax.adamw(1e-4))
     step = bps.build_train_step(loss_fn, opt, mesh, donate=True)
-    fw_tps = time_steps(step, jax.tree.map(jnp.copy, params),
-                        opt.init(params), steps)
+    fw_tps = _time_steps(step, jax.tree.map(jnp.copy, params),
+                         opt.init(params), (toks, tgts), steps, batch * seq)
 
     # Ideal path: same model/optimizer, no distribution framework, one shard
     # of the global batch on one device -> ideal per-chip throughput.
     raw_opt = optax.adamw(1e-4)
     n_dev = jax.device_count()
     rb = max(1, batch // n_dev)
-    rtoks, rtgts = toks[:rb], tgts[:rb]
 
     def raw_step(p, s, b):
         loss, g = jax.value_and_grad(loss_fn)(p, b)
@@ -154,13 +164,8 @@ def bench_flagship():
         return optax.apply_updates(p, u), s, loss
 
     rstep = jax.jit(raw_step, donate_argnums=(0, 1))
-    p, s, l = rstep(params, raw_opt.init(params), (rtoks, rtgts))
-    float(l)
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        p, s, l = rstep(p, s, (rtoks, rtgts))
-        float(l)
-    raw_tps = steps * rb * seq / (time.perf_counter() - t0)
+    raw_tps = _time_steps(rstep, params, raw_opt.init(params),
+                          (toks[:rb], tgts[:rb]), steps, rb * seq)
 
     efficiency = fw_tps / (raw_tps * n_dev)
     tps_per_chip = fw_tps / n_dev
@@ -186,6 +191,88 @@ def bench_flagship():
             "ce_chunk_rows": cfg.ce_chunk_rows,
             "attn_impl": cfg.attn_impl,
             "remat_policy": cfg.remat_policy,
+            **_note(),
+        },
+    }))
+
+
+def bench_cnn():
+    """Image-model DP training throughput: full framework path vs the
+    raw-jit roofline, images/sec.
+
+    Mirrors the reference's other headline rows — ResNet-50 / VGG-16
+    throughput at BS=64/GPU, fp32 (reference: docs/performance.md:5-26,
+    BASELINE.md) — with the flagship bench's methodology: identical
+    model/optimizer on both sides of the ratio, hard device sync every
+    step, efficiency = framework / ideal and vs_baseline against the
+    reference's 0.90 scaling-efficiency bar.  fp32 like the reference
+    rows (the MXU runs f32 matmuls in multi-pass emulation, so absolute
+    images/sec is conservative; the RATIO is what the metric carries).
+    """
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    import byteps_tpu as bps
+    from byteps_tpu import models
+
+    name = os.environ.get("BENCH_CNN", "resnet50")
+    on_tpu = jax.devices()[0].platform == "tpu"
+    small = os.environ.get("BENCH_SMALL", "0") == "1" or not on_tpu
+    if small:
+        # CPU-feasible stand-in keeping the same code path: shallow
+        # member of the same family, CIFAR-sized images.
+        name = "vgg16" if "vgg" in name else "resnet18"
+        batch_per, hw, steps = 8, 32, 3
+    else:
+        batch_per = int(os.environ.get("BENCH_CNN_BATCH", "64"))
+        hw, steps = 224, 10
+    n_dev = jax.device_count()
+    batch = batch_per * n_dev
+
+    # dtype=f32 explicitly: the model zoo defaults to bf16 compute, but
+    # the reference rows being mirrored are fp32.
+    model = models.create_cnn(name, num_classes=1000, dtype=jnp.float32)
+    x0 = jnp.ones((2, hw, hw, 3), jnp.float32)
+    variables = model.init(jax.random.key(0), x0, train=False)
+    n_params = _param_count(variables)
+    loss_fn = models.cnn_loss_fn(model)
+    images = jax.random.normal(jax.random.key(1), (batch, hw, hw, 3),
+                               jnp.float32)
+    labels = jax.random.randint(jax.random.key(2), (batch,), 0, 1000)
+
+    mesh = bps.make_mesh()
+    opt = bps.DistributedOptimizer(optax.sgd(0.1, momentum=0.9))
+    step = bps.build_train_step(loss_fn, opt, mesh, donate=True)
+    fw_ips = _time_steps(step, jax.tree.map(jnp.copy, variables),
+                         opt.init(variables), (images, labels), steps, batch)
+
+    raw_opt = optax.sgd(0.1, momentum=0.9)
+
+    def raw_step(v, s, b):
+        loss, g = jax.value_and_grad(loss_fn)(v, b)
+        u, s = raw_opt.update(g, s, v)
+        return optax.apply_updates(v, u), s, loss
+
+    rb = max(1, batch // n_dev)
+    rstep = jax.jit(raw_step, donate_argnums=(0, 1))
+    raw_ips = _time_steps(rstep, variables, raw_opt.init(variables),
+                          (images[:rb], labels[:rb]), steps, rb)
+
+    efficiency = fw_ips / (raw_ips * n_dev)
+    print(json.dumps({
+        "metric": f"{name}_dp_scaling_efficiency",
+        "value": round(efficiency, 4),
+        "unit": "fraction_of_ideal",
+        "vs_baseline": round(efficiency / 0.90, 4),
+        "detail": {
+            "framework_images_per_sec": round(fw_ips, 1),
+            "images_per_sec_per_chip": round(fw_ips / n_dev, 1),
+            "ideal_images_per_sec_per_chip": round(raw_ips, 1),
+            "params": n_params,
+            "devices": n_dev,
+            "batch": batch, "image_size": hw,
+            "model": name, "dtype": "float32",
             **_note(),
         },
     }))
@@ -594,6 +681,17 @@ def main():
         bench_machinery()
     elif os.environ.get("BENCH_PS", "0") == "1":
         bench_ps()           # host-only: no device backend involved
+    elif os.environ.get("BENCH_CNN", ""):
+        # Validate the name BEFORE the (possibly minutes-long) backend
+        # probe so a typo still honors the one-JSON-line contract.
+        from byteps_tpu.models.cnn import CNN_NAMES
+        if os.environ["BENCH_CNN"] not in CNN_NAMES:
+            _error_record(f"unknown BENCH_CNN={os.environ['BENCH_CNN']!r}; "
+                          f"options: {sorted(CNN_NAMES)}")
+            raise SystemExit(3)
+        _init_backend_or_fallback(float(os.environ.get("BENCH_INIT_TIMEOUT",
+                                                       "480")))
+        bench_cnn()
     elif (os.environ.get("BENCH_EXEC_CHILD", "0") == "1"
           or os.environ.get("BENCH_FORCE_CPU", "0") == "1"):
         # Execution child (or explicit local CPU mode): actually run the

@@ -92,14 +92,19 @@ class EFLRScaleCallback(Callback):
     def on_step(self, step: int, opt_state: PyTree) -> PyTree:
         from .ops.compressor import set_lr_scale
         lr = float(self.schedule(step))
-        # Both endpoints must be positive: warmup schedules commonly start
-        # at lr=0, and a 0/new_lr scale would zero the carried EF error
-        # (permanently — the scale one-shot resets after the next
-        # compress) instead of rescaling it.
+        # Rescale only between positive LRs, and track the last NONZERO
+        # lr: warmup schedules start at 0 (a 0/new_lr scale would zero the
+        # carried EF error permanently — the scale one-shot resets after
+        # the next compress), and a mid-training lr=0 step (cycle/restart
+        # schedules) must not make the eventual positive->positive
+        # transition forget the pre-zero scale.
         if (self._prev is not None and self._prev > 0 and lr > 0
                 and lr != self._prev):
             opt_state = set_lr_scale(opt_state, self._prev / lr)
-        self._prev = lr
+        if lr > 0:
+            self._prev = lr
+        elif self._prev is None:
+            self._prev = lr   # record that the schedule started at 0
         return opt_state
 
 

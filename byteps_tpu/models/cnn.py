@@ -136,10 +136,16 @@ VGG16 = partial(VGG, cfg=_VGG16_CFG)
 VGG19 = partial(VGG, cfg=_VGG19_CFG)
 
 
+CNN_NAMES = ("resnet18", "resnet34", "resnet50", "resnet101",
+             "vgg16", "vgg19")
+
+
 def create_cnn(name: str, num_classes: int = 1000, **kw) -> nn.Module:
     table = {"resnet18": ResNet18, "resnet34": ResNet34,
              "resnet50": ResNet50, "resnet101": ResNet101,
              "vgg16": VGG16, "vgg19": VGG19}
+    if name not in table:
+        raise ValueError(f"unknown cnn {name!r}; options: {sorted(table)}")
     return table[name](num_classes=num_classes, **kw)
 
 

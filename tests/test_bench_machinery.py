@@ -54,6 +54,23 @@ def _run_bench():
 
 
 @pytest.mark.slow
+def test_cnn_bench_emits_json():
+    """BENCH_CNN mode: one JSON line, sane ratio on a 1-device CPU mesh
+    (the reference's ResNet/VGG throughput rows, docs/performance.md:5-26)."""
+    env = dict(os.environ)
+    env.update({"BENCH_FORCE_CPU": "1", "BENCH_CNN": "resnet50",
+                "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+                "BYTEPS_LOG_LEVEL": "ERROR"})
+    r = subprocess.run([sys.executable, BENCH], env=env,
+                       capture_output=True, text=True, timeout=900)
+    assert r.returncode == 0, r.stderr
+    out = json.loads(r.stdout.strip().splitlines()[-1])
+    assert out["metric"] == "resnet18_dp_scaling_efficiency"  # CPU stand-in
+    assert out["detail"]["dtype"] == "float32"
+    assert 0.5 < out["value"] < 1.5, out
+
+
+@pytest.mark.slow
 def test_machinery_bench_bucketed_beats_naive():
     """Wall-clock: bucketed >= naive in the small-leaves regime.  Retries
     absorb CPU timing noise (observed band ~1.05-1.17x on an idle virtual

@@ -104,6 +104,21 @@ def test_ef_lr_scale_callback_zero_warmup():
     assert float(opt_state["comp"]["lr_scale"]) == pytest.approx(0.5)
 
 
+def test_ef_lr_scale_callback_zero_mid_training():
+    """An lr trajectory positive -> 0 -> positive must apply the
+    pre-zero/post-zero rescale (the zero step is skipped, not a reset)."""
+    from byteps_tpu.ops import compressor as C
+    comp = C.ErrorFeedback(C.TopkCompressor(k=2))
+    opt_state = {"comp": comp.init_state(8)}
+    lrs = {0: 0.25, 1: 0.0, 2: 0.5}
+    cb = callbacks.EFLRScaleCallback(lambda step: lrs[int(step)])
+    opt_state = cb.on_step(0, opt_state)
+    opt_state = cb.on_step(1, opt_state)         # lr=0: skip, keep 0.25
+    assert float(opt_state["comp"]["lr_scale"]) == 1.0
+    opt_state = cb.on_step(2, opt_state)         # 0.25 -> 0.5
+    assert float(opt_state["comp"]["lr_scale"]) == pytest.approx(0.5)
+
+
 def test_broadcast_callback(bps_initialized):
     cb = callbacks.BroadcastGlobalVariablesCallback(0)
     state = {"w": jnp.ones(3)}
