@@ -91,6 +91,14 @@ def _time_steps(fn, params, opt_state, batch, n, per_step):
     return n * per_step / (time.perf_counter() - t0)
 
 
+def _attn_block_for(seq: int) -> int:
+    """BENCH_ATTN_BLOCK, normalized to 0 (auto) when the kernel would
+    reject it (must divide seq and be a multiple of 64) — so the JSON
+    label never claims a block the sweep didn't actually run."""
+    ab = int(os.environ.get("BENCH_ATTN_BLOCK", "0"))
+    return ab if ab and seq % ab == 0 and ab % 64 == 0 else 0
+
+
 def bench_flagship():
     import jax
     import optax
@@ -130,7 +138,8 @@ def bench_flagship():
             "bert_large", causal=True, vocab_size=32768, max_seq_len=512,
             ce_chunk_rows=ce_chunk,
             remat_policy=os.environ.get("BENCH_REMAT_POLICY", "none"),
-            attn_impl=os.environ.get("BENCH_ATTN", "dense"))
+            attn_impl=os.environ.get("BENCH_ATTN", "dense"),
+            attn_block=_attn_block_for(512))
         batch = int(os.environ.get("BENCH_BATCH", "48")) * jax.device_count()
         seq, steps = 512, 10
 
@@ -190,6 +199,7 @@ def bench_flagship():
             "model": model_name,
             "ce_chunk_rows": cfg.ce_chunk_rows,
             "attn_impl": cfg.attn_impl,
+            "attn_block": cfg.attn_block,
             "remat_policy": cfg.remat_policy,
             **_note(),
         },
@@ -691,7 +701,18 @@ def main():
             raise SystemExit(3)
         _init_backend_or_fallback(float(os.environ.get("BENCH_INIT_TIMEOUT",
                                                        "480")))
-        bench_cnn()
+        try:
+            bench_cnn()
+        except Exception as e:  # noqa: BLE001 — one-JSON-line contract
+            # Device-side failure AFTER backend init (OOM, tunnel drop
+            # mid-step): same guarantee as the flagship ladder — fall back
+            # to an honestly-labelled hermetic CPU run rather than dying
+            # with a traceback and no record.  The fallback child itself
+            # must propagate failures (the parent emits the error record).
+            if (os.environ.get("BENCH_CPU_FALLBACK_CHILD", "0") == "1"
+                    or os.environ.get("BENCH_FORCE_CPU", "0") == "1"):
+                raise
+            _cpu_last_resort(f"device cnn bench failed: {e!r:.300}")
     elif (os.environ.get("BENCH_EXEC_CHILD", "0") == "1"
           or os.environ.get("BENCH_FORCE_CPU", "0") == "1"):
         # Execution child (or explicit local CPU mode): actually run the

@@ -103,8 +103,6 @@ class EFLRScaleCallback(Callback):
             opt_state = set_lr_scale(opt_state, self._prev / lr)
         if lr > 0:
             self._prev = lr
-        elif self._prev is None:
-            self._prev = lr   # record that the schedule started at 0
         return opt_state
 
 

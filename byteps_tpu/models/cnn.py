@@ -136,17 +136,17 @@ VGG16 = partial(VGG, cfg=_VGG16_CFG)
 VGG19 = partial(VGG, cfg=_VGG19_CFG)
 
 
-CNN_NAMES = ("resnet18", "resnet34", "resnet50", "resnet101",
-             "vgg16", "vgg19")
+_CNN_TABLE = {"resnet18": ResNet18, "resnet34": ResNet34,
+              "resnet50": ResNet50, "resnet101": ResNet101,
+              "vgg16": VGG16, "vgg19": VGG19}
+CNN_NAMES = tuple(_CNN_TABLE)
 
 
 def create_cnn(name: str, num_classes: int = 1000, **kw) -> nn.Module:
-    table = {"resnet18": ResNet18, "resnet34": ResNet34,
-             "resnet50": ResNet50, "resnet101": ResNet101,
-             "vgg16": VGG16, "vgg19": VGG19}
-    if name not in table:
-        raise ValueError(f"unknown cnn {name!r}; options: {sorted(table)}")
-    return table[name](num_classes=num_classes, **kw)
+    if name not in _CNN_TABLE:
+        raise ValueError(
+            f"unknown cnn {name!r}; options: {sorted(_CNN_TABLE)}")
+    return _CNN_TABLE[name](num_classes=num_classes, **kw)
 
 
 def cnn_loss_fn(model: nn.Module):

@@ -70,6 +70,9 @@ class TransformerConfig:
     # (measurements: docs/performance.md).
     remat_policy: str = "none"    # "none" | "dots" | "dots_no_batch" | "proj"
     attn_impl: str = "dense"           # "dense" | "flash" | "ring" (sp)
+    # Flash-kernel block size override (0 = auto 128).  Larger blocks at
+    # short S mean fewer, fatter kernel programs; must divide seq_len.
+    attn_block: int = 0
     # Fused LM-head cross-entropy: > 0 streams the readout matmul + softmax
     # in row chunks of this size so the [B*S, vocab] logits are never
     # materialized (forward OR backward — each chunk is rematerialised).
@@ -297,16 +300,27 @@ def dense_attention(q, k, v, causal: bool):
     return jnp.einsum("bhqk,bhkd->bhqd", probs, v)
 
 
-def flash_attention_fn(q, k, v, causal: bool, strict: bool = False):
+def flash_attention_fn(q, k, v, causal: bool, strict: bool = False,
+                       block: int = 0):
     """Adapter: [B, H, S, Dh] heads-layout -> the Pallas flash-attention
     kernel's [BH, S, Dh] layout, with automatic fallback to dense attention
     when the shape doesn't meet the kernel's tiling constraints (S must
     divide into 64- or 128-row blocks; Dh a multiple of 8).  strict=True
     raises instead of falling back — for callers where silent dense
     attention would materialize S x S logits at a length chosen precisely
-    to avoid that (e.g. Ulysses long-context)."""
+    to avoid that (e.g. Ulysses long-context).
+
+    block=0 auto-selects 128 (the MXU-native tile).  A nonzero override
+    trades grid-iteration overhead against VMEM per program — at short S
+    a larger block means fewer, fatter programs (TransformerConfig.
+    attn_block / BENCH_ATTN_BLOCK sweep it on-chip).  Overrides must
+    divide S and be a multiple of 64 (the row-tile sizes the kernel
+    guarantees); anything else reverts to the AUTO choice — never to
+    dense, so a sweep value can't silently attribute dense throughput to
+    a flash config."""
     B, H, S, Dh = q.shape
-    block = 128 if S % 128 == 0 else (64 if S % 64 == 0 else 0)
+    if not block or S % block or block % 64:
+        block = 128 if S % 128 == 0 else (64 if S % 64 == 0 else 0)
     if block == 0 or Dh % 8:
         if strict:
             raise ValueError(
@@ -410,6 +424,9 @@ def forward_hidden(params: PyTree, tokens: jax.Array, cfg: TransformerConfig,
                 f"make_ulysses_attn_fn); built-ins: "
                 f"{sorted(_ATTN_IMPLS)}")
         attn_fn = _ATTN_IMPLS[cfg.attn_impl]
+        if cfg.attn_impl == "flash" and cfg.attn_block:
+            attn_fn = functools.partial(flash_attention_fn,
+                                        block=cfg.attn_block)
     dt = cfg.dtype
     B, S = tokens.shape
     x = params["embed"].astype(dt)[tokens]

@@ -88,6 +88,31 @@ def test_model_adapter_falls_back_on_bad_shapes():
                                atol=1e-6)
 
 
+def test_block_override_parity():
+    """An explicit block override (attn_block) must not change values; an
+    override that doesn't divide S falls back to the auto choice."""
+    rng = np.random.RandomState(7)
+    q = _rand(rng, 2, 2, 128, 32)
+    base = flash_attention_fn(q, q, q, causal=True)
+    for blk in (64, 128):                     # valid overrides
+        out = flash_attention_fn(q, q, q, causal=True, block=blk)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(base),
+                                   atol=1e-6)
+    for blk in (32, 96):  # not mult-of-64 / doesn't divide S -> AUTO block
+        out = flash_attention_fn(q, q, q, causal=True, block=blk)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(base),
+                                   atol=1e-6)
+    # Threads through the model config
+    from byteps_tpu.models import transformer as tfm
+    cfg_b = tfm.get_config("tiny", causal=True, attn_impl="flash",
+                           attn_block=64)
+    cfg_f = tfm.get_config("tiny", causal=True, attn_impl="flash")
+    params = tfm.init_params(jax.random.key(0), cfg_b)
+    batch = tfm.synthetic_batch(jax.random.key(1), 2, 128, cfg_b)
+    assert abs(float(tfm.loss_fn(params, batch, cfg_b))
+               - float(tfm.loss_fn(params, batch, cfg_f))) < 1e-5
+
+
 def test_transformer_end_to_end_parity():
     """Full model: attn_impl='flash' must track 'dense' through loss and
     gradients at bf16 tolerance."""
