@@ -134,12 +134,15 @@ def bench_flagship():
         # kernel measured 0.91x dense here (docs/performance.md) — it wins
         # beyond ~1-2k seq.  Each knob env-overridable for on-TPU sweeps:
         # BENCH_CE_CHUNK=0 / BENCH_ATTN=flash / BENCH_REMAT_POLICY=dots.
+        attn = os.environ.get("BENCH_ATTN", "dense")
         cfg = tfm.get_config(
             "bert_large", causal=True, vocab_size=32768, max_seq_len=512,
             ce_chunk_rows=ce_chunk,
             remat_policy=os.environ.get("BENCH_REMAT_POLICY", "none"),
-            attn_impl=os.environ.get("BENCH_ATTN", "dense"),
-            attn_block=_attn_block_for(512))
+            attn_impl=attn,
+            # Gate on flash so the record never carries a block the dense
+            # path silently ignored.
+            attn_block=_attn_block_for(512) if attn == "flash" else 0)
         batch = int(os.environ.get("BENCH_BATCH", "48")) * jax.device_count()
         seq, steps = 512, 10
 
@@ -776,6 +779,7 @@ def _flagship_orchestrate() -> None:
         # config (classic full-logits CE, dense attention, full remat) in
         # case a newer tuned default misbehaves on the real chip.
         env.update({"BENCH_CE_CHUNK": "0", "BENCH_ATTN": "dense",
+                    "BENCH_ATTN_BLOCK": "0",
                     "BENCH_REMAT_POLICY": "none",
                     "BENCH_NOTE": ("conservative-retry: default config "
                                    f"failed in child (rc={rc})")})

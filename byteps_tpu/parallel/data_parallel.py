@@ -143,6 +143,17 @@ def _tree_decompress(tree, ctxs, compression):
     return jax.tree.unflatten(treedef, outs)
 
 
+class DistributedGradientTransformation(NamedTuple):
+    """optax-compatible (init/update duck type) transform that also records
+    the `backward_passes_per_step` knob, so build_train_step can refuse the
+    double-scaling combination with `accum_steps` (both would divide the
+    gradient by N)."""
+
+    init: Callable
+    update: Callable
+    backward_passes_per_step: int = 1
+
+
 def DistributedOptimizer(
     optimizer: optax.GradientTransformation,
     named_parameters: Any = None,  # accepted for API parity; unused in JAX
@@ -170,7 +181,10 @@ def DistributedOptimizer(
     if backward_passes_per_step > 1:
         chain.append(optax.scale(1.0 / backward_passes_per_step))
     chain.append(optimizer)
-    return optax.chain(*chain)
+    chained = optax.chain(*chain)
+    return DistributedGradientTransformation(
+        chained.init, chained.update,
+        backward_passes_per_step=backward_passes_per_step)
 
 
 # ---------------------------------------------------------------------------
@@ -183,6 +197,7 @@ def build_train_step(
     axis_name: str = "dp",
     batch_spec: Optional[P] = None,
     donate: bool = True,
+    accum_steps: int = 1,
 ) -> Callable:
     """Returns jitted `step(params, opt_state, batch) -> (params, opt_state,
     loss)` where:
@@ -193,13 +208,63 @@ def build_train_step(
         distributed transform (which must psum over `axis_name` — use
         DistributedOptimizer).
 
+    `accum_steps > 1` splits each shard's batch into that many microbatches
+    under `lax.scan` and averages their gradients before the ONE distributed
+    update — gradient accumulation with a single all-reduce per step (the
+    reference's `backward_passes_per_step` semantics, reference:
+    torch/__init__.py:115-174, without its per-pass push_pull traffic).
+    Peak activation memory drops to one microbatch's.
+
     This is the structural equivalent of the reference's
     backward-hook → push_pull → optimizer.step loop (reference:
     torch/__init__.py:140-174) collapsed into one compiled program.
     """
     if batch_spec is None:
         batch_spec = P(axis_name)
+    if accum_steps < 1:
+        raise ValueError(f"accum_steps must be >= 1, got {accum_steps}")
+    if (accum_steps > 1
+            and getattr(optimizer, "backward_passes_per_step", 1) > 1):
+        raise ValueError(
+            "accum_steps and DistributedOptimizer(backward_passes_per_step)"
+            " are alternative forms of the same averaging — combining them"
+            " would divide the update by the product.  Use accum_steps for"
+            " in-step (lax.scan) accumulation, or backward_passes_per_step"
+            " when the training loop itself calls update() once per pass.")
     donate_argnums = (0, 1) if donate else ()
+
+    def _value_and_grad(params, batch):
+        if accum_steps == 1:
+            return jax.value_and_grad(loss_fn)(params, batch)
+
+        def split(x):
+            if x.shape[0] % accum_steps:
+                raise ValueError(
+                    f"per-shard batch dim {x.shape[0]} is not divisible by "
+                    f"accum_steps={accum_steps}")
+            return x.reshape((accum_steps, x.shape[0] // accum_steps)
+                             + x.shape[1:])
+
+        micros = jax.tree.map(split, batch)
+
+        # Accumulate in f32 regardless of the param/grad dtype: bf16
+        # partial sums would round each step and break the equals-the-
+        # full-batch-gradient contract as accum_steps grows.  Cast back to
+        # the native grad dtype after averaging.
+        def micro(carry, mb):
+            loss_sum, g_sum = carry
+            l, g = jax.value_and_grad(loss_fn)(params, mb)
+            return (loss_sum + l.astype(jnp.float32),
+                    jax.tree.map(lambda s, x: s + x.astype(jnp.float32),
+                                 g_sum, g)), None
+
+        init = (jnp.zeros((), jnp.float32),
+                jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32),
+                             params))
+        (loss_sum, g_sum), _ = jax.lax.scan(micro, init, micros)
+        inv = 1.0 / accum_steps
+        return loss_sum * inv, jax.tree.map(
+            lambda g, p: (g * inv).astype(p.dtype), g_sum, params)
 
     if mesh.devices.size == 1:
         # Single-device fast path: the reference's non-distributed mode
@@ -209,7 +274,7 @@ def build_train_step(
         # dispatch overhead remains.
         def _local_step(params, opt_state, batch):
             with collectives.local_mode():
-                loss, grads = jax.value_and_grad(loss_fn)(params, batch)
+                loss, grads = _value_and_grad(params, batch)
                 updates, opt_state = optimizer.update(grads, opt_state,
                                                       params)
                 params = optax.apply_updates(params, updates)
@@ -223,7 +288,7 @@ def build_train_step(
         return local_call
 
     def _step(params, opt_state, batch):
-        loss, grads = jax.value_and_grad(loss_fn)(params, batch)
+        loss, grads = _value_and_grad(params, batch)
         updates, opt_state = optimizer.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
         # Per-shard losses -> global mean for reporting.

@@ -97,6 +97,47 @@ def test_distributed_matches_single_device():
                                    rtol=2e-4, atol=2e-5)
 
 
+def test_gradient_accumulation_matches_full_batch(mesh8):
+    """accum_steps=4 (microbatched under lax.scan, ONE all-reduce) must
+    produce the same update as the full-batch step — the loss is a mean,
+    so the average of microbatch gradients equals the full-batch gradient
+    (reference knob: backward_passes_per_step, torch/__init__.py:115-174)."""
+    params = _mlp_init(jax.random.PRNGKey(7))
+    batch = _synthetic_batch(jax.random.PRNGKey(8), 64)
+
+    outs = {}
+    for accum in (1, 4):
+        p = jax.tree.map(lambda x: x.copy(), params)
+        opt = bps.DistributedOptimizer(optax.sgd(0.1))
+        st = opt.init(p)
+        step = bps.build_train_step(_loss_fn, opt, mesh8, donate=False,
+                                    accum_steps=accum)
+        for _ in range(3):
+            p, st, loss = step(p, st, batch)
+        outs[accum] = (p, float(loss))
+
+    assert outs[1][1] == pytest.approx(outs[4][1], rel=1e-5)
+    for a, b in zip(jax.tree.leaves(outs[1][0]), jax.tree.leaves(outs[4][0])):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-5, atol=2e-6)
+
+
+def test_gradient_accumulation_rejects_indivisible(mesh8):
+    params = _mlp_init(jax.random.PRNGKey(7))
+    opt = bps.DistributedOptimizer(optax.sgd(0.1))
+    st = opt.init(params)
+    step = bps.build_train_step(_loss_fn, opt, mesh8, accum_steps=3)
+    with pytest.raises(ValueError, match="not divisible"):
+        step(params, st, _synthetic_batch(jax.random.PRNGKey(8), 64))
+    with pytest.raises(ValueError, match="accum_steps"):
+        bps.build_train_step(_loss_fn, opt, mesh8, accum_steps=0)
+    # Combining with backward_passes_per_step would double-divide.
+    opt2 = bps.DistributedOptimizer(optax.sgd(0.1),
+                                    backward_passes_per_step=4)
+    with pytest.raises(ValueError, match="backward_passes_per_step"):
+        bps.build_train_step(_loss_fn, opt2, mesh8, accum_steps=4)
+
+
 def test_hierarchical_optimizer_trains():
     """Two-level (dcn=2 × ici=4) hierarchical reduction end-to-end."""
     mesh = bps.make_hierarchical_mesh(ici_size=4)
