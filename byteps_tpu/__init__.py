@@ -24,7 +24,8 @@ from .parallel.async_ps import AsyncPSTrainer
 from .ops.compression import Compression
 from .ops import collectives
 from .parallel.data_parallel import (
-    DistributedOptimizer, distributed_gradient_transform, build_train_step,
+    DistributedOptimizer, DistributedGradientTransformation,
+    distributed_gradient_transform, build_train_step,
 )
 from .parallel.mesh import (
     make_mesh, make_hierarchical_mesh, get_mesh, set_mesh, reset_mesh,
@@ -58,8 +59,8 @@ __all__ = [
     "broadcast_parameters", "broadcast_optimizer_state",
     "get_pushpull_speed", "mark_step", "current_step",
     "Compression", "collectives",
-    "DistributedOptimizer", "distributed_gradient_transform",
-    "build_train_step",
+    "DistributedOptimizer", "DistributedGradientTransformation",
+    "distributed_gradient_transform", "build_train_step",
     "make_mesh", "make_hierarchical_mesh", "get_mesh", "set_mesh",
     "reset_mesh",
     "CrossBarrierDriver", "run_cross_barrier",

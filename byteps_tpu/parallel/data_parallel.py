@@ -165,13 +165,17 @@ def DistributedOptimizer(
     hierarchical: bool = False,
     backward_passes_per_step: int = 1,
     world: int = 1,
-) -> optax.GradientTransformation:
+) -> "DistributedGradientTransformation":
     """Wrap an optax optimizer so updates are preceded by distributed
     gradient push_pull — the JAX face of the reference's
     `bps.DistributedOptimizer`.
 
     `backward_passes_per_step > 1` scales gradients down to keep the average
     correct under gradient accumulation (reference exposes the same knob).
+
+    The return value is an optax-compatible init/update pair, but a
+    THREE-field NamedTuple (DistributedGradientTransformation) — use
+    `.init`/`.update` attribute access, not 2-tuple unpacking.
     """
     del named_parameters
     chain = [distributed_gradient_transform(
@@ -223,6 +227,9 @@ def build_train_step(
         batch_spec = P(axis_name)
     if accum_steps < 1:
         raise ValueError(f"accum_steps must be >= 1, got {accum_steps}")
+    # Best-effort guard: the knob is only visible on a directly-passed
+    # DistributedOptimizer.  If you re-wrap it (optax.chain(...)), the
+    # guard can't see it — don't combine the two forms yourself.
     if (accum_steps > 1
             and getattr(optimizer, "backward_passes_per_step", 1) > 1):
         raise ValueError(

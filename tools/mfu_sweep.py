@@ -1,0 +1,113 @@
+"""On-chip MFU sweep driver for the flagship bench.
+
+Runs a list of bench configurations serially, each in its own disposable
+subprocess (the chip's per-process lock is released between runs), records
+every JSON line to a results file, and PROBES TUNNEL HEALTH between runs —
+a crashed remote compile can wedge the device tunnel for every subsequent
+process (round-4 postmortem: two OOM-ing remat-policy compiles took the
+tunnel down for hours), so the sweep stops early rather than queueing more
+compiles into a wedged service.
+
+Usage:  python tools/mfu_sweep.py [results.jsonl]
+
+Config list lives in SWEEP below — edit freely; each entry is a dict of
+extra env vars layered on the flagship bench defaults.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# Ordered by expected yield; the control run (current default) goes first
+# so every sweep file has an anchor measured the same hour.
+SWEEP = [
+    {"name": "control_b48",   "env": {}},
+    {"name": "proj_b48",      "env": {"BENCH_REMAT_POLICY": "proj"}},
+    {"name": "proj_b64",      "env": {"BENCH_REMAT_POLICY": "proj",
+                                      "BENCH_BATCH": "64"}},
+    {"name": "flash_b256",    "env": {"BENCH_ATTN": "flash",
+                                      "BENCH_ATTN_BLOCK": "256"}},
+    {"name": "flash_b512",    "env": {"BENCH_ATTN": "flash",
+                                      "BENCH_ATTN_BLOCK": "512"}},
+    {"name": "flash_auto",    "env": {"BENCH_ATTN": "flash"}},
+    {"name": "proj_flash",    "env": {"BENCH_REMAT_POLICY": "proj",
+                                      "BENCH_ATTN": "flash",
+                                      "BENCH_ATTN_BLOCK": "256"}},
+]
+
+PROBE = ("import jax, jax.numpy as jnp; "
+         "print(float((jnp.ones((256,256))@jnp.ones((256,256))).sum()))")
+
+
+def tunnel_alive(timeout: float = 120.0) -> bool:
+    try:
+        r = subprocess.run([sys.executable, "-c", PROBE], timeout=timeout,
+                           capture_output=True, text=True)
+        return r.returncode == 0
+    except subprocess.TimeoutExpired:
+        return False
+
+
+def run_one(entry: dict, timeout: float) -> dict:
+    env = dict(os.environ)
+    env.update(entry["env"])
+    env["BENCH_EXEC_CHILD"] = "1"   # single measurement, no recovery ladder
+    t0 = time.time()
+    try:
+        r = subprocess.run([sys.executable, os.path.join(REPO, "bench.py")],
+                           env=env, timeout=timeout, capture_output=True,
+                           text=True)
+        rc, out, err = r.returncode, r.stdout, r.stderr
+    except subprocess.TimeoutExpired as e:
+        rc = 124
+        out = (e.stdout or b"").decode(errors="replace") \
+            if isinstance(e.stdout, bytes) else (e.stdout or "")
+        err = (e.stderr or b"").decode(errors="replace") \
+            if isinstance(e.stderr, bytes) else (e.stderr or "")
+    rec = {"name": entry["name"], "env": entry["env"], "rc": rc,
+           "wall_s": round(time.time() - t0, 1)}
+    lines = [ln for ln in out.strip().splitlines() if ln.startswith("{")]
+    try:
+        if rc == 0 and lines:
+            rec["result"] = json.loads(lines[-1])
+        else:
+            rec["stderr_tail"] = err[-1500:]
+    except json.JSONDecodeError:
+        # A half-flushed line from a dying child must not abort the sweep.
+        rec["bad_stdout_tail"] = out[-500:]
+        rec["stderr_tail"] = err[-1000:]
+    return rec
+
+
+def main() -> None:
+    out_path = sys.argv[1] if len(sys.argv) > 1 else \
+        os.path.join(REPO, "sweep_results.jsonl")
+    timeout = float(os.environ.get("SWEEP_RUN_TIMEOUT", "700"))
+    with open(out_path, "a") as f:
+        for entry in SWEEP:
+            if not tunnel_alive():
+                print(f"[sweep] tunnel wedged before {entry['name']}; "
+                      f"stopping", file=sys.stderr)
+                f.write(json.dumps({"name": entry["name"],
+                                    "skipped": "tunnel wedged"}) + "\n")
+                f.flush()
+                break
+            print(f"[sweep] running {entry['name']} ...", file=sys.stderr)
+            rec = run_one(entry, timeout)
+            f.write(json.dumps(rec) + "\n")
+            f.flush()
+            res = rec.get("result", {}).get("detail", {})
+            print(f"[sweep] {entry['name']}: rc={rec['rc']} "
+                  f"tok/s={res.get('tokens_per_sec_per_chip')} "
+                  f"mfu={res.get('mfu')}", file=sys.stderr)
+    print(f"[sweep] results in {out_path}", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()
