@@ -39,7 +39,8 @@ def build(args, mesh):
         unit = "imgs"
         per_batch = args.batch_size
     opt = bps.DistributedOptimizer(optax.sgd(0.01))
-    step = bps.build_train_step(loss, opt, mesh, donate=False)
+    step = bps.build_train_step(loss, opt, mesh, donate=False,
+                                accum_steps=args.accum_steps)
     return step, params, opt.init(params), batch, unit, per_batch
 
 
@@ -51,6 +52,9 @@ def main():
     ap.add_argument("--image-size", type=int, default=224)
     ap.add_argument("--num-warmup", type=int, default=2)
     ap.add_argument("--num-iters", type=int, default=10)
+    ap.add_argument("--accum-steps", type=int, default=1,
+                    help="microbatches per step (gradient accumulation, "
+                         "one all-reduce per step)")
     ap.add_argument("--profiler", action="store_true",
                     help="wrap timed iters in jax.profiler traces")
     ap.add_argument("--trace-dir", default="/tmp/byteps_tpu_profile")

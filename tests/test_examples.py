@@ -42,8 +42,9 @@ def test_benchmark_example_cnn():
 
 def test_benchmark_example_transformer():
     out = _run("benchmark_byteps.py", "--model", "tiny",
-               "--batch-size", "8", "--seq-len", "64",
-               "--num-iters", "2", "--num-warmup", "1")
+               "--batch-size", "16", "--seq-len", "64",
+               "--num-iters", "2", "--num-warmup", "1",
+               "--accum-steps", "2")
     assert "tokens/sec" in out
 
 

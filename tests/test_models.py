@@ -213,7 +213,10 @@ def test_fused_ce_trains(mesh8):
 
 
 def test_transformer_dp_training_loss_decreases(mesh8):
-    cfg = tfm.get_config("tiny", dtype=jnp.float32)
+    # remat_policy="proj" here doubles as the named-checkpoint policy's
+    # mesh/shard_map composition coverage (single-device parity is pinned
+    # by test_transformer_remat_policies_match).
+    cfg = tfm.get_config("tiny", dtype=jnp.float32, remat_policy="proj")
     params = tfm.init_params(jax.random.key(0), cfg)
     opt = bps.DistributedOptimizer(optax.adam(1e-3))
     step = bps.build_train_step(
