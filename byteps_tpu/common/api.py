@@ -400,6 +400,29 @@ def push_pull_tree(tree: PyTree, name: Optional[str] = None,
     return jax.tree.unflatten(treedef, outs)
 
 
+def _debug_sample(stage: str, name: str, tensor) -> None:
+    """BYTEPS_DEBUG_SAMPLE_TENSOR: log a sample of the named tensor at a
+    host-visible pipeline stage (reference: core_loops.cc:36-66 samples at
+    every queue stage; here the eager path's host stages are push-entry
+    and post-synchronize).  Substring match.  Written straight to stderr
+    like the C++ server's BYTEPS_SERVER_DEBUG — setting the env IS the
+    opt-in, independent of BYTEPS_LOG_LEVEL."""
+    cfg = _state.config or get_config()
+    pat = cfg.debug_sample_tensor
+    if not pat or pat not in name:
+        return
+    import sys
+    arr = np.asarray(tensor, dtype=np.float32).ravel()
+    head = ", ".join(f"{v:.6g}" for v in arr[:4])
+    sys.stderr.write(
+        f"[byteps_tpu DEBUG_SAMPLE] {stage} name={name} "
+        f"shape={tuple(np.shape(tensor))} "
+        f"dtype={getattr(tensor, 'dtype', '?')} "
+        f"norm2={float(np.linalg.norm(arr)):.6g} "
+        f"sum={float(arr.sum()):.6g} first=[{head}]\n")
+    sys.stderr.flush()
+
+
 def push_pull_async(tensor: jax.Array, name: Optional[str] = None,
                     average: bool = True, priority: int = 0,
                     compression=None) -> int:
@@ -409,6 +432,7 @@ def push_pull_async(tensor: jax.Array, name: Optional[str] = None,
     tensor = jnp.asarray(tensor)
     if name is None:
         name = f"byteps_tpu.tensor_{get_core().num_declared()}"
+    _debug_sample("push", name, tensor)
     dk = declare(name)
     core = get_core()
     handle = core.handle_allocate()
@@ -459,6 +483,7 @@ def synchronize(handle: int) -> jax.Array:
     if callable(out):  # PS-mode deferred result
         out = out()
     out = jax.block_until_ready(out)
+    _debug_sample("pull", name, out)
     core = get_core()
     core.handle_mark_done(handle)
     core.trace_record(name, "PUSH_PULL", t0, core.trace_now_us() - t0)

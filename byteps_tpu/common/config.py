@@ -75,6 +75,12 @@ class Config:
     trace_end_step: int = 20             # BYTEPS_TRACE_END_STEP
     trace_dir: str = "./traces"          # BYTEPS_TRACE_DIR
     telemetry_on: bool = True            # BYTEPS_TELEMETRY_ON
+    # Debug sampling: log norm + first values of any eager-path tensor
+    # whose name contains this substring, at each host-visible stage
+    # (reference: BYTEPS_DEBUG_SAMPLE_TENSOR, core_loops.cc:36-66; the
+    # server-side analog is BYTEPS_SERVER_DEBUG(_KEY), read by the C++
+    # server directly).
+    debug_sample_tensor: str = ""        # BYTEPS_DEBUG_SAMPLE_TENSOR
 
     # ---- logging ----
     log_level: str = "WARNING"           # BYTEPS_LOG_LEVEL
@@ -119,6 +125,7 @@ class Config:
             trace_end_step=_env_int("BYTEPS_TRACE_END_STEP", 20),
             trace_dir=_env_str("BYTEPS_TRACE_DIR", "./traces"),
             telemetry_on=_env_bool("BYTEPS_TELEMETRY_ON", True),
+            debug_sample_tensor=_env_str("BYTEPS_DEBUG_SAMPLE_TENSOR", ""),
             log_level=_env_str("BYTEPS_LOG_LEVEL", "WARNING"),
             mesh_dp=_env_int("BYTEPS_TPU_MESH_DP", 0),
             mesh_tp=_env_int("BYTEPS_TPU_MESH_TP", 1),

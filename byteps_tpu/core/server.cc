@@ -44,6 +44,7 @@
 
 #include <atomic>
 #include <cmath>
+#include <cstdio>
 #include <cstdlib>
 #include <condition_variable>
 #include <cstdint>
@@ -337,7 +338,15 @@ class Server {
       : port_(port), num_workers_(num_workers),
         engine_threads_(engine_threads < 1 ? 1 : engine_threads),
         schedule_(schedule), async_(async_mode),
-        queues_(engine_threads_), engine_load_(engine_threads_, 0) {}
+        queues_(engine_threads_), engine_load_(engine_threads_, 0) {
+    // Server value tracing (reference: BYTEPS_SERVER_DEBUG(_KEY),
+    // server.cc:124-201): log each push merge and round publish with the
+    // f32 sum of the buffer, optionally filtered to one key.
+    const char* dbg = std::getenv("BYTEPS_SERVER_DEBUG");
+    debug_ = dbg && dbg[0] && !(dbg[0] == '0' && dbg[1] == '\0');
+    const char* dk = std::getenv("BYTEPS_SERVER_DEBUG_KEY");
+    debug_key_ = dk && dk[0] ? std::strtoull(dk, nullptr, 10) : ~0ULL;
+  }
 
   int Run() {
     listen_fd_ = socket(AF_INET, SOCK_STREAM, 0);
@@ -642,11 +651,14 @@ class Server {
     }
     ks.dtype = t.dtype == kCompressed ? kF32 : t.dtype;
     ks.push_count.fetch_add(1, std::memory_order_relaxed);
+    DebugLog("push_recv", t.key, t.worker_id, ks.completed_round, *data);
     if (async_) {
       // Async PS mode: store += payload immediately, no round tracking
       // (reference: server.cc:319-323, BYTEPS_ENABLE_ASYNC).
       SumInto(ks, *data);
       ks.out = ks.store;
+      DebugLog("async_merge", t.key, t.worker_id, ks.completed_round,
+               ks.store);
       Respond(t.conn, kOk, t.req_id, t.key, nullptr, 0);
       FlushPulls(ks, t.key);
       return;
@@ -692,11 +704,36 @@ class Server {
       } else {
         ks.out = ks.store;
       }
+      // Log BEFORE the increment so all_recv and its contributing
+      // push_recv lines carry the same round number.
+      DebugLog("all_recv", t.key, t.worker_id, ks.completed_round,
+               ks.store);
       ks.completed_round++;
       ks.seen.clear();
       ks.round_compressed = false;
       FlushPulls(ks, t.key);
     }
+  }
+
+  void DebugLog(const char* stage, uint64_t key, uint32_t worker,
+                uint64_t round, const std::vector<char>& buf) {
+    if (!debug_ || (debug_key_ != ~0ULL && key != debug_key_)) return;
+    // f32 sum + first value — the reference's per-stage sample shape
+    // (sum_of_buffer; reference server.cc:124-201).
+    double sum = 0.0;
+    float first = 0.0f;
+    size_t n = buf.size() / sizeof(float);
+    const float* f = reinterpret_cast<const float*>(buf.data());
+    if (n > 0) {
+      first = f[0];
+      for (size_t i = 0; i < n; ++i) sum += f[i];
+    }
+    std::fprintf(stderr,
+                 "[byteps_tpu.server DEBUG] %s key=%llu worker=%u round=%llu"
+                 " len=%zu f32_sum=%.6g first=%.6g\n",
+                 stage, static_cast<unsigned long long>(key), worker,
+                 static_cast<unsigned long long>(round), buf.size(), sum,
+                 first);
   }
 
   void SumInto(KeyState& ks, const std::vector<char>& payload) {
@@ -753,6 +790,8 @@ class Server {
   int engine_threads_;
   bool schedule_;
   bool async_;
+  bool debug_ = false;
+  uint64_t debug_key_ = ~0ULL;   // ~0 = all keys
   int listen_fd_ = -1;
 
   std::vector<EngineQueue> queues_;

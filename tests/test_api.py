@@ -112,3 +112,30 @@ def test_suspend_resume_keeps_keys(bps_initialized):
     bps.resume(num_workers=1)
     # Keys survive elastic restart (reference: operations.cc:96-119).
     assert bps.declared_key("api.elastic.w") == k
+
+
+def test_debug_sample_tensor_logging():
+    """BYTEPS_DEBUG_SAMPLE_TENSOR (substring match) logs a sample of the
+    tensor at the eager path's host stages — push entry and
+    post-synchronize (reference: core_loops.cc:36-66)."""
+    import subprocess
+    import sys
+    from testutil import cpu_env
+
+    code = """
+import jax.numpy as jnp
+import byteps_tpu as bps
+bps.init()
+bps.push_pull(jnp.arange(4.0), name="Gradient.probe", average=False)
+bps.push_pull(jnp.ones(3), name="unrelated", average=False)
+bps.shutdown()
+print("DONE")
+"""
+    env = cpu_env({"BYTEPS_DEBUG_SAMPLE_TENSOR": "probe"})
+    r = subprocess.run([sys.executable, "-c", code], env=env,
+                       capture_output=True, text=True, timeout=180)
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "DEBUG_SAMPLE] push name=Gradient.probe" in r.stderr
+    assert "DEBUG_SAMPLE] pull name=Gradient.probe" in r.stderr
+    assert "sum=6" in r.stderr            # 0+1+2+3
+    assert "name=unrelated" not in r.stderr

@@ -29,7 +29,10 @@ def ps_server():
     """Yields (port, num_workers) with a live server; kills it after."""
     made = []
 
-    def start(num_workers=2, schedule=False, async_mode=False):
+    def start(num_workers=2, schedule=False, async_mode=False,
+              extra_env=None, capture_stderr=False):
+        """Returns the port; with capture_stderr=True returns (port, proc)
+        so the test can read the server's stderr (debug tracing)."""
         port = free_port()
         env = cpu_env({
             # serve() binds scheduler_port + 1 + server_id
@@ -39,6 +42,7 @@ def ps_server():
             "BYTEPS_SERVER_ENABLE_SCHEDULE": "1" if schedule else "0",
             "BYTEPS_ENABLE_ASYNC": "1" if async_mode else "0",
             "JAX_PLATFORMS": "cpu",
+            **(extra_env or {}),
         })
         if env.get("BYTEPS_TPU_TSAN") == "1":
             # Make any detected race fatal: the server dies mid-test and the
@@ -46,14 +50,16 @@ def ps_server():
             env["TSAN_OPTIONS"] = "halt_on_error=1"
         proc = subprocess.Popen(
             [sys.executable, "-m", "byteps_tpu.server"], env=env,
-            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+            stdout=subprocess.DEVNULL,
+            stderr=subprocess.PIPE if capture_stderr else subprocess.DEVNULL,
+            text=capture_stderr or None)
         made.append(proc)
         # wait for the listening socket
         deadline = time.time() + 30
         while time.time() < deadline:
             try:
                 socket.create_connection(("127.0.0.1", port), 0.5).close()
-                return port
+                return (port, proc) if capture_stderr else port
             except OSError:
                 if proc.poll() is not None:
                     raise RuntimeError(
@@ -517,3 +523,27 @@ print("TREE_COMP_OK")
                           capture_output=True, text=True, timeout=180)
     assert proc.returncode == 0, proc.stderr[-3000:]
     assert "TREE_COMP_OK" in proc.stdout
+
+
+def test_server_debug_value_tracing(ps_server):
+    """BYTEPS_SERVER_DEBUG logs push merges and round publishes with the
+    f32 sum of the buffer; BYTEPS_SERVER_DEBUG_KEY filters to one key
+    (reference: BYTEPS_SERVER_DEBUG(_KEY), server.cc:124-201)."""
+    port, proc = ps_server(
+        num_workers=1, capture_stderr=True,
+        extra_env={"BYTEPS_SERVER_DEBUG": "1",
+                   "BYTEPS_SERVER_DEBUG_KEY": str(5 << 16)})
+    s = _session(port, 0)
+    # The session encodes wire keys as (declared_key << 16) | part.
+    s.push_pull(5, np.full(8, 2.0, np.float32))   # traced key
+    s.push_pull(9, np.ones(8, np.float32))        # filtered out
+    s.close()
+    proc.terminate()
+    err = proc.communicate(timeout=30)[1]
+    assert "push_recv" in err and "all_recv" in err, err[-2000:]
+    assert f"key={5 << 16}" in err
+    assert "f32_sum=16" in err          # 8 elements x 2.0
+    assert f"key={9 << 16}" not in err  # DEBUG_KEY filter applies
+    # push and publish of the same round carry the same round number
+    assert "push_recv key=327680 worker=0 round=0" in err
+    assert "all_recv key=327680 worker=0 round=0" in err
