@@ -32,7 +32,23 @@ def ps_server():
     def start(num_workers=2, schedule=False, async_mode=False,
               extra_env=None, capture_stderr=False):
         """Returns the port; with capture_stderr=True returns (port, proc)
-        so the test can read the server's stderr (debug tracing)."""
+        so the test can read the server's stderr (debug tracing).
+
+        free_port() is bind-then-close (TOCTOU): under parallel test
+        workers another process can claim the port before the server
+        binds it, killing the server at startup — retry with a fresh
+        port (same mitigation as bench.py's bench_ps)."""
+        last = None
+        for _ in range(3):
+            try:
+                return _start_once(num_workers, schedule, async_mode,
+                                   extra_env, capture_stderr)
+            except RuntimeError as e:   # died at startup (bind race)
+                last = e
+        raise last
+
+    def _start_once(num_workers, schedule, async_mode, extra_env,
+                    capture_stderr):
         port = free_port()
         env = cpu_env({
             # serve() binds scheduler_port + 1 + server_id

@@ -191,19 +191,31 @@ def test_async_mode_against_ps_server():
 
     from testutil import cpu_env, free_port
 
-    port = free_port()
-    env = cpu_env({"DMLC_PS_ROOT_PORT": str(port - 1),
-                   "DMLC_NUM_WORKER": "1", "BYTEPS_ENABLE_ASYNC": "1"})
-    srv = subprocess.Popen([sys.executable, "-m", "byteps_tpu.server"],
-                           env=env, stdout=subprocess.DEVNULL,
-                           stderr=subprocess.DEVNULL)
-    try:
+    # free_port() is bind-then-close (TOCTOU) — retry the boot if another
+    # parallel test worker claims the port before the server binds it.
+    srv = None
+    for _ in range(3):
+        port = free_port()
+        env = cpu_env({"DMLC_PS_ROOT_PORT": str(port - 1),
+                       "DMLC_NUM_WORKER": "1", "BYTEPS_ENABLE_ASYNC": "1"})
+        srv = subprocess.Popen([sys.executable, "-m", "byteps_tpu.server"],
+                               env=env, stdout=subprocess.DEVNULL,
+                               stderr=subprocess.DEVNULL)
+        booted = False
         for _ in range(100):
+            if srv.poll() is not None:
+                break   # died at startup (bind race) -> new port
             try:
                 socket.create_connection(("127.0.0.1", port), 0.5).close()
+                booted = True
                 break
             except OSError:
                 time.sleep(0.1)
+        if booted:
+            break
+        srv.kill()
+        srv.wait()
+    try:
         code = """
 import numpy as np, torch
 import byteps_tpu.torch as bps
