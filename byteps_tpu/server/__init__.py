@@ -18,10 +18,12 @@ import os
 def serve(port: int | None = None, num_workers: int | None = None,
           engine_threads: int | None = None, schedule: bool | None = None,
           async_mode: bool | None = None) -> int:
-    """Run the native PS server (blocking). Returns its exit code.
-
-    Under BYTEPS_TPU_TSAN=1 the server runs as a standalone sanitized
-    binary (the TSAN runtime cannot be dlopen'd into an interpreter).
+    """Run the native PS server (blocking). Returns its exit code —
+    EXCEPT under BYTEPS_TPU_TSAN=1, where this call never returns: the
+    server runs as a standalone sanitized binary (the TSAN runtime cannot
+    be dlopen'd into an interpreter) and os.execv REPLACES the calling
+    process with it, so the binary's exit code becomes the process's.
+    Don't call the TSAN path from a process that has work after serve().
     """
     from ..core import build
     from ..common.config import get_config
@@ -40,9 +42,14 @@ def serve(port: int | None = None, num_workers: int | None = None,
         int(async_mode if async_mode is not None else cfg.enable_async),
     )
     if os.environ.get("BYTEPS_TPU_TSAN", "0") == "1":
-        import subprocess
+        # exec, don't spawn: a subprocess.call child would survive as an
+        # orphan when the supervising python gets SIGTERM (holding the
+        # parent's stderr pipe open — observed as a communicate() hang in
+        # the debug-tracing test), and signals wouldn't reach the server.
+        # The sanitized binary replaces this process; its exit code is the
+        # process exit code.
         exe = build.build_server_exe()
-        return subprocess.call([exe] + [str(a) for a in args])
+        os.execv(exe, [exe] + [str(a) for a in args])
     lib = ctypes.CDLL(build.build())
     lib.bps_ps_server_run.argtypes = [ctypes.c_int] * 5
     lib.bps_ps_server_run.restype = ctypes.c_int

@@ -39,6 +39,10 @@ SWEEP = [
     {"name": "proj_flash",    "env": {"BENCH_REMAT_POLICY": "proj",
                                       "BENCH_ATTN": "flash",
                                       "BENCH_ATTN_BLOCK": "256"}},
+    {"name": "ce4096_b48",    "env": {"BENCH_CE_CHUNK": "4096"}},
+    {"name": "proj_ce4096_b64", "env": {"BENCH_REMAT_POLICY": "proj",
+                                        "BENCH_CE_CHUNK": "4096",
+                                        "BENCH_BATCH": "64"}},
 ]
 
 PROBE = ("import jax, jax.numpy as jnp; "
