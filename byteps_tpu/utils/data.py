@@ -49,6 +49,50 @@ def prefetch_to_device(iterator: Iterable, size: int = 2,
             queue.append(put(batch))
 
 
+def host_shard(batch: PyTree, rank: Optional[int] = None,
+               size: Optional[int] = None) -> PyTree:
+    """Slice a GLOBAL host batch down to this process's contiguous rows.
+
+    The multihost input pattern (reference analog: rank-sharded sampling,
+    torch DistributedSampler in the reference's examples): every process
+    produces the same global batch deterministically (or addresses the
+    same storage) and keeps rows [rank·per, (rank+1)·per).
+
+    rank/size default to `jax.process_index()`/`jax.process_count()` —
+    deliberately NOT byteps rank(): `global_batch_from_local` assembles
+    by JAX process order, so the slicing index must use the same
+    coordinate system or the assembled global array is a silent row
+    permutation (byteps rank can diverge via BYTEPS_GLOBAL_RANK).  Pass
+    an explicit rank only if you also control the assembly order.
+    """
+    rank = jax.process_index() if rank is None else rank
+    size = jax.process_count() if size is None else size
+
+    def slc(x):
+        n = x.shape[0]
+        if n % size:
+            raise ValueError(
+                f"global batch dim {n} is not divisible by world size "
+                f"{size}")
+        per = n // size
+        return x[rank * per:(rank + 1) * per]
+
+    return jax.tree.map(slc, batch)
+
+
+def global_batch_from_local(batch: PyTree, mesh: Mesh,
+                            axis_name: str = "dp") -> PyTree:
+    """Assemble a global, dp-sharded jax.Array from each process's LOCAL
+    shard (the inverse hand-off of `host_shard`: load locally, train
+    globally).  Wraps jax.make_array_from_process_local_data so the
+    result is addressable by a jitted step over `mesh` with the batch dim
+    sharded over `axis_name`."""
+    sharding = NamedSharding(mesh, P(axis_name))
+    return jax.tree.map(
+        lambda x: jax.make_array_from_process_local_data(sharding, x),
+        batch)
+
+
 def synthetic_batches(make_batch, n: Optional[int] = None) -> Iterator:
     """Endless (or n-long) stream of `make_batch(i)` results — the pattern
     the reference's synthetic benchmarks use."""

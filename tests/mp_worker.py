@@ -134,6 +134,29 @@ def scenario_train_solo():
     bps.shutdown()
 
 
+def scenario_train_localdata():
+    # The production multihost input pattern: each process keeps only ITS
+    # slice of the global batch (utils.data.host_shard), assembles the
+    # global dp-sharded array from local shards
+    # (utils.data.global_batch_from_local), and trains on that.  Loss
+    # trajectory must match the everyone-holds-the-global-batch path.
+    bps.init()
+    from byteps_tpu.utils import data as D
+    params, loss_fn, batch = make_problem()
+    mesh = bps.make_mesh()
+    local = D.host_shard(batch)
+    gbatch = D.global_batch_from_local(local, mesh)
+    opt = bps.DistributedOptimizer(optax.sgd(0.1))
+    step = bps.build_train_step(loss_fn, opt, mesh, donate=False)
+    opt_state = opt.init(params)
+    losses = []
+    for _ in range(5):
+        params, opt_state, loss = step(params, opt_state, gbatch)
+        losses.append(float(loss))
+    emit(check="train", rank=bps.rank(), size=bps.size(), losses=losses)
+    bps.shutdown()
+
+
 def scenario_elastic_shrink():
     """World 2 -> suspend -> world 1 (worker 1 departs), keys stable."""
     bps.init()
@@ -318,6 +341,7 @@ SCENARIOS = {
     "basic": scenario_basic,
     "train": scenario_train,
     "train_solo": scenario_train_solo,
+    "train_localdata": scenario_train_localdata,
     "elastic_shrink": scenario_elastic_shrink,
     "elastic_grow": scenario_elastic_grow,
     "elastic_checkpoint": scenario_elastic_checkpoint,

@@ -101,20 +101,38 @@ def test_eager_collectives_three_processes():
         assert r["broadcast"]["w"] == [0.0] * 3
 
 
-def test_train_step_loss_parity_with_single_process():
+@pytest.fixture(scope="module")
+def solo_losses():
+    """The world-1 reference trajectory, launched once per module (both
+    parity tests compare against the identical solo run)."""
+    solo = _launch("train_solo", world=1)
+    ls = _by_check(solo[0])["train"]
+    assert ls["size"] == 1
+    return ls["losses"]
+
+
+def _assert_parity(mp, solo_losses):
+    l0 = _by_check(mp[0])["train"]
+    l1 = _by_check(mp[1])["train"]
+    assert l0["size"] == 2
+    np.testing.assert_allclose(l0["losses"], l1["losses"], rtol=1e-5)
+    np.testing.assert_allclose(l0["losses"], solo_losses, rtol=1e-4)
+    # and it actually trains
+    assert l0["losses"][-1] < l0["losses"][0]
+
+
+def test_train_step_loss_parity_with_single_process(solo_losses):
     """2-process DP training must track the single-process trajectory: the
     sum of per-shard gradients over half-batches equals the full-batch
     gradient (up to float reassociation)."""
-    mp = _launch("train", world=2)
-    solo = _launch("train_solo", world=1)
-    l0 = _by_check(mp[0])["train"]
-    l1 = _by_check(mp[1])["train"]
-    ls = _by_check(solo[0])["train"]
-    assert l0["size"] == 2 and ls["size"] == 1
-    np.testing.assert_allclose(l0["losses"], l1["losses"], rtol=1e-5)
-    np.testing.assert_allclose(l0["losses"], ls["losses"], rtol=1e-4)
-    # and it actually trains
-    assert l0["losses"][-1] < l0["losses"][0]
+    _assert_parity(_launch("train", world=2), solo_losses)
+
+
+def test_train_localdata_matches_global_batch(solo_losses):
+    """Per-process local input shards assembled via
+    utils.data.global_batch_from_local must produce the same trajectory as
+    every process holding the global batch (the multihost input pattern)."""
+    _assert_parity(_launch("train_localdata", world=2), solo_losses)
 
 
 def test_elastic_shrink_two_to_one():

@@ -48,6 +48,26 @@ def test_shard_batch(mesh8):
     np.testing.assert_array_equal(np.asarray(out["x"]), np.asarray(x))
 
 
+def test_host_shard_slices_global_batch():
+    x = jnp.arange(24.0).reshape(12, 2)
+    for r in range(3):
+        out = D.host_shard({"x": x}, rank=r, size=3)
+        np.testing.assert_array_equal(np.asarray(out["x"]),
+                                      np.asarray(x[r * 4:(r + 1) * 4]))
+    with pytest.raises(ValueError, match="divisible"):
+        D.host_shard({"x": x}, rank=0, size=5)
+
+
+def test_global_batch_from_local_single_process(mesh8):
+    """Single-process world: the local shard IS the global batch; the
+    result must be dp-sharded and value-identical (multi-process assembly
+    is covered by the jax.distributed worlds in test_multiprocess)."""
+    x = jnp.arange(32.0).reshape(16, 2)
+    out = D.global_batch_from_local({"x": x}, mesh8)
+    assert out["x"].sharding.spec == jax.sharding.PartitionSpec("dp")
+    np.testing.assert_array_equal(np.asarray(out["x"]), np.asarray(x))
+
+
 def test_prefetch_preserves_order(mesh8):
     batches = [{"x": jnp.full((8, 2), float(i))} for i in range(5)]
     out = list(D.prefetch_to_device(batches, size=2, mesh=mesh8))
