@@ -4,6 +4,14 @@ The reference builds its C++ core through setup.py extensions
 (reference: setup.py:249-337).  Here the core is framework-independent host
 logic, so a plain g++ shared-object build is enough; it is (re)built lazily on
 first import when the sources are newer than the binary.
+
+Sanitizer variants (coverage the reference's CI never had, SURVEY §5):
+`BYTEPS_TPU_TSAN=1` builds ThreadSanitizer, `BYTEPS_TPU_ASAN=1`
+AddressSanitizer + UBSan.  Sanitizers apply ONLY to the standalone PS
+server binary (server.serve() execs it): sanitizer runtimes cannot be
+dlopen'd into a running interpreter — TSAN's dlopen fails loudly, ASan
+init kills the process outright — so the in-process client/core library
+is always the plain build.
 """
 
 from __future__ import annotations
@@ -15,18 +23,33 @@ import sys
 _CORE_DIR = os.path.dirname(os.path.abspath(__file__))
 _SOURCES = ["core.cc", "server.cc"]
 _LIB_NAME = "libbyteps_core.so"
-_LIB_NAME_TSAN = "libbyteps_core_tsan.so"
+
+# env var -> (-fsanitize value, artifact suffix)
+_SANITIZERS = (
+    ("BYTEPS_TPU_TSAN", "thread", "_tsan"),
+    ("BYTEPS_TPU_ASAN", "address,undefined", "_asan"),
+)
 
 
-def _tsan() -> bool:
-    """BYTEPS_TPU_TSAN=1 builds/loads a ThreadSanitizer variant — the race
-    coverage for the host scheduler/server the reference never had
-    (SURVEY §5: 'CI does not run sanitizers')."""
-    return os.environ.get("BYTEPS_TPU_TSAN", "0") == "1"
+def _sanitizer():
+    """(fsanitize_value, suffix) for the first enabled sanitizer, else
+    (None, "")."""
+    for env, value, suffix in _SANITIZERS:
+        if os.environ.get(env, "0") == "1":
+            return value, suffix
+    return None, ""
+
+
+def sanitized() -> bool:
+    """True when any sanitizer variant is selected (server must exec the
+    standalone binary)."""
+    return _sanitizer()[0] is not None
 
 
 def lib_path() -> str:
-    return os.path.join(_CORE_DIR, _LIB_NAME_TSAN if _tsan() else _LIB_NAME)
+    # Always the PLAIN library: this .so is ctypes-loaded into running
+    # interpreters, where a sanitizer runtime cannot initialize.
+    return os.path.join(_CORE_DIR, _LIB_NAME)
 
 
 def _needs_build() -> bool:
@@ -41,6 +64,22 @@ def _needs_build() -> bool:
     return False
 
 
+def _san_flags() -> list:
+    value, _ = _sanitizer()
+    if value is None:
+        return []
+    flags = ["-g", f"-fsanitize={value}"]
+    if "address" in value:
+        flags.append("-fno-omit-frame-pointer")
+    if "undefined" in value:
+        # UBSan checks are recoverable by default: the binary would print
+        # a report and keep running, and with the test fixtures routing
+        # server stderr to DEVNULL the finding would vanish.  Make UB
+        # abort so the CI leg actually fails.
+        flags.append("-fno-sanitize-recover=undefined")
+    return flags
+
+
 def build(force: bool = False, verbose: bool = False) -> str:
     """Compile the native core if needed; returns the .so path.
 
@@ -52,12 +91,9 @@ def build(force: bool = False, verbose: bool = False) -> str:
     srcs = [os.path.join(_CORE_DIR, s) for s in _SOURCES
             if os.path.exists(os.path.join(_CORE_DIR, s))]
     cmd = [
-        "g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-pthread",
-        "-fvisibility=hidden", "-o", lib_path(), *srcs,
+        "g++", "-O2", "-std=c++17", "-shared", "-fPIC",
+        "-pthread", "-fvisibility=hidden", "-o", lib_path(), *srcs,
     ]
-    if _tsan():
-        cmd.insert(1, "-fsanitize=thread")
-        cmd.insert(1, "-g")
     if verbose:
         print(" ".join(cmd), file=sys.stderr)
     subprocess.run(cmd, check=True, capture_output=not verbose)
@@ -70,24 +106,22 @@ if __name__ == "__main__":
 
 
 _EXE_NAME = "bps_ps_server"
-_EXE_NAME_TSAN = "bps_ps_server_tsan"
 
 
 def exe_path() -> str:
-    return os.path.join(_CORE_DIR, _EXE_NAME_TSAN if _tsan() else _EXE_NAME)
+    _, suffix = _sanitizer()
+    return os.path.join(_CORE_DIR, f"{_EXE_NAME}{suffix}")
 
 
 def build_server_exe(force: bool = False) -> str:
-    """Standalone PS-server binary (required for TSAN, usable generally)."""
+    """Standalone PS-server binary (required under sanitizers, usable
+    generally)."""
     src = os.path.join(_CORE_DIR, "server.cc")
     out = exe_path()
     if not force and os.path.exists(out) \
             and os.path.getmtime(out) >= os.path.getmtime(src):
         return out
-    cmd = ["g++", "-O2", "-std=c++17", "-pthread", "-DBPS_SERVER_MAIN",
-           "-o", out, src]
-    if _tsan():
-        cmd.insert(1, "-fsanitize=thread")
-        cmd.insert(1, "-g")
+    cmd = ["g++", *_san_flags(), "-O2", "-std=c++17", "-pthread",
+           "-DBPS_SERVER_MAIN", "-o", out, src]
     subprocess.run(cmd, check=True, capture_output=True)
     return out

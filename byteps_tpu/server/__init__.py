@@ -19,11 +19,12 @@ def serve(port: int | None = None, num_workers: int | None = None,
           engine_threads: int | None = None, schedule: bool | None = None,
           async_mode: bool | None = None) -> int:
     """Run the native PS server (blocking). Returns its exit code —
-    EXCEPT under BYTEPS_TPU_TSAN=1, where this call never returns: the
-    server runs as a standalone sanitized binary (the TSAN runtime cannot
-    be dlopen'd into an interpreter) and os.execv REPLACES the calling
-    process with it, so the binary's exit code becomes the process's.
-    Don't call the TSAN path from a process that has work after serve().
+    EXCEPT under a sanitizer (BYTEPS_TPU_TSAN=1 / BYTEPS_TPU_ASAN=1),
+    where this call never returns: the server runs as a standalone
+    sanitized binary (sanitizer runtimes cannot be dlopen'd into an
+    interpreter) and os.execv REPLACES the calling process with it, so
+    the binary's exit code becomes the process's.  Don't call the
+    sanitized path from a process that has work after serve().
     """
     from ..core import build
     from ..common.config import get_config
@@ -41,7 +42,7 @@ def serve(port: int | None = None, num_workers: int | None = None,
         int(schedule if schedule is not None else cfg.server_enable_schedule),
         int(async_mode if async_mode is not None else cfg.enable_async),
     )
-    if os.environ.get("BYTEPS_TPU_TSAN", "0") == "1":
+    if build.sanitized():
         # exec, don't spawn: a subprocess.call child would survive as an
         # orphan when the supervising python gets SIGTERM (holding the
         # parent's stderr pipe open — observed as a communicate() hang in
