@@ -87,12 +87,16 @@ enum WireDtype : uint8_t {
 //   onebit(1):    f32 scale | u8 bits[ceil(n/8)]        (LSB-first, 1 = neg)
 //   topk(2):      u32 k | i32 idx[k] | f32 val[k]
 //   randomk(3):   u32 k | i32 idx[k] | f32 val[k]
-//   dithering(4): u8 flags(bit0=natural) | u8 s | f32 norm
-//                 | level bitstream [ceil(n*b/8)] | u8 signs[ceil(n/8)]
+//   dithering(4): u8 flags(bit0=natural, bit1=elias) | u8 s | f32 norm |...
+//     dense (bit1=0): level bitstream [ceil(n*b/8)] | u8 signs[ceil(n/8)]
 //                 (b = ceil(log2(s+1)); levels packed LSB-first at b bits —
-//                 dense like the reference's Elias-delta wire,
-//                 compressor/impl/dithering.cc:51-120, but fixed-width so
-//                 decode stays a flat loop)
+//                 fixed-width so decode stays a flat loop)
+//     elias (bit1=1): u32 nbits | stream — per NONZERO level,
+//                 EliasDelta(index gap, prev=-1) | sign bit |
+//                 EliasDelta(level); bits LSB-first within bytes, each
+//                 code MSB-first (the reference's sparse entropy coding,
+//                 compressor/impl/dithering.cc:51-120; bit-matched to
+//                 server/wire.py _emit_bitstream)
 // ---------------------------------------------------------------------------
 namespace codec {
 
@@ -160,6 +164,63 @@ inline bool Decompress(const std::vector<char>& payload,
       if (!r.Take(&flags, 1) || !r.Take(&s, 1) || !r.Take(&norm, 4))
         return false;
       if (s == 0) return false;
+      bool natural_p = (flags & 1) != 0;
+      if (flags & 2) {
+        // Sparse elias stream (see layout comment above).
+        uint32_t nbits = 0;
+        if (!r.Take(&nbits, 4)) return false;
+        size_t nbytes = (static_cast<size_t>(nbits) + 7) / 8;
+        if (r.left < nbytes) return false;
+        const unsigned char* stream =
+            reinterpret_cast<const unsigned char*>(r.p);
+        size_t pos = 0;
+        auto take = [&]() -> int {
+          int b = (stream[pos >> 3] >> (pos & 7)) & 1;
+          ++pos;
+          return b;
+        };
+        auto elias = [&](uint64_t* out) -> bool {
+          if (pos >= nbits) return false;
+          int zeros = 0;
+          bool saw_one = false;
+          while (pos < nbits) {
+            if (take() == 1) { saw_one = true; break; }
+            ++zeros;
+          }
+          if (!saw_one) return false;   // stream ended inside the prefix
+          if (zeros == 0) { *out = 1; return true; }
+          // Valid streams have zeros = LL-1 <= 5 (L <= 63 => LL <= 6); a
+          // longer prefix is malformed, and letting it through would wrap
+          // the 64-bit L reconstruction below past the L<=63 check.
+          if (zeros > 6) return false;
+          if (pos + zeros > nbits) return false;
+          uint64_t L = 1;
+          for (int i = 0; i < zeros; ++i) L = (L << 1) | take();
+          if (L < 1 || L > 63 || pos + (L - 1) > nbits) return false;
+          uint64_t v = 1;
+          for (uint64_t i = 1; i < L; ++i) v = (v << 1) | take();
+          *out = v;
+          return true;
+        };
+        int64_t idx = -1;
+        while (pos < nbits) {
+          uint64_t gap = 0, lvl = 0;
+          if (!elias(&gap)) return false;
+          idx += static_cast<int64_t>(gap);
+          if (idx < 0 || idx >= static_cast<int64_t>(n)) return false;
+          if (pos >= nbits) return false;
+          int sgn = take();
+          if (!elias(&lvl) || lvl > s) return false;
+          float mag;
+          if (natural_p)
+            mag = std::pow(2.0f, static_cast<float>(static_cast<int>(lvl)
+                                                    - static_cast<int>(s)));
+          else
+            mag = static_cast<float>(lvl) / static_cast<float>(s);
+          dst[idx] = (sgn ? -1.0f : 1.0f) * mag * norm;
+        }
+        return true;
+      }
       // Levels ride an LSB-first bitstream at b = ceil(log2(s+1)) bits per
       // element (bit-matched to server/wire.py _pack_levels).
       int b = 0;

@@ -17,17 +17,24 @@ Wire layout (little-endian):
     onebit(1):    f32 scale | u8 bits[ceil(n/8)]       (LSB-first, 1 = neg)
     topk(2):      u32 k | i32 idx[k] | f32 val[k]
     randomk(3):   u32 k | i32 idx[k] | f32 val[k]
-    dithering(4): u8 flags(bit0=natural) | u8 s | f32 norm
-                  | level bitstream [ceil(n*b/8)] | u8 signs[ceil(n/8)]
+    dithering(4): u8 flags(bit0=natural, bit1=elias) | u8 s | f32 norm | ...
+      dense (bit1=0): level bitstream [ceil(n*b/8)] | u8 signs[ceil(n/8)]
                   where b = ceil(log2(s+1)); levels are packed LSB-first at
                   b bits each, byte-contiguous.  (The on-device JAX plane
                   also bit-packs levels, but into sublane-layout uint32
                   words at 32//b levels per word — bitpack.pack_levels —
                   so the two planes' level streams are NOT interchangeable,
-                  like the sign streams.)  s=15 ships 4+1 bits/elem here,
+                  like the sign streams.)  s=15 ships 4+1 bits/elem,
                   within the reference's Elias-delta budget (reference:
                   compressor/impl/dithering.cc:51-120) without
                   variable-length decode.
+      elias (bit1=1, kwargs coding=elias): u32 nbits | stream — per
+                  NONZERO level in index order, EliasDelta(index gap,
+                  prev=-1) · sign bit · EliasDelta(level) — the
+                  reference's sparse entropy coding.  Bits are LSB-first
+                  within bytes; within one code, MSB-of-code-first.
+                  Denser than the dense form whenever most levels
+                  quantize to 0 (typical gradients).
 """
 
 from __future__ import annotations
@@ -74,6 +81,84 @@ def _unpack_levels(packed: np.ndarray, n: int, s: int) -> np.ndarray:
     return (raw << np.arange(b, dtype=np.int32)).sum(axis=1)
 
 
+def _bit_length(v: np.ndarray) -> np.ndarray:
+    """Vectorized bit_length for int64 1 <= v < 2^62 (the correction
+    shifts clip at 62; wire values — u32 index gaps, u8 levels — are far
+    inside the domain)."""
+    L = np.floor(np.log2(v.astype(np.float64))).astype(np.int64) + 1
+    # float edges: force 2^(L-1) <= v < 2^L exactly
+    L = np.where(v >> L.clip(0, 62) > 0, L + 1, L)
+    L = np.where((v < (np.int64(1) << (L - 1).clip(0, 62))) & (L > 1),
+                 L - 1, L)
+    return L
+
+
+def _elias_delta_codes(v: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+    """Elias-delta (code, length) pairs for int64 v >= 1.
+
+    Code layout (emitted MSB-of-code-first): LL-1 zeros, then L in LL bits
+    (MSB first), then v's low L-1 bits (MSB first) — where L = bitlen(v),
+    LL = bitlen(L).  The leading zeros carry no value, so the numeric code
+    is L's bits followed by v's low bits; `length` includes the zeros.
+    """
+    L = _bit_length(v)
+    LL = _bit_length(L)
+    length = 2 * LL + L - 2
+    low_mask = (np.int64(1) << (L - 1)) - 1
+    code = (L.astype(np.uint64) << (L - 1).astype(np.uint64)) \
+        | (v & low_mask).astype(np.uint64)
+    return code, length
+
+
+def _emit_bitstream(codes: np.ndarray, lengths: np.ndarray) -> Tuple[
+        np.ndarray, int]:
+    """Concatenate (code, length) pairs into an LSB-first-per-byte
+    bitstream; returns (uint8 bytes, total_bits).  Bit i of the stream is
+    (byte[i>>3] >> (i&7)) & 1; within one code, bits appear in
+    MSB-of-code-first order."""
+    total = int(lengths.sum())
+    if total == 0:
+        return np.zeros(0, np.uint8), 0
+    starts = np.concatenate([[0], np.cumsum(lengths)[:-1]])
+    owner = np.repeat(np.arange(len(codes)), lengths)
+    k = np.arange(total) - starts[owner]          # position within code
+    shift = (lengths[owner] - 1 - k).astype(np.uint64)
+    bits = ((codes[owner] >> shift) & np.uint64(1)).astype(np.uint8)
+    return np.packbits(bits, bitorder="little"), total
+
+
+class _BitCursor:
+    """Sequential LSB-first-per-byte bit reader (decode reference path —
+    the C++ server codec is the production decoder)."""
+
+    def __init__(self, data: np.ndarray, nbits: int):
+        self.bits = np.unpackbits(data, bitorder="little", count=nbits)
+        self.pos = 0
+
+    def left(self) -> int:
+        return len(self.bits) - self.pos
+
+    def take(self) -> int:
+        b = int(self.bits[self.pos])
+        self.pos += 1
+        return b
+
+    def take_int(self, nbits: int) -> int:
+        v = 0
+        for _ in range(nbits):
+            v = (v << 1) | self.take()
+        return v
+
+    def elias_delta(self) -> int:
+        ll = 1
+        while self.left() and self.take() == 0:
+            ll += 1
+        if ll == 1:
+            return 1        # L = 1 -> v = 1
+        L = (1 << (ll - 1)) | self.take_int(ll - 1)
+        return (1 << (L - 1)) | self.take_int(L - 1)
+
+
 def _xorshift32(x: np.ndarray) -> np.ndarray:
     x = x ^ (x << np.uint32(13))
     x = x ^ (x >> np.uint32(17))
@@ -116,6 +201,17 @@ class WireCompressor:
         self.s = int(_get(kwargs, "k", 127)) if ctype == "dithering" else 0
         self.partition = str(_get(kwargs, "partition", "linear"))
         self.normalize = str(_get(kwargs, "normalize", "max"))
+        # Dithering wire coding: "dense" = fixed ceil(log2(s+1)) bits per
+        # level; "elias" = the reference's sparse entropy coding — per
+        # NONZERO level, EliasDelta(index gap) · sign bit ·
+        # EliasDelta(level) (reference: compressor/impl/dithering.cc:
+        # 51-120).  Elias wins when most levels quantize to 0 (real
+        # gradients); dense wins on incompressible level streams and
+        # keeps decode a flat loop.
+        self.coding = str(_get(kwargs, "coding", "dense"))
+        if self.coding not in ("dense", "elias"):
+            raise ValueError(f"dithering coding={self.coding!r}; "
+                             f"options: dense, elias")
         if ctype in ("topk", "randomk") and self.k <= 0:
             raise ValueError(f"{ctype} requires k > 0")
         self.bidirectional = ctype == "onebit"
@@ -140,6 +236,7 @@ class WireCompressor:
         self.momentum_mu = parse_momentum(kwargs)
         self._mom: Dict[int, np.ndarray] = {}
         self._rng: Dict[int, np.ndarray] = {}  # per-partition-key PRNG lanes
+        self._last_recon: Optional[np.ndarray] = None  # see encode()
 
     def set_lr_scale(self, scale: float) -> None:
         """Rescale the carried EF error once when the learning rate
@@ -171,6 +268,8 @@ class WireCompressor:
         if self.name == "dithering":
             kw.update(k=str(self.s), seed=str(self.seed),
                       partition=self.partition, normalize=self.normalize)
+            if self.coding != "dense":
+                kw["coding"] = self.coding
         return ",".join(f"{k}={v}" for k, v in sorted(kw.items()))
 
     # -- encode -------------------------------------------------------------
@@ -199,11 +298,20 @@ class WireCompressor:
             if e is not None and e.size == x.size:
                 x = x + e
             blob = self._encode_raw(pkey, x)
-            self._err[pkey] = x - decode(blob, x.size)
+            # The dithering encoder hands back its reconstruction directly
+            # (the elias decode loop is sequential — don't pay it per
+            # push); other formats decode the blob, which doubles as a
+            # the-error-matches-the-wire self check.
+            recon = self._last_recon
+            self._last_recon = None
+            if recon is None:
+                recon = decode(blob, x.size)
+            self._err[pkey] = x - recon
             return blob
 
     def _encode_raw(self, pkey: int, x: np.ndarray) -> bytes:
         n = x.size
+        self._last_recon = None
         hdr = struct.pack("<BI", self.comp_id, n)
         if self.comp_id == COMP_ONEBIT:
             scale = (np.abs(x).sum() / max(n, 1)) if self.scaled else 1.0
@@ -246,10 +354,38 @@ class WireCompressor:
         self._rng[pkey] = rng
         u = (rng >> np.uint32(8)).astype(np.float32) / np.float32(1 << 24)
         level = (j + (u < p_up)).astype(np.uint8)
+        signs = x < 0
+        if self.ef:
+            # EF reconstruction computed here so encode() never needs the
+            # (sequential) elias decode loop; skipped entirely without EF
+            # (no extra O(n) pass or retained buffer).
+            if self.partition == "natural":
+                mag = np.where(level == 0, 0.0,
+                               2.0 ** (level.astype(np.float32) - s))
+            else:
+                mag = level.astype(np.float32) / np.float32(s)
+            self._last_recon = ((1.0 - 2.0 * signs) * mag
+                                * np.float32(norm)).astype(np.float32)
         flags = 1 if self.partition == "natural" else 0
+        if self.coding == "elias":
+            flags |= 2
+            nz = np.flatnonzero(level)
+            if nz.size:
+                gaps = np.diff(nz, prepend=-1).astype(np.int64)
+                gcode, glen = _elias_delta_codes(gaps)
+                lcode, llen = _elias_delta_codes(level[nz].astype(np.int64))
+                scode = signs[nz].astype(np.uint64)
+                slen = np.ones(nz.size, np.int64)
+                codes = np.stack([gcode, scode, lcode], 1).ravel()
+                lens = np.stack([glen, slen, llen], 1).ravel()
+                stream, nbits = _emit_bitstream(codes, lens)
+            else:
+                stream, nbits = np.zeros(0, np.uint8), 0
+            return (hdr + struct.pack("<BBfI", flags, s, np.float32(norm),
+                                      nbits) + stream.tobytes())
         return (hdr + struct.pack("<BBf", flags, s, np.float32(norm))
                 + _pack_levels(level, s).tobytes()
-                + _pack_bits(x < 0).tobytes())
+                + _pack_bits(signs).tobytes())
 
     def _levels(self) -> np.ndarray:
         s = self.s
@@ -280,12 +416,31 @@ def decode(data: bytes, n: int) -> np.ndarray:
         return out
     if comp == COMP_DITHERING:
         flags, s, norm = struct.unpack_from("<BBf", body, 0)
-        lvlbytes = (n * _level_bits(s) + 7) // 8
-        level = _unpack_levels(
-            np.frombuffer(body[6:6 + lvlbytes], np.uint8), n, s)
-        signs = _unpack_bits(
-            np.frombuffer(body[6 + lvlbytes:6 + lvlbytes + (n + 7) // 8],
-                          np.uint8), n)
+        if flags & 2:
+            # Sparse elias coding: EliasDelta(gap) · sign · EliasDelta(lvl)
+            # per nonzero.  Sequential reference decoder — the C++ server
+            # codec is the production path; encode-side EF uses the direct
+            # reconstruction and never calls this.
+            (nbits,) = struct.unpack_from("<I", body, 6)
+            cur = _BitCursor(np.frombuffer(
+                body[10:10 + (nbits + 7) // 8], np.uint8), nbits)
+            level = np.zeros(n, np.int64)
+            signs = np.zeros(n, np.uint8)
+            pos = -1
+            while cur.left() > 0:
+                pos += cur.elias_delta()
+                if pos >= n:
+                    raise ValueError("elias stream overruns tensor")
+                sgn = cur.take()
+                level[pos] = cur.elias_delta()
+                signs[pos] = sgn
+        else:
+            lvlbytes = (n * _level_bits(s) + 7) // 8
+            level = _unpack_levels(
+                np.frombuffer(body[6:6 + lvlbytes], np.uint8), n, s)
+            signs = _unpack_bits(
+                np.frombuffer(body[6 + lvlbytes:6 + lvlbytes + (n + 7) // 8],
+                              np.uint8), n)
         if flags & 1:
             mag = np.where(level == 0, 0.0,
                            2.0 ** (level.astype(np.float32) - s))

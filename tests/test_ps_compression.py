@@ -208,6 +208,40 @@ def test_dithering_wire_density_vs_elias_delta():
             assert len(blob) <= 11 + (5 * g.size + 7) // 8 + 16
 
 
+def test_dithering_elias_coding_density_and_parity():
+    """coding=elias ships the reference's sparse entropy coding (gap ·
+    sign · level per nonzero, reference dithering.cc:51-120): identical
+    reconstruction to the dense wire (same seed -> same quantization) and
+    strictly smaller payloads on sparse-quantizing gradients."""
+    rng = np.random.RandomState(12)
+    # heavy-tailed gradient: most levels quantize to 0 under max-norm
+    g = (rng.randn(10_000) * (rng.rand(10_000) < 0.2)).astype(np.float32)
+    for part, s in (("linear", 15), ("linear", 4), ("natural", 8)):
+        kw = {"compressor": "dithering", "k": str(s), "seed": "5",
+              "partition": part, "normalize": "max"}
+        dense = wire.WireCompressor(dict(kw)).encode(0, g)
+        eli = wire.WireCompressor(dict(kw, coding="elias")).encode(0, g)
+        np.testing.assert_array_equal(wire.decode(dense, g.size),
+                                      wire.decode(eli, g.size))
+        assert len(eli) < len(dense), (part, s, len(eli), len(dense))
+    with pytest.raises(ValueError, match="coding"):
+        wire.WireCompressor({"compressor": "dithering", "k": "15",
+                             "coding": "huffman"})
+
+
+def test_dithering_elias_with_ef_converges_error():
+    """EF over the elias wire: carried error equals x - reconstruction
+    (the encoder's direct recon path, no decode loop)."""
+    rng = np.random.RandomState(13)
+    g = rng.randn(2048).astype(np.float32)
+    wc = wire.WireCompressor({"compressor": "dithering", "k": "15",
+                              "seed": "5", "partition": "linear",
+                              "coding": "elias", "ef": "vanilla"})
+    blob = wc.encode(9, g)
+    recon = wire.decode(blob, g.size)
+    np.testing.assert_allclose(wc._err[9], g - recon, rtol=1e-6, atol=1e-7)
+
+
 def test_onebit_through_server_matches_requantization(ps_server):
     """2 workers, onebit, multiple partitions: the pulled result must equal
     decompress(onebit(sum of decompressed pushes)) per partition — the
@@ -240,6 +274,10 @@ def test_onebit_through_server_matches_requantization(ps_server):
      "partition": "linear", "normalize": "max"},
     {"compressor": "dithering", "k": "7", "seed": "5",
      "partition": "natural", "normalize": "l2"},
+    {"compressor": "dithering", "k": "15", "seed": "5",
+     "partition": "linear", "normalize": "max", "coding": "elias"},
+    {"compressor": "dithering", "k": "7", "seed": "5",
+     "partition": "natural", "normalize": "l2", "coding": "elias"},
 ])
 def test_unidirectional_through_server(ps_server, kwargs):
     """Unidirectional compressors: server decompress-sums; the pull leg is
@@ -254,6 +292,24 @@ def test_unidirectional_through_server(ps_server, kwargs):
     ref = wire.WireCompressor({str(k): str(v) for k, v in kwargs.items()})
     want = wire.decode(ref.encode((4 << 16) | 0, g), g.size)
     np.testing.assert_allclose(got, want, rtol=1e-6)
+    s.close()
+
+
+def test_elias_sparse_large_gaps_through_server(ps_server):
+    """The elias wire's large-gap regime (very sparse levels) through the
+    C++ decoder: the small dense-tensor cases only exercise gap=1-ish
+    codes; this pins multi-bit gap codes end-to-end."""
+    port = ps_server(num_workers=1)
+    kw = {"compressor": "dithering", "k": "15", "seed": "5",
+          "partition": "linear", "normalize": "max", "coding": "elias"}
+    s = _sess(port, 0, partition_bytes=1 << 20)  # one big partition
+    s.register_compressor(6, kw)
+    rng = np.random.RandomState(21)
+    g = (rng.randn(65536) * (rng.rand(65536) < 0.02)).astype(np.float32)
+    got = s.push_pull(6, g)
+    want = wire.decode(
+        wire.WireCompressor(dict(kw)).encode((6 << 16) | 0, g), g.size)
+    np.testing.assert_array_equal(got, want)
     s.close()
 
 
