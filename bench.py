@@ -487,13 +487,58 @@ def bench_ps():
                     time.sleep(0.1)
         raise RuntimeError("PS server lost the port race 4 times")
 
+    # BENCH_PS_COMPRESSOR: measure EFFECTIVE goodput with a compressed
+    # wire — logical gradient bytes synced per second while the TCP link
+    # carries the compressed stream (the reference's slow-network pitch:
+    # compression buys wire bytes, docs/performance.md:5-26).  Accepts a
+    # shorthand name or full "k=v,k=v" kwargs.
+    comp_env = os.environ.get("BENCH_PS_COMPRESSOR", "")
+    comp_presets = {
+        "onebit": {"compressor": "onebit"},
+        "dithering": {"compressor": "dithering", "k": "15", "seed": "5",
+                      "partition": "linear", "normalize": "max"},
+        "dithering_elias": {"compressor": "dithering", "k": "15",
+                            "seed": "5", "partition": "linear",
+                            "normalize": "max", "coding": "elias"},
+    }
+    comp_kw = None
+    if comp_env:
+        comp_kw = comp_presets.get(comp_env) or dict(
+            kv.split("=", 1) for kv in comp_env.split(","))
+
     proc, port = boot_server()
     try:
         sess = PSSession(["127.0.0.1"], [port], worker_id=0, num_servers=1,
                          wire_conns=int(os.environ.get(
-                             "BYTEPS_TPU_WIRE_CONNS", "2")))
+                             "BYTEPS_TPU_WIRE_CONNS", "2")),
+                         **({"min_compress_bytes": 0} if comp_kw else {}))
         x = np.random.default_rng(0).standard_normal(
             16 << 20, dtype=np.float32)            # 64 MB
+        wire_detail = {}
+        if comp_kw:
+            from byteps_tpu.server import wire as _wire
+            sess.register_compressor(1, comp_kw)
+            # Size one 4MB PARTITION (what the session actually ships, with
+            # its own per-partition norm) — encoding the whole 64MB in one
+            # call would also spike the elias emitter's per-bit temporaries.
+            part = x[:1 << 20]
+            blob = _wire.WireCompressor(dict(comp_kw)).encode(0, part)
+            wire_detail = {
+                "compressor": ",".join(f"{k}={v}"
+                                       for k, v in sorted(comp_kw.items())),
+                "wire_bytes_per_partition": len(blob),
+                "wire_reduction": round(part.nbytes / len(blob), 2),
+            }
+            if comp_kw.get("coding") == "elias":
+                # The bench tensor is dense standard-normal — the regime
+                # where elias roughly ties the dense packing.  Also report
+                # the heavy-tailed (sparse-quantizing) regime elias is FOR
+                # (real gradients: most levels quantize to 0).
+                sp = (part * (np.random.default_rng(1)
+                              .random(part.size) < 0.1)).astype(np.float32)
+                sblob = _wire.WireCompressor(dict(comp_kw)).encode(0, sp)
+                wire_detail["wire_reduction_sparse_gradient"] = round(
+                    sp.nbytes / len(sblob), 2)
         sess.push_pull(1, x)                       # init push + warm path
         reps = int(os.environ.get("BENCH_PS_REPS", "10"))
         t0 = time.perf_counter()
@@ -501,10 +546,11 @@ def bench_ps():
             sess.push_pull(1, x)
         dt = time.perf_counter() - t0
         sess.close()
-        goodput = 2 * x.nbytes * reps / dt / 1e9   # push + pull bytes
+        goodput = 2 * x.nbytes * reps / dt / 1e9   # logical push+pull bytes
         floor = echo_floor(x.nbytes, reps)
         print(json.dumps({
-            "metric": "ps_wire_goodput",
+            "metric": ("ps_wire_goodput_compressed" if comp_kw
+                       else "ps_wire_goodput"),
             "value": round(goodput, 3),
             "unit": "GB/s",
             "vs_baseline": round(goodput / floor, 3),
@@ -514,9 +560,13 @@ def bench_ps():
                 "partitions": -(-x.nbytes // (4 << 20)),
                 "transport": "loopback TCP, req_id-multiplexed",
                 "raw_loopback_echo_floor_gbps": round(floor, 3),
+                **wire_detail,
                 "note": "vs_baseline = fraction of this host's raw Python "
                         "loopback echo floor sustained by full PS "
-                        "semantics (partitioned, summed, round-tracked)",
+                        "semantics (partitioned, summed, round-tracked)"
+                        + ("; goodput counts LOGICAL f32 bytes — the wire "
+                           "carries the compressed stream" if comp_kw
+                           else ""),
             },
         }))
     finally:

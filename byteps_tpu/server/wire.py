@@ -139,6 +139,8 @@ class _BitCursor:
         return len(self.bits) - self.pos
 
     def take(self) -> int:
+        if self.pos >= len(self.bits):
+            raise ValueError("truncated elias stream")
         b = int(self.bits[self.pos])
         self.pos += 1
         return b
@@ -432,7 +434,10 @@ def decode(data: bytes, n: int) -> np.ndarray:
                 if pos >= n:
                     raise ValueError("elias stream overruns tensor")
                 sgn = cur.take()
-                level[pos] = cur.elias_delta()
+                lvl = cur.elias_delta()
+                if lvl > s:
+                    raise ValueError(f"elias level {lvl} > s={s}")
+                level[pos] = lvl
                 signs[pos] = sgn
         else:
             lvlbytes = (n * _level_bits(s) + 7) // 8

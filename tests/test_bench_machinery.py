@@ -71,6 +71,24 @@ def test_cnn_bench_emits_json():
 
 
 @pytest.mark.slow
+def test_ps_bench_compressed_mode_emits_json():
+    """BENCH_PS_COMPRESSOR: one JSON line with the compressed metric and
+    the wire-reduction factor (host-only — safe with a dead tunnel)."""
+    env = dict(os.environ)
+    env.update({"BENCH_PS": "1", "BENCH_PS_REPS": "2",
+                "BENCH_PS_COMPRESSOR": "onebit",
+                "BYTEPS_LOG_LEVEL": "ERROR"})
+    env.pop("XLA_FLAGS", None)
+    r = subprocess.run([sys.executable, BENCH], env=env,
+                       capture_output=True, text=True, timeout=600)
+    assert r.returncode == 0, r.stderr[-2000:]
+    out = json.loads(r.stdout.strip().splitlines()[-1])
+    assert out["metric"] == "ps_wire_goodput_compressed"
+    assert out["detail"]["wire_reduction"] > 30   # onebit: 32x on f32
+    assert out["value"] > 0
+
+
+@pytest.mark.slow
 def test_machinery_bench_bucketed_beats_naive():
     """Wall-clock: bucketed >= naive in the small-leaves regime.  Retries
     absorb CPU timing noise (observed band ~1.05-1.17x on an idle virtual
