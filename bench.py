@@ -36,6 +36,7 @@ or the 8-device virtual CPU mesh locally.
 
 from __future__ import annotations
 
+import dataclasses
 import json
 import os
 import time
@@ -92,11 +93,36 @@ def _time_steps(fn, params, opt_state, batch, n, per_step):
 
 
 def _attn_block_for(seq: int) -> int:
-    """BENCH_ATTN_BLOCK, normalized to 0 (auto) when the kernel would
-    reject it (must divide seq and be a multiple of 64) — so the JSON
-    label never claims a block the sweep didn't actually run."""
+    """BENCH_ATTN_BLOCK, normalized to the kernel's auto choice when unset
+    or when the kernel would reject it (must divide seq and be a multiple
+    of 64) — so the JSON label always states the block that actually ran.
+    The auto rule is imported, not duplicated, so record and kernel can't
+    drift."""
+    from byteps_tpu.models.transformer import flash_auto_block
     ab = int(os.environ.get("BENCH_ATTN_BLOCK", "0"))
-    return ab if ab and seq % ab == 0 and ab % 64 == 0 else 0
+    if ab and seq % ab == 0 and ab % 64 == 0:
+        return ab
+    return flash_auto_block(seq)
+
+
+def _cfg_with_env_overrides(cfg, seq: int, default_attn: str = ""):
+    """Apply the sweep env knobs (BENCH_ATTN / BENCH_ATTN_BLOCK /
+    BENCH_REMAT / BENCH_REMAT_POLICY) to a model config — one parser for
+    every bench branch so the knobs can't silently diverge.  Defaults
+    come from the config itself unless `default_attn` pins a different
+    attention choice (the flagship default)."""
+    attn = os.environ.get("BENCH_ATTN", default_attn or cfg.attn_impl)
+    return dataclasses.replace(
+        cfg, attn_impl=attn,
+        # BENCH_REMAT=0 disables per-layer remat entirely (viable only
+        # when the config avoids the S^2 logits, i.e. with flash, and at
+        # batches where saved activations fit HBM).
+        remat=(os.environ["BENCH_REMAT"] != "0"
+               if "BENCH_REMAT" in os.environ else cfg.remat),
+        remat_policy=os.environ.get("BENCH_REMAT_POLICY", cfg.remat_policy),
+        # Gate on flash so the record never carries a block the dense
+        # path silently ignored.
+        attn_block=_attn_block_for(seq) if attn == "flash" else 0)
 
 
 def bench_flagship():
@@ -120,30 +146,33 @@ def bench_flagship():
         # Bench any named config (e.g. BENCH_MODEL=llama_1b for the
         # modern-LLM block) at its native sequence length.  The streamed
         # LM head applies here too (llama_1b's full logits at seq 2048
-        # would be 2.1 GB of f32 HBM traffic).
+        # would be 2.1 GB of f32 HBM traffic).  BENCH_ATTN / _ATTN_BLOCK /
+        # _REMAT_POLICY / _BATCH override the config's defaults so sweeps
+        # (e.g. the long-seq block question in tools/mfu_sweep.py) can
+        # run on these geometries too.
         cfg = tfm.get_config(alt_model, causal=True, ce_chunk_rows=ce_chunk)
         seq = min(cfg.max_seq_len, 2048)
-        batch, steps = 8 * jax.device_count(), 10
+        cfg = _cfg_with_env_overrides(cfg, seq)
+        batch = int(os.environ.get("BENCH_BATCH",
+                                   "8")) * jax.device_count()
+        steps = 10
     else:
         # Full BERT-large geometry (reference benchmark: README.md:38-46),
-        # causal-LM objective, bf16 activations, per-layer remat.  Batch 48
-        # per chip saturates the v5e MXU (measured: 16->48 is +15% tokens/s,
-        # 48->64 is flat).  Round 4 adds the streamed LM-head cross-entropy
-        # (the full f32 logits were 3.2 GB of HBM traffic — the largest
-        # non-matmul cost).  Attention stays dense at seq 512: the flash
-        # kernel measured 0.91x dense here (docs/performance.md) — it wins
-        # beyond ~1-2k seq.  Each knob env-overridable for on-TPU sweeps:
-        # BENCH_CE_CHUNK=0 / BENCH_ATTN=flash / BENCH_REMAT_POLICY=dots.
-        attn = os.environ.get("BENCH_ATTN", "dense")
+        # causal-LM objective, bf16 activations, per-layer remat, streamed
+        # LM-head cross-entropy.  Round-4 on-chip sweep
+        # (bench_runs/r04_sweep{1,2}.jsonl): flash attention with a
+        # full-sequence 512 block beats XLA's dense fusion at this size
+        # (33.7k vs 30.6k tok/s at batch 48 — the old "0.91x at seq 512"
+        # guidance was measured at batch 16), and batch 64 under flash
+        # adds another 2% -> 34.3k tok/s, MFU 0.352 (dense at batch 64 is
+        # unmeasured).  Each knob stays env-overridable for sweeps:
+        # BENCH_CE_CHUNK=0 / BENCH_ATTN=dense / BENCH_REMAT_POLICY=proj /
+        # BENCH_BATCH=48.
         cfg = tfm.get_config(
             "bert_large", causal=True, vocab_size=32768, max_seq_len=512,
-            ce_chunk_rows=ce_chunk,
-            remat_policy=os.environ.get("BENCH_REMAT_POLICY", "none"),
-            attn_impl=attn,
-            # Gate on flash so the record never carries a block the dense
-            # path silently ignored.
-            attn_block=_attn_block_for(512) if attn == "flash" else 0)
-        batch = int(os.environ.get("BENCH_BATCH", "48")) * jax.device_count()
+            ce_chunk_rows=ce_chunk)
+        cfg = _cfg_with_env_overrides(cfg, 512, default_attn="flash")
+        batch = int(os.environ.get("BENCH_BATCH", "64")) * jax.device_count()
         seq, steps = 512, 10
 
     mesh = bps.make_mesh()  # all devices on dp
@@ -203,6 +232,7 @@ def bench_flagship():
             "ce_chunk_rows": cfg.ce_chunk_rows,
             "attn_impl": cfg.attn_impl,
             "attn_block": cfg.attn_block,
+            "remat": cfg.remat,
             "remat_policy": cfg.remat_policy,
             **_note(),
         },
@@ -824,13 +854,21 @@ def _flagship_orchestrate() -> None:
     env["BENCH_EXEC_CHILD"] = "1"
     rc, out = _run_bench_child(env, timeout=remaining(cpu_reserve + 400))
     _emit_child_result(rc, out)
-    if rc != 124:
+    if rc != 124 and not os.environ.get("BENCH_MODEL"):
         # Fast failure (not a wedge): one retry with the conservative
-        # config (classic full-logits CE, dense attention, full remat) in
-        # case a newer tuned default misbehaves on the real chip.
+        # config, in case a newer tuned default misbehaves on the real
+        # chip.  Exactly the BENCH_r02 driver-verified configuration
+        # (dense attention, full-logits CE, full remat, batch 16): the
+        # tuned default's batch 64 is only feasible because flash never
+        # materializes the S^2 logits, so the fallback must drop batch
+        # along with the kernel.  Only meaningful for the default
+        # bert_large path — for an explicit BENCH_MODEL these pins would
+        # RAISE the memory footprint (dense + full-logits CE at the
+        # model's native seq at a higher batch), so those runs go
+        # straight to the CPU rung instead.
         env.update({"BENCH_CE_CHUNK": "0", "BENCH_ATTN": "dense",
-                    "BENCH_ATTN_BLOCK": "0",
-                    "BENCH_REMAT_POLICY": "none",
+                    "BENCH_ATTN_BLOCK": "0", "BENCH_BATCH": "16",
+                    "BENCH_REMAT": "1", "BENCH_REMAT_POLICY": "none",
                     "BENCH_NOTE": ("conservative-retry: default config "
                                    f"failed in child (rc={rc})")})
         rc, out = _run_bench_child(env, timeout=remaining(cpu_reserve))

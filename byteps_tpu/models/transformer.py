@@ -300,6 +300,28 @@ def dense_attention(q, k, v, causal: bool):
     return jnp.einsum("bhqk,bhkd->bhqd", probs, v)
 
 
+def flash_auto_block(S: int) -> int:
+    """The flash adapter's auto block-size rule, exported so records (e.g.
+    bench.py's JSON detail) can state the block that actually runs without
+    duplicating the logic.  Returns 0 when no valid block exists (S not
+    divisible by 64).
+
+    S <= 512: the full sequence as one block (any multiple of 64 divides
+    itself) — measured on a v5e chip at BERT-large geometry, S=512, batch
+    48: block 512 = 33.7k tok/s vs 31.0k (256) vs 27.0k (128), i.e. the
+    old fixed-128 choice left 25% on the table
+    (bench_runs/r04_sweep1.jsonl); per-program VMEM stays small (block x
+    block f32 logits at 512 is 1 MB).  S > 512 keeps the previous 128
+    tile: the long-context regime (including the strict ring/Ulysses
+    path) was measured under 128 (docs/performance.md seq-2048/4096
+    rows) and larger blocks do more wasted masked compute on causal
+    diagonal blocks — don't extend the 512 preference there without an
+    on-chip measurement."""
+    if S <= 512:
+        return S if S % 64 == 0 else 0
+    return 128 if S % 128 == 0 else (64 if S % 64 == 0 else 0)
+
+
 def flash_attention_fn(q, k, v, causal: bool, strict: bool = False,
                        block: int = 0):
     """Adapter: [B, H, S, Dh] heads-layout -> the Pallas flash-attention
@@ -310,17 +332,18 @@ def flash_attention_fn(q, k, v, causal: bool, strict: bool = False,
     attention would materialize S x S logits at a length chosen precisely
     to avoid that (e.g. Ulysses long-context).
 
-    block=0 auto-selects 128 (the MXU-native tile).  A nonzero override
-    trades grid-iteration overhead against VMEM per program — at short S
-    a larger block means fewer, fatter programs (TransformerConfig.
-    attn_block / BENCH_ATTN_BLOCK sweep it on-chip).  Overrides must
-    divide S and be a multiple of 64 (the row-tile sizes the kernel
-    guarantees); anything else reverts to the AUTO choice — never to
-    dense, so a sweep value can't silently attribute dense throughput to
-    a flash config."""
+    block=0 auto-selects via `flash_auto_block` (full-sequence block at
+    S <= 512 — measured +25% over the old fixed 128 — and the classic
+    128 tile beyond; see its docstring for the evidence).  A nonzero
+    override trades grid-iteration overhead against VMEM per program by
+    hand (TransformerConfig.attn_block / BENCH_ATTN_BLOCK sweep it
+    on-chip).  Overrides must divide S and be a multiple of 64 (the
+    row-tile sizes the kernel guarantees); anything else reverts to the
+    AUTO choice — never to dense, so a sweep value can't silently
+    attribute dense throughput to a flash config."""
     B, H, S, Dh = q.shape
     if not block or S % block or block % 64:
-        block = 128 if S % 128 == 0 else (64 if S % 64 == 0 else 0)
+        block = flash_auto_block(S)
     if block == 0 or Dh % 8:
         if strict:
             raise ValueError(

@@ -26,23 +26,32 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 # Ordered by expected yield; the control run (current default) goes first
 # so every sweep file has an anchor measured the same hour.
+# Pass 3.  Pass 1+2 (bench_runs/r04_sweep{1,2}.jsonl) retuned the
+# flagship default to flash/block-512/batch-64 (34.3k tok/s, MFU 0.352).
+# This pass (a) anchors the NEW default (entry 0 = current defaults, per
+# the control-first rule above), and (b) measures the long-sequence
+# block question that gates `flash_auto_block`: S > 512 kept the classic
+# 128 tile because larger blocks were unmeasured there (more wasted
+# masked compute on causal diagonal blocks).  BENCH_MODEL=llama_1b runs
+# its native seq 2048.  Every entry pins BENCH_BATCH explicitly so a
+# future default change can't silently move an entry into a different
+# memory regime (pass-2 lesson).
 SWEEP = [
-    {"name": "control_b48",   "env": {}},
-    {"name": "proj_b48",      "env": {"BENCH_REMAT_POLICY": "proj"}},
-    {"name": "proj_b64",      "env": {"BENCH_REMAT_POLICY": "proj",
-                                      "BENCH_BATCH": "64"}},
-    {"name": "flash_b256",    "env": {"BENCH_ATTN": "flash",
-                                      "BENCH_ATTN_BLOCK": "256"}},
-    {"name": "flash_b512",    "env": {"BENCH_ATTN": "flash",
-                                      "BENCH_ATTN_BLOCK": "512"}},
-    {"name": "flash_auto",    "env": {"BENCH_ATTN": "flash"}},
-    {"name": "proj_flash",    "env": {"BENCH_REMAT_POLICY": "proj",
-                                      "BENCH_ATTN": "flash",
-                                      "BENCH_ATTN_BLOCK": "256"}},
-    {"name": "ce4096_b48",    "env": {"BENCH_CE_CHUNK": "4096"}},
-    {"name": "proj_ce4096_b64", "env": {"BENCH_REMAT_POLICY": "proj",
-                                        "BENCH_CE_CHUNK": "4096",
-                                        "BENCH_BATCH": "64"}},
+    {"name": "control_flash512_b64", "env": {"BENCH_BATCH": "64"}},
+    {"name": "dense_b64",            "env": {"BENCH_ATTN": "dense",
+                                             "BENCH_BATCH": "64"}},
+    {"name": "llama1b_s2048_blk128", "env": {"BENCH_MODEL": "llama_1b",
+                                             "BENCH_ATTN": "flash",
+                                             "BENCH_BATCH": "8",
+                                             "BENCH_ATTN_BLOCK": "128"}},
+    {"name": "llama1b_s2048_blk256", "env": {"BENCH_MODEL": "llama_1b",
+                                             "BENCH_ATTN": "flash",
+                                             "BENCH_BATCH": "8",
+                                             "BENCH_ATTN_BLOCK": "256"}},
+    {"name": "llama1b_s2048_blk512", "env": {"BENCH_MODEL": "llama_1b",
+                                             "BENCH_ATTN": "flash",
+                                             "BENCH_BATCH": "8",
+                                             "BENCH_ATTN_BLOCK": "512"}},
 ]
 
 PROBE = ("import jax, jax.numpy as jnp; "
