@@ -30,6 +30,11 @@ Modes:
   BENCH_SMALL=1      shrink the model for quick local runs
   BENCH_FORCE_CPU=1  8 virtual CPU devices
 
+Sweep knobs (tools/mfu_sweep.py): BENCH_MODEL picks any named config
+(e.g. llama_300m), BENCH_SEQ overrides its sequence length, BENCH_BATCH /
+BENCH_ATTN / BENCH_ATTN_BLOCK / BENCH_REMAT / BENCH_REMAT_POLICY /
+BENCH_CE_CHUNK override the rest of the geometry.
+
 Runs on whatever jax.devices() offers: the real TPU chip under the driver,
 or the 8-device virtual CPU mesh locally.
 """
@@ -112,6 +117,12 @@ def _cfg_with_env_overrides(cfg, seq: int, default_attn: str = ""):
     come from the config itself unless `default_attn` pins a different
     attention choice (the flagship default)."""
     attn = os.environ.get("BENCH_ATTN", default_attn or cfg.attn_impl)
+    if attn == "flash" and _attn_block_for(seq) == 0:
+        # flash_attention_fn would silently fall back to dense here and
+        # the record would archive dense throughput under a flash label —
+        # an invalid sweep geometry must fail loudly instead.
+        raise SystemExit(f"BENCH_ATTN=flash needs seq divisible by 64 "
+                         f"(got BENCH_SEQ/seq={seq})")
     return dataclasses.replace(
         cfg, attn_impl=attn,
         # BENCH_REMAT=0 disables per-layer remat entirely (viable only
@@ -151,7 +162,10 @@ def bench_flagship():
         # (e.g. the long-seq block question in tools/mfu_sweep.py) can
         # run on these geometries too.
         cfg = tfm.get_config(alt_model, causal=True, ce_chunk_rows=ce_chunk)
-        seq = min(cfg.max_seq_len, 2048)
+        seq = int(os.environ.get("BENCH_SEQ", "0")) \
+            or min(cfg.max_seq_len, 2048)
+        if seq > cfg.max_seq_len:
+            cfg = dataclasses.replace(cfg, max_seq_len=seq)
         cfg = _cfg_with_env_overrides(cfg, seq)
         batch = int(os.environ.get("BENCH_BATCH",
                                    "8")) * jax.device_count()

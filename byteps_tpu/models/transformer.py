@@ -141,6 +141,16 @@ CONFIGS: Dict[str, TransformerConfig] = {
                                   d_model=2048, num_heads=32, num_kv_heads=8,
                                   d_ff=5504, max_seq_len=2048, norm="rmsnorm",
                                   act="swiglu", pos="rope", use_bias=False),
+    # ~300M-param llama geometry: the largest modern-LLM config whose f32
+    # master weights + Adam moments (~4.8 GB) leave headroom for a real
+    # batch at seq 2048 on one 16 GB chip — llama_1b's ~9.3 GB of
+    # optimizer state OOMs the single-chip bench, so long-sequence
+    # single-chip sweeps run here (multi-chip llama_1b shards the state).
+    "llama_300m": TransformerConfig(vocab_size=32768, num_layers=24,
+                                    d_model=1024, num_heads=16,
+                                    num_kv_heads=4, d_ff=2816,
+                                    max_seq_len=2048, norm="rmsnorm",
+                                    act="swiglu", pos="rope", use_bias=False),
 }
 
 

@@ -130,6 +130,26 @@ def test_llama_param_specs_tree_matches_params():
         specs, is_leaf=lambda x: isinstance(x, jax.sharding.PartitionSpec))
 
 
+def test_every_named_config_is_consistent():
+    """Every CONFIGS entry builds, and its param tree (via eval_shape —
+    bench-scale configs never materialize) matches its TP spec tree leaf
+    for leaf, with spec ranks == param ranks."""
+    for name in tfm.CONFIGS:
+        cfg = tfm.get_config(name)
+        shapes = jax.eval_shape(lambda k, c=cfg: tfm.init_params(k, c),
+                                jax.random.key(0))
+        specs = tfm.param_specs(cfg)
+        is_spec = lambda x: isinstance(x, jax.sharding.PartitionSpec)
+        assert jax.tree.structure(shapes) == jax.tree.structure(
+            specs, is_leaf=is_spec), name
+        for path, spec in jax.tree.flatten_with_path(
+                specs, is_leaf=is_spec)[0]:
+            leaf = shapes
+            for p in path:
+                leaf = leaf[p.key if hasattr(p, "key") else p.idx]
+            assert len(spec) <= leaf.ndim, (name, path, spec, leaf.shape)
+
+
 def test_transformer_remat_matches_no_remat():
     cfg_r = tfm.get_config("tiny", remat=True, dtype=jnp.float32)
     cfg_n = tfm.get_config("tiny", remat=False, dtype=jnp.float32)

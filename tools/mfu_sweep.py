@@ -24,34 +24,37 @@ import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
-# Ordered by expected yield; the control run (current default) goes first
-# so every sweep file has an anchor measured the same hour.
-# Pass 3.  Pass 1+2 (bench_runs/r04_sweep{1,2}.jsonl) retuned the
-# flagship default to flash/block-512/batch-64 (34.3k tok/s, MFU 0.352).
-# This pass (a) anchors the NEW default (entry 0 = current defaults, per
-# the control-first rule above), and (b) measures the long-sequence
-# block question that gates `flash_auto_block`: S > 512 kept the classic
-# 128 tile because larger blocks were unmeasured there (more wasted
-# masked compute on causal diagonal blocks).  BENCH_MODEL=llama_1b runs
-# its native seq 2048.  Every entry pins BENCH_BATCH explicitly so a
-# future default change can't silently move an entry into a different
-# memory regime (pass-2 lesson).
+# Conventions (learned over passes 1-4, results in bench_runs/):
+# - an anchor of the current default opens a pass whenever the default
+#   moved, so every sweep file self-calibrates against the same hour;
+# - every entry pins BENCH_BATCH explicitly so a future default change
+#   can't silently move an entry into a different memory regime;
+# - entries that escalate memory carry `group`: once one entry of a
+#   group fails (OOM), later entries of the SAME group are skipped — an
+#   OOM-ing remote compile is exactly what wedged the tunnel in the
+#   pass-2 postmortem.
+#
+# Pass 5.  Pass 4 (bench_runs/r04_sweep4.jsonl) closed the no-remat
+# question (scan-stacked activations OOM the compile even at batch 16)
+# and found llama_1b's optimizer state alone (~9.3 GB f32 Adam) OOMs the
+# single-chip bench — so the long-seq block question moves to the new
+# llama_300m config (native seq 2048, ~4.8 GB of state), plus the
+# dense-attention anchor the flagship table still lists as unmeasured.
 SWEEP = [
-    {"name": "control_flash512_b64", "env": {"BENCH_BATCH": "64"}},
-    {"name": "dense_b64",            "env": {"BENCH_ATTN": "dense",
-                                             "BENCH_BATCH": "64"}},
-    {"name": "llama1b_s2048_blk128", "env": {"BENCH_MODEL": "llama_1b",
-                                             "BENCH_ATTN": "flash",
-                                             "BENCH_BATCH": "8",
-                                             "BENCH_ATTN_BLOCK": "128"}},
-    {"name": "llama1b_s2048_blk256", "env": {"BENCH_MODEL": "llama_1b",
-                                             "BENCH_ATTN": "flash",
-                                             "BENCH_BATCH": "8",
-                                             "BENCH_ATTN_BLOCK": "256"}},
-    {"name": "llama1b_s2048_blk512", "env": {"BENCH_MODEL": "llama_1b",
-                                             "BENCH_ATTN": "flash",
-                                             "BENCH_BATCH": "8",
-                                             "BENCH_ATTN_BLOCK": "512"}},
+    {"name": "l300m_s2048_blk128", "group": "llama",
+     "env": {"BENCH_MODEL": "llama_300m", "BENCH_ATTN": "flash",
+             "BENCH_BATCH": "8", "BENCH_ATTN_BLOCK": "128"}},
+    {"name": "l300m_s2048_blk256", "group": "llama",
+     "env": {"BENCH_MODEL": "llama_300m", "BENCH_ATTN": "flash",
+             "BENCH_BATCH": "8", "BENCH_ATTN_BLOCK": "256"}},
+    {"name": "l300m_s2048_blk512", "group": "llama",
+     "env": {"BENCH_MODEL": "llama_300m", "BENCH_ATTN": "flash",
+             "BENCH_BATCH": "8", "BENCH_ATTN_BLOCK": "512"}},
+    {"name": "l300m_s2048_dense", "group": "llama",
+     "env": {"BENCH_MODEL": "llama_300m", "BENCH_ATTN": "dense",
+             "BENCH_BATCH": "8"}},
+    {"name": "dense_b64",
+     "env": {"BENCH_ATTN": "dense", "BENCH_BATCH": "64"}},
 ]
 
 PROBE = ("import jax, jax.numpy as jnp; "
@@ -102,8 +105,16 @@ def main() -> None:
     out_path = sys.argv[1] if len(sys.argv) > 1 else \
         os.path.join(REPO, "sweep_results.jsonl")
     timeout = float(os.environ.get("SWEEP_RUN_TIMEOUT", "700"))
+    failed_groups = set()
     with open(out_path, "a") as f:
         for entry in SWEEP:
+            if entry.get("group") in failed_groups:
+                print(f"[sweep] skipping {entry['name']} (group "
+                      f"{entry['group']!r} already failed)", file=sys.stderr)
+                f.write(json.dumps({"name": entry["name"],
+                                    "skipped": "group failed"}) + "\n")
+                f.flush()
+                continue
             if not tunnel_alive():
                 print(f"[sweep] tunnel wedged before {entry['name']}; "
                       f"stopping", file=sys.stderr)
@@ -115,6 +126,8 @@ def main() -> None:
             rec = run_one(entry, timeout)
             f.write(json.dumps(rec) + "\n")
             f.flush()
+            if rec["rc"] != 0 and entry.get("group"):
+                failed_groups.add(entry["group"])
             res = rec.get("result", {}).get("detail", {})
             print(f"[sweep] {entry['name']}: rc={rec['rc']} "
                   f"tok/s={res.get('tokens_per_sec_per_chip')} "
