@@ -9,6 +9,7 @@ on the dp axis, Megatron-style column/row specs on the tp axis
 
   python example/jax/train_llama_byteps.py --steps 20
   python example/jax/train_llama_byteps.py --tp 2 --model llama_tiny
+  python example/jax/train_llama_byteps.py --tp 2 --zero1   # ZeRO-1
 """
 
 import argparse
@@ -32,6 +33,9 @@ def main():
     ap.add_argument("--tp", type=int, default=1,
                     help="tensor-parallel degree (GSPMD-sharded params)")
     ap.add_argument("--attn", choices=["dense", "flash"], default="dense")
+    ap.add_argument("--zero1", action="store_true",
+                    help="shard optimizer state over dp (GSPMD path; "
+                         "Adam moments drop to 1/dp per chip)")
     args = ap.parse_args()
 
     bps.init()
@@ -44,15 +48,19 @@ def main():
     def loss_f(p, b):
         return tfm.loss_fn(p, b, cfg)
 
-    if args.tp > 1:
+    if args.tp > 1 or args.zero1:
         # GSPMD path: params stay column/row-sharded over 'tp' end to end
         # (build_train_step's shard_map replicates params — wrong tool
-        # for TP).
+        # for TP); --zero1 additionally shards the Adam moments over 'dp'
+        # (weight-update sharding — the state that OOMs first at scale).
         specs = tfm.param_specs(cfg)
         params = sharded.shard_params(params, mesh, specs)
         raw_opt = optax.adamw(3e-3)
-        step = bps.build_sharded_train_step(loss_f, raw_opt, mesh, specs)
-        opt_state = raw_opt.init(params)
+        step = bps.build_sharded_train_step(
+            loss_f, raw_opt, mesh, specs, zero1=args.zero1,
+            params=params if args.zero1 else None)
+        opt_state = (sharded.zero1_init(raw_opt, params, mesh, specs)
+                     if args.zero1 else raw_opt.init(params))
     else:
         opt = bps.DistributedOptimizer(optax.adamw(3e-3))
         step = bps.build_train_step(loss_f, opt, mesh)
