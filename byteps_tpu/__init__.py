@@ -33,7 +33,7 @@ from .parallel.mesh import (
 from .parallel.cross_barrier import CrossBarrierDriver, run_cross_barrier
 from .parallel.sharded import (
     build_sharded_train_step, shard_params, init_sharded,
-    zero1_opt_specs, zero1_init,
+    zero1_opt_specs, zero1_init, fsdp_param_specs, fsdp_init,
 )
 from .ops import compressor
 from .ops import ring_attention
@@ -66,6 +66,6 @@ __all__ = [
     "reset_mesh",
     "CrossBarrierDriver", "run_cross_barrier",
     "build_sharded_train_step", "shard_params", "init_sharded",
-    "zero1_opt_specs", "zero1_init",
+    "zero1_opt_specs", "zero1_init", "fsdp_param_specs", "fsdp_init",
     "compressor", "ring_attention", "models", "callbacks", "utils",
 ]

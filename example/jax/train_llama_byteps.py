@@ -10,6 +10,7 @@ on the dp axis, Megatron-style column/row specs on the tp axis
   python example/jax/train_llama_byteps.py --steps 20
   python example/jax/train_llama_byteps.py --tp 2 --model llama_tiny
   python example/jax/train_llama_byteps.py --tp 2 --zero1   # ZeRO-1
+  python example/jax/train_llama_byteps.py --fsdp           # ZeRO-3-style
 """
 
 import argparse
@@ -36,6 +37,9 @@ def main():
     ap.add_argument("--zero1", action="store_true",
                     help="shard optimizer state over dp (GSPMD path; "
                          "Adam moments drop to 1/dp per chip)")
+    ap.add_argument("--fsdp", action="store_true",
+                    help="shard the params themselves over dp too "
+                         "(ZeRO-3-style; params+grads+moments all 1/dp)")
     args = ap.parse_args()
 
     bps.init()
@@ -48,19 +52,31 @@ def main():
     def loss_f(p, b):
         return tfm.loss_fn(p, b, cfg)
 
-    if args.tp > 1 or args.zero1:
+    if args.tp > 1 or args.zero1 or args.fsdp:
         # GSPMD path: params stay column/row-sharded over 'tp' end to end
         # (build_train_step's shard_map replicates params — wrong tool
         # for TP); --zero1 additionally shards the Adam moments over 'dp'
-        # (weight-update sharding — the state that OOMs first at scale).
+        # (weight-update sharding — the state that OOMs first at scale);
+        # --fsdp shards the params themselves over 'dp' as well, with the
+        # optimizer state following the params' layout.
         specs = tfm.param_specs(cfg)
+        if args.fsdp:
+            specs = sharded.fsdp_param_specs(params, mesh,
+                                             base_specs=specs)
         params = sharded.shard_params(params, mesh, specs)
         raw_opt = optax.adamw(3e-3)
+        z_specs = (sharded.zero1_opt_specs(raw_opt, params, mesh, specs)
+                   if args.zero1 else None)
         step = bps.build_sharded_train_step(
             loss_f, raw_opt, mesh, specs, zero1=args.zero1,
-            params=params if args.zero1 else None)
-        opt_state = (sharded.zero1_init(raw_opt, params, mesh, specs)
-                     if args.zero1 else raw_opt.init(params))
+            zero1_specs=z_specs)
+        if args.zero1:
+            opt_state = sharded.zero1_init(raw_opt, params, mesh, specs,
+                                           opt_specs=z_specs)
+        elif args.fsdp:
+            opt_state = sharded.fsdp_init(raw_opt, params, mesh, specs)
+        else:
+            opt_state = raw_opt.init(params)
     else:
         opt = bps.DistributedOptimizer(optax.adamw(3e-3))
         step = bps.build_train_step(loss_f, opt, mesh)

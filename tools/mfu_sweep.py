@@ -34,25 +34,25 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 #   OOM-ing remote compile is exactly what wedged the tunnel in the
 #   pass-2 postmortem.
 #
-# Pass 5.  Pass 4 (bench_runs/r04_sweep4.jsonl) closed the no-remat
-# question (scan-stacked activations OOM the compile even at batch 16)
-# and found llama_1b's optimizer state alone (~9.3 GB f32 Adam) OOMs the
-# single-chip bench — so the long-seq block question moves to the new
-# llama_300m config (native seq 2048, ~4.8 GB of state), plus the
-# dense-attention anchor the flagship table still lists as unmeasured.
+# Pass 5 (first half in bench_runs/r04_sweep5.jsonl): at llama_300m
+# seq 2048 batch 8, flash block 256 beats 128 by +34% (20.7k vs 15.4k
+# tok/s) — then the tunnel wedged.  This remainder finishes the block
+# ladder (512, dense anchor) and asks whether the low absolute MFU
+# (0.19) is batch starvation: batch escalates 16 -> 24 under block 256
+# (grouped — an OOM stops the escalation).
 SWEEP = [
-    {"name": "l300m_s2048_blk128", "group": "llama",
-     "env": {"BENCH_MODEL": "llama_300m", "BENCH_ATTN": "flash",
-             "BENCH_BATCH": "8", "BENCH_ATTN_BLOCK": "128"}},
-    {"name": "l300m_s2048_blk256", "group": "llama",
-     "env": {"BENCH_MODEL": "llama_300m", "BENCH_ATTN": "flash",
-             "BENCH_BATCH": "8", "BENCH_ATTN_BLOCK": "256"}},
     {"name": "l300m_s2048_blk512", "group": "llama",
      "env": {"BENCH_MODEL": "llama_300m", "BENCH_ATTN": "flash",
              "BENCH_BATCH": "8", "BENCH_ATTN_BLOCK": "512"}},
     {"name": "l300m_s2048_dense", "group": "llama",
      "env": {"BENCH_MODEL": "llama_300m", "BENCH_ATTN": "dense",
              "BENCH_BATCH": "8"}},
+    {"name": "l300m_b16_blk256", "group": "lbatch",
+     "env": {"BENCH_MODEL": "llama_300m", "BENCH_ATTN": "flash",
+             "BENCH_BATCH": "16", "BENCH_ATTN_BLOCK": "256"}},
+    {"name": "l300m_b24_blk256", "group": "lbatch",
+     "env": {"BENCH_MODEL": "llama_300m", "BENCH_ATTN": "flash",
+             "BENCH_BATCH": "24", "BENCH_ATTN_BLOCK": "256"}},
     {"name": "dense_b64",
      "env": {"BENCH_ATTN": "dense", "BENCH_BATCH": "64"}},
 ]
