@@ -50,7 +50,16 @@ def restore(path: str, template: Optional[PyTree] = None,
     """Load the checkpoint at `path`; with `broadcast` (default) the result
     is broadcast from rank 0 so all workers start bit-identical — the same
     consistency contract the reference gets from broadcast_parameters
-    (reference: torch/__init__.py:259-291)."""
+    (reference: torch/__init__.py:259-291).
+
+    When the template's leaves are jax.Arrays, each leaf restores with
+    the TEMPLATE's sharding (orbax restore_args), not the sharding
+    recorded in the checkpoint file — so a run saved on one mesh resumes
+    correctly on a different topology (elastic resize, the reference's
+    suspend/resume scenario), and sharded (FSDP/ZeRO) state restores
+    partitioned without ever materializing replicated."""
+    import jax
+
     apath = os.path.abspath(os.path.expanduser(path))
     if template is not None:
         # Hand orbax the template so it restores directly into the caller's
@@ -58,7 +67,16 @@ def restore(path: str, template: Optional[PyTree] = None,
         # would silently permute leaves whenever orbax's container flatten
         # order differs from the template's — e.g. >=10 tuple entries
         # restored as string-keyed dicts sort "10" before "2".)
-        restored = _ckptr().restore(apath, item=template)
+        restore_args = None
+        if all(isinstance(l, jax.Array)
+               for l in jax.tree.leaves(template)):
+            # Without restore_args orbax repopulates shardings from the
+            # file — stale device assignments when the mesh changed
+            # between save and restore.
+            from orbax.checkpoint import checkpoint_utils
+            restore_args = checkpoint_utils.construct_restore_args(template)
+        restored = _ckptr().restore(apath, item=template,
+                                    restore_args=restore_args)
     else:
         restored = _ckptr().restore(apath)
     if broadcast:
