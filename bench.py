@@ -32,8 +32,9 @@ Modes:
 
 Sweep knobs (tools/mfu_sweep.py): BENCH_MODEL picks any named config
 (e.g. llama_300m), BENCH_SEQ overrides its sequence length, BENCH_BATCH /
-BENCH_ATTN / BENCH_ATTN_BLOCK / BENCH_REMAT / BENCH_REMAT_POLICY /
-BENCH_CE_CHUNK override the rest of the geometry.
+BENCH_ATTN / BENCH_ATTN_BLOCK / BENCH_ATTN_BLOCK_K (decoupled K/V tile) /
+BENCH_REMAT / BENCH_REMAT_POLICY / BENCH_CE_CHUNK override the rest of
+the geometry.
 
 Runs on whatever jax.devices() offers: the real TPU chip under the driver,
 or the 8-device virtual CPU mesh locally.
@@ -123,6 +124,14 @@ def _cfg_with_env_overrides(cfg, seq: int, default_attn: str = ""):
         # an invalid sweep geometry must fail loudly instead.
         raise SystemExit(f"BENCH_ATTN=flash needs seq divisible by 64 "
                          f"(got BENCH_SEQ/seq={seq})")
+    bk = 0
+    if attn == "flash":
+        # Same normalize-to-auto contract as BENCH_ATTN_BLOCK: an invalid
+        # K tile reverts to the Q tile (exactly what the adapter would
+        # run), and the knob is ignored entirely off the flash path.
+        bk = int(os.environ.get("BENCH_ATTN_BLOCK_K", "0"))
+        if bk and (seq % bk or bk % 64):
+            bk = 0
     return dataclasses.replace(
         cfg, attn_impl=attn,
         # BENCH_REMAT=0 disables per-layer remat entirely (viable only
@@ -133,7 +142,8 @@ def _cfg_with_env_overrides(cfg, seq: int, default_attn: str = ""):
         remat_policy=os.environ.get("BENCH_REMAT_POLICY", cfg.remat_policy),
         # Gate on flash so the record never carries a block the dense
         # path silently ignored.
-        attn_block=_attn_block_for(seq) if attn == "flash" else 0)
+        attn_block=_attn_block_for(seq) if attn == "flash" else 0,
+        attn_block_k=bk if attn == "flash" else 0)
 
 
 def bench_flagship():
@@ -246,6 +256,7 @@ def bench_flagship():
             "ce_chunk_rows": cfg.ce_chunk_rows,
             "attn_impl": cfg.attn_impl,
             "attn_block": cfg.attn_block,
+            "attn_block_k": cfg.attn_block_k or cfg.attn_block,
             "remat": cfg.remat,
             "remat_policy": cfg.remat_policy,
             **_note(),

@@ -73,6 +73,10 @@ class TransformerConfig:
     # Flash-kernel block size override (0 = auto 128).  Larger blocks at
     # short S mean fewer, fatter kernel programs; must divide seq_len.
     attn_block: int = 0
+    # K/V tile override (0 = same as attn_block).  Decoupling lets long-S
+    # sweeps trade per-iteration VMEM / causal masked waste (K tile)
+    # against program count (Q tile) independently.
+    attn_block_k: int = 0
     # Fused LM-head cross-entropy: > 0 streams the readout matmul + softmax
     # in row chunks of this size so the [B*S, vocab] logits are never
     # materialized (forward OR backward — each chunk is rematerialised).
@@ -333,7 +337,7 @@ def flash_auto_block(S: int) -> int:
 
 
 def flash_attention_fn(q, k, v, causal: bool, strict: bool = False,
-                       block: int = 0):
+                       block: int = 0, block_k: int = 0):
     """Adapter: [B, H, S, Dh] heads-layout -> the Pallas flash-attention
     kernel's [BH, S, Dh] layout, with automatic fallback to dense attention
     when the shape doesn't meet the kernel's tiling constraints (S must
@@ -347,13 +351,19 @@ def flash_attention_fn(q, k, v, causal: bool, strict: bool = False,
     128 tile beyond; see its docstring for the evidence).  A nonzero
     override trades grid-iteration overhead against VMEM per program by
     hand (TransformerConfig.attn_block / BENCH_ATTN_BLOCK sweep it
-    on-chip).  Overrides must divide S and be a multiple of 64 (the
-    row-tile sizes the kernel guarantees); anything else reverts to the
-    AUTO choice — never to dense, so a sweep value can't silently
-    attribute dense throughput to a flash config."""
+    on-chip); `block_k` additionally decouples the K/V tile from the Q
+    tile (TransformerConfig.attn_block_k) — at long S the Q tile sets
+    program count while the K tile sets per-iteration VMEM and masked
+    waste on causal diagonals, and the optimum need not be square.
+    Overrides must divide S and be a multiple of 64 (the row-tile sizes
+    the kernel guarantees); anything else reverts to the AUTO choice —
+    never to dense, so a sweep value can't silently attribute dense
+    throughput to a flash config."""
     B, H, S, Dh = q.shape
     if not block or S % block or block % 64:
         block = flash_auto_block(S)
+    if not block_k or S % block_k or block_k % 64:
+        block_k = block
     if block == 0 or Dh % 8:
         if strict:
             raise ValueError(
@@ -366,7 +376,7 @@ def flash_attention_fn(q, k, v, causal: bool, strict: bool = False,
     def fold(t):
         return t.reshape(B * H, S, Dh)
     out = flash_attention(fold(q), fold(k), fold(v), causal, None,
-                          block, block)
+                          block, block_k)
     return out.reshape(B, H, S, Dh)
 
 
@@ -457,9 +467,11 @@ def forward_hidden(params: PyTree, tokens: jax.Array, cfg: TransformerConfig,
                 f"make_ulysses_attn_fn); built-ins: "
                 f"{sorted(_ATTN_IMPLS)}")
         attn_fn = _ATTN_IMPLS[cfg.attn_impl]
-        if cfg.attn_impl == "flash" and cfg.attn_block:
+        if cfg.attn_impl == "flash" and (cfg.attn_block
+                                         or cfg.attn_block_k):
             attn_fn = functools.partial(flash_attention_fn,
-                                        block=cfg.attn_block)
+                                        block=cfg.attn_block,
+                                        block_k=cfg.attn_block_k)
     dt = cfg.dtype
     B, S = tokens.shape
     x = params["embed"].astype(dt)[tokens]

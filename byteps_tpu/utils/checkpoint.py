@@ -67,21 +67,27 @@ def restore(path: str, template: Optional[PyTree] = None,
         # would silently permute leaves whenever orbax's container flatten
         # order differs from the template's — e.g. >=10 tuple entries
         # restored as string-keyed dicts sort "10" before "2".)
-        restore_args = None
-        if all(isinstance(l, jax.Array)
-               for l in jax.tree.leaves(template)):
-            # Without restore_args orbax repopulates shardings from the
-            # file — stale device assignments when the mesh changed
-            # between save and restore.
-            from orbax.checkpoint import checkpoint_utils
-            restore_args = checkpoint_utils.construct_restore_args(template)
+        # Without restore_args orbax repopulates shardings from the
+        # file — stale device assignments when the mesh changed between
+        # save and restore.  construct_restore_args handles mixed trees
+        # per-leaf (jax.Arrays get their sharding, numpy/scalar leaves
+        # plain RestoreArgs), so no all-or-nothing guard.
+        from orbax.checkpoint import checkpoint_utils
+        restore_args = checkpoint_utils.construct_restore_args(template)
         restored = _ckptr().restore(apath, item=template,
                                     restore_args=restore_args)
     else:
         restored = _ckptr().restore(apath)
     if broadcast:
         from ..common.api import broadcast_parameters, size
-        if size() > 1:
+        # Broadcast exists for env-based clusters (PS mode) where ranks
+        # share storage but not a JAX coordinator.  Multi-host GLOBAL
+        # arrays (sharded restore under jax.distributed) are already
+        # coordinated by orbax, and broadcast_one_to_all requires fully
+        # addressable inputs — skip them.
+        if size() > 1 and all(
+                getattr(l, "is_fully_addressable", True)
+                for l in jax.tree.leaves(restored)):
             restored = broadcast_parameters(restored, root_rank=0)
     return restored
 

@@ -113,6 +113,31 @@ def test_block_override_parity():
                - float(tfm.loss_fn(params, batch, cfg_f))) < 1e-5
 
 
+def test_asymmetric_block_parity():
+    """block_k decoupled from block (Q tile) must not change values, in
+    both tall (bq > bk) and wide (bk > bq) shapes; invalid block_k
+    reverts to the Q block, and the pair threads through the config."""
+    rng = np.random.RandomState(11)
+    q = _rand(rng, 2, 2, 256, 32)
+    base = flash_attention_fn(q, q, q, causal=True)
+    for bq, bk in ((128, 64), (64, 128), (256, 64)):
+        out = flash_attention_fn(q, q, q, causal=True, block=bq,
+                                 block_k=bk)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(base),
+                                   atol=1e-6)
+    out = flash_attention_fn(q, q, q, causal=True, block=128, block_k=96)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(base),
+                               atol=1e-6)
+    from byteps_tpu.models import transformer as tfm
+    cfg_a = tfm.get_config("tiny", causal=True, attn_impl="flash",
+                           attn_block=128, attn_block_k=64)
+    cfg_f = tfm.get_config("tiny", causal=True, attn_impl="flash")
+    params = tfm.init_params(jax.random.key(0), cfg_a)
+    batch = tfm.synthetic_batch(jax.random.key(1), 2, 128, cfg_a)
+    assert abs(float(tfm.loss_fn(params, batch, cfg_a))
+               - float(tfm.loss_fn(params, batch, cfg_f))) < 1e-5
+
+
 def test_transformer_end_to_end_parity():
     """Full model: attn_impl='flash' must track 'dense' through loss and
     gradients at bf16 tolerance."""

@@ -54,6 +54,16 @@ def test_checkpoint_roundtrip_preserves_fsdp_sharding(tmp_path, mesh8):
                                   np.asarray(params["w"]))
     assert r2["w"].sharding.is_fully_replicated
 
+    # Mixed tree: a non-array leaf (step counter) must not disable the
+    # template-sharding path for the array leaves beside it.
+    mixed = {"state": p, "step": 7}
+    path2 = str(tmp_path / "ckpt_mixed")
+    ckpt.save(path2, mixed)
+    r3 = ckpt.restore(path2, template=mixed, broadcast=False)
+    assert int(np.asarray(r3["step"])) == 7
+    assert not r3["state"]["w"].sharding.is_fully_replicated
+    assert "dp" in (r3["state"]["w"].sharding.spec or ())
+
 
 def test_async_checkpoint_roundtrip(tmp_path, bps_initialized):
     state = {"w": jnp.arange(12.0).reshape(3, 4), "step": jnp.asarray(3)}

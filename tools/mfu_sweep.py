@@ -55,6 +55,17 @@ SWEEP = [
              "BENCH_BATCH": "24", "BENCH_ATTN_BLOCK": "256"}},
     {"name": "dense_b64",
      "env": {"BENCH_ATTN": "dense", "BENCH_BATCH": "64"}},
+    # Asymmetric tiles (BENCH_ATTN_BLOCK_K decouples the K/V tile from
+    # the Q tile): at causal long-S a wide Q tile keeps programs fat
+    # while a narrow K tile trims masked diagonal waste — unmeasured.
+    {"name": "l300m_q512_k256", "group": "llama",
+     "env": {"BENCH_MODEL": "llama_300m", "BENCH_ATTN": "flash",
+             "BENCH_BATCH": "8", "BENCH_ATTN_BLOCK": "512",
+             "BENCH_ATTN_BLOCK_K": "256"}},
+    {"name": "l300m_q256_k128", "group": "llama",
+     "env": {"BENCH_MODEL": "llama_300m", "BENCH_ATTN": "flash",
+             "BENCH_BATCH": "8", "BENCH_ATTN_BLOCK": "256",
+             "BENCH_ATTN_BLOCK_K": "128"}},
 ]
 
 PROBE = ("import jax, jax.numpy as jnp; "
