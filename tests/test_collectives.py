@@ -99,6 +99,38 @@ def test_bucket_plan_partitions_and_reverse_priority():
         assert sum(seg[2] for seg in b) <= 16
 
 
+def test_bucket_plan_random_property():
+    """Randomized invariants over many size mixes: every element of every
+    leaf is covered exactly once by contiguous, in-order segments; no
+    bucket exceeds the partition capacity; priority order holds."""
+    import random
+    rng = random.Random(0)
+    for trial in range(60):
+        sizes = [rng.randint(0, 50) for _ in range(rng.randint(1, 10))]
+        pb = rng.choice([4, 8, 32, 128])
+        plan = collectives.BucketPlan(sizes, partition_bytes=pb, itemsize=4)
+        cap = max(1, pb // 4)
+        segs_by_leaf = {}
+        for b in plan.buckets:
+            assert sum(s[2] for s in b) <= cap, (trial, sizes, pb)
+            for li, start, ln in b:
+                assert ln > 0
+                segs_by_leaf.setdefault(li, []).append((start, ln))
+        for li, size in enumerate(sizes):
+            segs = sorted(segs_by_leaf.get(li, []))
+            # contiguous, non-overlapping, complete
+            pos = 0
+            for start, ln in segs:
+                assert start == pos, (trial, li, segs)
+                pos += ln
+            assert pos == size, (trial, li, sizes)
+        # Priority: first segment of the first bucket comes from the
+        # highest-index nonempty leaf (backward-first).
+        nonempty = [i for i, s in enumerate(sizes) if s > 0]
+        if nonempty:
+            assert plan.buckets[0][0][0] == nonempty[-1]
+
+
 def test_large_leaf_is_split_across_buckets():
     plan = collectives.BucketPlan([100], partition_bytes=64, itemsize=4,
                                   reverse=True)

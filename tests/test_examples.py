@@ -71,6 +71,12 @@ def test_llama_example():
     assert "improved=True" in out
 
 
+def test_llama_example_fsdp_zero1():
+    out = _run("train_llama_byteps.py", "--steps", "6", "--tp", "2",
+               "--fsdp", "--zero1")
+    assert "improved=True" in out
+
+
 def test_long_context_example():
     out = _run("train_long_context.py", "--sp", "8", "--seq-len", "256",
                "--steps", "2")
