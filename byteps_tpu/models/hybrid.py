@@ -206,6 +206,7 @@ def build_hybrid_train_step(
     mesh: Mesh,
     num_microbatches: int = 1,
     donate: bool = False,
+    zero1: bool = False,
 ):
     """Returns (step, init_fn) where step(params, opt_state, (tokens,
     targets)) -> (params, opt_state, loss) is jitted over the full mesh and
@@ -213,6 +214,15 @@ def build_hybrid_train_step(
 
     tokens/targets: [B, S] with B divisible by dp*ep*microbatches and S by
     sp.  params must come from init_fn (stacked layers pre-reshaped for pp).
+
+    `zero1=True` additionally shards the optimizer state over 'dp'
+    (ZeRO-1 on the explicit shard_map plane, the hand-built analog of
+    parallel.sharded's GSPMD path): each param spec gains the dp axis on
+    its first free dp-divisible dimension, the optimizer update runs on
+    the local 1/dp shard of grads/params/state, and only the UPDATES are
+    all-gathered back — Adam moments drop to 1/dp per device.  A
+    replicated opt_state from `optimizer.init` is resharded on first
+    call; at dp=1 the step is identical to zero1=False.
     """
     pp = int(mesh.shape.get("pp", 1))
     specs = param_specs(cfg)
@@ -284,39 +294,102 @@ def build_hybrid_train_step(
                 cfg.num_layers * shards)
         return loss
 
-    def grad_sync(grads):
-        def sync(path, g):
+    def make_grad_sync(dp_axes):
+        """Cross-shard gradient reduction.  `dp_axes` is a params-shaped
+        tree of ints: the dimension each leaf's 1/dp shard lives on, or
+        -1 for leaves that stay whole (zero1 off, or no free divisible
+        axis).  Whole leaves get the full psum; dp-sharded leaves psum
+        only the non-dp axes and REDUCE-SCATTER over dp — each rank
+        receives exactly the shard its optimizer update consumes, so the
+        dp wire cost is scatter + (update) gather = one ring
+        all-reduce, not all-reduce + gather."""
+        def sync(path, g, ax):
             keys = [getattr(k, "key", getattr(k, "name", "")) for k in path]
             if "layers" in keys:
                 if any(str(k).startswith("ffn_e") for k in keys):
-                    return lax.psum(g, ("dp", "sp"))
-                return lax.psum(g, ("dp", "ep", "sp"))
-            return lax.psum(g, ("dp", "ep", "sp", "pp"))
-        return jax.tree_util.tree_map_with_path(sync, grads)
+                    nondp = ("sp",)
+                else:
+                    nondp = ("ep", "sp")
+            else:
+                nondp = ("ep", "sp", "pp")
+            if ax < 0:
+                return lax.psum(g, ("dp",) + nondp)
+            g = lax.psum(g, nondp)
+            return lax.psum_scatter(g, "dp", scatter_dimension=ax,
+                                    tiled=True)
+        return lambda grads: jax.tree_util.tree_map_with_path(
+            sync, grads, dp_axes)
 
-    def _step(params, opt_state, batch):
-        tokens, targets = batch
-        loss, grads = jax.value_and_grad(
-            lambda p: loss_fn(p, tokens, targets))(params)
-        grads = grad_sync(grads)
-        updates, opt_state = optimizer.update(grads, opt_state, params)
-        params = optax.apply_updates(params, updates)
-        loss = lax.psum(loss, ("dp", "ep", "sp", "pp"))
-        return params, opt_state, loss
+    def make_update_leg(dp_axes):
+        """Optimizer leg: grads for dp-sharded leaves already arrive as
+        this rank's shard (reduce-scattered by grad_sync); params are
+        sliced locally (free — they are replicated over dp) and only the
+        UPDATES are all-gathered back."""
+        def slice_dp(x, ax):
+            if ax < 0:
+                return x
+            n = lax.axis_size("dp")
+            size = x.shape[ax] // n
+            return lax.dynamic_slice_in_dim(
+                x, lax.axis_index("dp") * size, size, ax)
+
+        def gather_dp(u, ax):
+            if ax < 0:
+                return u
+            return lax.all_gather(u, "dp", axis=ax, tiled=True)
+
+        def update_leg(params, opt_state, grads):
+            p_s = jax.tree.map(slice_dp, params, dp_axes)
+            # State leaves arrive as their local shard (in_specs carry
+            # the dp-upgraded layout); the update math runs on 1/dp of
+            # every sharded leaf, so the moment buffers never exist
+            # whole on any device.
+            updates_s, opt_state = optimizer.update(grads, opt_state, p_s)
+            updates = jax.tree.map(gather_dp, updates_s, dp_axes)
+            return optax.apply_updates(params, updates), opt_state
+        return update_leg
+
+    def make_sm_step(grad_sync, update_leg):
+        def _step(params, opt_state, batch):
+            tokens, targets = batch
+            loss, grads = jax.value_and_grad(
+                lambda p: loss_fn(p, tokens, targets))(params)
+            grads = grad_sync(grads)
+            params, opt_state = update_leg(params, opt_state, grads)
+            loss = lax.psum(loss, ("dp", "ep", "sp", "pp"))
+            return params, opt_state, loss
+        return _step
 
     # Optimizer-state specs: shape-match against params (adam mu/nu inherit
-    # the param layout; scalars replicate).  The shard_map+jit is built once
-    # per opt_state structure and cached (rebuilding per call would retrace).
+    # the param layout; scalars replicate).  With zero1 the param specs are
+    # first upgraded with the dp axis, and the state follows THAT layout.
+    # The shard_map+jit is built once per opt_state structure and cached
+    # (rebuilding per call would retrace).
     def make_step():
-        from ..parallel.sharded import opt_state_specs
+        from ..parallel.sharded import (_is_spec, _shard_free_axis,
+                                        opt_state_specs)
         cache = {}
+
+        def dp_axis_of(old: P, new: P) -> int:
+            for i, e in enumerate(new):
+                if e == "dp" and (i >= len(old) or old[i] != "dp"):
+                    return i
+            return -1
 
         def call(params, opt_state, batch):
             key = jax.tree.structure(opt_state)
             if key not in cache:
-                o_specs = opt_state_specs(optimizer, params, specs)
+                if zero1:
+                    p_up = _shard_free_axis(specs, params, mesh, "dp",
+                                            min_shard_elems=1024)
+                else:
+                    p_up = specs
+                dp_axes = jax.tree.map(dp_axis_of, specs, p_up,
+                                       is_leaf=_is_spec)
+                o_specs = opt_state_specs(optimizer, params, p_up)
                 sm = jax.shard_map(
-                    _step, mesh=mesh,
+                    make_sm_step(make_grad_sync(dp_axes),
+                                 make_update_leg(dp_axes)), mesh=mesh,
                     in_specs=(specs, o_specs, (batch_spec, batch_spec)),
                     out_specs=(specs, o_specs, P()),
                     check_vma=False)

@@ -25,11 +25,12 @@ CFG_MOE = hybrid.HybridConfig(vocab_size=64, num_layers=2, d_model=16,
                               num_experts=4, capacity_factor=8.0)
 
 
-def _run(cfg, mesh_axes, steps=3, num_microbatches=1, seed=0):
+def _run(cfg, mesh_axes, steps=3, num_microbatches=1, seed=0,
+         optimizer=None, zero1=False, ret_opt_state=False):
     mesh = bps.make_mesh(**mesh_axes)
-    opt = optax.sgd(0.1)
+    opt = optimizer if optimizer is not None else optax.sgd(0.1)
     step, init_fn = hybrid.build_hybrid_train_step(
-        cfg, opt, mesh, num_microbatches=num_microbatches)
+        cfg, opt, mesh, num_microbatches=num_microbatches, zero1=zero1)
     params = init_fn(jax.random.key(seed))
     opt_state = opt.init(params)
     rng = jax.random.key(seed + 1)
@@ -39,6 +40,8 @@ def _run(cfg, mesh_axes, steps=3, num_microbatches=1, seed=0):
     for _ in range(steps):
         params, opt_state, loss = step(params, opt_state, (toks, tgts))
         losses.append(float(loss))
+    if ret_opt_state:
+        return losses, params, opt_state
     return losses, params
 
 
@@ -145,3 +148,36 @@ def test_moe_aux_loss_matches_across_pp():
                   num_microbatches=2)
     got, _ = _run(cfg, dict(pp=2, ep=2, dp=2), num_microbatches=2)
     np.testing.assert_allclose(got, ref, rtol=1e-3)
+
+
+@pytest.mark.parametrize("axes", [
+    dict(dp=8),
+    dict(dp=2, tp=2, sp=2),
+    dict(pp=2, dp=2, tp=2),
+])
+def test_zero1_matches_single_device(axes):
+    """ZeRO-1 on the shard_map plane: the adam trajectory with the
+    optimizer state dp-sharded must match the single-device baseline,
+    and the returned state must actually live dp-sharded."""
+    opt = optax.adam(1e-2)
+    ref, _ = _run(CFG, dict(dp=1, devices=jax.devices()[:1]),
+                  optimizer=opt)
+    mb = 2 if axes.get("pp", 1) > 1 else 1
+    got, _, opt_state = _run(CFG, axes, optimizer=opt, zero1=True,
+                             num_microbatches=mb, ret_opt_state=True)
+    np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-5)
+    dp_sharded = [
+        l for l in jax.tree.leaves(opt_state)
+        if hasattr(l, "sharding")
+        and "dp" in [a for e in (l.sharding.spec or ()) if e is not None
+                     for a in (e if isinstance(e, tuple) else (e,))]]
+    assert dp_sharded, "no opt-state leaf is dp-sharded under zero1"
+
+
+def test_zero1_moe_trains():
+    """ZeRO-1 composes with expert parallelism (grad psum subsets)."""
+    opt = optax.adam(1e-2)
+    ref, _ = _run(CFG_MOE, dict(dp=1, devices=jax.devices()[:1]),
+                  optimizer=opt)
+    got, _ = _run(CFG_MOE, dict(ep=2, dp=4), optimizer=opt, zero1=True)
+    np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-5)
