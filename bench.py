@@ -143,7 +143,12 @@ def _cfg_with_env_overrides(cfg, seq: int, default_attn: str = ""):
         # Gate on flash so the record never carries a block the dense
         # path silently ignored.
         attn_block=_attn_block_for(seq) if attn == "flash" else 0,
-        attn_block_k=bk if attn == "flash" else 0)
+        attn_block_k=bk if attn == "flash" else 0,
+        # BENCH_UNROLL=k groups k layers per scan iteration (must divide
+        # num_layers — the config validates, so a bad sweep value fails
+        # loudly rather than silently benching unroll=1).
+        scan_unroll=int(os.environ.get("BENCH_UNROLL", "0")) or
+        cfg.scan_unroll)
 
 
 def bench_flagship():
@@ -259,6 +264,7 @@ def bench_flagship():
             "attn_block_k": cfg.attn_block_k or cfg.attn_block,
             "remat": cfg.remat,
             "remat_policy": cfg.remat_policy,
+            "scan_unroll": cfg.scan_unroll,
             **_note(),
         },
     }))

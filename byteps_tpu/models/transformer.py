@@ -84,6 +84,13 @@ class TransformerConfig:
     # full f32 logits are 3.2 GB and their HBM traffic is the largest
     # non-matmul cost in the step (round-3 profiling).
     ce_chunk_rows: int = 0
+    # Unroll factor for the layer scan (lax.scan unroll=).  > 1 groups
+    # that many layers per scan iteration: more code, but XLA can
+    # schedule/fuse across adjacent layers and the stacked-param slice
+    # overhead amortizes.  Remat granularity is unchanged (each layer
+    # body is checkpointed individually).  Must divide num_layers or be
+    # 1; sweep knob BENCH_UNROLL.
+    scan_unroll: int = 1
 
     def __post_init__(self):
         for field, val, allowed in (
@@ -111,6 +118,11 @@ class TransformerConfig:
         if self.ce_chunk_rows < 0:
             raise ValueError(f"ce_chunk_rows={self.ce_chunk_rows} must be "
                              f">= 0 (0 = unfused full-logits path)")
+        if self.scan_unroll < 1 or self.num_layers % self.scan_unroll:
+            raise ValueError(
+                f"scan_unroll={self.scan_unroll} must be >= 1 and divide "
+                f"num_layers={self.num_layers} (a remainder iteration "
+                f"would compile a second layer-group program)")
 
     @property
     def head_dim(self) -> int:
@@ -325,15 +337,19 @@ def flash_auto_block(S: int) -> int:
     48: block 512 = 33.7k tok/s vs 31.0k (256) vs 27.0k (128), i.e. the
     old fixed-128 choice left 25% on the table
     (bench_runs/r04_sweep1.jsonl); per-program VMEM stays small (block x
-    block f32 logits at 512 is 1 MB).  S > 512 keeps the previous 128
-    tile: the long-context regime (including the strict ring/Ulysses
-    path) was measured under 128 (docs/performance.md seq-2048/4096
-    rows) and larger blocks do more wasted masked compute on causal
-    diagonal blocks — don't extend the 512 preference there without an
-    on-chip measurement."""
+    block f32 logits at 512 is 1 MB).  S > 512: the largest of
+    512/256/128/64 that divides S — the long-context regime was
+    re-measured on-chip at llama_300m S=2048 batch 8 (causal, f32-tile
+    kernel): block 512 = 27.0k tok/s vs 20.7k (256) vs 15.4k (128), so
+    the old 128 tile left 75% on the table; the extra masked compute on
+    causal diagonal blocks is far outweighed by fewer, fatter programs
+    (bench_runs/r04_sweep5{,b}.jsonl)."""
     if S <= 512:
         return S if S % 64 == 0 else 0
-    return 128 if S % 128 == 0 else (64 if S % 64 == 0 else 0)
+    for b in (512, 256, 128, 64):
+        if S % b == 0:
+            return b
+    return 0
 
 
 def flash_attention_fn(q, k, v, causal: bool, strict: bool = False,
@@ -347,8 +363,8 @@ def flash_attention_fn(q, k, v, causal: bool, strict: bool = False,
     to avoid that (e.g. Ulysses long-context).
 
     block=0 auto-selects via `flash_auto_block` (full-sequence block at
-    S <= 512 — measured +25% over the old fixed 128 — and the classic
-    128 tile beyond; see its docstring for the evidence).  A nonzero
+    S <= 512, the largest of 512/256/128/64 dividing S beyond — both
+    regimes measured on-chip; see its docstring for the evidence).  A nonzero
     override trades grid-iteration overhead against VMEM per program by
     hand (TransformerConfig.attn_block / BENCH_ATTN_BLOCK sweep it
     on-chip); `block_k` additionally decouples the K/V tile from the Q
@@ -506,7 +522,7 @@ def forward_hidden(params: PyTree, tokens: jax.Array, cfg: TransformerConfig,
         step = jax.checkpoint(body, policy=policies[cfg.remat_policy])
     else:
         step = body
-    x, _ = lax.scan(step, x, params["layers"])
+    x, _ = lax.scan(step, x, params["layers"], unroll=cfg.scan_unroll)
     return _NORMS[cfg.norm](x, params["ln_f_scale"], params.get("ln_f_bias"))
 
 

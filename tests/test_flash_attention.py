@@ -113,6 +113,24 @@ def test_block_override_parity():
                - float(tfm.loss_fn(params, batch, cfg_f))) < 1e-5
 
 
+def test_auto_block_rule():
+    """Pin the measured auto block-size policy (flash_auto_block
+    docstring carries the on-chip evidence): full-sequence block at
+    S <= 512, largest of 512/256/128/64 dividing S beyond, 0 when no
+    64-row block divides S."""
+    from byteps_tpu.models.transformer import flash_auto_block
+    assert flash_auto_block(64) == 64
+    assert flash_auto_block(512) == 512
+    assert flash_auto_block(448) == 448      # mult of 64, <= 512
+    assert flash_auto_block(2048) == 512     # long-S: 512 tile wins
+    assert flash_auto_block(4096) == 512
+    assert flash_auto_block(768) == 256      # 512 doesn't divide
+    assert flash_auto_block(640) == 128
+    assert flash_auto_block(1088) == 64      # only 64 divides
+    assert flash_auto_block(100) == 0        # no valid block
+    assert flash_auto_block(1000) == 0
+
+
 def test_asymmetric_block_parity():
     """block_k decoupled from block (Q tile) must not change values, in
     both tall (bq > bk) and wide (bk > bq) shapes; invalid block_k

@@ -179,6 +179,25 @@ def test_transformer_remat_policies_match():
                     tfm.get_config("tiny", remat_policy="bogus"))
 
 
+def test_scan_unroll_matches_rolled():
+    """scan_unroll groups layers per scan iteration — a scheduling knob
+    that must never change loss or gradients; invalid factors fail at
+    config construction."""
+    cfg1 = tfm.get_config("tiny", dtype=jnp.float32)   # tiny has 2 layers
+    params = tfm.init_params(jax.random.key(1), cfg1)
+    toks = jax.random.randint(jax.random.key(2), (2, 12), 0, cfg1.vocab_size)
+    l1, g1 = jax.value_and_grad(tfm.loss_fn)(params, (toks, toks), cfg1)
+    cfg2 = tfm.get_config("tiny", dtype=jnp.float32, scan_unroll=2)
+    l2, g2 = jax.value_and_grad(tfm.loss_fn)(params, (toks, toks), cfg2)
+    np.testing.assert_allclose(float(l1), float(l2), rtol=1e-6)
+    for a, b in zip(jax.tree.leaves(g1), jax.tree.leaves(g2)):
+        np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6)
+    with pytest.raises(ValueError):
+        tfm.get_config("tiny", scan_unroll=3)  # doesn't divide num_layers
+    with pytest.raises(ValueError):
+        tfm.get_config("tiny", scan_unroll=0)
+
+
 def test_fused_ce_matches_dense_loss_and_grads():
     """Streamed LM-head cross-entropy (ce_chunk_rows > 0) must equal the
     full-logits path up to f32 reduction order — loss AND grads, including

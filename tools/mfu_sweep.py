@@ -34,25 +34,28 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 #   OOM-ing remote compile is exactly what wedged the tunnel in the
 #   pass-2 postmortem.
 #
-# Pass 5 (first half in bench_runs/r04_sweep5.jsonl): at llama_300m
-# seq 2048 batch 8, flash block 256 beats 128 by +34% (20.7k vs 15.4k
-# tok/s) — then the tunnel wedged.  This remainder finishes the block
-# ladder (512, dense anchor) and asks whether the low absolute MFU
-# (0.19) is batch starvation: batch escalates 16 -> 24 under block 256
-# (grouped — an OOM stops the escalation).
+# Pass 6.  Pass 5 (bench_runs/r04_sweep5{,b}.jsonl) established the
+# long-S block ladder (blk512 27.0k > 256 20.7k > 128 15.4k tok/s at
+# llama_300m seq 2048 batch 8; dense 15.9k) before the tunnel wedged
+# again.  This pass: (a) flagship anchor re-run under the new auto
+# rule, (b) the BENCH_UNROLL ladder (scan_unroll groups layers per
+# scan iteration — scheduling freedom vs code size, unmeasured),
+# (c) the llama batch escalation pass 5 never reached (now under the
+# winning blk512), (d) the asymmetric-tile question, (e) the dense
+# batch-64 anchor from the pass-3 list.
 SWEEP = [
-    {"name": "l300m_s2048_blk512", "group": "llama",
+    {"name": "flagship_anchor",
+     "env": {"BENCH_BATCH": "64"}},
+    {"name": "flagship_unroll2", "group": "unroll",
+     "env": {"BENCH_BATCH": "64", "BENCH_UNROLL": "2"}},
+    {"name": "flagship_unroll4", "group": "unroll",
+     "env": {"BENCH_BATCH": "64", "BENCH_UNROLL": "4"}},
+    {"name": "l300m_b16_blk512", "group": "lbatch",
      "env": {"BENCH_MODEL": "llama_300m", "BENCH_ATTN": "flash",
-             "BENCH_BATCH": "8", "BENCH_ATTN_BLOCK": "512"}},
-    {"name": "l300m_s2048_dense", "group": "llama",
-     "env": {"BENCH_MODEL": "llama_300m", "BENCH_ATTN": "dense",
-             "BENCH_BATCH": "8"}},
-    {"name": "l300m_b16_blk256", "group": "lbatch",
+             "BENCH_BATCH": "16", "BENCH_ATTN_BLOCK": "512"}},
+    {"name": "l300m_b24_blk512", "group": "lbatch",
      "env": {"BENCH_MODEL": "llama_300m", "BENCH_ATTN": "flash",
-             "BENCH_BATCH": "16", "BENCH_ATTN_BLOCK": "256"}},
-    {"name": "l300m_b24_blk256", "group": "lbatch",
-     "env": {"BENCH_MODEL": "llama_300m", "BENCH_ATTN": "flash",
-             "BENCH_BATCH": "24", "BENCH_ATTN_BLOCK": "256"}},
+             "BENCH_BATCH": "24", "BENCH_ATTN_BLOCK": "512"}},
     {"name": "dense_b64",
      "env": {"BENCH_ATTN": "dense", "BENCH_BATCH": "64"}},
     # Asymmetric tiles (BENCH_ATTN_BLOCK_K decouples the K/V tile from
@@ -62,10 +65,10 @@ SWEEP = [
      "env": {"BENCH_MODEL": "llama_300m", "BENCH_ATTN": "flash",
              "BENCH_BATCH": "8", "BENCH_ATTN_BLOCK": "512",
              "BENCH_ATTN_BLOCK_K": "256"}},
-    {"name": "l300m_q256_k128", "group": "llama",
+    {"name": "l300m_s2048_unroll2",
      "env": {"BENCH_MODEL": "llama_300m", "BENCH_ATTN": "flash",
-             "BENCH_BATCH": "8", "BENCH_ATTN_BLOCK": "256",
-             "BENCH_ATTN_BLOCK_K": "128"}},
+             "BENCH_BATCH": "8", "BENCH_ATTN_BLOCK": "512",
+             "BENCH_UNROLL": "2"}},
 ]
 
 PROBE = ("import jax, jax.numpy as jnp; "
