@@ -33,8 +33,12 @@ Modes:
 Sweep knobs (tools/mfu_sweep.py): BENCH_MODEL picks any named config
 (e.g. llama_300m), BENCH_SEQ overrides its sequence length, BENCH_BATCH /
 BENCH_ATTN / BENCH_ATTN_BLOCK / BENCH_ATTN_BLOCK_K (decoupled K/V tile) /
-BENCH_REMAT / BENCH_REMAT_POLICY / BENCH_CE_CHUNK override the rest of
-the geometry.
+BENCH_REMAT / BENCH_REMAT_POLICY / BENCH_CE_CHUNK / BENCH_UNROLL
+(layer-scan unroll) override the rest of the geometry.  BENCH_COST=1
+adds XLA's compile-time accounting (flops, HBM bytes, arithmetic
+intensity) for the raw single-chip step to the JSON detail — off by
+default because the AOT re-lower is a fresh-compile risk on a flaky
+tunnel.
 
 Runs on whatever jax.devices() offers: the real TPU chip under the driver,
 or the 8-device virtual CPU mesh locally.
@@ -234,8 +238,34 @@ def bench_flagship():
         return optax.apply_updates(p, u), s, loss
 
     rstep = jax.jit(raw_step, donate_argnums=(0, 1))
-    raw_tps = _time_steps(rstep, params, raw_opt.init(params),
+    raw_state = raw_opt.init(params)
+    # Abstract arg shapes captured before timing donates the buffers —
+    # BENCH_COST re-lowers from these (cache-warm) for cost_analysis.
+    abs_args = jax.tree.map(
+        lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype),
+        (params, raw_state, (toks[:rb], tgts[:rb])))
+    raw_tps = _time_steps(rstep, params, raw_state,
                           (toks[:rb], tgts[:rb]), steps, rb * seq)
+
+    cost = {}
+    if os.environ.get("BENCH_COST", "0") == "1":
+        # XLA's compile-time accounting for the single-chip step: total
+        # flops and HBM bytes accessed -> arithmetic intensity and which
+        # roofline (compute vs bandwidth) the config sits under.  Off by
+        # default: the AOT lower/compile is normally a cache hit but any
+        # fresh remote compile is a tunnel-wedge risk (pass-2 postmortem),
+        # so only sweeps ask for it.
+        try:
+            ca = rstep.lower(*abs_args).compile().cost_analysis()
+            ca = ca[0] if isinstance(ca, (list, tuple)) else (ca or {})
+            flops = float(ca.get("flops", 0.0))
+            hbm = float(ca.get("bytes accessed", 0.0))
+            cost = {"xla_flops_per_raw_step": flops,
+                    "xla_hbm_bytes_per_raw_step": hbm}
+            if hbm > 0:
+                cost["arithmetic_intensity"] = round(flops / hbm, 2)
+        except Exception as e:   # never let accounting kill the bench
+            cost = {"cost_analysis_error": repr(e)[:200]}
 
     efficiency = fw_tps / (raw_tps * n_dev)
     tps_per_chip = fw_tps / n_dev
@@ -265,6 +295,7 @@ def bench_flagship():
             "remat": cfg.remat,
             "remat_policy": cfg.remat_policy,
             "scan_unroll": cfg.scan_unroll,
+            **cost,
             **_note(),
         },
     }))

@@ -70,7 +70,9 @@ class TransformerConfig:
     # (measurements: docs/performance.md).
     remat_policy: str = "none"    # "none" | "dots" | "dots_no_batch" | "proj"
     attn_impl: str = "dense"           # "dense" | "flash" | "ring" (sp)
-    # Flash-kernel block size override (0 = auto 128).  Larger blocks at
+    # Flash-kernel block size override (0 = flash_auto_block's measured
+    # rule: full-sequence at S <= 512, largest of 512/256/128/64 dividing
+    # S beyond).  Larger blocks at
     # short S mean fewer, fatter kernel programs; must divide seq_len.
     attn_block: int = 0
     # K/V tile override (0 = same as attn_block).  Decoupling lets long-S
@@ -343,7 +345,12 @@ def flash_auto_block(S: int) -> int:
     kernel): block 512 = 27.0k tok/s vs 20.7k (256) vs 15.4k (128), so
     the old 128 tile left 75% on the table; the extra masked compute on
     causal diagonal blocks is far outweighed by fewer, fatter programs
-    (bench_runs/r04_sweep5{,b}.jsonl)."""
+    (bench_runs/r04_sweep5{,b}.jsonl).  Caveat: measured at S=2048 on
+    the plain single-chip path; at gathered-sequence lengths (the
+    strict ring/Ulysses path, S >= 8k) the 512 preference is an
+    extrapolation — the relative diagonal waste only shrinks with S,
+    but it is unmeasured there (S=8192 A/B queued in tools/mfu_sweep.py;
+    attn_block=128 restores the old tile per-config if it regresses)."""
     if S <= 512:
         return S if S % 64 == 0 else 0
     for b in (512, 256, 128, 64):

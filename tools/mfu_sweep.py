@@ -45,7 +45,7 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 # batch-64 anchor from the pass-3 list.
 SWEEP = [
     {"name": "flagship_anchor",
-     "env": {"BENCH_BATCH": "64"}},
+     "env": {"BENCH_BATCH": "64", "BENCH_COST": "1"}},
     {"name": "flagship_unroll2", "group": "unroll",
      "env": {"BENCH_BATCH": "64", "BENCH_UNROLL": "2"}},
     {"name": "flagship_unroll4", "group": "unroll",
@@ -69,6 +69,18 @@ SWEEP = [
      "env": {"BENCH_MODEL": "llama_300m", "BENCH_ATTN": "flash",
              "BENCH_BATCH": "8", "BENCH_ATTN_BLOCK": "512",
              "BENCH_UNROLL": "2"}},
+    # Gathered-sequence A/B: the strict ring/Ulysses path runs flash at
+    # S >= 8k, where the new 512 auto tile is an extrapolation from the
+    # S=2048 ladder — settle it on-chip (grouped: the 8k compile is the
+    # memory-heavy one; an OOM skips the second leg).
+    {"name": "l300m_s8192_blk512", "group": "s8k",
+     "env": {"BENCH_MODEL": "llama_300m", "BENCH_SEQ": "8192",
+             "BENCH_ATTN": "flash", "BENCH_BATCH": "1",
+             "BENCH_ATTN_BLOCK": "512"}},
+    {"name": "l300m_s8192_blk128", "group": "s8k",
+     "env": {"BENCH_MODEL": "llama_300m", "BENCH_SEQ": "8192",
+             "BENCH_ATTN": "flash", "BENCH_BATCH": "1",
+             "BENCH_ATTN_BLOCK": "128"}},
 ]
 
 PROBE = ("import jax, jax.numpy as jnp; "
