@@ -107,3 +107,33 @@ def test_machinery_bench_bucketed_beats_naive():
         if rerun["value"] > out["value"]:
             out = rerun
     assert out["value"] >= 1.0, out
+
+
+def test_latest_onchip_archive_resilient(tmp_path):
+    """The CPU-fallback provenance lookup must survive truncated lines
+    (a child killed mid-write), null mfu fields, and sweep-wrapped record
+    shapes — and return the newest valid record, not give up."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location("_bench_mod", BENCH)
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+
+    good = {"metric": "m", "value": 1.0, "vs_baseline": 1.1,
+            "detail": {"framework_tokens_per_sec": 100, "mfu": 0.35,
+                       "batch": 64, "seq": 512, "attn_impl": "flash"}}
+    wrapped = {"name": "run", "rc": 0,
+               "result": {"metric": "m2", "value": 0.9,
+                          "detail": {"mfu": 0.30}}}
+    null_mfu = {"metric": "m3", "value": 1.0, "detail": {"mfu": None}}
+    p = tmp_path / "r99_onchip.jsonl"
+    p.write_text("\n".join([
+        json.dumps(good),
+        json.dumps(null_mfu),          # skipped: mfu None
+        json.dumps(wrapped),           # newest valid (sweep shape)
+        '{"metric": "trunc', ]) + "\n")  # killed mid-write: skipped
+    got = bench._latest_onchip_archive(runs_dir=str(tmp_path))
+    assert got["metric"] == "m2" and got["mfu"] == 0.30
+    # Empty dir -> empty dict, never an exception.
+    assert bench._latest_onchip_archive(
+        runs_dir=str(tmp_path / "nope")) == {}
