@@ -824,9 +824,11 @@ def _emit_child_result(rc: int, out: str, extra_detail: dict = None) -> None:
         if extra_detail:
             try:
                 rec = json.loads(line)
-                rec.setdefault("detail", {}).update(extra_detail)
-                line = json.dumps(rec)
-            except (ValueError, TypeError):
+                if (isinstance(rec, dict)
+                        and isinstance(rec.setdefault("detail", {}), dict)):
+                    rec["detail"].update(extra_detail)
+                    line = json.dumps(rec)
+            except (ValueError, TypeError, AttributeError):
                 pass
         print(line, flush=True)
         os._exit(0)

@@ -567,10 +567,15 @@ class Server {
           t.conn = conn;
           t.seq = seq_.fetch_add(1);
           t.priority = 0;
-          int idx = EngineFor(h.key, h.len);
+          // h is #pragma pack(1): h.key sits at offset 12, so binding
+          // unordered_map::operator[]'s `const key_type&` directly to it
+          // is UB (misaligned 8-byte reference — UBSan catches it under
+          // the 4x2 soak).  Copy to an aligned local first.
+          const uint64_t key = h.key;
+          int idx = EngineFor(key, h.len);
           if (schedule_) {
             std::lock_guard<std::mutex> lk(store_mu_);
-            t.priority = store_[h.key].push_count.load(
+            t.priority = store_[key].push_count.load(
                 std::memory_order_relaxed);  // closest-to-done first
           }
           queues_[idx].Push(std::move(t));
