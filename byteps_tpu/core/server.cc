@@ -43,6 +43,8 @@
 #include <unistd.h>
 
 #include <atomic>
+#include <cerrno>
+#include <chrono>
 #include <cmath>
 #include <cstdio>
 #include <cstdlib>
@@ -303,6 +305,12 @@ struct RespHeader {
 struct Conn {
   int fd;
   std::mutex write_mu;
+  // Set (by the owning reader) the first time anything that outlives the
+  // reader records this conn: an engine task, a barrier waiter, or a
+  // deferred pull.  A reader that exits with referenced still false may
+  // close the fd immediately (nothing can Respond on it later) — this is
+  // what reclaims fds from rejected/rogue connections; see ReaderLoop.
+  bool referenced = false;
 };
 
 struct PendingPull {
@@ -407,6 +415,29 @@ class Server {
     debug_ = dbg && dbg[0] && !(dbg[0] == '0' && dbg[1] == '\0');
     const char* dk = std::getenv("BYTEPS_SERVER_DEBUG_KEY");
     debug_key_ = dk && dk[0] ? std::strtoull(dk, nullptr, 10) : ~0ULL;
+    // Frame-size cap: h.len comes off the wire, so a corrupted client (or
+    // a stray non-protocol connection) could otherwise drive a multi-GB
+    // vector allocation -> bad_alloc -> the whole PS tier dies.  Partition
+    // payloads are bounded by BYTEPS_PARTITION_BYTES (4MB default), so
+    // 1GB default headroom is generous; oversize frames drop the one
+    // connection, never the server.
+    const char* mx = std::getenv("BYTEPS_SERVER_MAX_MSG_BYTES");
+    if (mx && mx[0]) {
+      // Strict parse: a human-style value ("4MB", "1e9") would otherwise
+      // silently yield a tiny cap and the server would drop every
+      // connection while looking healthy.
+      char* end = nullptr;
+      uint64_t v = std::strtoull(mx, &end, 10);
+      if (end && *end == '\0' && v > 0) {
+        max_msg_ = v;
+      } else {
+        std::fprintf(stderr,
+                     "[byteps server] ignoring invalid "
+                     "BYTEPS_SERVER_MAX_MSG_BYTES=%s (want a positive "
+                     "integer byte count); using %llu\n",
+                     mx, static_cast<unsigned long long>(max_msg_));
+      }
+    }
   }
 
   int Run() {
@@ -428,7 +459,18 @@ class Server {
 
     while (!shutdown_.load()) {
       int fd = accept(listen_fd_, nullptr, nullptr);
-      if (fd < 0) break;
+      if (fd < 0) {
+        // Transient accept failures (fd pressure, aborted handshakes,
+        // signals) must not tear down the tier — existing sessions keep
+        // training and new connections retry.  Anything else (EBADF from
+        // the shutdown path closing the listener) ends the loop.
+        if (errno == EINTR || errno == ECONNABORTED || errno == EMFILE ||
+            errno == ENFILE || errno == ENOBUFS || errno == ENOMEM) {
+          std::this_thread::sleep_for(std::chrono::milliseconds(20));
+          continue;
+        }
+        break;
+      }
       setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
       auto* conn = new Conn{fd, {}};
       {
@@ -503,6 +545,7 @@ class Server {
     ReqHeader h;
     while (!shutdown_.load()) {
       if (!ReadFull(conn->fd, &h, sizeof(h))) break;
+      if (h.len > max_msg_) break;  // corrupt/hostile frame: drop the conn
       std::vector<char> payload(h.len);
       if (h.len && !ReadFull(conn->fd, payload.data(), h.len)) break;
       switch (h.cmd) {
@@ -541,6 +584,7 @@ class Server {
           break;
         }
         case kBarrier:
+          conn->referenced = true;   // barrier waiters outlive the reader
           HandleBarrier(conn, h.req_id, h.key);
           break;
         case kShutdown:
@@ -578,9 +622,29 @@ class Server {
             t.priority = store_[key].push_count.load(
                 std::memory_order_relaxed);  // closest-to-done first
           }
+          conn->referenced = true;   // engine tasks/deferred pulls hold conn
           queues_[idx].Push(std::move(t));
         }
       }
+    }
+    // Reader exit (peer hung up, or we rejected an oversize frame): the
+    // fd is closed/freed only at server shutdown, so half-close it here —
+    // the peer sees EOF immediately instead of a silently dead socket.
+    // Engine responses racing on this conn fail with EPIPE, which Respond
+    // already tolerates (crashed-worker path).
+    //
+    // If NOTHING that outlives this reader ever recorded the conn (no
+    // engine task, no barrier waiter — the rejected-rogue-frame case),
+    // also close the fd now: otherwise a connect-and-send-garbage loop
+    // leaks one fd per attempt until accept() hits EMFILE.  Referenced
+    // conns keep their fd until shutdown (engine responses and deferred
+    // pulls may still write; closing would let the fd number be reused
+    // by a new accept and misdirect those writes).
+    std::lock_guard<std::mutex> lk(conns_mu_);
+    ::shutdown(conn->fd, SHUT_RDWR);
+    if (!conn->referenced) {
+      ::close(conn->fd);
+      conn->fd = -1;   // shutdown-path cleanup tolerates EBADF
     }
   }
 
@@ -858,6 +922,7 @@ class Server {
   bool async_;
   bool debug_ = false;
   uint64_t debug_key_ = ~0ULL;   // ~0 = all keys
+  uint64_t max_msg_ = 1ULL << 30;  // wire frame cap (see ctor)
   int listen_fd_ = -1;
 
   std::vector<EngineQueue> queues_;

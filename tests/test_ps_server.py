@@ -464,6 +464,46 @@ def test_worker_restart_mid_training_against_live_servers(ps_server):
     np.testing.assert_allclose(w[0], w[1])
 
 
+def test_oversize_frame_drops_connection_not_server(ps_server):
+    """A wire frame whose length field exceeds BYTEPS_SERVER_MAX_MSG_BYTES
+    (corrupted client, stray non-protocol connection) must cost only that
+    connection — a naive `vector(h.len)` would bad_alloc and take down the
+    whole PS tier.  The server must keep serving existing and new
+    sessions afterwards."""
+    from byteps_tpu.server.client import _REQ
+
+    port = ps_server(num_workers=1)
+    s = _session(port, 0)
+    x = np.arange(32, dtype=np.float32)
+    np.testing.assert_array_equal(s.push_pull(7, x), x)  # healthy round
+
+    # Hand-craft a header claiming a 1 TB payload on a raw socket.
+    rogue = socket.create_connection(("127.0.0.1", port), 5)
+    rogue.sendall(_REQ.pack(2, 0, 0, 1, 0, 99, 1 << 40))
+    # The server must close THIS connection (read returns EOF)...
+    rogue.settimeout(10)
+    assert rogue.recv(1) == b"", "oversize frame was not rejected"
+    rogue.close()
+
+    # A connect-and-send-garbage LOOP must not leak fds either (each
+    # rejected conn's fd is reclaimed on reader exit because nothing
+    # referenced it) — 50 attempts would show up quickly against a
+    # lowered fd budget; here we just assert the tier stays healthy.
+    for _ in range(50):
+        r = socket.create_connection(("127.0.0.1", port), 5)
+        r.sendall(_REQ.pack(2, 0, 0, 1, 0, 99, 1 << 40))
+        r.settimeout(10)
+        assert r.recv(1) == b""
+        r.close()
+
+    # ...while the live session and a brand-new one keep working.
+    np.testing.assert_array_equal(s.push_pull(7, 2 * x), 2 * x)
+    s2 = _session(port, 0)
+    np.testing.assert_array_equal(s2.push_pull(8, x), x)
+    s.close()
+    s2.close()
+
+
 def test_api_push_pull_via_ps_mode(ps_server):
     """BYTEPS_TPU_PS_MODE=1 routes bps.push_pull through the server tier,
     partitioned and priority-scheduled, transparently to the API user."""
