@@ -119,13 +119,19 @@ struct Reader {
 };
 
 // Decompress `payload` into n*4 bytes of f32 at `out`. Returns false on a
-// malformed payload (bad sizes / out-of-range indices).
+// malformed payload (bad sizes / out-of-range indices).  `max_out` caps
+// the CLAIMED decompressed size before the buffer is allocated: n comes
+// off the wire, so a crafted 5-byte payload could otherwise demand a
+// 16 GB allocation (bad_alloc in the engine thread) — the same hostile-
+// frame class as the reader's length cap.
 inline bool Decompress(const std::vector<char>& payload,
-                       std::vector<char>* out) {
+                       std::vector<char>* out,
+                       size_t max_out = (1ULL << 30)) {
   Reader r{payload.data(), payload.size()};
   uint8_t comp = 0;
   uint32_t n = 0;
   if (!r.Take(&comp, 1) || !r.Take(&n, 4)) return false;
+  if (static_cast<size_t>(n) * 4 > max_out) return false;
   out->assign(static_cast<size_t>(n) * 4, 0);
   float* dst = reinterpret_cast<float*>(out->data());
   switch (comp) {
@@ -764,7 +770,7 @@ class Server {
     std::vector<char> scratch;
     const std::vector<char>* data = &t.payload;
     if (t.dtype == kCompressed) {
-      if (!codec::Decompress(t.payload, &scratch)) {
+      if (!codec::Decompress(t.payload, &scratch, max_msg_)) {
         Respond(t.conn, kError, t.req_id, t.key, nullptr, 0);
         return;
       }

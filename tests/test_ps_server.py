@@ -496,6 +496,22 @@ def test_oversize_frame_drops_connection_not_server(ps_server):
         assert r.recv(1) == b""
         r.close()
 
+    # A compressed push whose header CLAIMS a 16GB decompressed size (a
+    # 10-byte payload, n=0xFFFFFFFF) must get an error response — not a
+    # bad_alloc in the engine thread.
+    bad = struct.pack("<BI", 1, 0xFFFFFFFF) + b"\0\0\0\0"  # onebit, huge n
+    crafty = socket.create_connection(("127.0.0.1", port), 5)
+    crafty.sendall(_REQ.pack(2, 2, 0, 7, 0, 99, len(bad)) + bad)
+    crafty.settimeout(10)
+    resp = b""
+    while len(resp) < 21:     # RespHeader: status u8, req_id u32, 2x u64
+        chunk = crafty.recv(21 - len(resp))
+        assert chunk, "no response to oversize-claim compressed push"
+        resp += chunk
+    status, req_id, _, _ = struct.unpack("<BIQQ", resp)
+    assert status != 0 and req_id == 7, "bogus decompress size not rejected"
+    crafty.close()
+
     # ...while the live session and a brand-new one keep working.
     np.testing.assert_array_equal(s.push_pull(7, 2 * x), 2 * x)
     s2 = _session(port, 0)
