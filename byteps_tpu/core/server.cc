@@ -40,6 +40,7 @@
 #include <netinet/in.h>
 #include <netinet/tcp.h>
 #include <sys/socket.h>
+#include <sys/uio.h>
 #include <unistd.h>
 
 #include <atomic>
@@ -530,8 +531,30 @@ class Server {
                       const char* data, uint64_t len) {
     std::lock_guard<std::mutex> lk(c->write_mu);
     RespHeader h{status, req_id, key, len};
-    if (!WriteFull(c->fd, &h, sizeof(h))) return;
-    if (len) WriteFull(c->fd, data, len);
+    // One sendmsg for header+payload: two send() calls under TCP_NODELAY
+    // put the 21-byte header on the wire as its own packet (extra syscall
+    // + packet + reader wakeup per response on the pull-heavy path).
+    iovec iov[2] = {{&h, sizeof(h)},
+                    {const_cast<char*>(data), static_cast<size_t>(len)}};
+    msghdr msg{};
+    msg.msg_iov = iov;
+    msg.msg_iovlen = len ? 2 : 1;
+    while (true) {
+      ssize_t r = sendmsg(c->fd, &msg, MSG_NOSIGNAL);
+      if (r < 0 && errno == EINTR) continue;  // signal mid-frame: resume,
+                                              // or the stream desyncs
+      if (r <= 0) return;   // peer gone: reader/engine paths tolerate
+      size_t done = static_cast<size_t>(r);
+      while (msg.msg_iovlen > 0 && done >= msg.msg_iov[0].iov_len) {
+        done -= msg.msg_iov[0].iov_len;
+        ++msg.msg_iov;
+        --msg.msg_iovlen;
+      }
+      if (msg.msg_iovlen == 0) return;
+      msg.msg_iov[0].iov_base =
+          static_cast<char*>(msg.msg_iov[0].iov_base) + done;
+      msg.msg_iov[0].iov_len -= done;
+    }
   }
 
   // Key -> engine by least accumulated load (reference: server.h:149-173).
@@ -837,13 +860,20 @@ class Server {
           for (size_t i = 0; i < ne; ++i)
             ks.ef_err[i] = s[i] - (s[i] < 0.0f ? -scale : scale);
         }
+        // Log BEFORE the increment so all_recv and its contributing
+        // push_recv lines carry the same round number (the compressed
+        // branch logs after the EF fold — the store it publishes).
+        DebugLog("all_recv", t.key, t.worker_id, ks.completed_round,
+                 ks.store);
       } else {
-        ks.out = ks.store;
+        DebugLog("all_recv", t.key, t.worker_id, ks.completed_round,
+                 ks.store);
+        // Publish by swap, not copy: `out` takes the merged round (what
+        // pulls serve) and `store` inherits a stale same-size buffer that
+        // the next round's COPY_FIRST fully overwrites — saving a
+        // full-buffer memcpy per partition per round on the serve path.
+        std::swap(ks.out, ks.store);
       }
-      // Log BEFORE the increment so all_recv and its contributing
-      // push_recv lines carry the same round number.
-      DebugLog("all_recv", t.key, t.worker_id, ks.completed_round,
-               ks.store);
       ks.completed_round++;
       ks.seen.clear();
       ks.round_compressed = false;

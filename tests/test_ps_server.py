@@ -497,8 +497,8 @@ def test_oversize_frame_drops_connection_not_server(ps_server):
         r.close()
 
     # A compressed push whose header CLAIMS a 16GB decompressed size (a
-    # 10-byte payload, n=0xFFFFFFFF) must get an error response — not a
-    # bad_alloc in the engine thread.
+    # 9-byte payload: comp u8 + n u32 + 4 filler, n=0xFFFFFFFF) must get
+    # an error response — not a bad_alloc in the engine thread.
     bad = struct.pack("<BI", 1, 0xFFFFFFFF) + b"\0\0\0\0"  # onebit, huge n
     crafty = socket.create_connection(("127.0.0.1", port), 5)
     crafty.sendall(_REQ.pack(2, 2, 0, 7, 0, 99, len(bad)) + bad)
