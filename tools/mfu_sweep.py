@@ -50,6 +50,15 @@ SWEEP = [
      "env": {"BENCH_BATCH": "64", "BENCH_UNROLL": "2"}},
     {"name": "flagship_unroll4", "group": "unroll",
      "env": {"BENCH_BATCH": "64", "BENCH_UNROLL": "4"}},
+    # proj selective remat at the tuned batch: at 48 it matched full remat
+    # within noise, but it skips ~2/3 of the recomputed matmul FLOPs — if
+    # it still fits at 64 (flash keeps the S^2 logits out of HBM), the
+    # saved recompute should finally show.  Grouped: OOM stops the pair.
+    {"name": "flagship_proj_b64", "group": "proj",
+     "env": {"BENCH_BATCH": "64", "BENCH_REMAT_POLICY": "proj"}},
+    {"name": "flagship_proj_b64_unroll2", "group": "proj",
+     "env": {"BENCH_BATCH": "64", "BENCH_REMAT_POLICY": "proj",
+             "BENCH_UNROLL": "2"}},
     {"name": "l300m_b16_blk512", "group": "lbatch",
      "env": {"BENCH_MODEL": "llama_300m", "BENCH_ATTN": "flash",
              "BENCH_BATCH": "16", "BENCH_ATTN_BLOCK": "512"}},
