@@ -909,8 +909,13 @@ def _latest_onchip_archive(runs_dir: str = None) -> dict:
                 except (ValueError, TypeError, AttributeError):
                     continue
                 if ok:
+                    import datetime
+
+                    stamp = datetime.datetime.fromtimestamp(
+                        os.path.getmtime(path)).strftime("%Y-%m-%d %H:%M")
                     return {
                         "source": os.path.basename(path),
+                        "archived_at": stamp,
                         "metric": res.get("metric"),
                         "value": res.get("value"),
                         "vs_baseline": res.get("vs_baseline"),
