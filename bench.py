@@ -84,6 +84,21 @@ def _note() -> dict:
     return {"note": n} if n else {}
 
 
+def _headline(unit: str, vs_baseline: float) -> dict:
+    """Headline {unit, vs_baseline}, marked when this process is the
+    hermetic CPU-fallback child.  Contract (round-4 review): a driver
+    parsing only {rc, value, vs_baseline} must never mistake a fallback
+    for an on-chip measurement — a tiny-model CPU run's vs_baseline of
+    ~1.1 reads exactly like a passing flagship number.  So the fallback's
+    unit gains a `cpu_fallback_` prefix and vs_baseline is zeroed; the
+    detail note + last_onchip_archive pointer still carry the human
+    story.  An EXPLICIT local CPU run (BENCH_FORCE_CPU, used by tests
+    and dev loops) is not a fallback and keeps the plain headline."""
+    if os.environ.get("BENCH_CPU_FALLBACK_CHILD", "0") == "1":
+        return {"unit": f"cpu_fallback_{unit}", "vs_baseline": 0.0}
+    return {"unit": unit, "vs_baseline": vs_baseline}
+
+
 def _time_steps(fn, params, opt_state, batch, n, per_step):
     """Shared timing harness: warmup+compile step, then n timed steps.
 
@@ -275,8 +290,7 @@ def bench_flagship():
     print(json.dumps({
         "metric": f"{model_name}_dp_scaling_efficiency",
         "value": round(efficiency, 4),
-        "unit": "fraction_of_ideal",
-        "vs_baseline": round(efficiency / 0.90, 4),
+        **_headline("fraction_of_ideal", round(efficiency / 0.90, 4)),
         "detail": {
             "framework_tokens_per_sec": round(fw_tps),
             "tokens_per_sec_per_chip": round(tps_per_chip),
@@ -368,8 +382,7 @@ def bench_cnn():
     print(json.dumps({
         "metric": f"{name}_dp_scaling_efficiency",
         "value": round(efficiency, 4),
-        "unit": "fraction_of_ideal",
-        "vs_baseline": round(efficiency / 0.90, 4),
+        **_headline("fraction_of_ideal", round(efficiency / 0.90, 4)),
         "detail": {
             "framework_images_per_sec": round(fw_ips, 1),
             "images_per_sec_per_chip": round(fw_ips / n_dev, 1),
@@ -452,8 +465,8 @@ def bench_machinery():
     print(json.dumps({
         "metric": "machinery_bucketed_speedup_vs_naive",
         "value": small["bucketed_speedup"],
-        "unit": "x",
-        "vs_baseline": small["bucketed_speedup"],  # >1.0: bucketing pays
+        # >1.0: bucketing pays
+        **_headline("x", small["bucketed_speedup"]),
         "detail": {
             "small_leaves": small,
             "mixed": mixed,
@@ -546,7 +559,11 @@ def bench_ps():
             env = cpu_subprocess_env({
                 "DMLC_PS_ROOT_PORT": str(port - 1),
                 "DMLC_NUM_WORKER": "1",
-                "BYTEPS_SERVER_ENGINE_THREAD": "4",
+                # Engines beyond the core count only add context
+                # switches to the serve path (measured -10% goodput at
+                # 4 engines on a 1-core host).
+                "BYTEPS_SERVER_ENGINE_THREAD":
+                    str(min(4, os.cpu_count() or 4)),
             })
             import tempfile
             errf = tempfile.TemporaryFile(mode="w+")
@@ -892,8 +909,16 @@ def _latest_onchip_archive(runs_dir: str = None) -> dict:
         if runs_dir is None:
             runs_dir = os.path.join(
                 os.path.dirname(os.path.abspath(__file__)), "bench_runs")
-        files = sorted(glob.glob(os.path.join(runs_dir, "*onchip*.jsonl")),
-                       key=os.path.getmtime)
+        # Per-file mtime guard: a file vanishing between glob and sort
+        # must skip THAT file, not abort the whole scan into the blanket
+        # except below (advisor r4).
+        stamped = []
+        for p in glob.glob(os.path.join(runs_dir, "*onchip*.jsonl")):
+            try:
+                stamped.append((os.path.getmtime(p), p))
+            except OSError:
+                continue
+        files = [p for _, p in sorted(stamped)]
         for path in reversed(files):
             with open(path) as f:
                 lines = [ln for ln in f.read().splitlines() if ln.strip()]
@@ -911,8 +936,17 @@ def _latest_onchip_archive(runs_dir: str = None) -> dict:
                 if ok:
                     import datetime
 
-                    stamp = datetime.datetime.fromtimestamp(
-                        os.path.getmtime(path)).strftime("%Y-%m-%d %H:%M")
+                    # Prefer a timestamp recorded IN the line (a fresh
+                    # clone's file mtime is checkout time, not
+                    # measurement time — advisor r4); fall back to mtime.
+                    stamp = rec.get("archived_at") or rec.get("ts")
+                    if not stamp:
+                        try:
+                            stamp = datetime.datetime.fromtimestamp(
+                                os.path.getmtime(path)
+                            ).strftime("%Y-%m-%d %H:%M")
+                        except OSError:
+                            stamp = "unknown"
                     return {
                         "source": os.path.basename(path),
                         "archived_at": stamp,

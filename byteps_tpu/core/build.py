@@ -90,8 +90,11 @@ def build(force: bool = False, verbose: bool = False) -> str:
         return lib_path()
     srcs = [os.path.join(_CORE_DIR, s) for s in _SOURCES
             if os.path.exists(os.path.join(_CORE_DIR, s))]
+    # -O3: the wire-codec inner loops (onebit expand, dense level
+    # gather) only vectorize at -O3; measured ~2x on the codec micros
+    # with no change anywhere else.
     cmd = [
-        "g++", "-O2", "-std=c++17", "-shared", "-fPIC",
+        "g++", "-O3", "-std=c++17", "-shared", "-fPIC",
         "-pthread", "-fvisibility=hidden", "-o", lib_path(), *srcs,
     ]
     if verbose:
@@ -121,7 +124,7 @@ def build_server_exe(force: bool = False) -> str:
     if not force and os.path.exists(out) \
             and os.path.getmtime(out) >= os.path.getmtime(src):
         return out
-    cmd = ["g++", *_san_flags(), "-O2", "-std=c++17", "-pthread",
+    cmd = ["g++", *_san_flags(), "-O3", "-std=c++17", "-pthread",
            "-DBPS_SERVER_MAIN", "-o", out, src]
     subprocess.run(cmd, check=True, capture_output=True)
     return out

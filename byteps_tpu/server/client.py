@@ -112,12 +112,15 @@ class _ServerConn:
         try:
             with self.lock:
                 if len(payload) >= 65536:
-                    # Zero-copy send for data partitions: memoryview goes
-                    # straight to the socket (the reference's ZPush
-                    # zero-copy SArray stance, core_loops.cc:564-569);
-                    # concatenating would copy ~4MB per partition twice.
-                    self.sock.sendall(hdr)
-                    self.sock.sendall(payload)
+                    # Zero-copy gather send for data partitions: the
+                    # memoryview goes straight to the socket (the
+                    # reference's ZPush zero-copy SArray stance,
+                    # core_loops.cc:564-569) and header+payload ride ONE
+                    # sendmsg — under TCP_NODELAY a separate header
+                    # sendall is its own packet + syscall + server-reader
+                    # wakeup per partition (mirror of the server-side
+                    # Respond coalescing).
+                    self._send_gather(hdr, payload)
                 else:
                     self.sock.sendall(hdr + bytes(payload))
         except OSError as e:
@@ -125,6 +128,19 @@ class _ServerConn:
                 self._pending.pop(req_id, None)
             raise ConnectionError(f"PS send failed: {e}") from e
         return fut
+
+    def _send_gather(self, hdr: bytes, payload) -> None:
+        """header+payload in one gather syscall, with the partial-write
+        loop sendmsg needs (unlike sendall it returns after one write)."""
+        mv_h, mv_p = memoryview(hdr), memoryview(payload)
+        total = len(mv_h) + len(mv_p)
+        sent = self.sock.sendmsg([mv_h, mv_p])
+        while sent < total:
+            if sent < len(mv_h):
+                sent += self.sock.sendmsg([mv_h[sent:], mv_p])
+            else:
+                self.sock.sendall(mv_p[sent - len(mv_h):])
+                sent = total
 
     def request(self, cmd: int, key: int = 0, payload: bytes = b"",
                 worker_id: int = 0, dtype: int = 0, flags: int = 0,

@@ -50,6 +50,40 @@ COMP_ONEBIT, COMP_TOPK, COMP_RANDOMK, COMP_DITHERING = 1, 2, 3, 4
 _NAMES = {"onebit": COMP_ONEBIT, "topk": COMP_TOPK,
           "randomk": COMP_RANDOMK, "dithering": COMP_DITHERING}
 
+_CWIRE = False   # False = untried, None = unavailable, else the CDLL
+
+
+def _c_wire():
+    """ctypes handle to the C codec in libbyteps_core.so (the same
+    decoder/encoder the server engine runs), or None when the native
+    build is unavailable — every caller keeps a numpy fallback, so a
+    toolchain-less install stays fully functional, just slower (the
+    numpy dithering/elias paths are 10-1000x off the C ones)."""
+    global _CWIRE
+    if _CWIRE is False:
+        try:
+            import ctypes
+
+            from ..core import native
+            core = native.get_core()
+            lib = getattr(core, "_lib", None)
+            if lib is None:
+                _CWIRE = None
+            else:
+                u64, u32 = ctypes.c_uint64, ctypes.c_uint32
+                lib.bps_wire_decode.argtypes = [
+                    ctypes.c_char_p, u64, ctypes.c_void_p, u64]
+                lib.bps_wire_decode.restype = ctypes.c_int
+                lib.bps_wire_encode_dithering.argtypes = [
+                    ctypes.c_void_p, u64, u32, ctypes.c_int, ctypes.c_int,
+                    ctypes.c_float, ctypes.c_void_p, ctypes.c_void_p,
+                    ctypes.c_void_p, u64]
+                lib.bps_wire_encode_dithering.restype = ctypes.c_int64
+                _CWIRE = lib
+        except Exception:   # pragma: no cover - defensive
+            _CWIRE = None
+    return _CWIRE
+
 
 def _pack_bits(bits: np.ndarray) -> np.ndarray:
     """bits [n] in {0,1} -> uint8 [ceil(n/8)], LSB-first (matches the C++
@@ -317,7 +351,15 @@ class WireCompressor:
         hdr = struct.pack("<BI", self.comp_id, n)
         if self.comp_id == COMP_ONEBIT:
             scale = (np.abs(x).sum() / max(n, 1)) if self.scaled else 1.0
-            bits = _pack_bits(x < 0)
+            signs = x < 0
+            bits = _pack_bits(signs)
+            if self.ef:
+                # Reconstruction directly from the signs — the decoded
+                # onebit value is just +-scale, so the EF path never
+                # needs to re-decode the blob it just wrote.
+                self._last_recon = np.where(
+                    signs, np.float32(-scale),
+                    np.float32(scale)).astype(np.float32)
             return hdr + struct.pack("<f", np.float32(scale)) + bits.tobytes()
         if self.comp_id == COMP_TOPK:
             k = min(self.k, n)
@@ -342,6 +384,33 @@ class WireCompressor:
         else:
             norm = float(np.sqrt(np.sum(x * x)))
         norm = max(norm, float(np.finfo(np.float32).tiny))
+        lib = _c_wire()
+        if lib is not None and n:
+            # C fast path: same float32 quantization arithmetic and PRNG
+            # as the numpy code below, asserted byte-identical by
+            # tests/test_ps_compression.py.  norm stays Python-computed
+            # (numpy's pairwise float32 sum is the l2 parity reference).
+            rng = self._rng.get(pkey)
+            if rng is None or rng.size < n:
+                rng = _seed_state(self.seed, n)
+            rng = np.ascontiguousarray(rng[:n])
+            recon = np.empty(n, np.float32) if self.ef else None
+            elias = self.coding == "elias"
+            cap = 15 + (4 * n + 64 if elias
+                        else (n * _level_bits(s) + 7) // 8 + (n + 7) // 8)
+            out = np.empty(cap, np.uint8)
+            wrote = lib.bps_wire_encode_dithering(
+                x.ctypes.data, n, s,
+                1 if self.partition == "natural" else 0,
+                1 if elias else 0, float(np.float32(norm)),
+                rng.ctypes.data,
+                recon.ctypes.data if recon is not None else None,
+                out.ctypes.data, cap)
+            if wrote > 0:
+                self._rng[pkey] = rng
+                if recon is not None:
+                    self._last_recon = recon
+                return out[:wrote].tobytes()
         mag = np.abs(x) / np.float32(norm)
         levels = self._levels()
         j = np.clip(np.searchsorted(levels, mag, side="right") - 1, 0, s - 1)
@@ -399,7 +468,27 @@ class WireCompressor:
 
 def decode(data: bytes, n: int) -> np.ndarray:
     """Decode any compressed wire payload to an n-element f32 vector
-    (the worker pull-leg decompress for bidirectional compressors)."""
+    (the worker pull-leg decompress for bidirectional compressors).
+
+    Rides the C decoder from libbyteps_core.so when available (the
+    exact routine the server engine runs — the numpy paths below are
+    the behavioral reference and the toolchain-less fallback; the
+    elias path in particular is ~1000x slower in Python)."""
+    comp, wn = struct.unpack_from("<BI", data, 0)
+    if wn != n:
+        raise ValueError(f"wire n={wn} != expected {n}")
+    lib = _c_wire()
+    if lib is not None:
+        out = np.empty(n, np.float32)
+        if lib.bps_wire_decode(data, len(data), out.ctypes.data, n) == 0:
+            return out
+        raise ValueError("malformed compressed wire payload (C decoder)")
+    return _decode_py(data, n)
+
+
+def _decode_py(data: bytes, n: int) -> np.ndarray:
+    """numpy reference decoder (kept as the toolchain-less fallback and
+    the cross-implementation parity target for tests)."""
     comp, wn = struct.unpack_from("<BI", data, 0)
     if wn != n:
         raise ValueError(f"wire n={wn} != expected {n}")

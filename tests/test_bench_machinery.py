@@ -109,6 +109,39 @@ def test_machinery_bench_bucketed_beats_naive():
     assert out["value"] >= 1.0, out
 
 
+@pytest.mark.slow
+def test_cpu_fallback_record_is_machine_distinguishable():
+    """A CPU-fallback child's record must never be mistaken for an
+    on-chip measurement by a driver parsing only {rc, value,
+    vs_baseline}: the unit carries a cpu_fallback_ prefix and
+    vs_baseline is 0.0 (VERDICT r4 weak #5)."""
+    env = dict(os.environ)
+    env.update({"BENCH_CPU_FALLBACK_CHILD": "1", "BENCH_EXEC_CHILD": "1",
+                "BENCH_SMALL": "1", "JAX_PLATFORMS": "cpu",
+                "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+                "BENCH_NOTE": "cpu-fallback: contract test",
+                "BYTEPS_LOG_LEVEL": "ERROR"})
+    env.pop("BENCH_MODEL", None)
+    r = subprocess.run([sys.executable, BENCH], env=env,
+                       capture_output=True, text=True, timeout=900)
+    assert r.returncode == 0, r.stderr[-2000:]
+    out = json.loads(r.stdout.strip().splitlines()[-1])
+    assert out["unit"] == "cpu_fallback_fraction_of_ideal"
+    assert out["vs_baseline"] == 0.0
+    assert out["detail"]["note"].startswith("cpu-fallback")
+    # An EXPLICIT local CPU run is not a fallback: plain headline.
+    env2 = dict(env)
+    del env2["BENCH_CPU_FALLBACK_CHILD"]
+    env2["BENCH_FORCE_CPU"] = "1"
+    env2.pop("BENCH_NOTE")
+    r2 = subprocess.run([sys.executable, BENCH], env=env2,
+                        capture_output=True, text=True, timeout=900)
+    assert r2.returncode == 0, r2.stderr[-2000:]
+    out2 = json.loads(r2.stdout.strip().splitlines()[-1])
+    assert out2["unit"] == "fraction_of_ideal"
+    assert out2["vs_baseline"] > 0
+
+
 def test_latest_onchip_archive_resilient(tmp_path):
     """The CPU-fallback provenance lookup must survive truncated lines
     (a child killed mid-write), null mfu fields, and sweep-wrapped record
@@ -123,6 +156,7 @@ def test_latest_onchip_archive_resilient(tmp_path):
             "detail": {"framework_tokens_per_sec": 100, "mfu": 0.35,
                        "batch": 64, "seq": 512, "attn_impl": "flash"}}
     wrapped = {"name": "run", "rc": 0,
+               "archived_at": "2026-01-01 00:00",
                "result": {"metric": "m2", "value": 0.9,
                           "detail": {"mfu": 0.30}}}
     null_mfu = {"metric": "m3", "value": 1.0, "detail": {"mfu": None}}
@@ -134,6 +168,9 @@ def test_latest_onchip_archive_resilient(tmp_path):
         '{"metric": "trunc', ]) + "\n")  # killed mid-write: skipped
     got = bench._latest_onchip_archive(runs_dir=str(tmp_path))
     assert got["metric"] == "m2" and got["mfu"] == 0.30
+    # In-record timestamp preferred over file mtime (fresh-clone mtime
+    # is checkout time, not measurement time).
+    assert got["archived_at"] == "2026-01-01 00:00"
     # Empty dir -> empty dict, never an exception.
     assert bench._latest_onchip_archive(
         runs_dir=str(tmp_path / "nope")) == {}

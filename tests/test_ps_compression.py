@@ -457,3 +457,54 @@ def test_late_joiner_adopts_global_weights(ps_server):
     t1.step({"w": w})  # no-op delta, just pull
     np.testing.assert_array_equal(t1.params["w"], progressed)
     s1.close(); s2.close()
+
+
+@pytest.mark.parametrize("kwargs", [
+    {"compressor": "onebit", "ef": "vanilla"},
+    {"compressor": "dithering", "k": "15"},
+    {"compressor": "dithering", "k": "15", "coding": "elias"},
+    {"compressor": "dithering", "k": "7", "partition": "natural",
+     "normalize": "l2", "coding": "elias", "ef": "vanilla"},
+])
+def test_c_codec_bytes_match_numpy_reference(kwargs):
+    """The C wire codec (libbyteps_core) must produce byte-identical
+    blobs, EF state, and decodes to the numpy reference paths — a
+    C-enabled worker and a toolchain-less worker on the same tier must
+    carry the same wire bytes.  Forces BOTH paths explicitly (with the
+    C library present, ordinary tests only ever exercise the C path).
+    Inputs include sparse, NaN, and inf gradients (loss-overflow shapes
+    that historically diverged on the natural-partition NaN ordering).
+    """
+    if wire._c_wire() is None:
+        pytest.skip("native wire codec unavailable")
+    rng = np.random.default_rng(7)
+    cases = []
+    for n in (1, 7, 255, 2048, 65537):
+        x = (rng.standard_normal(n) * 0.01).astype(np.float32)
+        cases.append(x)
+        sparse = np.where(rng.random(n) < 0.002, x, 0.0).astype(np.float32)
+        cases.append(sparse)
+    bad = (rng.standard_normal(1024) * 0.01).astype(np.float32)
+    bad[::100] = np.inf
+    bad[::173] = np.nan
+    cases.append(bad)
+    cases.append(np.full(17, np.inf, np.float32))
+    for x in cases:
+        try:
+            wire._CWIRE = False            # C path
+            wc_c = wire.WireCompressor(kwargs)
+            blob_c = wc_c.encode(3, x)
+            err_c = {k: v.copy() for k, v in wc_c._err.items()}
+            wire._CWIRE = None             # numpy reference path
+            wc_p = wire.WireCompressor(kwargs)
+            blob_p = wc_p.encode(3, x)
+            assert blob_c == blob_p, (kwargs, x.size)
+            for k, v in wc_p._err.items():
+                np.testing.assert_array_equal(err_c[k], v, err_msg=str(
+                    (kwargs, x.size)))
+            wire._CWIRE = False
+            np.testing.assert_array_equal(
+                wire.decode(blob_c, x.size), wire._decode_py(blob_c, x.size),
+                err_msg=str((kwargs, x.size)))
+        finally:
+            wire._CWIRE = False            # leave the loader re-armed

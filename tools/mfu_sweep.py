@@ -34,59 +34,83 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 #   OOM-ing remote compile is exactly what wedged the tunnel in the
 #   pass-2 postmortem.
 #
-# Pass 6.  Pass 5 (bench_runs/r04_sweep5{,b}.jsonl) established the
-# long-S block ladder (blk512 27.0k > 256 20.7k > 128 15.4k tok/s at
-# llama_300m seq 2048 batch 8; dense 15.9k) before the tunnel wedged
-# again.  This pass: (a) flagship anchor re-run under the new auto
-# rule, (b) the BENCH_UNROLL ladder (scan_unroll groups layers per
-# scan iteration — scheduling freedom vs code size, unmeasured),
-# (c) the llama batch escalation pass 5 never reached (now under the
-# winning blk512), (d) the asymmetric-tile question, (e) the dense
-# batch-64 anchor from the pass-3 list.
+# Pass 7 (round 5).  Priorities from the round-4 review, ordered so the
+# never-measured evidence lands FIRST if the tunnel wedges mid-pass:
+# (a) flagship anchor (self-calibration), (b) the CNN baseline rows that
+# have existed for four rounds with zero on-chip data, (c) the levers
+# round 4 built but never measured (proj remat at b64/96, the no-remat
+# ladder, asymmetric K tile at S=512, CE chunk ladder, unroll), (d) the
+# truncated long-context sweeps (llama batch escalation, llama_1b
+# S=2048, S=8192 end-to-end).
 SWEEP = [
     {"name": "flagship_anchor",
      "env": {"BENCH_BATCH": "64", "BENCH_COST": "1"}},
-    {"name": "flagship_unroll2", "group": "unroll",
-     "env": {"BENCH_BATCH": "64", "BENCH_UNROLL": "2"}},
-    {"name": "flagship_unroll4", "group": "unroll",
-     "env": {"BENCH_BATCH": "64", "BENCH_UNROLL": "4"}},
-    # proj selective remat at the tuned batch: at 48 it matched full remat
-    # within noise, but it skips ~2/3 of the recomputed matmul FLOPs — if
-    # it still fits at 64 (flash keeps the S^2 logits out of HBM), the
-    # saved recompute should finally show.  Grouped: OOM stops the pair.
+    # CNN rows: BS=64/chip like the reference's headline table
+    # (reference docs/performance.md:5-12).  fp32, 224x224.
+    {"name": "cnn_resnet50", "timeout": 1200,
+     "env": {"BENCH_CNN": "resnet50", "BENCH_CNN_BATCH": "64"}},
+    {"name": "cnn_vgg16", "timeout": 1200, "group": "cnn_vgg",
+     "env": {"BENCH_CNN": "vgg16", "BENCH_CNN_BATCH": "64"}},
+    # proj selective remat at the tuned batch: skips ~2/3 of the
+    # recomputed matmul FLOPs vs full remat.  Grouped: OOM stops the
+    # escalation (b96 probes whether the freed remat memory buys batch).
     {"name": "flagship_proj_b64", "group": "proj",
      "env": {"BENCH_BATCH": "64", "BENCH_REMAT_POLICY": "proj"}},
-    {"name": "flagship_proj_b64_unroll2", "group": "proj",
-     "env": {"BENCH_BATCH": "64", "BENCH_REMAT_POLICY": "proj",
-             "BENCH_UNROLL": "2"}},
+    {"name": "flagship_proj_b96", "group": "proj",
+     "env": {"BENCH_BATCH": "96", "BENCH_REMAT_POLICY": "proj"}},
+    # No remat at all: zero recompute, activations live in HBM — the
+    # ladder finds the largest batch that still fits (flash keeps the
+    # S^2 logits out of HBM, so this was never measurable pre-flash).
+    {"name": "flagship_noremat_b16", "group": "noremat",
+     "env": {"BENCH_BATCH": "16", "BENCH_REMAT": "0"}},
+    {"name": "flagship_noremat_b24", "group": "noremat",
+     "env": {"BENCH_BATCH": "24", "BENCH_REMAT": "0"}},
+    {"name": "flagship_noremat_b32", "group": "noremat",
+     "env": {"BENCH_BATCH": "32", "BENCH_REMAT": "0"}},
+    # Asymmetric tiles at the flagship geometry: narrow K tile trims
+    # masked diagonal waste in the causal kernel.
+    {"name": "flagship_q512_k256",
+     "env": {"BENCH_BATCH": "64", "BENCH_ATTN_BLOCK_K": "256"}},
+    # CE chunk ladder: 2048 is the tuned default; the sweep has never
+    # measured either neighbor at batch 64.
+    {"name": "flagship_ce4096",
+     "env": {"BENCH_BATCH": "64", "BENCH_CE_CHUNK": "4096"}},
+    {"name": "flagship_ce8192",
+     "env": {"BENCH_BATCH": "64", "BENCH_CE_CHUNK": "8192"}},
+    {"name": "flagship_unroll2",
+     "env": {"BENCH_BATCH": "64", "BENCH_UNROLL": "2"}},
+    # Long context: the batch escalation pass 5 never reached (under the
+    # winning blk512), then llama_1b at S=2048 (never ran: sweep4 died).
     {"name": "l300m_b16_blk512", "group": "lbatch",
      "env": {"BENCH_MODEL": "llama_300m", "BENCH_ATTN": "flash",
              "BENCH_BATCH": "16", "BENCH_ATTN_BLOCK": "512"}},
     {"name": "l300m_b24_blk512", "group": "lbatch",
      "env": {"BENCH_MODEL": "llama_300m", "BENCH_ATTN": "flash",
              "BENCH_BATCH": "24", "BENCH_ATTN_BLOCK": "512"}},
-    {"name": "dense_b64",
-     "env": {"BENCH_ATTN": "dense", "BENCH_BATCH": "64"}},
-    # Asymmetric tiles (BENCH_ATTN_BLOCK_K decouples the K/V tile from
-    # the Q tile): at causal long-S a wide Q tile keeps programs fat
-    # while a narrow K tile trims masked diagonal waste — unmeasured.
-    {"name": "l300m_q512_k256", "group": "llama",
+    {"name": "l1b_s2048_blk512", "group": "l1b", "timeout": 1200,
+     "env": {"BENCH_MODEL": "llama_1b", "BENCH_ATTN": "flash",
+             "BENCH_BATCH": "4", "BENCH_ATTN_BLOCK": "512"}},
+    {"name": "l1b_s2048_blk256", "group": "l1b", "timeout": 1200,
+     "env": {"BENCH_MODEL": "llama_1b", "BENCH_ATTN": "flash",
+             "BENCH_BATCH": "4", "BENCH_ATTN_BLOCK": "256"}},
+    # Long-S selective remat: the O(S^2)-free proj policy is the round-4
+    # lever for pushing S=2048 MFU past 0.30.
+    {"name": "l300m_s2048_proj", "group": "lproj",
      "env": {"BENCH_MODEL": "llama_300m", "BENCH_ATTN": "flash",
              "BENCH_BATCH": "8", "BENCH_ATTN_BLOCK": "512",
-             "BENCH_ATTN_BLOCK_K": "256"}},
-    {"name": "l300m_s2048_unroll2",
+             "BENCH_REMAT_POLICY": "proj"}},
+    {"name": "l300m_s2048_noremat", "group": "lproj",
      "env": {"BENCH_MODEL": "llama_300m", "BENCH_ATTN": "flash",
              "BENCH_BATCH": "8", "BENCH_ATTN_BLOCK": "512",
-             "BENCH_UNROLL": "2"}},
-    # Gathered-sequence A/B: the strict ring/Ulysses path runs flash at
-    # S >= 8k, where the new 512 auto tile is an extrapolation from the
-    # S=2048 ladder — settle it on-chip (grouped: the 8k compile is the
-    # memory-heavy one; an OOM skips the second leg).
-    {"name": "l300m_s8192_blk512", "group": "s8k",
+             "BENCH_REMAT": "0"}},
+    # S=8192 end-to-end (the kernel microbench says streaming flash is
+    # 1.61x at S=4096 — prove it on a full train step).  Grouped: the 8k
+    # compile is the memory-heavy one; an OOM skips the second leg.
+    {"name": "l300m_s8192_blk512", "group": "s8k", "timeout": 1200,
      "env": {"BENCH_MODEL": "llama_300m", "BENCH_SEQ": "8192",
              "BENCH_ATTN": "flash", "BENCH_BATCH": "1",
              "BENCH_ATTN_BLOCK": "512"}},
-    {"name": "l300m_s8192_blk128", "group": "s8k",
+    {"name": "l300m_s8192_blk128", "group": "s8k", "timeout": 1200,
      "env": {"BENCH_MODEL": "llama_300m", "BENCH_SEQ": "8192",
              "BENCH_ATTN": "flash", "BENCH_BATCH": "1",
              "BENCH_ATTN_BLOCK": "128"}},
@@ -122,6 +146,7 @@ def run_one(entry: dict, timeout: float) -> dict:
         err = (e.stderr or b"").decode(errors="replace") \
             if isinstance(e.stderr, bytes) else (e.stderr or "")
     rec = {"name": entry["name"], "env": entry["env"], "rc": rc,
+           "ts": time.strftime("%Y-%m-%d %H:%M"),
            "wall_s": round(time.time() - t0, 1)}
     lines = [ln for ln in out.strip().splitlines() if ln.startswith("{")]
     try:
@@ -158,7 +183,7 @@ def main() -> None:
                 f.flush()
                 break
             print(f"[sweep] running {entry['name']} ...", file=sys.stderr)
-            rec = run_one(entry, timeout)
+            rec = run_one(entry, float(entry.get("timeout", timeout)))
             f.write(json.dumps(rec) + "\n")
             f.flush()
             if rec["rc"] != 0 and entry.get("group"):

@@ -1,0 +1,24 @@
+#!/bin/sh
+# Probe the device tunnel until it answers, then immediately run the
+# on-chip MFU sweep.  Round-5 front-loading: the tunnel wedged at round
+# end twice (r03, r04) taking the round's best numbers with it — so the
+# moment it comes back, measure first and ask questions later.
+#
+# Usage: tools/tunnel_watch.sh [sweep_out.jsonl] [watch.log]
+OUT=${1:-bench_runs/r05_sweep1.jsonl}
+LOG=${2:-bench_runs/r05_watchdog.log}
+cd "$(dirname "$0")/.." || exit 1
+mkdir -p bench_runs
+i=0
+while :; do
+  i=$((i + 1))
+  if timeout 240 python -c "import jax, jax.numpy as jnp; print(float((jnp.ones((256,256))@jnp.ones((256,256))).sum()))" >>"$LOG" 2>&1; then
+    echo "[watch] tunnel alive at probe $i $(date '+%F %T')" >>"$LOG"
+    SWEEP_RUN_TIMEOUT=${SWEEP_RUN_TIMEOUT:-700} \
+      python tools/mfu_sweep.py "$OUT" >>"$LOG" 2>&1
+    echo "[watch] sweep finished $(date '+%F %T')" >>"$LOG"
+    exit 0
+  fi
+  echo "[watch] probe $i: tunnel dead $(date '+%F %T'); retry in 240s" >>"$LOG"
+  sleep 240
+done
