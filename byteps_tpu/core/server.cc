@@ -129,13 +129,17 @@ struct Reader {
 // worker-side ctypes binding bps_wire_decode — one decoder, one set of
 // hostile-input checks.
 inline bool DecompressTo(const char* data, size_t size, float* dst,
-                         uint32_t n) {
+                         uint32_t n, bool zero_dst = true) {
   Reader r{data, size};
   uint8_t comp = 0;
   uint32_t wn = 0;
   if (!r.Take(&comp, 1) || !r.Take(&wn, 4)) return false;
   if (wn != n) return false;
-  std::memset(dst, 0, static_cast<size_t>(n) * 4);
+  // Sparse formats (topk/randomk/elias) only scatter into dst, so it
+  // must start zeroed — but the server path hands in a buffer its
+  // vector::assign already zero-filled; zero_dst=false skips the
+  // second full-buffer pass there (4MB per partition per round).
+  if (zero_dst) std::memset(dst, 0, static_cast<size_t>(n) * 4);
   switch (comp) {
     case kOnebit: {
       float scale = 0;
@@ -207,12 +211,15 @@ inline bool DecompressTo(const char* data, size_t size, float* dst,
         uint64_t window = 0;
         int wbits = 0;
         size_t bytepos = 0;
+        auto refill = [&]() {
+          while (wbits <= 56 && bytepos < nbytes) {
+            window |= static_cast<uint64_t>(stream[bytepos++]) << wbits;
+            wbits += 8;
+          }
+        };
         auto take = [&]() -> int {
           if (wbits == 0) {
-            while (wbits <= 56 && bytepos < nbytes) {
-              window |= static_cast<uint64_t>(stream[bytepos++]) << wbits;
-              wbits += 8;
-            }
+            refill();
             if (wbits == 0) { ++pos; return 0; }  // past end; bounds
           }                                        // checks reject later
           int b = static_cast<int>(window & 1);
@@ -238,12 +245,6 @@ inline bool DecompressTo(const char* data, size_t size, float* dst,
           for (int sh = 0; sh < k; sh += 8)
             r = (r << 8) | kRev8[(v >> sh) & 0xFF];
           return r >> ((8 - (k & 7)) & 7);
-        };
-        auto refill = [&]() {
-          while (wbits <= 56 && bytepos < nbytes) {
-            window |= static_cast<uint64_t>(stream[bytepos++]) << wbits;
-            wbits += 8;
-          }
         };
         auto elias = [&](uint64_t* out) -> bool {
           if (pos >= nbits) return false;
@@ -375,7 +376,8 @@ inline bool Decompress(const std::vector<char>& payload,
   if (static_cast<size_t>(n) * 4 > max_out) return false;
   out->assign(static_cast<size_t>(n) * 4, 0);
   return DecompressTo(payload.data(), payload.size(),
-                      reinterpret_cast<float*>(out->data()), n);
+                      reinterpret_cast<float*>(out->data()), n,
+                      /*zero_dst=*/false);
 }
 
 // Re-compress the merged f32 buffer with onebit — the bidirectional pull

@@ -15,6 +15,7 @@ is the cross-barrier effect the reference builds by hand with threads + locks
 from __future__ import annotations
 
 import functools
+import math
 from typing import Any, Callable, NamedTuple, Optional
 
 import jax
@@ -223,6 +224,14 @@ def build_train_step(
     backward-hook → push_pull → optimizer.step loop (reference:
     torch/__init__.py:140-174) collapsed into one compiled program.
     """
+    if (axis_name == "dp" and "dp" not in mesh.shape
+            and {"dcn_dp", "ici_dp"} <= set(mesh.axis_names)):
+        # Two-level mesh from make_hierarchical_mesh: the batch shards
+        # over BOTH dp levels and the loss pmean spans them, so the
+        # canonical `build_train_step(loss, opt, make_hierarchical_mesh(),
+        # DistributedOptimizer(..., hierarchical=True))` pod recipe works
+        # without the caller naming internal axes.
+        axis_name = ("dcn_dp", "ici_dp")
     if batch_spec is None:
         batch_spec = P(axis_name)
     if accum_steps < 1:
@@ -308,7 +317,8 @@ def build_train_step(
     # the opt_state pytree structure, so the shard_map is built lazily on
     # first call and cached per structure.
     cache = {}
-    dp_world = int(mesh.shape.get(axis_name, 1))
+    axes = axis_name if isinstance(axis_name, tuple) else (axis_name,)
+    dp_world = int(math.prod(mesh.shape.get(a, 1) for a in axes))
 
     def call(params, opt_state, batch):
         opt_state = _retile_comp_state(opt_state, dp_world)

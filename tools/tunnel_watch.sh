@@ -10,15 +10,32 @@ LOG=${2:-bench_runs/r05_watchdog.log}
 cd "$(dirname "$0")/.." || exit 1
 mkdir -p bench_runs
 i=0
+broken=0
 while :; do
   i=$((i + 1))
-  if timeout 240 python -c "import jax, jax.numpy as jnp; print(float((jnp.ones((256,256))@jnp.ones((256,256))).sum()))" >>"$LOG" 2>&1; then
+  timeout 240 python -c "import jax, jax.numpy as jnp; print(float((jnp.ones((256,256))@jnp.ones((256,256))).sum()))" >>"$LOG" 2>&1
+  rc=$?
+  if [ "$rc" -eq 0 ]; then
     echo "[watch] tunnel alive at probe $i $(date '+%F %T')" >>"$LOG"
     SWEEP_RUN_TIMEOUT=${SWEEP_RUN_TIMEOUT:-700} \
       python tools/mfu_sweep.py "$OUT" >>"$LOG" 2>&1
     echo "[watch] sweep finished $(date '+%F %T')" >>"$LOG"
     exit 0
   fi
-  echo "[watch] probe $i: tunnel dead $(date '+%F %T'); retry in 240s" >>"$LOG"
+  # 124/137: the probe TIMED OUT (wedged tunnel) -> keep waiting.  Any
+  # other rc is the probe itself failing (no python, broken jax, bad
+  # env); retrying that forever would silently skip the round's
+  # measurements — abort loudly after 3 in a row instead.
+  if [ "$rc" -eq 124 ] || [ "$rc" -eq 137 ]; then
+    broken=0
+    echo "[watch] probe $i: tunnel dead $(date '+%F %T'); retry in 240s" >>"$LOG"
+  else
+    broken=$((broken + 1))
+    echo "[watch] probe $i: probe FAILED rc=$rc (not a timeout) $(date '+%F %T')" >>"$LOG"
+    if [ "$broken" -ge 3 ]; then
+      echo "[watch] aborting: probe broken (rc=$rc) 3x in a row" >>"$LOG"
+      exit 1
+    fi
+  fi
   sleep 240
 done
