@@ -92,10 +92,15 @@ def build(force: bool = False, verbose: bool = False) -> str:
             if os.path.exists(os.path.join(_CORE_DIR, s))]
     # -O3: the wire-codec inner loops (onebit expand, dense level
     # gather) only vectorize at -O3; measured ~2x on the codec micros
-    # with no change anywhere else.
+    # with no change anywhere else.  -ffp-contract=off: the codec's
+    # byte-/EF-state-parity contract with the numpy reference requires
+    # numpy's two-step rounding for mu*m + x — on FMA-baseline targets
+    # (aarch64) -O3 would otherwise legally contract it to fmadd and
+    # drift the two paths.
     cmd = [
-        "g++", "-O3", "-std=c++17", "-shared", "-fPIC",
-        "-pthread", "-fvisibility=hidden", "-o", lib_path(), *srcs,
+        "g++", "-O3", "-ffp-contract=off", "-std=c++17", "-shared",
+        "-fPIC", "-pthread", "-fvisibility=hidden", "-o", lib_path(),
+        *srcs,
     ]
     if verbose:
         print(" ".join(cmd), file=sys.stderr)
@@ -124,7 +129,7 @@ def build_server_exe(force: bool = False) -> str:
     if not force and os.path.exists(out) \
             and os.path.getmtime(out) >= os.path.getmtime(src):
         return out
-    cmd = ["g++", *_san_flags(), "-O3", "-std=c++17", "-pthread",
-           "-DBPS_SERVER_MAIN", "-o", out, src]
+    cmd = ["g++", *_san_flags(), "-O3", "-ffp-contract=off", "-std=c++17",
+           "-pthread", "-DBPS_SERVER_MAIN", "-o", out, src]
     subprocess.run(cmd, check=True, capture_output=True)
     return out

@@ -380,6 +380,25 @@ inline bool Decompress(const std::vector<char>& payload,
                       /*zero_dst=*/false);
 }
 
+// Sign bits of x[n] into bits[(n+7)/8], LSB-first, 1 = negative.  The
+// ONE packing loop for both the server recompress leg and the worker's
+// ctypes pack — branchless byte-register accumulation (a conditional
+// store on ~random gradient signs mispredicts half the time, ~5 ns/elem).
+// The tail ORs, so the final partial byte must arrive zeroed.
+inline void PackSigns(const float* x, size_t n, unsigned char* bits) {
+  size_t nfull = n / 8;
+  for (size_t byte = 0; byte < nfull; ++byte) {
+    const float* xi = x + byte * 8;
+    unsigned b = 0;
+    for (int t = 0; t < 8; ++t)
+      b |= static_cast<unsigned>(xi[t] < 0.0f) << t;
+    bits[byte] = static_cast<unsigned char>(b);
+  }
+  for (size_t i = nfull * 8; i < n; ++i)
+    bits[i >> 3] |= static_cast<unsigned char>(
+        static_cast<unsigned>(x[i] < 0.0f) << (i & 7));
+}
+
 // Re-compress the merged f32 buffer with onebit — the bidirectional pull
 // leg (reference: impl/onebit.cc:34-66; server re-compresses merged grads).
 inline void CompressOnebit(const std::vector<char>& store, bool scaled,
@@ -399,9 +418,7 @@ inline void CompressOnebit(const std::vector<char>& store, bool scaled,
     scale = static_cast<float>(acc / static_cast<double>(n));
   }
   std::memcpy(p + 5, &scale, 4);
-  unsigned char* bits = reinterpret_cast<unsigned char*>(p + 9);
-  for (size_t i = 0; i < n; ++i)
-    if (x[i] < 0.0f) bits[i >> 3] |= static_cast<unsigned char>(1u << (i & 7));
+  PackSigns(x, n, reinterpret_cast<unsigned char*>(p + 9));
 }
 
 // ---------------------------------------------------------------------------
@@ -1168,26 +1185,86 @@ class Server {
     }
     // Compressed pushes are expanded to f32 before the merge — the
     // reference server's decompress-sum engine (server.cc:86-207).
+    //
+    // ORDERING INVARIANT: nothing that could stall a live round
+    // (store wipe, seen.clear, dtype/round_compressed/push_count) is
+    // mutated until the frame is fully validated — a corrupt payload
+    // with a plausible header must leave the in-progress merge exactly
+    // as it found it (already-acked workers never re-push, so a wiped
+    // `seen` could otherwise never refill and every pull would hang).
     std::vector<char> scratch;
     const std::vector<char>* data = &t.payload;
+    uint32_t comp_n = 0;
+    uint64_t want = t.payload.size();   // merged (f32) size this push implies
     if (t.dtype == kCompressed) {
-      if (!codec::Decompress(t.payload, &scratch, max_msg_)) {
+      if (t.payload.size() < 5) {
         Respond(t.conn, kError, t.req_id, t.key, nullptr, 0);
         return;
       }
-      data = &scratch;
+      std::memcpy(&comp_n, t.payload.data() + 1, 4);
+      want = static_cast<uint64_t>(comp_n) * 4;
+      if (want > max_msg_) {   // claimed-size cap, as in Decompress
+        Respond(t.conn, kError, t.req_id, t.key, nullptr, 0);
+        return;
+      }
+    }
+    if (!async_ && ks.seen.count(t.worker_id)) {
+      // Duplicate within a round — ignore merge, still ack (reference dedups
+      // by seen_sender, server.cc:150-177).  Checked before the decompress:
+      // a dup's payload is never expanded (or value-logged) at all.
+      ks.push_count.fetch_add(1, std::memory_order_relaxed);
+      Respond(t.conn, kOk, t.req_id, t.key, nullptr, 0);
+      return;
+    }
+    if (t.dtype == kCompressed) {
+      if (!async_ && ks.seen.empty()) {
+        // COPY_FIRST for compressed pushes: decompress straight into
+        // the store — skips both the scratch allocation and the copy
+        // pass (the uncompressed analog of the buffer move below).
+        // Safe before full validation ONLY because seen is empty: a
+        // mid-parse failure leaves garbage in `store` but no merge
+        // existed, and the next valid first push overwrites it all.
+        // Scatter formats need the zeroed destination; the dense ones
+        // (onebit, fixed-width dithering) store every element, so
+        // skipping their memset saves a full-buffer pass per round.
+        if (ks.store.size() != want) ks.store.assign(want, 0);
+        bool need_zero = true;
+        uint8_t comp = static_cast<uint8_t>(t.payload[0]);
+        if (comp == codec::kOnebit) need_zero = false;
+        if (comp == codec::kDithering && t.payload.size() > 5
+            && !(static_cast<uint8_t>(t.payload[5]) & 2))
+          need_zero = false;
+        if (!codec::DecompressTo(
+                t.payload.data(), t.payload.size(),
+                reinterpret_cast<float*>(ks.store.data()), comp_n,
+                need_zero)) {
+          Respond(t.conn, kError, t.req_id, t.key, nullptr, 0);
+          return;
+        }
+        data = &ks.store;
+      } else {
+        // Mid-round (or async): validate into scratch BEFORE touching
+        // any round state.
+        if (!codec::Decompress(t.payload, &scratch, max_msg_)) {
+          Respond(t.conn, kError, t.req_id, t.key, nullptr, 0);
+          return;
+        }
+        data = &scratch;
+      }
       ks.round_compressed = true;
     }
-    if (ks.store.size() != data->size()) {
+    // Frame fully validated from here on.
+    if (ks.store.size() != want) {
       // Size changed mid-stream (re-declared tensor / missing INIT): restart
       // the merge consistently — clearing `seen` too, so earlier workers'
       // contributions are never silently discarded while the round counter
       // still advances on a wrong sum.
-      ks.store.assign(data->size(), 0);
+      ks.store.assign(want, 0);
       ks.seen.clear();
     }
     ks.dtype = t.dtype == kCompressed ? kF32 : t.dtype;
     ks.push_count.fetch_add(1, std::memory_order_relaxed);
+    const bool first = !async_ && ks.seen.empty();
     DebugLog("push_recv", t.key, t.worker_id, ks.completed_round, *data);
     if (async_) {
       // Async PS mode: store += payload immediately, no round tracking
@@ -1200,22 +1277,20 @@ class Server {
       FlushPulls(ks, t.key);
       return;
     }
-    if (ks.seen.count(t.worker_id)) {
-      // Duplicate within a round — ignore merge, still ack (reference dedups
-      // by seen_sender, server.cc:150-177).
-      Respond(t.conn, kOk, t.req_id, t.key, nullptr, 0);
-      return;
-    }
-    if (ks.seen.empty()) {
+    if (first) {
       // COPY_FIRST (reference: server.cc:299-379) — by MOVE when the
       // payload arrived uncompressed: adopting the reader's buffer
       // saves a full per-partition memory pass on the serve path (the
       // buffer it replaces recycles through the heap, mallopt above).
+      // A compressed first push normally landed in the store above;
+      // the exception is a size-change reset that PROMOTED a
+      // scratch-validated push to first — copy it over.
       if (data == &t.payload) {
         ks.store = std::move(t.payload);
         data = &ks.store;   // t.payload is dead from here
-      } else {
-        std::memcpy(ks.store.data(), data->data(), data->size());
+      } else if (data == &scratch) {
+        std::memcpy(ks.store.data(), scratch.data(), scratch.size());
+        data = &ks.store;
       }
     } else {
       SumInto(ks, *data);  // SUM_RECV
@@ -1400,6 +1475,45 @@ int bps_wire_decode(const char* payload, uint64_t len, float* out,
   return bps_server::codec::DecompressTo(
              payload, static_cast<size_t>(len), out,
              static_cast<uint32_t>(n)) ? 0 : -1;
+}
+
+// Onebit worker-side fused passes (ctypes from server/wire.py).  The
+// numpy chain (momentum -> EF add -> sign pack -> reconstruction ->
+// error store) is 7+ full-buffer passes with fresh allocations; these
+// two single-pass routines replace all but the scale reduction (which
+// stays in numpy — its pairwise float32 sum is the parity reference).
+// All per-element float ops match the numpy expressions exactly, so
+// C-path and numpy-path workers stay byte- and state-identical.
+
+// Pass A: in-place Nesterov momentum + error-feedback correction.
+//   if mom:  m = mu*m + x;  x += mu*m   (m updated in place)
+//   if err:  x += err
+__attribute__((visibility("default")))
+void bps_wire_onebit_correct(float* x, uint64_t n, float* mom, float mu,
+                             const float* err) {
+  if (mom) {
+    for (uint64_t i = 0; i < n; ++i) {
+      float m = mu * mom[i] + x[i];
+      mom[i] = m;
+      x[i] = x[i] + mu * m;
+    }
+  }
+  if (err)
+    for (uint64_t i = 0; i < n; ++i) x[i] += err[i];
+}
+
+// Pass B: pack sign bits (LSB-first, 1 = negative) and, when err_out
+// is non-null, store the EF error x - (sign ? -scale : +scale).
+// `bits` must be zeroed ((n+7)/8 bytes).
+__attribute__((visibility("default")))
+void bps_wire_onebit_pack(const float* x, uint64_t n, float scale,
+                          unsigned char* bits, float* err_out) {
+  bps_server::codec::PackSigns(x, n, bits);
+  if (err_out)
+    for (uint64_t i = 0; i < n; ++i) {
+      float q = x[i] < 0.0f ? -scale : scale;   // compiles to a blend
+      err_out[i] = x[i] - q;
+    }
 }
 
 // Dithering encode (see codec::EncodeDithering).  Returns bytes

@@ -79,6 +79,14 @@ def _c_wire():
                     ctypes.c_float, ctypes.c_void_p, ctypes.c_void_p,
                     ctypes.c_void_p, u64]
                 lib.bps_wire_encode_dithering.restype = ctypes.c_int64
+                lib.bps_wire_onebit_correct.argtypes = [
+                    ctypes.c_void_p, u64, ctypes.c_void_p, ctypes.c_float,
+                    ctypes.c_void_p]
+                lib.bps_wire_onebit_correct.restype = None
+                lib.bps_wire_onebit_pack.argtypes = [
+                    ctypes.c_void_p, u64, ctypes.c_float, ctypes.c_void_p,
+                    ctypes.c_void_p]
+                lib.bps_wire_onebit_pack.restype = None
                 _CWIRE = lib
         except Exception:   # pragma: no cover - defensive
             _CWIRE = None
@@ -320,6 +328,15 @@ class WireCompressor:
         # WireCompressor are same-tensor re-pushes (one codec per declared
         # key), which the session's sequential-use guard serializes anyway.
         with self._state_lock:
+            if self.comp_id == COMP_ONEBIT and x.size:
+                lib = _c_wire()
+                if lib is not None:
+                    # Fused C path: momentum+EF correction in one pass,
+                    # sign-pack + error store in another — same float
+                    # ops per element as the numpy chain below, so both
+                    # paths stay byte- and EF-state-identical (asserted
+                    # by the codec parity test).
+                    return self._encode_onebit_fused(lib, pkey, x)
             if self.momentum_mu:
                 # m = mu*m + g; g += mu*m (Nesterov) — before EF, matching
                 # the reference layering and the JAX NesterovMomentum.
@@ -344,6 +361,41 @@ class WireCompressor:
                 recon = decode(blob, x.size)
             self._err[pkey] = x - recon
             return blob
+
+    def _encode_onebit_fused(self, lib, pkey: int, x: np.ndarray) -> bytes:
+        """C-fused onebit encode with momentum/EF state (caller holds
+        _state_lock).  The scale reduction stays numpy: its pairwise
+        float32 sum is the byte-parity reference for both paths."""
+        n = x.size
+        xw = np.array(x, np.float32, copy=True)  # never mutate caller's
+        mom = None
+        if self.momentum_mu:
+            mom = self._mom.get(pkey)
+            if mom is None or mom.size != n:
+                # First push (or size change): m = mu*0 + x == x, the
+                # same value the numpy path's m = x.copy() produces.
+                mom = np.zeros(n, np.float32)
+            self._mom[pkey] = mom
+        err = self._err.get(pkey) if self.ef else None
+        if err is not None and err.size != n:
+            err = None
+        lib.bps_wire_onebit_correct(
+            xw.ctypes.data, n,
+            mom.ctypes.data if mom is not None else None,
+            float(self.momentum_mu or 0.0),
+            err.ctypes.data if err is not None else None)
+        scale = (np.abs(xw).sum() / max(n, 1)) if self.scaled else 1.0
+        bits = np.zeros((n + 7) // 8, np.uint8)
+        if self.ef:
+            new_err = np.empty(n, np.float32)
+            lib.bps_wire_onebit_pack(xw.ctypes.data, n, np.float32(scale),
+                                     bits.ctypes.data, new_err.ctypes.data)
+            self._err[pkey] = new_err
+        else:
+            lib.bps_wire_onebit_pack(xw.ctypes.data, n, np.float32(scale),
+                                     bits.ctypes.data, None)
+        return (struct.pack("<BI", self.comp_id, n)
+                + struct.pack("<f", np.float32(scale)) + bits.tobytes())
 
     def _encode_raw(self, pkey: int, x: np.ndarray) -> bytes:
         n = x.size

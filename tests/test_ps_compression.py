@@ -461,6 +461,8 @@ def test_late_joiner_adopts_global_weights(ps_server):
 
 @pytest.mark.parametrize("kwargs", [
     {"compressor": "onebit", "ef": "vanilla"},
+    {"compressor": "onebit", "ef": "vanilla", "momentum": "nesterov",
+     "momentum_mu": "0.9"},
     {"compressor": "dithering", "k": "15"},
     {"compressor": "dithering", "k": "15", "coding": "elias"},
     {"compressor": "dithering", "k": "7", "partition": "natural",
@@ -491,20 +493,27 @@ def test_c_codec_bytes_match_numpy_reference(kwargs):
     cases.append(np.full(17, np.inf, np.float32))
     for x in cases:
         try:
+            # Two encodes per case so stateful EF/momentum/PRNG
+            # evolution is compared, not just the first blob.
             wire._CWIRE = False            # C path
             wc_c = wire.WireCompressor(kwargs)
-            blob_c = wc_c.encode(3, x)
+            blobs_c = [wc_c.encode(3, x), wc_c.encode(3, x)]
             err_c = {k: v.copy() for k, v in wc_c._err.items()}
+            mom_c = {k: v.copy() for k, v in wc_c._mom.items()}
             wire._CWIRE = None             # numpy reference path
             wc_p = wire.WireCompressor(kwargs)
-            blob_p = wc_p.encode(3, x)
-            assert blob_c == blob_p, (kwargs, x.size)
+            blobs_p = [wc_p.encode(3, x), wc_p.encode(3, x)]
+            assert blobs_c == blobs_p, (kwargs, x.size)
             for k, v in wc_p._err.items():
                 np.testing.assert_array_equal(err_c[k], v, err_msg=str(
                     (kwargs, x.size)))
+            for k, v in wc_p._mom.items():
+                np.testing.assert_array_equal(mom_c[k], v, err_msg=str(
+                    (kwargs, x.size)))
             wire._CWIRE = False
             np.testing.assert_array_equal(
-                wire.decode(blob_c, x.size), wire._decode_py(blob_c, x.size),
+                wire.decode(blobs_c[0], x.size),
+                wire._decode_py(blobs_c[0], x.size),
                 err_msg=str((kwargs, x.size)))
         finally:
             wire._CWIRE = False            # leave the loader re-armed
@@ -578,3 +587,63 @@ def test_soak_8workers_4servers_elias_schedule_restarts(ps_server):
             np.testing.assert_allclose(
                 results[(w, r)], want, rtol=1e-5, atol=1e-6,
                 err_msg=f"worker {w} round {r} diverged")
+
+
+def test_malformed_compressed_push_mid_round_does_not_stall(ps_server):
+    """A corrupt compressed frame whose header is plausible but whose
+    body fails validation must leave the in-progress merge untouched
+    (review r5): wiping `seen`/`store` before validation would strand a
+    round forever — already-acked workers never re-push.  Drive workers
+    0 and 1 of 3 to acked pushes, inject the corrupt frame, then let
+    worker 2 complete the round; every pull must resolve."""
+    import socket as socket_mod
+    import struct as struct_mod
+
+    from byteps_tpu.server.client import _REQ
+
+    port = ps_server(num_workers=3)
+    sessions = [_sess(port, w, partition_bytes=4096) for w in range(3)]
+    for s in sessions:
+        s.register_compressor(17, dict(ONEBIT_KW))
+    g = [np.full(256, float(w + 1), np.float32) for w in range(3)]
+
+    handles = [sessions[w].push_pull_async(17, g[w]) for w in (0, 1)]
+    deadline = __import__("time").time() + 20
+    # Both pushes must be merged (acked) before the corruption lands —
+    # poll the handles' partial state via a short wait on a 3rd-push
+    # absence (the round can't complete yet, so just give the wire a
+    # moment to drain the two pushes).
+    __import__("time").sleep(1.0)
+
+    # Corrupt frame: valid ReqHeader + onebit comp header claiming the
+    # SAME element count, but a truncated bit body -> DecompressTo and
+    # Decompress both reject it after header checks pass.
+    pkey = (17 << 16) | 0
+    bad_body = struct_mod.pack("<BI", 1, 256) + b"\x00\x00"  # no scale/bits
+    rogue = socket_mod.create_connection(("127.0.0.1", port), 5)
+    rogue.sendall(_REQ.pack(2, 2, 0, 5, 9, pkey, len(bad_body)) + bad_body)
+    resp = b""
+    rogue.settimeout(10)
+    while len(resp) < 21:
+        chunk = rogue.recv(21 - len(resp))
+        assert chunk, "no response to corrupt compressed push"
+        resp += chunk
+    status = resp[0]
+    assert status != 0, "corrupt compressed push was not rejected"
+    rogue.close()
+
+    # Worker 2 completes the round; ALL pulls must resolve with the
+    # 3-worker merged result (sum of onebit quantizations).
+    out2 = sessions[2].push_pull(17, g[2])
+    outs = [h.wait(timeout=60) for h in handles] + [out2]
+    sims = [wire.WireCompressor(ONEBIT_KW) for _ in range(3)]
+    merged = np.zeros(256, np.float32)
+    for w in range(3):
+        merged += wire.decode(sims[w].encode(0, g[w]), 256)
+    req = wire.WireCompressor(ONEBIT_KW)
+    want = wire.decode(req.encode(0, merged), 256)
+    for w, got in enumerate(outs):
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-7,
+                                   err_msg=f"worker {w}")
+    for s in sessions:
+        s.close()
