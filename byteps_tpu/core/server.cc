@@ -122,6 +122,26 @@ struct Reader {
   }
 };
 
+// Byte bit-reversal table, shared by the elias encoder (reversed-chunk
+// appends) and decoder (MSB-first group reads from the LSB-first window).
+const unsigned char kRev8[256] = {
+#define R2(x) (x), (x) + 128, (x) + 64, (x) + 192
+#define R4(x) R2(x), R2((x) + 32), R2((x) + 16), R2((x) + 48)
+#define R6(x) R4(x), R4((x) + 8), R4((x) + 4), R4((x) + 12)
+    R6(0), R6(2), R6(1), R6(3)
+#undef R6
+#undef R4
+#undef R2
+};
+
+inline uint64_t RevBits(uint64_t v, int k) {
+  // Reverse the low k bits of v (k <= 64): byte-table chunks.
+  uint64_t r = 0;
+  for (int sh = 0; sh < k; sh += 8)
+    r = (r << 8) | kRev8[(v >> sh) & 0xFF];
+  return r >> ((8 - (k & 7)) & 7);
+}
+
 // Decode a full wire blob into `dst` (caller-provided, n f32 slots;
 // zeroed here).  Returns false on a malformed payload (bad sizes /
 // out-of-range indices) or when the blob's element count differs from
@@ -230,21 +250,10 @@ inline bool DecompressTo(const char* data, size_t size, float* dst,
         };
         // MSB-first k-bit group read from the LSB-first stream window:
         // the next k stream bits, assembled high-to-low (what take_int
-        // did bit-by-bit), is the bit-reversal of the window's low k.
-        static const unsigned char kRev8[256] = {
-#define R2(x) (x), (x) + 128, (x) + 64, (x) + 192
-#define R4(x) R2(x), R2((x) + 32), R2((x) + 16), R2((x) + 48)
-#define R6(x) R4(x), R4((x) + 8), R4((x) + 4), R4((x) + 12)
-            R6(0), R6(2), R6(1), R6(3)
-#undef R6
-#undef R4
-#undef R2
-        };
+        // did bit-by-bit), is the bit-reversal of the window's low k
+        // (RevBits — the same table the encoder appends through).
         auto rev = [](uint64_t v, int k) -> uint64_t {
-          uint64_t r = 0;
-          for (int sh = 0; sh < k; sh += 8)
-            r = (r << 8) | kRev8[(v >> sh) & 0xFF];
-          return r >> ((8 - (k & 7)) & 7);
+          return RevBits(v, k);
         };
         auto elias = [&](uint64_t* out) -> bool {
           if (pos >= nbits) return false;
@@ -443,21 +452,37 @@ struct BitWriter {
   int nacc = 0;      // bits pending in acc (< 64)
   size_t nbytes = 0; // bytes flushed so far
   size_t pos = 0;    // total bits appended
+  void Flush() {
+    std::memcpy(buf + nbytes, &acc, 8);    // little-endian == LSB-first
+    nbytes += 8;
+    acc = 0;
+    nacc = 0;
+  }
   void Put(int bit) {
     acc |= static_cast<uint64_t>(bit) << nacc;
     ++pos;
-    if (++nacc == 64) {
-      std::memcpy(buf + nbytes, &acc, 8);  // little-endian == LSB-first
-      nbytes += 8;
-      acc = 0;
-      nacc = 0;
-    }
+    if (++nacc == 64) Flush();
   }
   // Emit `len` bits of `code`, MSB-of-code-first (matches
-  // wire.py _emit_bitstream).
+  // wire.py _emit_bitstream).  Appending MSB-first into an LSB-first
+  // stream == appending the bit-reversed code as one chunk — ~8 table
+  // ops per code instead of `len` shift/or round trips.
   void PutCode(uint64_t code, int len) {
-    for (int i = len - 1; i >= 0; --i)
-      Put(static_cast<int>((code >> i) & 1));
+    if (len == 0) return;
+    uint64_t rev = RevBits(code, len);
+    pos += static_cast<size_t>(len);
+    acc |= rev << nacc;
+    int spill = nacc + len - 64;
+    if (spill >= 0) {
+      int taken = len - spill;
+      nacc = 64;
+      Flush();
+      if (spill > 0)
+        acc = (taken >= 64) ? 0 : rev >> taken;
+      nacc = spill;
+    } else {
+      nacc += len;
+    }
   }
   void Finish() {   // flush the partial word (zero-padded final byte)
     int left = nacc;

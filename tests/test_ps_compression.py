@@ -593,33 +593,40 @@ def test_malformed_compressed_push_mid_round_does_not_stall(ps_server):
     """A corrupt compressed frame whose header is plausible but whose
     body fails validation must leave the in-progress merge untouched
     (review r5): wiping `seen`/`store` before validation would strand a
-    round forever — already-acked workers never re-push.  Drive workers
-    0 and 1 of 3 to acked pushes, inject the corrupt frame, then let
-    worker 2 complete the round; every pull must resolve."""
+    round forever — already-acked workers never re-push.
+
+    Driven over raw blocking requests so the mid-round precondition is
+    DETERMINISTIC: workers 0 and 1's pushes are each acked (ack ==
+    merged, the engine responds after the merge) before the corrupt
+    frame is injected, then worker 2 completes the round and every
+    worker's pull must resolve with the 3-way merge."""
     import socket as socket_mod
     import struct as struct_mod
 
-    from byteps_tpu.server.client import _REQ
+    from byteps_tpu.server.client import _REQ, _ServerConn
 
     port = ps_server(num_workers=3)
-    sessions = [_sess(port, w, partition_bytes=4096) for w in range(3)]
-    for s in sessions:
-        s.register_compressor(17, dict(ONEBIT_KW))
-    g = [np.full(256, float(w + 1), np.float32) for w in range(3)]
+    key, n = 17, 256
+    pkey = (key << 16) | 0
+    kw_str = b"compressor=onebit"
+    conn = _ServerConn("127.0.0.1", port)
+    # INIT: u64 declared f32 length | u32 kwargs len | kwargs.
+    init_payload = struct_mod.pack("<QI", n * 4, len(kw_str)) + kw_str
+    conn.request(1, pkey, init_payload, worker_id=0)   # CMD_INIT
 
-    handles = [sessions[w].push_pull_async(17, g[w]) for w in (0, 1)]
-    deadline = __import__("time").time() + 20
-    # Both pushes must be merged (acked) before the corruption lands —
-    # poll the handles' partial state via a short wait on a 3rd-push
-    # absence (the round can't complete yet, so just give the wire a
-    # moment to drain the two pushes).
-    __import__("time").sleep(1.0)
+    g = [np.full(n, float(w + 1), np.float32) for w in range(3)]
+    sims = [wire.WireCompressor(ONEBIT_KW) for _ in range(3)]
+    blobs = [sims[w].encode(0, g[w]) for w in range(3)]
+
+    # Workers 0 and 1 push; each request() returns only after the
+    # server's ack, i.e. after HandlePush merged them (seen = {0, 1}).
+    conn.request(2, pkey, blobs[0], worker_id=0, dtype=2, flags=0)
+    conn.request(2, pkey, blobs[1], worker_id=1, dtype=2, flags=0)
 
     # Corrupt frame: valid ReqHeader + onebit comp header claiming the
     # SAME element count, but a truncated bit body -> DecompressTo and
     # Decompress both reject it after header checks pass.
-    pkey = (17 << 16) | 0
-    bad_body = struct_mod.pack("<BI", 1, 256) + b"\x00\x00"  # no scale/bits
+    bad_body = struct_mod.pack("<BI", 1, n) + b"\x00\x00"  # no scale/bits
     rogue = socket_mod.create_connection(("127.0.0.1", port), 5)
     rogue.sendall(_REQ.pack(2, 2, 0, 5, 9, pkey, len(bad_body)) + bad_body)
     resp = b""
@@ -628,22 +635,20 @@ def test_malformed_compressed_push_mid_round_does_not_stall(ps_server):
         chunk = rogue.recv(21 - len(resp))
         assert chunk, "no response to corrupt compressed push"
         resp += chunk
-    status = resp[0]
-    assert status != 0, "corrupt compressed push was not rejected"
+    assert resp[0] != 0, "corrupt compressed push was not rejected"
     rogue.close()
 
-    # Worker 2 completes the round; ALL pulls must resolve with the
-    # 3-worker merged result (sum of onebit quantizations).
-    out2 = sessions[2].push_pull(17, g[2])
-    outs = [h.wait(timeout=60) for h in handles] + [out2]
-    sims = [wire.WireCompressor(ONEBIT_KW) for _ in range(3)]
-    merged = np.zeros(256, np.float32)
+    # Worker 2 completes the round (would hang forever if the corrupt
+    # frame had wiped `seen`); every pull must serve the 3-way merge.
+    conn.request(2, pkey, blobs[2], worker_id=2, dtype=2, flags=0)
+    merged = np.zeros(n, np.float32)
     for w in range(3):
-        merged += wire.decode(sims[w].encode(0, g[w]), 256)
+        merged += wire.decode(blobs[w], n)
     req = wire.WireCompressor(ONEBIT_KW)
-    want = wire.decode(req.encode(0, merged), 256)
-    for w, got in enumerate(outs):
+    want = wire.decode(req.encode(0, merged), n)
+    for w in range(3):
+        got_blob = conn.request(3, pkey, worker_id=w, flags=0)  # CMD_PULL
+        got = wire.decode(bytes(got_blob), n)
         np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-7,
                                    err_msg=f"worker {w}")
-    for s in sessions:
-        s.close()
+    conn.close()
