@@ -900,9 +900,15 @@ def main():
 
 
 def _latest_onchip_archive(runs_dir: str = None) -> dict:
-    """Most recent archived on-chip flagship record (bench_runs/*onchip*),
+    """Most recent archived on-chip flagship record (bench_runs/*.jsonl),
     trimmed to the fields a reader needs to connect a CPU-fallback record
-    to real-TPU evidence.  Empty dict when no archive exists."""
+    to real-TPU evidence.  Empty dict when no archive exists.
+
+    The scan covers SWEEP archives too, not just *onchip* files: a
+    record qualifies via its detail.mfu > 0, which only a real
+    accelerator produces (peak_bf16_flops is 0 off-TPU), so a
+    mid-wedge round whose only on-chip evidence is a sweep entry still
+    surfaces it."""
     import glob
 
     try:
@@ -911,14 +917,17 @@ def _latest_onchip_archive(runs_dir: str = None) -> dict:
                 os.path.dirname(os.path.abspath(__file__)), "bench_runs")
         # Per-file mtime guard: a file vanishing between glob and sort
         # must skip THAT file, not abort the whole scan into the blanket
-        # except below (advisor r4).
+        # except below (advisor r4).  Curated *onchip* archives outrank
+        # sweep files (a sweep's last mfu>0 line is whatever geometry
+        # ran last, not the flagship anchor a reader wants first).
         stamped = []
-        for p in glob.glob(os.path.join(runs_dir, "*onchip*.jsonl")):
+        for p in glob.glob(os.path.join(runs_dir, "*.jsonl")):
             try:
-                stamped.append((os.path.getmtime(p), p))
+                stamped.append(("onchip" in os.path.basename(p),
+                                os.path.getmtime(p), p))
             except OSError:
                 continue
-        files = [p for _, p in sorted(stamped)]
+        files = [p for _, _, p in sorted(stamped)]
         for path in reversed(files):
             with open(path) as f:
                 lines = [ln for ln in f.read().splitlines() if ln.strip()]

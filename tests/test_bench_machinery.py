@@ -171,6 +171,18 @@ def test_latest_onchip_archive_resilient(tmp_path):
     # In-record timestamp preferred over file mtime (fresh-clone mtime
     # is checkout time, not measurement time).
     assert got["archived_at"] == "2026-01-01 00:00"
+    # A NEWER sweep file with an mfu>0 record is still outranked by the
+    # curated *onchip* archive (sweep tails are whatever geometry ran
+    # last, not the flagship anchor)...
+    sweep = tmp_path / "r99_sweep9.jsonl"
+    sweep.write_text(json.dumps(
+        {"metric": "s", "value": 0.5, "detail": {"mfu": 0.10}}) + "\n")
+    got = bench._latest_onchip_archive(runs_dir=str(tmp_path))
+    assert got["metric"] == "m2", got
+    # ...but with no onchip archive at all, the sweep record surfaces.
+    p.unlink()
+    got = bench._latest_onchip_archive(runs_dir=str(tmp_path))
+    assert got["metric"] == "s" and got["mfu"] == 0.10
     # Empty dir -> empty dict, never an exception.
     assert bench._latest_onchip_archive(
         runs_dir=str(tmp_path / "nope")) == {}
