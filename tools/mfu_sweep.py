@@ -52,21 +52,17 @@ SWEEP = [
     {"name": "cnn_vgg16", "timeout": 1200, "group": "cnn_vgg",
      "env": {"BENCH_CNN": "vgg16", "BENCH_CNN_BATCH": "64"}},
     # proj selective remat at the tuned batch: skips ~2/3 of the
-    # recomputed matmul FLOPs vs full remat.  Grouped: OOM stops the
-    # escalation (b96 probes whether the freed remat memory buys batch).
+    # recomputed matmul FLOPs vs full remat.  (The b96/no-remat
+    # escalations live at the END of the list: an OOM-ing remote
+    # compile is the known tunnel-wedge trigger — pass-2 postmortem —
+    # and must not be able to take the rest of the pass down with it.)
     {"name": "flagship_proj_b64", "group": "proj",
      "env": {"BENCH_BATCH": "64", "BENCH_REMAT_POLICY": "proj"}},
-    {"name": "flagship_proj_b96", "group": "proj",
-     "env": {"BENCH_BATCH": "96", "BENCH_REMAT_POLICY": "proj"}},
-    # No remat at all: zero recompute, activations live in HBM — the
-    # ladder finds the largest batch that still fits (flash keeps the
-    # S^2 logits out of HBM, so this was never measurable pre-flash).
+    # No remat at all: zero recompute, activations live in HBM.  b16 is
+    # the safe rung (flash keeps the S^2 logits out of HBM); the b24/32
+    # escalation is at the tail with the other OOM risks.
     {"name": "flagship_noremat_b16", "group": "noremat",
      "env": {"BENCH_BATCH": "16", "BENCH_REMAT": "0"}},
-    {"name": "flagship_noremat_b24", "group": "noremat",
-     "env": {"BENCH_BATCH": "24", "BENCH_REMAT": "0"}},
-    {"name": "flagship_noremat_b32", "group": "noremat",
-     "env": {"BENCH_BATCH": "32", "BENCH_REMAT": "0"}},
     # Asymmetric tiles at the flagship geometry: narrow K tile trims
     # masked diagonal waste in the causal kernel.
     {"name": "flagship_q512_k256",
@@ -114,6 +110,15 @@ SWEEP = [
      "env": {"BENCH_MODEL": "llama_300m", "BENCH_SEQ": "8192",
              "BENCH_ATTN": "flash", "BENCH_BATCH": "1",
              "BENCH_ATTN_BLOCK": "128"}},
+    # ---- memory-escalation tail: every entry below is an OOM
+    # candidate, and an OOM-ing remote compile can wedge the tunnel for
+    # everything after it — so nothing of value runs after these.
+    {"name": "flagship_noremat_b24", "group": "noremat",
+     "env": {"BENCH_BATCH": "24", "BENCH_REMAT": "0"}},
+    {"name": "flagship_noremat_b32", "group": "noremat",
+     "env": {"BENCH_BATCH": "32", "BENCH_REMAT": "0"}},
+    {"name": "flagship_proj_b96", "group": "proj",
+     "env": {"BENCH_BATCH": "96", "BENCH_REMAT_POLICY": "proj"}},
 ]
 
 PROBE = ("import jax, jax.numpy as jnp; "
