@@ -620,6 +620,8 @@ def bench_ps():
         sess = PSSession(["127.0.0.1"], [port], worker_id=0, num_servers=1,
                          wire_conns=int(os.environ.get(
                              "BYTEPS_TPU_WIRE_CONNS", "2")),
+                         compress_threads=int(os.environ.get(
+                             "BYTEPS_TPU_COMPRESS_THREADS", "2")),
                          **({"min_compress_bytes": 0} if comp_kw else {}))
         x = np.random.default_rng(0).standard_normal(
             16 << 20, dtype=np.float32)            # 64 MB

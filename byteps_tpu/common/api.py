@@ -544,6 +544,18 @@ def get_pushpull_speed() -> tuple:
     return (time.time(), get_core().telemetry_speed_mbps())
 
 
+def get_codec_stats() -> Dict[str, int]:
+    """Counters from the PS-mode codec pipeline (BYTEPS_TPU_COMPRESS_THREADS):
+    parts encoded/decoded off the caller/receiver threads and the pool's
+    busy time in µs.  All-zero outside PS mode or with the pipeline
+    disabled (compress_threads=0) — used by tools/wire_bench.py to prove
+    where codec work actually ran."""
+    if _state.ps_session is not None:
+        return _state.ps_session.codec_stats()
+    from ..server.codec_pool import CompressionPool
+    return dict(CompressionPool.ZERO_STATS)
+
+
 def timeline_start_step() -> int:
     cfg = _state.config or get_config()
     return cfg.trace_start_step

@@ -63,6 +63,10 @@ class Config:
     partition_bytes: int = 4 * 1024 * 1024   # BYTEPS_PARTITION_BYTES
     min_compress_bytes: int = 65536          # BYTEPS_MIN_COMPRESS_BYTES
     wire_conns: int = 2                      # BYTEPS_TPU_WIRE_CONNS
+    # Worker-side codec pipeline threads (the reference's COMPRESS/
+    # DECOMPRESS loop threads, core_loops.cc); 0 = inline encode/decode on
+    # the caller/receiver threads.
+    compress_threads: int = 2                # BYTEPS_TPU_COMPRESS_THREADS
     scheduling_credit: int = 0               # BYTEPS_SCHEDULING_CREDIT (0 = off)
     server_engine_threads: int = 4           # BYTEPS_SERVER_ENGINE_THREAD
     server_enable_schedule: bool = False     # BYTEPS_SERVER_ENABLE_SCHEDULE
@@ -115,6 +119,7 @@ class Config:
             partition_bytes=_env_int("BYTEPS_PARTITION_BYTES", 4 * 1024 * 1024),
             min_compress_bytes=_env_int("BYTEPS_MIN_COMPRESS_BYTES", 65536),
             wire_conns=_env_int("BYTEPS_TPU_WIRE_CONNS", 2),
+            compress_threads=_env_int("BYTEPS_TPU_COMPRESS_THREADS", 2),
             scheduling_credit=_env_int("BYTEPS_SCHEDULING_CREDIT", 0),
             server_engine_threads=_env_int("BYTEPS_SERVER_ENGINE_THREAD", 4),
             server_enable_schedule=_env_bool("BYTEPS_SERVER_ENABLE_SCHEDULE"),

@@ -1233,10 +1233,17 @@ class Server {
         return;
       }
     }
-    if (!async_ && ks.seen.count(t.worker_id)) {
+    if (!async_ && ks.seen.count(t.worker_id) &&
+        ks.store.size() == static_cast<size_t>(want)) {
       // Duplicate within a round — ignore merge, still ack (reference dedups
       // by seen_sender, server.cc:150-177).  Checked before the decompress:
       // a dup's payload is never expanded (or value-logged) at all.
+      // The size guard keeps the dedup SUBORDINATE to the size-change
+      // reset below: a worker already in `seen` that re-pushes with a NEW
+      // implied size (re-declared tensor mid-round) must fall through to
+      // the reset — acking-and-dropping it would leave the restarted
+      // merge permanently one push short once the reset clears `seen`
+      // (already-acked workers never re-push), wedging every pull.
       ks.push_count.fetch_add(1, std::memory_order_relaxed);
       Respond(t.conn, kOk, t.req_id, t.key, nullptr, 0);
       return;

@@ -18,7 +18,14 @@ TPU-host redesign of that data path:
   - each connection multiplexes outstanding requests by req_id, the
     redesign of ps-lite's completion callbacks (core_loops.cc:536-616),
     so per-partition pushes/pulls to one server pipeline instead of
-    serializing on a blocking round-trip.
+    serializing on a blocking round-trip,
+  - codec work rides a CompressionPool (BYTEPS_TPU_COMPRESS_THREADS,
+    the redesign of the reference's COMPRESS/DECOMPRESS pipeline loop
+    threads, core_loops.cc): partitions are encoded ahead of the
+    dispatcher in the same (priority desc, key asc) order, so the wire
+    send of partition k overlaps the encode of k+1, and compressed pull
+    payloads are decoded off the receiver thread, so one slow decode
+    never stalls other partitions' responses on the same socket.
 """
 
 from __future__ import annotations
@@ -33,6 +40,7 @@ import numpy as np
 from ..common.config import Config
 from ..common.logging import get_logger
 from ..core.native import get_core
+from .codec_pool import CompressionPool
 
 _REQ = struct.Struct("<BBHIIQQ")   # cmd dtype flags req_id worker_id key len
 _RESP = struct.Struct("<BIQQ")     # status req_id key len
@@ -275,15 +283,17 @@ class _PartTask:
 
     __slots__ = ("pkey", "payload", "off", "ln", "round", "conn", "handle",
                  "dtype", "done_evt", "wire_ln", "bidirectional",
-                 "label", "priority", "enq_ts", "push_ts", "pull_ts")
+                 "label", "priority", "enq_ts", "push_ts", "pull_ts",
+                 "ready", "enc_err", "credit_ln")
 
     def __init__(self, pkey, payload, off, ln, rnd, conn, handle,
                  dtype=DT_F32, bidirectional=False, label=""):
         self.pkey = pkey
-        self.payload = payload        # wire bytes (raw f32 or compressed)
+        self.payload = payload        # wire bytes (raw f32 or compressed);
+        #                               None while a pipelined encode runs
         self.off = off                # raw byte offset in the tensor
         self.ln = ln                  # raw byte length of the partition
-        self.wire_ln = len(payload)   # bytes actually in flight (credit)
+        self.wire_ln = len(payload) if payload is not None else ln
         self.round = rnd
         self.conn = conn
         self.handle = handle
@@ -298,6 +308,15 @@ class _PartTask:
         self.enq_ts = 0
         self.push_ts = 0
         self.pull_ts = 0
+        # Codec pipeline state: `ready` is set once the pool has produced
+        # (or failed to produce) this partition's wire payload; None means
+        # the payload was ready at staging time (raw parts, inline mode).
+        self.ready = None
+        self.enc_err = None
+        # Scheduling-credit charge: actual wire bytes when known, else
+        # the codec's worst-case bound (set by _stage_parts for pipelined
+        # encodes, whose true size doesn't exist at enqueue time).
+        self.credit_ln = self.wire_ln
 
 
 class PSSession:
@@ -314,7 +333,8 @@ class PSSession:
                  partition_bytes: int = 4 * 1024 * 1024,
                  scheduling_credit: int = 0,
                  min_compress_bytes: int = 65536,
-                 wire_conns: int = 2):
+                 wire_conns: int = 2,
+                 compress_threads: int = 2):
         self.worker_id = worker_id
         self.num_servers = max(1, num_servers)
         self.hash_fn = hash_fn
@@ -323,6 +343,10 @@ class PSSession:
         # BYTEPS_MIN_COMPRESS_BYTES floor (reference: global.cc:43,
         # operations.cc:362-364).
         self.min_compress_bytes = min_compress_bytes
+        # Codec pipeline width (BYTEPS_TPU_COMPRESS_THREADS).  0 = inline
+        # fallback: encode on the caller thread, decode on the receiver
+        # thread, exactly the pre-pipeline data path.
+        self.compress_threads = max(0, compress_threads)
         # Any failure before __init__ returns (a connect, the dispatcher,
         # the HELLO mode check) must tear down every socket and receiver
         # thread already created — the caller gets an exception, not a
@@ -362,6 +386,8 @@ class PSSession:
                 self._closed = True
                 self._cv.notify_all()
             self._dispatcher.join(timeout=5)
+        if getattr(self, "_codec_pool", None) is not None:
+            self._codec_pool.close()
         for pool in self._data_conns:
             for c in pool:
                 c.close()
@@ -385,6 +411,15 @@ class PSSession:
         if credit_bytes > 0:
             credit_bytes = max(credit_bytes, self.partition_bytes)
         self._queue = get_core().queue_create(credit_bytes)
+        # Codec pipeline engine (the reference's COMPRESS/DECOMPRESS loop
+        # threads, core_loops.cc): encodes run ahead of the dispatcher in
+        # the same (priority desc, key asc) order, decodes run off the
+        # receiver thread.  NOTE: with the pipeline on, a compressed
+        # partition's credit is charged at the codec's worst-case wire
+        # size (WireCompressor.wire_cap_bytes, clamped to raw size) —
+        # the true encoded size is not known at enqueue time.
+        self._codec_pool = (CompressionPool(self.compress_threads)
+                            if self.compress_threads > 0 else None)
         self._inflight: Dict[int, _PartTask] = {}
         self._inflight_lock = threading.Lock()
         self._cv = threading.Condition()
@@ -432,7 +467,8 @@ class PSSession:
                    partition_bytes=cfg.partition_bytes,
                    scheduling_credit=cfg.scheduling_credit,
                    min_compress_bytes=cfg.min_compress_bytes,
-                   wire_conns=cfg.wire_conns)
+                   wire_conns=cfg.wire_conns,
+                   compress_threads=cfg.compress_threads)
 
     def set_lr_scale(self, scale: float) -> None:
         """One-shot EF-error rescale after a learning-rate change;
@@ -525,6 +561,23 @@ class PSSession:
                 continue
             if self.record_push_order:
                 self.push_order.append(pkey)
+            if part.ready is not None and not part.ready.is_set():
+                # Codec pipeline: the pool encodes in this same
+                # (priority desc, key asc) order ahead of this loop, so
+                # the wait is the pipeline-fill case (first partition) or
+                # an encoder still catching up — either way the pool keeps
+                # working k+1 while k's bytes go out below.
+                while not part.ready.wait(timeout=1.0):
+                    with self._cv:
+                        if self._closed:
+                            self._queue.report_finish(nbytes)
+                            return
+            if part.enc_err is not None:
+                self._queue.report_finish(nbytes)
+                with self._cv:
+                    self._cv.notify_all()
+                self._finish_part(pkey, part.enc_err)
+                continue
             core = get_core()
             if core.trace_on and part.enq_ts:
                 part.push_ts = core.trace_now_us()
@@ -596,6 +649,34 @@ class PSSession:
             core.trace_record_part(part.label, "PULL", part.pull_ts,
                                    core.trace_now_us() - part.pull_ts, pkey,
                                    len(data), part.priority)
+        if (self._codec_pool is not None and part.bidirectional
+                and not isinstance(data, memoryview)
+                and len(data) != part.ln):
+            # Compressed pull payload: decode OFF the receiver thread, so
+            # one slow decode cannot stall every other partition's
+            # response parsing on this socket (the reference's DECOMPRESS
+            # loop thread, core_loops.cc:618-646).  The part already left
+            # _inflight above, so a staged re-push of the same key
+            # proceeds while this round's payload decodes.
+            try:
+                self._codec_pool.submit(
+                    part.priority, pkey,
+                    lambda part=part, data=data:
+                        self._complete_pull(part, data))
+                return
+            except RuntimeError:
+                pass    # pool already closing: finish inline below
+        self._complete_pull(part, data)
+
+    def _complete_pull(self, part: "_PartTask", data) -> None:
+        """Land one pull payload in the handle's output buffer.
+
+        Runs on the receiver thread for raw/sink payloads (a straight
+        frombuffer/no-op), and on a codec pool thread for compressed
+        payloads (wire_decode is real work) — inline mode
+        (compress_threads=0) keeps everything on the receiver thread.
+        """
+        core = get_core()
         try:
             n = part.ln // 4
             if isinstance(data, memoryview):
@@ -608,12 +689,23 @@ class PSSession:
                     # re-compressed; decode it (reference: worker DECOMPRESS
                     # stage, core_loops.cc:618-646).
                     from .wire import decode as wire_decode
+                    t0 = (core.trace_now_us()
+                          if core.trace_on or self._codec_pool is not None
+                          else 0)
                     got = wire_decode(bytes(data), n)
+                    if t0:
+                        dur = core.trace_now_us() - t0
+                        if core.trace_on:
+                            core.trace_record_part(
+                                part.label, "DECODE", t0, dur, part.pkey,
+                                len(data), part.priority)
+                        if self._codec_pool is not None:
+                            self._codec_pool.record("DECODE", dur)
                 else:
                     got = np.frombuffer(data, np.float32)
                 if got.size != n:
                     raise ValueError(
-                        f"PS pull size mismatch for key {pkey}: "
+                        f"PS pull size mismatch for key {part.pkey}: "
                         f"got {got.size} f32, want {n}")
                 part.handle.out[part.off // 4:part.off // 4 + n] = got
             part.handle._part_done()
@@ -682,7 +774,7 @@ class PSSession:
         parts = []
         try:
             self._stage_parts(plan, payload, mv, comp, kw_bytes, handle,
-                              parts, raw, seed, label)
+                              parts, raw, seed, label, priority)
         except Exception:
             # Roll back partitions already staged in _inflight: leaving them
             # would wedge the key forever (the sequential-use guard waits on
@@ -697,9 +789,13 @@ class PSSession:
         enq = core.trace_now_us() if core.trace_on else 0
         with self._cv:
             for p in parts:
-                p.priority = priority
                 p.enq_ts = enq
-                self._queue.add(p.pkey, priority, p.wire_ln)
+                # credit_ln: actual wire bytes for ready parts; the
+                # codec's worst-case bound for pipelined encodes (their
+                # true size doesn't exist yet and p.wire_ln is racing the
+                # encoder).  The queue returns the same figure at get(),
+                # so report_finish stays symmetric either way.
+                self._queue.add(p.pkey, priority, p.credit_ln)
             self._cv.notify_all()
         return handle
 
@@ -713,25 +809,77 @@ class PSSession:
             self._trace_labels[declared_key] = lbl
         return lbl
 
+    def _init_parts(self, plan, kw_bytes) -> None:
+        """Pipelined per-partition CMD_INIT: issue every needed INIT
+        concurrently, then await them all — one round-trip time per tensor
+        instead of one blocking round-trip per partition (a 64-partition
+        tensor's first push used to pay 64 serial RTTs here).  All futures
+        resolve before any partition is staged, so the PUSH of a key can
+        never beat its INIT to the server."""
+        inits = []
+        for pkey, off, ln, conn in plan:
+            if self._inited.get(pkey) != (ln, kw_bytes):
+                init_payload = struct.pack(
+                    "<QI", ln, len(kw_bytes)) + kw_bytes
+                inits.append((pkey, ln,
+                              conn.send(CMD_INIT, pkey, init_payload,
+                                        worker_id=self.worker_id)))
+        for pkey, ln, fut in inits:
+            resp = fut.wait(60.0)
+            # Seed the round counter from server state so a reconnected
+            # worker can never pull a stale previous round.
+            (completed,) = struct.unpack("<Q", resp)
+            self._round[pkey] = completed
+            self._inited[pkey] = (ln, kw_bytes)
+
+    def _encode_part(self, part: "_PartTask", comp, seg) -> None:
+        """Produce one partition's compressed wire payload on a codec pool
+        thread, recording the ENCODE span; always resolves part.ready (an
+        unset event would hang the dispatcher on this key forever)."""
+        core = get_core()
+        t0 = core.trace_now_us()
+        try:
+            blob = comp.encode(part.pkey, seg)
+            part.payload = blob
+            part.wire_ln = len(blob)
+        except Exception as e:
+            part.enc_err = e
+        finally:
+            # ready FIRST: if the tracer/stats below ever raised, an unset
+            # event would wedge the in-order dispatcher forever (the
+            # pool's catch-all only logs).
+            part.ready.set()
+            dur = core.trace_now_us() - t0
+            if core.trace_on:
+                core.trace_record_part(part.label, "ENCODE", t0, dur,
+                                       part.pkey, part.wire_ln,
+                                       part.priority)
+            self._codec_pool.record("ENCODE", dur)
+
     def _stage_parts(self, plan, payload, mv, comp, kw_bytes, handle,
-                     parts, raw, seed, label="") -> None:
+                     parts, raw, seed, label="", priority=0) -> None:
+        self._init_parts(plan, kw_bytes)
+        pool = self._codec_pool
+        core = get_core()
         for pkey, off, ln, conn in plan:
             # BYTEPS_MIN_COMPRESS_BYTES floor: small partitions go raw
             # (reference: operations.cc:362-364).
             use_comp = (comp is not None and not raw and not seed
                         and ln >= self.min_compress_bytes)
-            if self._inited.get(pkey) != (ln, kw_bytes):
-                init_payload = struct.pack("<QI", ln, len(kw_bytes)) + kw_bytes
-                resp = conn.request(CMD_INIT, pkey, init_payload,
-                                    worker_id=self.worker_id)
-                # Seed the round counter from server state so a reconnected
-                # worker can never pull a stale previous round.
-                (completed,) = struct.unpack("<Q", resp)
-                self._round[pkey] = completed
-                self._inited[pkey] = (ln, kw_bytes)
-            if use_comp:
+            if use_comp and pool is None:
+                # Inline fallback (BYTEPS_TPU_COMPRESS_THREADS=0): encode
+                # on the caller thread, the pre-pipeline data path.
+                t0 = core.trace_now_us() if core.trace_on else 0
                 wire_payload = comp.encode(
                     pkey, payload[off // 4:(off + ln) // 4])
+                if t0:
+                    core.trace_record_part(
+                        f"{label}.part{pkey & 0xFFFF}", "ENCODE", t0,
+                        core.trace_now_us() - t0, pkey, len(wire_payload),
+                        priority)
+                dtype = DT_COMPRESSED
+            elif use_comp:
+                wire_payload = None     # pipelined: the pool fills it in
                 dtype = DT_COMPRESSED
             else:
                 wire_payload = mv[off:off + ln]
@@ -751,10 +899,30 @@ class PSSession:
                             dtype=dtype,
                             bidirectional=use_comp and comp.bidirectional,
                             label=f"{label}.part{pkey & 0xFFFF}")
+                        part.priority = priority
+                        if wire_payload is None:
+                            part.ready = threading.Event()
+                            # Credit charge for a not-yet-encoded part:
+                            # the codec's worst-case wire size (never the
+                            # raw 4n — that would cut credit-gated
+                            # concurrency by the compression ratio).
+                            part.credit_ln = min(
+                                ln, comp.wire_cap_bytes(ln // 4))
                         self._inflight[pkey] = part
                         parts.append(part)
                         break
                 prev.done_evt.wait(timeout=60.0)
+            if part.ready is not None:
+                # Submitted AFTER the guard admits the part, so the encoder
+                # reads this round's EF/momentum/PRNG state strictly after
+                # the previous round's encode finished with it; the pool
+                # drains jobs in (priority desc, key asc) order, ahead of
+                # the dispatcher's identical order, overlapping partition
+                # k's wire send with the encode of k+1.
+                seg = payload[off // 4:(off + ln) // 4]
+                pool.submit(priority, pkey,
+                            lambda part=part, seg=seg:
+                                self._encode_part(part, comp, seg))
 
     def push_pull(self, key: int, tensor, priority: int = 0,
                   **kw) -> np.ndarray:
@@ -773,11 +941,24 @@ class PSSession:
             except (ConnectionError, OSError) as e:
                 get_logger().debug("shutdown race: %s", e)
 
+    def codec_stats(self) -> dict:
+        """Codec pipeline counters (parts encoded/decoded off-thread and
+        busy time); zeros with the pipeline disabled (compress_threads=0,
+        where codec work runs inline on the caller/receiver threads)."""
+        if self._codec_pool is None:
+            return dict(CompressionPool.ZERO_STATS)
+        return self._codec_pool.stats()
+
     def close(self) -> None:
         with self._cv:
             self._closed = True
             self._cv.notify_all()
+        # Dispatcher first (it may be waiting on an encode the pool still
+        # owes), then the codec pool (drains queued jobs so every staged
+        # handle resolves), then the sockets.
         self._dispatcher.join(timeout=10)
+        if self._codec_pool is not None:
+            self._codec_pool.close()
         for pool in self._data_conns:
             for c in pool:
                 c.close()

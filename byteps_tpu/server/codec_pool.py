@@ -1,0 +1,129 @@
+"""Worker-side codec pipeline engine.
+
+The reference runs COMPRESS and DECOMPRESS as dedicated pipeline loop
+threads, so codec work overlaps wire transfer instead of serializing on
+the caller or receiver threads (reference: core_loops.cc COMPRESS /
+DECOMPRESS stages of the 13-loop state machine).  This is the TPU-host
+analog: a small priority thread pool shared by both directions.
+
+  - ENCODE jobs are drained in (priority desc, key asc) order — the same
+    control law as the dispatcher's ScheduledQueue
+    (scheduled_queue.cc:26-46) — so the encoder works *ahead of* the
+    dispatcher: while partition k's bytes are on the wire, partition k+1
+    is being compressed.
+  - DECODE jobs carry the partition's scheduling priority too, so a
+    high-priority tensor's pull leg is decoded before a backlog of
+    low-priority ones.
+
+Jobs are plain callables and must do their own error containment (the
+session's jobs resolve the partition's handle with the exception); the
+pool's catch-all only guards against a job that leaks — a dead codec
+thread would silently wedge every waiter behind it.
+"""
+
+from __future__ import annotations
+
+import heapq
+import threading
+from typing import Callable, List
+
+from ..common.logging import get_logger
+
+
+class CompressionPool:
+    """Priority thread pool for wire encode/decode jobs.
+
+    `threads == 0` is the inline fallback: callers must not construct a
+    pool at all (the session keeps the pre-pipeline inline paths); this
+    class always owns at least one thread.
+    """
+
+    # Canonical stats schema — the single source for the all-zero shape
+    # returned by PSSession.codec_stats / bps.get_codec_stats when no
+    # pool exists, so the three surfaces can never drift apart.
+    ZERO_STATS = {"threads": 0, "pending": 0, "encoded_parts": 0,
+                  "decoded_parts": 0, "encode_busy_us": 0,
+                  "decode_busy_us": 0}
+
+    def __init__(self, threads: int, name: str = "bps-ps-codec"):
+        if threads < 1:
+            raise ValueError("CompressionPool needs >= 1 thread; "
+                             "use threads=0 at the session level for the "
+                             "inline fallback")
+        self._cv = threading.Condition()
+        self._heap: list = []    # (-priority, key, seq, job)
+        self._seq = 0            # FIFO tiebreak for equal (priority, key)
+        self._closed = False
+        # Telemetry counters (read via stats(); exposed through
+        # bps.get_codec_stats for tooling like tools/wire_bench.py).
+        self._counts = {"ENCODE": 0, "DECODE": 0}
+        self._busy_us = {"ENCODE": 0, "DECODE": 0}
+        self.num_threads = threads
+        self._threads: List[threading.Thread] = [
+            threading.Thread(target=self._loop, daemon=True,
+                             name=f"{name}-{i}")
+            for i in range(threads)]
+        for t in self._threads:
+            t.start()
+
+    def submit(self, priority: int, key: int, job: Callable[[], None]) -> None:
+        """Queue `job`; higher priority first, then ascending key, then
+        submission order."""
+        with self._cv:
+            if self._closed:
+                raise RuntimeError("CompressionPool closed")
+            self._seq += 1
+            heapq.heappush(self._heap, (-priority, key, self._seq, job))
+            self._cv.notify()
+
+    def record(self, stage: str, dur_us: int) -> None:
+        """Count one finished codec job.  Only pool-owning sessions count
+        anything: with compress_threads=0 there is no pool and codec_stats
+        stays all-zero — zeros mean "nothing measured", not "no codec
+        work" (inline mode does its codec work uncounted on the
+        caller/receiver threads).  The receiver-thread fallback decode
+        during shutdown is the one non-pool-thread path that records."""
+        with self._cv:
+            self._counts[stage] = self._counts.get(stage, 0) + 1
+            self._busy_us[stage] = self._busy_us.get(stage, 0) + max(
+                0, int(dur_us))
+
+    def stats(self) -> dict:
+        with self._cv:
+            s = dict(self.ZERO_STATS)
+            s.update(
+                threads=self.num_threads,
+                pending=len(self._heap),
+                encoded_parts=self._counts.get("ENCODE", 0),
+                decoded_parts=self._counts.get("DECODE", 0),
+                encode_busy_us=self._busy_us.get("ENCODE", 0),
+                decode_busy_us=self._busy_us.get("DECODE", 0),
+            )
+            return s
+
+    def _loop(self) -> None:
+        while True:
+            with self._cv:
+                while not self._heap and not self._closed:
+                    self._cv.wait()
+                if not self._heap:          # closed and drained
+                    return
+                _, _, _, job = heapq.heappop(self._heap)
+            try:
+                job()
+            except Exception:   # pragma: no cover - jobs contain their own
+                get_logger().exception("codec pipeline job failed")
+
+    def close(self) -> None:
+        """Drain queued jobs, then stop the threads.
+
+        Draining (not dropping) matters: queued DECODE jobs hold pull
+        payloads whose handles nothing else will ever resolve, and queued
+        ENCODE jobs must still set their partition's ready event or the
+        dispatcher would wait on it forever during shutdown.
+        """
+        with self._cv:
+            self._closed = True
+            self._cv.notify_all()
+        for t in self._threads:
+            t.join(timeout=10)

@@ -295,6 +295,29 @@ class WireCompressor:
             for k in self._err:
                 self._err[k] = self._err[k] * s
 
+    def wire_cap_bytes(self, n: int) -> int:
+        """Worst-case wire payload size for an n-element partition.
+
+        The codec pipeline charges scheduling credit at enqueue time,
+        BEFORE the encode has produced actual wire bytes — this bound
+        keeps the charge at compressed scale (an onebit partition charges
+        ~n/8, not 4n, preserving the credit law's in-flight concurrency).
+        The bound must not meaningfully under-estimate (the charge is
+        returned verbatim by report_finish, so bookkeeping stays
+        symmetric regardless, but the credit law meters wire bytes).
+        The client clamps the charge to the raw partition size: the
+        credit floor guarantees one raw partition always fits, and
+        elias's worst case exceeds raw by its ~80-byte framing."""
+        if self.comp_id == COMP_ONEBIT:
+            return 9 + (n + 7) // 8
+        if self.comp_id in (COMP_TOPK, COMP_RANDOMK):
+            return 9 + 8 * min(self.k, n)
+        # dithering — the same caps the C encoder is given (elias's
+        # worst case is ~raw size; dense is b bits + sign per element).
+        if self.coding == "elias":
+            return 15 + 4 * n + 64
+        return 15 + (n * _level_bits(self.s) + 7) // 8 + (n + 7) // 8
+
     def kwargs_string(self) -> str:
         """Canonical "k=v,k=v" form sent in the INIT payload."""
         kw = {"compressor": self.name}
@@ -324,9 +347,14 @@ class WireCompressor:
         # One lock across the whole stateful read-correct-write: a
         # set_lr_scale landing between the EF read and the error store
         # would otherwise be silently overwritten by an error computed
-        # from the unscaled value.  Concurrent encodes on one
-        # WireCompressor are same-tensor re-pushes (one codec per declared
-        # key), which the session's sequential-use guard serializes anyway.
+        # from the unscaled value.  The codec pipeline routinely encodes
+        # DIFFERENT partitions of one tensor concurrently on this object:
+        # the stateful paths serialize here (state correctness over
+        # encode parallelism), while the stateless _encode_raw path runs
+        # unlocked and must touch only per-pkey dict entries (GIL-atomic)
+        # — no cross-key shared scratch outside this lock.  Same-key
+        # rounds stay ordered: the session submits round r+1's encode
+        # only after round r's partition fully completed.
         with self._state_lock:
             if self.comp_id == COMP_ONEBIT and x.size:
                 lib = _c_wire()
@@ -445,7 +473,13 @@ class WireCompressor:
             rng = self._rng.get(pkey)
             if rng is None or rng.size < n:
                 rng = _seed_state(self.seed, n)
-            rng = np.ascontiguousarray(rng[:n])
+            # The C encoder advances the lanes IN PLACE — hand it a private
+            # copy and store that back only on success, so a failed encode
+            # (wrote <= 0, cap exhausted) leaves the per-key state
+            # untouched and the numpy fallback below continues from
+            # unadvanced lanes (byte/PRNG parity with a pure-numpy worker;
+            # ADVICE round 5).
+            rng = np.array(rng[:n], dtype=np.uint32)
             recon = np.empty(n, np.float32) if self.ef else None
             elias = self.coding == "elias"
             cap = 15 + (4 * n + 64 if elias

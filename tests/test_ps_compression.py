@@ -229,6 +229,59 @@ def test_dithering_elias_coding_density_and_parity():
                              "coding": "huffman"})
 
 
+def test_dithering_c_encoder_failure_preserves_rng_state(monkeypatch):
+    """ADVICE round 5 (wire.py): the C dithering encoder advances the
+    xorshift lanes in place, so it must be handed a PRIVATE copy, stored
+    back only when it succeeds — a failed encode (wrote <= 0) that had
+    partially advanced the shared state would silently break byte/PRNG
+    parity with a pure-numpy worker for every later round."""
+    if wire._c_wire() is None:
+        pytest.skip("native wire codec unavailable")
+    import ctypes
+
+    kw = {"compressor": "dithering", "k": "15", "seed": "5"}
+    rng = np.random.RandomState(17)
+    g1 = rng.randn(512).astype(np.float32)
+    g2 = rng.randn(512).astype(np.float32)
+
+    # Reference: a pure-numpy worker's blobs + lane state over two rounds.
+    wire._CWIRE = None
+    try:
+        ref = wire.WireCompressor(kw)
+        ref_blobs = [ref.encode(3, g1), ref.encode(3, g2)]
+        ref_state = ref._rng[3].copy()
+    finally:
+        wire._CWIRE = False
+
+    real = wire._c_wire()
+
+    class _FailingLib:
+        """Real lib, except the dithering encoder scribbles on the rng
+        lanes (as a genuine partial encode would) and reports failure."""
+
+        def __getattr__(self, name):
+            return getattr(real, name)
+
+        @staticmethod
+        def bps_wire_encode_dithering(x, n, s, natural, elias, norm,
+                                      rng_ptr, recon, out, cap):
+            ctypes.memset(rng_ptr, 0xAB, int(n) * 4)
+            return -1
+
+    wc = wire.WireCompressor(kw)
+    blob1 = wc.encode(3, g1)       # healthy C encode: state advances once
+    stored = wc._rng[3]
+    snapshot = stored.copy()
+    monkeypatch.setattr(wire, "_CWIRE", _FailingLib())
+    blob2 = wc.encode(3, g2)       # C fails -> numpy fallback, same round
+    # The failed C call only ever saw a private copy of the lanes...
+    np.testing.assert_array_equal(stored, snapshot)
+    # ...so both rounds' bytes and the surviving state match the
+    # pure-numpy worker exactly.
+    assert [blob1, blob2] == ref_blobs
+    np.testing.assert_array_equal(wc._rng[3], ref_state)
+
+
 def test_dithering_elias_with_ef_converges_error():
     """EF over the elias wire: carried error equals x - reconstruction
     (the encoder's direct recon path, no decode loop)."""
@@ -388,6 +441,138 @@ def test_soak_4workers_2servers_schedule_compression_restart(ps_server):
             np.testing.assert_allclose(
                 results[(w, r)], want, rtol=1e-5, atol=1e-7,
                 err_msg=f"worker {w} round {r} diverged")
+
+
+def test_slow_decode_does_not_stall_other_partitions(ps_server, monkeypatch):
+    """Codec pipeline contract: with a registered compressor and >=4
+    partitions on ONE socket, (a) the receiver thread performs no codec
+    work — every wire decode runs on a codec pool thread — and (b) one
+    slow partition decode does not delay an independent partition's pull
+    completion on the same connection (pre-pipeline, the decode ran
+    inside _recv_loop and serialized every response behind it)."""
+    import time as time_mod
+
+    port = ps_server(num_workers=1)
+    s = PSSession(["127.0.0.1"], [port], worker_id=0, num_servers=1,
+                  partition_bytes=1024, min_compress_bytes=0,
+                  wire_conns=1, compress_threads=2)
+    s.register_compressor(8, ONEBIT_KW)   # bidirectional: pull leg decodes
+    g = np.random.RandomState(6).randn(1024).astype(np.float32)  # 4 parts
+
+    real_decode = wire.decode
+    lock = threading.Lock()
+    calls = []            # (thread_name, finish_time) per decode
+    slowed = []
+
+    def traced_decode(data, n):
+        with lock:
+            slow = not slowed
+            if slow:
+                slowed.append(True)
+        if slow:
+            time_mod.sleep(0.75)   # one slow partition (elias-like cost)
+        out = real_decode(data, n)
+        with lock:
+            calls.append((threading.current_thread().name,
+                          time_mod.monotonic()))
+        return out
+
+    monkeypatch.setattr(wire, "decode", traced_decode)
+    got = s.push_pull(8, g)
+    s.close()
+    monkeypatch.undo()   # _expected_onebit_sum below uses wire.decode
+    assert len(calls) == 4, calls
+    names = [name for name, _ in calls]
+    # (a) _recv_loop did no codec work: every decode ran in the pool.
+    assert all(n.startswith("bps-ps-codec") for n in names), names
+    assert not any(n.startswith("bps-ps-recv") for n in names), names
+    # (b) the slow decode finished LAST: the other partitions' pulls
+    # completed while it slept (they would queue behind it on the
+    # receiver thread otherwise).  calls[] is completion-ordered.
+    slow_finish = max(t for _, t in calls)
+    earlier = [t for _, t in calls if t < slow_finish - 0.5]
+    assert len(earlier) >= 2, calls
+    np.testing.assert_allclose(got, _expected_onebit_sum([g]), rtol=1e-6)
+
+
+def test_priority_order_with_compressed_pipeline(ps_server):
+    """record_push_order's (priority desc, key asc) dispatch law must hold
+    with compression enabled and BYTEPS_TPU_COMPRESS_THREADS>1: the
+    dispatcher pops in queue order and waits for the pipelined encode of
+    THAT key, so out-of-order encode completions can never reorder the
+    wire (the pool drains the same order, making the wait rare)."""
+    port = ps_server(num_workers=1)
+    s = PSSession(["127.0.0.1"], [port], worker_id=0, num_servers=1,
+                  partition_bytes=1024, min_compress_bytes=0,
+                  scheduling_credit=1, compress_threads=2)
+    s.register_compressor(1, ONEBIT_KW)
+    s.register_compressor(2, ONEBIT_KW)
+    s.record_push_order = True
+    s.pause_dispatch()
+    a = np.random.RandomState(3).randn(1024).astype(np.float32)  # 4 parts
+    b = np.random.RandomState(4).randn(512).astype(np.float32)   # 2 parts
+    ha = s.push_pull_async(1, a, priority=0)    # low, enqueued first
+    hb = s.push_pull_async(2, b, priority=10)   # high, enqueued second
+    s.resume_dispatch()
+    ra, rb = ha.wait(), hb.wait()
+    order = list(s.push_order)
+    expect = [(2 << 16) | i for i in range(2)] \
+        + [(1 << 16) | i for i in range(4)]
+    assert order == expect, order
+    np.testing.assert_allclose(ra, _expected_onebit_sum([a]), rtol=1e-6)
+    np.testing.assert_allclose(rb, _expected_onebit_sum([b]), rtol=1e-6)
+    s.close()
+
+
+def test_wire_cap_bytes_bounds_actual_payloads():
+    """wire_cap_bytes is the scheduling-credit charge for pipelined
+    encodes — it must never fall below a real encoded payload (the
+    credit law meters wire bytes), for every codec and size, including
+    the all-nonzero regime that maximizes the elias stream."""
+    rng = np.random.RandomState(19)
+    kws = [{"compressor": "onebit"}, {"compressor": "topk", "k": "32"},
+           {"compressor": "randomk", "k": "32", "seed": "7"},
+           {"compressor": "dithering", "k": "15"},
+           {"compressor": "dithering", "k": "15", "coding": "elias"},
+           {"compressor": "dithering", "k": "7", "partition": "natural",
+            "normalize": "l2", "coding": "elias"}]
+    for kw in kws:
+        for n in (1, 255, 4096):
+            for x in (rng.randn(n).astype(np.float32),
+                      np.where(np.arange(n) % 2 == 0, 1.0,
+                               -1.0).astype(np.float32)):
+                wc = wire.WireCompressor(dict(kw))
+                blob = wc.encode(3, x)
+                assert len(blob) <= wc.wire_cap_bytes(n), (kw, n)
+        # the compressed caps (the ones the credit law benefits from)
+        # stay well under raw size
+        if kw["compressor"] != "dithering" or "coding" not in kw:
+            assert wc.wire_cap_bytes(65536) < 65536 * 4
+
+
+def test_inline_mode_stays_available(ps_server):
+    """BYTEPS_TPU_COMPRESS_THREADS=0 is the supported fallback: codec work
+    runs inline (caller-thread encode, receiver-thread decode) and results
+    match the pipelined path exactly."""
+    port = ps_server(num_workers=1)
+    g = np.random.RandomState(8).randn(1024).astype(np.float32)
+    outs = {}
+    for ct in (0, 2):
+        s = PSSession(["127.0.0.1"], [port], worker_id=0, num_servers=1,
+                      partition_bytes=1024, min_compress_bytes=0,
+                      compress_threads=ct)
+        s.register_compressor(20 + ct, ONEBIT_KW)
+        outs[ct] = s.push_pull(20 + ct, g)
+        stats = s.codec_stats()
+        if ct == 0:
+            assert stats["threads"] == 0
+            assert stats["encoded_parts"] == 0  # nothing ran in a pool
+        else:
+            assert stats["threads"] == 2
+            assert stats["encoded_parts"] == 4
+            assert stats["decoded_parts"] == 4  # onebit pull leg
+        s.close()
+    np.testing.assert_array_equal(outs[0], outs[2])
 
 
 def test_min_compress_bytes_floor(ps_server):

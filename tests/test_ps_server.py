@@ -225,6 +225,44 @@ def test_dedup_within_round(ps_server):
     np.testing.assert_allclose(out["w1"], 2 * a)
 
 
+def test_size_change_from_seen_worker_not_dropped_as_dup(ps_server):
+    """A worker already in `seen` that re-pushes the SAME key with a NEW
+    payload size (re-declared tensor mid-round) must trigger the
+    size-change merge reset, not be acked-and-dropped by the dedup
+    (ADVICE round 5: the dedup ran before the size check, so after the
+    reset cleared `seen` the round stayed one push short forever and
+    every pull hung)."""
+    port = ps_server(num_workers=2)
+    key = 13
+    a = np.ones(16, np.float32)                  # original size
+    b = np.full(32, 2.0, np.float32)             # re-declared size
+
+    s0 = _session(port, 0)
+    s1 = _session(port, 1)
+    # Worker 0 joins the round at the original size: seen = {0}.
+    s0.conns[0].request(1, key, struct.pack("<QI", a.nbytes, 0), worker_id=0)
+    s0.conns[0].request(2, key, a.tobytes(), worker_id=0)
+    # Worker 0 re-pushes the key at the NEW size with no intervening INIT
+    # (a re-INIT's own size check would mask the bug by clearing `seen`
+    # first, HandleInit).  Must reset the merge (store=b, seen={0}), NOT
+    # vanish as a dup: pre-fix, this ack-and-drop left worker 0 out of the
+    # restarted merge forever.
+    s0.conns[0].request(2, key, b.tobytes(), worker_id=0)
+    # Worker 1 completes the round at the new size (its INIT sees the
+    # already-resized store, so worker 0's contribution survives).
+    s1.conns[0].request(1, key, struct.pack("<QI", b.nbytes, 0), worker_id=1)
+    s1.conns[0].request(2, key, b.tobytes(), worker_id=1)
+    # Both pulls must serve the 2-way size-B merge (pre-fix: hangs —
+    # the 30s timeout turns the wedge into a loud failure).
+    for s, wid in ((s0, 0), (s1, 1)):
+        got = np.frombuffer(
+            s.conns[0].request(3, key, worker_id=wid, timeout=30.0),
+            np.float32)
+        np.testing.assert_array_equal(got, 2 * b)
+    s0.close()
+    s1.close()
+
+
 def test_pull_with_impossible_round_rejected(ps_server):
     """The pull round compare is 16-bit on the wire (u16 flags); the server
     asserts the sequential-use invariant (pull round == completed_round or

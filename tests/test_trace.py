@@ -68,6 +68,40 @@ def test_ps_partition_spans(ps_server, tracing, tmp_path):  # noqa: F811
             f"key_{dk}.part{i}" for i in range(4)]
 
 
+def test_codec_pipeline_emits_encode_decode_spans(ps_server, tracing,  # noqa: F811
+                                                  tmp_path):
+    """With a registered compressor, the codec pipeline closes one ENCODE
+    span per partition (pool thread, ahead of the dispatcher) and — for
+    bidirectional compressors — one DECODE span per partition (pull-leg
+    decode off the receiver thread), alongside QUEUE/PUSH/PULL."""
+    port = ps_server(num_workers=1)
+    sess = PSSession(["127.0.0.1"], [port], worker_id=0, num_servers=1,
+                     partition_bytes=1024, min_compress_bytes=0,
+                     compress_threads=2)
+    dk = get_core().num_declared() + 801
+    sess.register_compressor(dk, {"compressor": "onebit"})
+    x = np.linspace(-1.0, 1.0, 1024).astype(np.float32)  # 4 partitions
+    sess.push_pull(dk, x, priority=3)
+    sess.close()
+
+    events = _dump(tracing, tmp_path)
+    by_stage = {}
+    for e in events:
+        by_stage.setdefault(e["tid"], []).append(e)
+    for stage in ("QUEUE", "PUSH", "PULL", "ENCODE", "DECODE"):
+        rows = by_stage.get(stage, [])
+        assert len(rows) == 4, (stage, sorted(by_stage))
+        for r in rows:
+            assert r["ph"] == "X" and r["dur"] >= 0
+            assert r["args"]["priority"] == 3
+            assert r["args"]["bytes"] > 0
+        assert {k >> 16 for k in (r["args"]["key"] for r in rows)} == {dk}
+    # The ENCODE span's bytes are the compressed wire size (onebit:
+    # 9-byte header+scale + n/8 sign bits), not the raw partition.
+    for r in by_stage["ENCODE"]:
+        assert r["args"]["bytes"] == 9 + (1024 // 4) // 8
+
+
 def test_ps_spans_use_declared_names(ps_server, tracing, tmp_path):  # noqa: F811
     """Sessions driven through the declare() registry label spans with the
     tensor's name, as the reference timeline does."""

@@ -1,0 +1,286 @@
+#!/usr/bin/env python
+"""PS-wire codec microbenchmark.
+
+Two sections, both CPU-only (no JAX, no accelerator):
+
+  1. codec throughput — raw encode/decode MB/s and compression ratio per
+     wire codec (`server/wire.py`, riding the C codec when built);
+  2. pipeline A/B — a multi-partition compressed push_pull through the
+     real native PS server over loopback, codec pipeline ON
+     (BYTEPS_TPU_COMPRESS_THREADS=N) vs the inline fallback
+     (COMPRESS_THREADS=0, encode on the caller thread / decode on the
+     receiver thread).  Headline: the CALLER-BLOCK wall time — how long
+     the compressed push_pull holds the caller thread before it can
+     overlap its own step compute (inline pays every partition's encode
+     there; the pipeline hands it to pool threads and returns in ~ms).
+     Full sync round-trips are reported alongside (see pipeline_ab's
+     docstring for the colocated-server caveat on small hosts).
+
+Usage:
+    python tools/wire_bench.py [--quick] [--json] [--threads N]
+                               [--mb MB] [--part-kb KB] [--rounds R]
+
+--json prints a machine-readable result document on stdout (progress
+lines go to stderr); tests/test_wire_bench.py runs `--quick --json` as
+the `-m slow` smoke invocation.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import socket
+import statistics
+import subprocess
+import sys
+import time
+
+import numpy as np
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+from byteps_tpu.server import wire                      # noqa: E402
+from byteps_tpu.server.client import PSSession          # noqa: E402
+from byteps_tpu.utils.hermetic import cpu_subprocess_env  # noqa: E402
+
+# Codec set for the throughput section: the production wire formats.
+_CODECS = [
+    ("onebit", {"compressor": "onebit"}),
+    ("onebit+ef", {"compressor": "onebit", "ef": "vanilla"}),
+    ("dithering-dense", {"compressor": "dithering", "k": "15"}),
+    ("dithering-elias", {"compressor": "dithering", "k": "15",
+                         "coding": "elias"}),
+    ("topk", {"compressor": "topk", "k": "4096"}),
+]
+
+
+def _log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def _gradient(n: int, seed: int = 1) -> np.ndarray:
+    """Heavy-tailed sparse-ish gradient (the regime real training ships:
+    most dithering levels quantize to 0, so elias has gaps to code)."""
+    rng = np.random.RandomState(seed)
+    return (rng.randn(n) * (rng.rand(n) < 0.2)).astype(np.float32)
+
+
+def codec_throughput(n: int, reps: int) -> list:
+    out = []
+    x = _gradient(n)
+    for name, kw in _CODECS:
+        wc = wire.WireCompressor(dict(kw))
+        blob = wc.encode(1, x)                     # warm (+ EF state)
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            blob = wc.encode(1, x)
+        enc = (time.perf_counter() - t0) / reps
+        wire.decode(blob, n)                       # warm
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            wire.decode(blob, n)
+        dec = (time.perf_counter() - t0) / reps
+        row = {
+            "codec": name,
+            "encode_MBps": round(x.nbytes / enc / 1e6, 1),
+            "decode_MBps": round(x.nbytes / dec / 1e6, 1),
+            "ratio": round(x.nbytes / len(blob), 2),
+            "native": wire._c_wire() is not None,
+        }
+        out.append(row)
+        _log(f"  {name:17s} enc {row['encode_MBps']:8.1f} MB/s   "
+             f"dec {row['decode_MBps']:8.1f} MB/s   {row['ratio']:5.1f}x")
+    return out
+
+
+def boot_server():
+    """Native PS server subprocess on a freshly-probed port (the bind
+    race retry pattern of bench.py bench_ps)."""
+    import tempfile
+    for _ in range(4):
+        with socket.socket() as sk:
+            sk.bind(("127.0.0.1", 0))
+            port = sk.getsockname()[1]
+        env = cpu_subprocess_env({
+            "DMLC_PS_ROOT_PORT": str(port - 1),
+            "DMLC_NUM_WORKER": "1",
+            "BYTEPS_SERVER_ENGINE_THREAD": str(min(4, os.cpu_count() or 4)),
+        })
+        errf = tempfile.TemporaryFile(mode="w+")
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "byteps_tpu.server"],
+            env=env, stdout=subprocess.DEVNULL, stderr=errf)
+        deadline = time.time() + 30
+        while True:
+            try:
+                socket.create_connection(("127.0.0.1", port), 0.5).close()
+                return proc, port
+            except OSError:
+                if proc.poll() is not None:
+                    errf.seek(0)
+                    stderr = errf.read()[-500:]
+                    errf.close()
+                    if "in use" not in stderr.lower():
+                        raise RuntimeError(
+                            f"PS server died at startup "
+                            f"(rc={proc.returncode}): {stderr}")
+                    break               # lost the port race — retry fresh
+                if time.time() > deadline:
+                    proc.kill()
+                    proc.wait()
+                    raise RuntimeError("PS server did not come up")
+                time.sleep(0.1)
+    raise RuntimeError("PS server lost the port race 4 times")
+
+
+def _timed_rounds(sess, key, data, rounds: int):
+    """(caller_block, sync_round) second-pairs per round.
+
+    caller_block = the push_pull_async() call's own duration: how long
+    the CALLER thread is captive to codec work before it can go do the
+    training step's compute.  sync_round = issue + wait, the full
+    round-trip."""
+    sess.push_pull(key, data)          # warm: INITs + first merge
+    out = []
+    for _ in range(rounds):
+        t0 = time.perf_counter()
+        h = sess.push_pull_async(key, data)
+        t1 = time.perf_counter()
+        h.wait()
+        out.append((t1 - t0, time.perf_counter() - t0))
+    return out
+
+
+def pipeline_ab(nbytes: int, part_bytes: int, rounds: int,
+                threads: int, kw: dict) -> dict:
+    """Compressed multi-partition push_pull, codec pipeline vs inline.
+
+    Headline (`inline_s`/`pipelined_s`): best-of caller-block wall time —
+    the wall time a compressed push_pull holds the CALLER thread, which
+    is what the pipeline exists to remove (inline mode encodes every
+    partition before push_pull_async returns; a training loop pays that
+    serially against its step compute every iteration).  Best-of because
+    shared hosts put noisy-neighbor stalls in the tail of both modes.
+
+    `sync_round` (reported alongside): the full issue+wait round trip.
+    NOTE an honest caveat: with the PS server COLOCATED on a small host
+    (this bench's only option), total CPU is the binding resource, so
+    overlapping encode with the server's merge buys little and the
+    thread interleaving costs a few percent — parity-ish sync rounds
+    here.  The overlap pays on deployment shapes: server on separate
+    hardware, or workers with idle cores for the pool.
+    """
+    data = _gradient(nbytes // 4, seed=2)
+    proc, port = boot_server()
+    try:
+        res = {}
+        # Pipelined first, then inline: if anything, the later run enjoys
+        # the warmer page cache, biasing AGAINST the pipeline claim.
+        for label, ct, key in (("pipelined", threads, 7), ("inline", 0, 8)):
+            s = PSSession(["127.0.0.1"], [port], worker_id=0, num_servers=1,
+                          partition_bytes=part_bytes, min_compress_bytes=0,
+                          compress_threads=ct)
+            s.register_compressor(key, dict(kw))
+            times = _timed_rounds(s, key, data, rounds)
+            blocks = [b for b, _ in times]
+            syncs = [r for _, r in times]
+            res[label] = {
+                "caller_block_best_s": round(min(blocks), 5),
+                "caller_block_median_s": round(
+                    statistics.median(blocks), 5),
+                "sync_round_best_s": round(min(syncs), 4),
+                "sync_round_median_s": round(statistics.median(syncs), 4),
+                "compress_threads": ct,
+                **{k: v for k, v in s.codec_stats().items()
+                   if k in ("encoded_parts", "decoded_parts",
+                            "encode_busy_us", "decode_busy_us")},
+            }
+            s.close()
+            r = res[label]
+            _log(f"  {label:10s} (threads={ct}) caller-block best "
+                 f"{r['caller_block_best_s'] * 1e3:7.2f} ms   sync round "
+                 f"best {r['sync_round_best_s'] * 1e3:7.2f} ms  median "
+                 f"{r['sync_round_median_s'] * 1e3:7.2f} ms")
+        blk_i = res["inline"]["caller_block_best_s"]
+        blk_p = res["pipelined"]["caller_block_best_s"]
+        return {
+            "tensor_mb": nbytes / 1e6,
+            "partitions": (nbytes + part_bytes - 1) // part_bytes,
+            "compressor": dict(kw),
+            "rounds": rounds,
+            "stat": "caller_block_best",
+            "inline_s": blk_i,
+            "pipelined_s": blk_p,
+            "speedup": round(blk_i / blk_p, 2) if blk_p else 0.0,
+            **res,
+        }
+    finally:
+        proc.kill()
+        proc.wait()
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--quick", action="store_true",
+                    help="small sizes / few reps (CI smoke)")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable results on stdout")
+    ap.add_argument("--threads", type=int, default=2,
+                    help="codec pipeline width for the A/B (default 2)")
+    ap.add_argument("--mb", type=float, default=None,
+                    help="tensor size for the A/B in MB")
+    ap.add_argument("--part-kb", type=int, default=None,
+                    help="partition size in KB")
+    ap.add_argument("--rounds", type=int, default=None,
+                    help="timed push_pull rounds per mode")
+    args = ap.parse_args(argv)
+
+    quick = args.quick
+    n_codec = (1 << 18) if quick else (1 << 21)
+    reps = 3 if quick else 10
+    mb = args.mb if args.mb is not None else (8.0 if quick else 32.0)
+    part_kb = args.part_kb or (512 if quick else 1024)
+    rounds = args.rounds or (9 if quick else 15)
+
+    _log(f"wire_bench: codec throughput ({n_codec} f32, {reps} reps)")
+    codec = codec_throughput(n_codec, reps)
+
+    # Encode-heavy codec for the headline A/B: elias dithering is the
+    # reference's entropy coder and the costliest encoder in the set, the
+    # regime the pipeline exists for.  No EF: the EF state lock would
+    # serialize the pool's encoders (documented in docs/performance.md).
+    ab_kw = {"compressor": "dithering", "k": "15", "coding": "elias"}
+    _log(f"wire_bench: pipeline A/B ({mb:.0f} MB tensor, {part_kb} KB "
+         f"partitions, {rounds} rounds, threads={args.threads})")
+    pipeline = pipeline_ab(int(mb * 1e6), part_kb * 1024, rounds,
+                           max(1, args.threads), ab_kw)
+    _log(f"  caller-block speedup (inline/pipelined): "
+         f"{pipeline['speedup']:.1f}x")
+
+    # Bidirectional codec A/B: onebit's pull leg comes back re-compressed,
+    # so this is the config that drives the DECODE half of the pipeline
+    # (decoded_parts > 0 in the pipelined row proves the receiver thread
+    # stayed codec-free); cheap codec, so the caller-block gap is smaller
+    # — the elias A/B above stays the headline.
+    bidi_kw = {"compressor": "onebit"}
+    _log(f"wire_bench: bidirectional (decode-leg) A/B "
+         f"({mb:.0f} MB tensor, onebit)")
+    bidi = pipeline_ab(int(mb * 1e6), part_kb * 1024, rounds,
+                       max(1, args.threads), bidi_kw)
+    _log(f"  caller-block speedup (inline/pipelined): {bidi['speedup']:.1f}x"
+         f"  decoded_parts={bidi['pipelined']['decoded_parts']}")
+
+    doc = {"codec": codec, "pipeline": pipeline,
+           "pipeline_bidirectional": bidi,
+           "config": {"quick": quick, "threads": args.threads,
+                      "cpus": os.cpu_count()}}
+    if args.json:
+        print(json.dumps(doc, indent=1))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
