@@ -24,6 +24,9 @@ Modes:
   BENCH_PS=1         PS wire goodput through the real C++ server over
                      loopback TCP (reference analog: the ps-lite transport
                      benchmark in .travis.yml:29-34)
+  BENCH_FUSION=1     fusion-layer wire bench: many small tensors, per-leaf
+                     vs fused-bucket dispatch through the real PS server
+                     (emits fusion_small_tensor_caller_block)
   BENCH_CNN=<name>   image-model throughput (resnet50 / vgg16 ...), fp32 —
                      the reference's other headline rows (reference:
                      docs/performance.md:5-26); BENCH_CNN_BATCH per chip
@@ -477,6 +480,60 @@ def bench_machinery():
     }))
 
 
+def bench_fusion():
+    """Fusion-layer wire benchmark: the many-small-tensors regime through
+    the real PS server (tools/wire_bench.py fusion_ab), emitted as the
+    `fusion_small_tensor_caller_block` metric so BENCH_r* tracks the
+    trajectory.
+
+    value = the fused caller-block wall time for one round of the
+    many-small-tensors scenario (512 leaves of 4-64 KiB; 128 with
+    BENCH_SMALL=1); vs_baseline = the per-leaf (unfused) caller-block
+    time over it — how many times faster the caller gets back to its
+    step compute with the fusion layer on.  Host-only, like BENCH_PS.
+    """
+    import subprocess
+    import sys
+
+    tool = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "tools", "wire_bench.py")
+    argv = [sys.executable, tool, "--fusion-only", "--json"]
+    if os.environ.get("BENCH_SMALL", "0") == "1":
+        argv.append("--quick")
+    r = subprocess.run(argv, capture_output=True, text=True, timeout=1200)
+    if r.returncode != 0:
+        _error_record(f"fusion bench failed rc={r.returncode}: "
+                      f"{r.stderr[-400:]}")
+        raise SystemExit(3)
+    fus = json.loads(r.stdout)["fusion"]
+    print(json.dumps({
+        "metric": "fusion_small_tensor_caller_block",
+        "value": round(fus["fused"]["caller_block_best_s"] * 1e3, 3),
+        "unit": "ms",
+        "vs_baseline": fus["caller_block_speedup"],
+        "detail": {
+            "num_leaves": fus["num_leaves"],
+            "leaf_kb": fus["leaf_kb"],
+            "total_mb": fus["total_mb"],
+            "fusion_bytes": fus["fusion_bytes"],
+            "wire_message_reduction": fus["wire_message_reduction"],
+            "sync_round_speedup": fus["sync_round_speedup"],
+            "priority_descending": fus["priority_descending"],
+            "unfused_caller_block_ms": round(
+                fus["unfused"]["caller_block_best_s"] * 1e3, 3),
+            "unfused_msgs_per_round":
+                fus["unfused"]["wire_messages_per_round"],
+            "fused_msgs_per_round":
+                fus["fused"]["wire_messages_per_round"],
+            "buckets": fus["fused"]["buckets"],
+            "note": "vs_baseline = unfused/fused caller-block time; "
+                    "wire messages are PUSH dispatches per round "
+                    "(PULLs mirror 1:1)",
+            **_note(),
+        },
+    }))
+
+
 def bench_ps():
     """PS-tier wire benchmark: push_pull goodput through the real native
     KV server over loopback TCP.
@@ -867,6 +924,8 @@ def main():
         bench_machinery()
     elif os.environ.get("BENCH_PS", "0") == "1":
         bench_ps()           # host-only: no device backend involved
+    elif os.environ.get("BENCH_FUSION", "0") == "1":
+        bench_fusion()       # host-only: no device backend involved
     elif os.environ.get("BENCH_CNN", ""):
         # Validate the name BEFORE the (possibly minutes-long) backend
         # probe so a typo still honors the one-JSON-line contract.

@@ -18,7 +18,8 @@ from .common.api import (
     declare, declared_key, register_compressor, get_ps_session,
     push_pull, push_pull_async, push_pull_tree, synchronize, poll,
     broadcast_parameters, broadcast_optimizer_state,
-    get_pushpull_speed, get_codec_stats, mark_step, current_step,
+    get_pushpull_speed, get_codec_stats, get_fusion_stats,
+    mark_step, current_step,
 )
 from .parallel.async_ps import AsyncPSTrainer
 from .ops.compression import Compression
@@ -58,7 +59,8 @@ __all__ = [
     "push_pull", "push_pull_async", "push_pull_tree", "synchronize",
     "poll", "AsyncPSTrainer",
     "broadcast_parameters", "broadcast_optimizer_state",
-    "get_pushpull_speed", "get_codec_stats", "mark_step", "current_step",
+    "get_pushpull_speed", "get_codec_stats", "get_fusion_stats",
+    "mark_step", "current_step",
     "Compression", "collectives",
     "DistributedOptimizer", "DistributedGradientTransformation",
     "distributed_gradient_transform", "build_train_step",

@@ -316,34 +316,50 @@ def push_pull(tensor: jax.Array, name: Optional[str] = None,
 
 def push_pull_tree(tree: PyTree, name: Optional[str] = None,
                    average: bool = True, compression=None,
-                   leaf_names=None) -> PyTree:
-    """Sum/average EVERY leaf of a pytree across workers in one batched
-    collective — a single host crossing and a single wire transfer.
+                   leaf_names=None, fusion_bytes: Optional[int] = None
+                   ) -> PyTree:
+    """Sum/average EVERY leaf of a pytree across workers.
 
     The eager plugins' gradient lists ride this (reference analog: DDP
     gradient batching, torch/parallel/distributed.py:235-243; per-tensor
-    eager push_pull pays one crossing per gradient).  Floating leaves are
-    flattened into one f32 vector, reduced through push_pull (so PS
-    partitioning, compression, telemetry, and tracing all apply), then
-    split back to the original shapes/dtypes.
+    eager push_pull pays one crossing per gradient).
 
-    Two classes of leaves are deliberately NOT batched:
+    With fusion enabled (``BYTEPS_TPU_FUSION_BYTES`` > 0, the default
+    1 MiB; the ``fusion_bytes`` argument overrides per call), leaves
+    below the threshold are packed by the fusion planner
+    (common/fusion.py) into dtype-homogeneous, size-capped buckets in
+    reverse backprop order; each bucket rides ONE wire key at the max
+    priority of its members, and larger leaves keep their own key and
+    backprop-position priority — so the PS dispatcher sends last-layer
+    buckets first while earlier buckets still stage (the overlap the
+    priority ScheduledQueues exist for), instead of one all-or-nothing
+    f32 vector that can't overlap with anything.
+
+    With fusion DISABLED (``BYTEPS_TPU_FUSION_BYTES=0``), floating
+    leaves are flattened into one f32 vector reduced through a single
+    push_pull — byte-identical to the pre-fusion wire path.
+
+    Two classes of leaves are deliberately never fused/batched:
       - non-floating leaves (ints, bools): an f32 round-trip corrupts
         values above 2^24 and truncates averages — they ride individual
         exact push_pulls;
       - leaves whose `leaf_names[i]` has a PS wire compressor registered
-        (register_compressor): folding them into the batch key would
+        (register_compressor): folding them into a shared key would
         silently drop the user's compression config — they keep their own
         named push_pull so the compressed wire still applies.
     `leaf_names` aligns with the FLATTENED leaf order (for a dict tree:
-    sorted keys).
+    sorted keys).  Unnamed leaves get deterministic names derived from
+    the batch name + the leaf's TREE PATH (stable under structural
+    growth elsewhere in the tree, unlike a flat index).
     """
     _require_init()
-    leaves, treedef = jax.tree.flatten(tree)
-    if not leaves:
+    paths_leaves, treedef = jax.tree_util.tree_flatten_with_path(tree)
+    if not paths_leaves:
         return tree
-    leaves = [jnp.asarray(l) for l in leaves]
+    leaves = [jnp.asarray(l) for _, l in paths_leaves]
     metas = [(l.shape, l.dtype, int(l.size)) for l in leaves]
+    cfg = _state.config or get_config()
+    fb = cfg.fusion_bytes if fusion_bytes is None else int(fusion_bytes)
 
     compressed_keys = (set(_state.ps_session._compressors)
                        if _state.ps_session is not None else set())
@@ -370,20 +386,31 @@ def push_pull_tree(tree: PyTree, name: Optional[str] = None,
             .encode()).hexdigest()[:12]
         name = f"byteps_tpu.tree.{sig}"
 
+    def leaf_name(i: int) -> str:
+        # Deterministic per-leaf name: explicit, or batch name + TREE PATH
+        # — an unnamed push would auto-declare a FRESH key on every call
+        # and grow the registry unboundedly, and an index-derived name
+        # would re-key every separated leaf whenever the tree gains or
+        # loses an unrelated leaf.
+        if leaf_names is not None:
+            return str(leaf_names[i])
+        return f"{name}{jax.tree_util.keystr(paths_leaves[i][0])}"
+
+    if fb > 0 and len(batch_idx) > 1:
+        outs = _fused_tree_push_pull(
+            name, leaves, metas, sep_idx, batch_idx, leaf_name,
+            average, compression, fb)
+        return jax.tree.unflatten(treedef, outs)
+
     outs: list = [None] * len(leaves)
     for i in sep_idx:
-        # Stable per-leaf name (explicit, or derived from the batch name +
-        # leaf index) — an unnamed push would auto-declare a FRESH key on
-        # every call and grow the registry unboundedly.
-        nm = (str(leaf_names[i]) if leaf_names is not None
-              else f"{name}.leaf{i}")
         # Non-float leaves are separated precisely for exactness: a lossy
         # intra-node cast (fp16) would corrupt them worse than the f32
         # batch they were pulled out of.
         comp = (compression
                 if jnp.issubdtype(metas[i][1], jnp.floating) else None)
         outs[i] = jnp.asarray(
-            push_pull(leaves[i], name=nm, average=average,
+            push_pull(leaves[i], name=leaf_name(i), average=average,
                       compression=comp)).astype(metas[i][1])
     if batch_idx:
         flat = (jnp.concatenate([leaves[i].ravel().astype(jnp.float32)
@@ -398,6 +425,89 @@ def push_pull_tree(tree: PyTree, name: Optional[str] = None,
             outs[i] = out[o:o + n].reshape(shp).astype(dt)
             o += n
     return jax.tree.unflatten(treedef, outs)
+
+
+def _fused_tree_push_pull(name, leaves, metas, sep_idx, batch_idx,
+                          leaf_name, average, compression, fb) -> list:
+    """Dispatch a tree through the fusion planner.
+
+    Builds dtype-homogeneous buckets over the fusable leaves, then sends
+    every dispatch unit (bucket, over-threshold solo leaf, forced-solo
+    exact/compressed leaf) in priority-descending order.  In PS mode the
+    whole set rides PSSession.push_pull_group, so the scheduler sees all
+    units before the first dispatch; in collective mode the units are
+    issued as concurrent async push_pulls and synchronized together.
+    """
+    from .fusion import plan_buckets
+
+    plan = plan_buckets(
+        tuple((i, metas[i][2], str(metas[i][1]),
+               jnp.dtype(metas[i][1]).itemsize) for i in batch_idx), fb)
+    plan.record_use()
+
+    # Dispatch units: (unit_name, payload, priority, compression, scatter)
+    # where scatter = [(leaf_idx, num_elems), ...] in pack order.
+    units = []
+    for b in plan.buckets:
+        members = [(li, n) for li, n in b.members]
+        packed = (jnp.concatenate([leaves[li].ravel() for li, _ in members])
+                  if len(members) > 1 else leaves[members[0][0]].ravel())
+        units.append((f"{name}.{b.tag}", packed, b.priority, compression,
+                      members))
+    for li, prio in plan.solo:
+        units.append((leaf_name(li), leaves[li].ravel(), prio, compression,
+                      [(li, metas[li][2])]))
+    for i in sep_idx:
+        # Forced-solo leaves (non-float exactness, registered wire
+        # compressors) join the same priority-ordered dispatch, minus any
+        # lossy intra-node cast for non-floats.  Raveled like every other
+        # unit: scatter() below slices elements, and a 0-d payload would
+        # not even be sliceable.
+        comp = (compression
+                if jnp.issubdtype(metas[i][1], jnp.floating) else None)
+        units.append((leaf_name(i), leaves[i].ravel(), i, comp,
+                      [(i, metas[i][2])]))
+    units.sort(key=lambda u: -u[2])
+
+    outs: list = [None] * len(leaves)
+
+    def scatter(members, vec) -> None:
+        off = 0
+        for li, n in members:
+            shp, dt, _ = metas[li]
+            outs[li] = jnp.asarray(vec[off:off + n]).reshape(shp).astype(dt)
+            off += n
+
+    sess = _state.ps_session
+    if sess is not None:
+        from ..ops.compression import Compression
+        items, ctxs = [], []
+        for nm, payload, prio, comp, _ in units:
+            _debug_sample("push", nm, payload)
+            comp = comp or Compression.none
+            wire, ctx = comp.compress(payload)
+            items.append((declare(nm), wire, prio))
+            ctxs.append((comp, ctx))
+        handles = sess.push_pull_group(items)
+        for (nm, _, _, _, members), h, (comp, ctx) in zip(
+                units, handles, ctxs):
+            out = comp.decompress(jnp.asarray(h.wait()), ctx)
+            if average:
+                out = out / size()
+            scatter(members, out)
+            _debug_sample("pull", nm, out)
+        cfg = _state.config or get_config()
+        if cfg.telemetry_on:
+            get_core().telemetry_record(
+                sum(int(p.size * p.dtype.itemsize)
+                    for _, p, _, _, _ in units))
+    else:
+        handles = [push_pull_async(payload, name=nm, average=average,
+                                   priority=prio, compression=comp)
+                   for nm, payload, prio, comp, _ in units]
+        for (nm, _, _, _, members), h in zip(units, handles):
+            scatter(members, jnp.asarray(synchronize(h)))
+    return outs
 
 
 def _debug_sample(stage: str, name: str, tensor) -> None:
@@ -554,6 +664,21 @@ def get_codec_stats() -> Dict[str, int]:
         return _state.ps_session.codec_stats()
     from ..server.codec_pool import CompressionPool
     return dict(CompressionPool.ZERO_STATS)
+
+
+def get_fusion_stats() -> Dict[str, int]:
+    """Counters from the fusion-bucket layer (BYTEPS_TPU_FUSION_BYTES):
+    buckets built, leaves fused vs solo, payload bytes per class, wire
+    message chains saved, and streaming-flush causes (size-cap vs
+    FLUSH_MS deadline vs explicit flush()/close() drain), plus the
+    in-graph collective plane's plan counts.  The get_codec_stats()
+    analog for fusion.  The wire-plane counters are all-zero with fusion
+    disabled; `ingraph_plans`/`ingraph_buckets` track the collective
+    plane's BucketPlan activity regardless (that plane packs at
+    BYTEPS_PARTITION_BYTES and is not gated by the fusion knob).  Used by
+    tools/wire_bench.py to prove where small tensors actually rode."""
+    from .fusion import get_stats
+    return get_stats()
 
 
 def timeline_start_step() -> int:

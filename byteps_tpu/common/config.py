@@ -68,6 +68,16 @@ class Config:
     # the caller/receiver threads.
     compress_threads: int = 2                # BYTEPS_TPU_COMPRESS_THREADS
     scheduling_credit: int = 0               # BYTEPS_SCHEDULING_CREDIT (0 = off)
+    # Fusion-bucket layer (common/fusion.py): leaves below this size are
+    # packed into dtype-homogeneous, size-capped buckets in reverse
+    # backprop order, so each bucket rides one wire key at the max member
+    # priority.  0 disables fusion, restoring per-leaf / whole-tree
+    # behavior byte-for-byte.
+    fusion_bytes: int = 1024 * 1024          # BYTEPS_TPU_FUSION_BYTES
+    # Deadline (ms) after which a streaming FusionBuffer flushes a
+    # not-yet-full bucket, so straggler leaves never wait on members that
+    # aren't coming.  0 = flush only when full / at end of pass.
+    fusion_flush_ms: float = 5.0             # BYTEPS_TPU_FUSION_FLUSH_MS
     server_engine_threads: int = 4           # BYTEPS_SERVER_ENGINE_THREAD
     server_enable_schedule: bool = False     # BYTEPS_SERVER_ENABLE_SCHEDULE
     enable_async: bool = False               # BYTEPS_ENABLE_ASYNC
@@ -121,6 +131,9 @@ class Config:
             wire_conns=_env_int("BYTEPS_TPU_WIRE_CONNS", 2),
             compress_threads=_env_int("BYTEPS_TPU_COMPRESS_THREADS", 2),
             scheduling_credit=_env_int("BYTEPS_SCHEDULING_CREDIT", 0),
+            fusion_bytes=_env_int("BYTEPS_TPU_FUSION_BYTES", 1024 * 1024),
+            fusion_flush_ms=float(
+                os.environ.get("BYTEPS_TPU_FUSION_FLUSH_MS") or 5.0),
             server_engine_threads=_env_int("BYTEPS_SERVER_ENGINE_THREAD", 4),
             server_enable_schedule=_env_bool("BYTEPS_SERVER_ENABLE_SCHEDULE"),
             enable_async=_env_bool("BYTEPS_ENABLE_ASYNC"),

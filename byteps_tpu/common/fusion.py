@@ -1,0 +1,375 @@
+"""Fusion-bucket layer between the gradient tree and the wire.
+
+BytePS's priority scheduler only pays off when gradients hit the wire as
+backprop produces them, but per-key declare/push/ack overhead dominates
+once payloads shrink (hundreds of layernorm scales and biases per
+transformer), while the all-or-nothing whole-tree flatten fuses
+EVERYTHING into one f32 vector that can't overlap with backprop at all
+and upcasts every leaf.  This module is the middle ground (reference
+analog: the reference's tensor partitioning, operations.cc:140-180,
+generalised to many-small-tensors *packing*; DDP gradient bucketing,
+torch/parallel/distributed.py:235-243):
+
+  - leaves below ``BYTEPS_TPU_FUSION_BYTES`` are packed into
+    dtype-homogeneous, size-capped buckets assigned in **reverse
+    backprop order** (the tail of the flattened tree — produced first by
+    the backward pass — fills bucket 0);
+  - each bucket rides ONE wire key and inherits the max priority of its
+    members, so the priority-scheduled dispatcher (client.py) sends
+    last-layer buckets first while earlier layers are still being
+    produced — the overlap the ScheduledQueues exist for;
+  - leaves at/above the threshold keep their own key and their own
+    backprop-position priority (per-leaf overlap is already optimal for
+    them);
+  - bucket *names* are a pure function of the member composition, so the
+    same tree maps to the same declared keys on every worker, on every
+    call, and across the elastic re-declare/restart path
+    (common/api.py resume()).
+
+The same segment-packing algorithm also drives the in-graph collective
+plane (``ops.collectives.BucketPlan`` routes through
+:func:`plan_segments`), so bucket composition logic lives in exactly one
+place.  ``BYTEPS_TPU_FUSION_BYTES=0`` disables fusion everywhere it is
+consulted, restoring per-leaf / whole-tree behavior byte-for-byte.
+"""
+
+from __future__ import annotations
+
+import functools
+import hashlib
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+# ---------------------------------------------------------------------------
+# Counters (the get_codec_stats() analog for the fusion layer).
+# ---------------------------------------------------------------------------
+ZERO_STATS: Dict[str, int] = {
+    "plans_used": 0,            # fusion plans applied to a dispatch
+    "buckets_built": 0,         # fused buckets dispatched
+    "leaves_fused": 0,          # leaves that rode a fused bucket
+    "leaves_solo": 0,           # leaves >= threshold (own key, own priority)
+    "fused_bytes": 0,           # payload bytes that rode fused buckets
+    "solo_bytes": 0,            # payload bytes that rode solo keys
+    "wire_messages_saved": 0,   # per-leaf chains avoided: fused - buckets
+    "full_flushes": 0,          # streaming buckets closed by the size cap
+    "deadline_flushes": 0,      # streaming buckets closed by FLUSH_MS
+    "drain_flushes": 0,         # streaming buckets closed by flush()/close()
+    "ingraph_plans": 0,         # collective-plane BucketPlans built
+    "ingraph_buckets": 0,       # buckets in those plans
+}
+
+_stats = dict(ZERO_STATS)
+_stats_lock = threading.Lock()
+
+
+def _bump(**kw) -> None:
+    with _stats_lock:
+        for k, v in kw.items():
+            _stats[k] += v
+
+
+def get_stats() -> Dict[str, int]:
+    with _stats_lock:
+        return dict(_stats)
+
+
+def reset_stats() -> None:
+    with _stats_lock:
+        for k in _stats:
+            _stats[k] = 0
+
+
+# ---------------------------------------------------------------------------
+# The planner.
+# ---------------------------------------------------------------------------
+class Bucket:
+    """One fused dispatch unit: a dtype-homogeneous run of small leaves.
+
+    ``members`` is ``((leaf_idx, num_elems), ...)`` in pack order (reverse
+    backprop order: the member produced last in the forward pass — first
+    in backward — is packed first).  ``priority`` is the max member
+    priority, i.e. the backprop position of that first member.
+    """
+
+    __slots__ = ("index", "dtype", "members", "num_elems", "nbytes",
+                 "priority", "sig")
+
+    def __init__(self, index: int, dtype: str,
+                 members: Tuple[Tuple[int, int], ...], itemsize: int):
+        self.index = index
+        self.dtype = dtype
+        self.members = members
+        self.num_elems = sum(n for _, n in members)
+        self.nbytes = self.num_elems * itemsize
+        self.priority = max(li for li, _ in members)
+        self.sig = hashlib.md5(
+            "|".join(f"{li}:{n}" for li, n in members).encode()
+        ).hexdigest()[:8]
+
+    @property
+    def tag(self) -> str:
+        """Deterministic wire-name suffix: a pure function of the member
+        composition, so the bucket maps to the same declared key on every
+        worker/call and across the re-declare/restart path."""
+        return f"fb{self.index}.{self.dtype}x{self.num_elems}.{self.sig}"
+
+
+class FusionPlan:
+    """Static fused-dispatch plan for one leaf signature.
+
+    ``buckets`` are ordered by descending priority (the order they should
+    hit the wire); ``solo`` is ``((leaf_idx, priority), ...)`` for leaves
+    at/above the threshold, which keep their own key.
+    """
+
+    def __init__(self, buckets: Tuple[Bucket, ...],
+                 solo: Tuple[Tuple[int, int], ...], fusion_bytes: int,
+                 solo_bytes: int):
+        self.buckets = buckets
+        self.solo = solo
+        self.fusion_bytes = fusion_bytes
+        self.fused_bytes = sum(b.nbytes for b in buckets)
+        self.solo_bytes = solo_bytes
+        self.leaves_fused = sum(len(b.members) for b in buckets)
+
+    def record_use(self) -> None:
+        """Count one application of this plan (plans are cached; stats
+        track dispatches, not cache builds)."""
+        _bump(plans_used=1,
+              buckets_built=len(self.buckets),
+              leaves_fused=self.leaves_fused,
+              leaves_solo=len(self.solo),
+              fused_bytes=self.fused_bytes,
+              solo_bytes=self.solo_bytes,
+              wire_messages_saved=max(
+                  0, self.leaves_fused - len(self.buckets)))
+
+
+@functools.lru_cache(maxsize=256)
+def plan_buckets(items: Tuple[Tuple[int, int, str, int], ...],
+                 fusion_bytes: int,
+                 cap_bytes: Optional[int] = None) -> FusionPlan:
+    """Build (or fetch the cached) fusion plan for a leaf signature.
+
+    ``items``: ``((leaf_idx, num_elems, dtype_str, itemsize), ...)`` for
+    the fusable leaves, in FORWARD (declaration) order; ``leaf_idx`` is
+    the leaf's global backprop position and doubles as its priority (the
+    last leaf — first gradient out of backward — has the max priority).
+
+    Leaves with ``nbytes >= fusion_bytes`` go solo.  The rest pack into
+    per-dtype buckets capped at ``cap_bytes`` (default ``fusion_bytes``),
+    scanning in REVERSE order so bucket 0 holds the latest leaves and
+    carries the highest priority — buckets then dispatch in
+    priority-descending order, preserving backprop overlap.
+    """
+    cap = cap_bytes or fusion_bytes
+    solo: List[Tuple[int, int]] = []
+    solo_bytes = 0
+    open_members: Dict[str, List[Tuple[int, int]]] = {}
+    open_bytes: Dict[str, int] = {}
+    open_itemsize: Dict[str, int] = {}
+    buckets: List[Bucket] = []
+
+    def close(dtype: str) -> None:
+        buckets.append(Bucket(len(buckets), dtype,
+                              tuple(open_members.pop(dtype)),
+                              open_itemsize[dtype]))
+        open_bytes.pop(dtype)
+
+    for li, n, dtype, itemsize in reversed(items):
+        nbytes = n * itemsize
+        if fusion_bytes <= 0 or nbytes >= fusion_bytes:
+            solo.append((li, li))
+            solo_bytes += nbytes
+            continue
+        if dtype in open_members and open_bytes[dtype] + nbytes > cap:
+            close(dtype)
+        open_members.setdefault(dtype, []).append((li, n))
+        open_bytes[dtype] = open_bytes.get(dtype, 0) + nbytes
+        open_itemsize[dtype] = itemsize
+    # Flush remainder buckets in the deterministic order they were opened
+    # (sorted by the max member priority, which is descending already for
+    # a single dtype; across dtypes, sort to keep the contract explicit).
+    for dtype in sorted(open_members,
+                        key=lambda d: -max(li for li, _ in open_members[d])):
+        close(dtype)
+    buckets.sort(key=lambda b: -b.priority)
+    for i, b in enumerate(buckets):
+        # Re-index after the sort so bucket indices follow dispatch order;
+        # composition (members/sig) is untouched, so names stay stable.
+        b.index = i
+    solo.sort(key=lambda s: -s[1])
+    return FusionPlan(tuple(buckets), tuple(solo), fusion_bytes, solo_bytes)
+
+
+def plan_segments(sizes: Sequence[int], capacity_elems: int,
+                  reverse: bool = True) -> List[List[Tuple[int, int, int]]]:
+    """Segment-packing used by the in-graph collective plane: split/pack
+    leaves into buckets of ``capacity_elems``, spilling large leaves
+    across buckets.  Each bucket is ``[(leaf_idx, start, length), ...]``.
+
+    This is the whole-tree packing the XLA plane wants (slicing is free
+    in-graph, and the psum dtype is uniform there); the wire plane uses
+    :func:`plan_buckets`, which never splits a leaf — a solo leaf rides
+    the session's own partitioner instead.
+    """
+    order = list(range(len(sizes)))
+    if reverse:
+        order.reverse()
+    buckets: List[List[Tuple[int, int, int]]] = []
+    cur: List[Tuple[int, int, int]] = []
+    cur_n = 0
+    for li in order:
+        remaining = sizes[li]
+        start = 0
+        while remaining > 0:
+            take = min(remaining, capacity_elems - cur_n)
+            cur.append((li, start, take))
+            start += take
+            remaining -= take
+            cur_n += take
+            if cur_n >= capacity_elems:
+                buckets.append(cur)
+                cur, cur_n = [], 0
+    if cur:
+        buckets.append(cur)
+    _bump(ingraph_plans=1, ingraph_buckets=len(buckets))
+    return buckets
+
+
+# ---------------------------------------------------------------------------
+# Streaming face: incremental producers (backward hooks, callback-driven
+# plugins) that see one gradient at a time.
+# ---------------------------------------------------------------------------
+class FusionBuffer:
+    """Streaming fusion accumulator with a deadline flush.
+
+    Incremental gradient producers (the torch/tf eager plugins' backward
+    hooks) can't hand the planner a whole tree; they ``add()`` leaves as
+    backprop emits them.  Small leaves accumulate into per-dtype open
+    buckets that flush when full (``fusion_bytes``) — and, crucially,
+    after ``flush_ms`` milliseconds even when NOT full, so a straggler
+    tail (the front layers' last few biases) never sits in a half-empty
+    bucket waiting for members that aren't coming
+    (``BYTEPS_TPU_FUSION_FLUSH_MS``).
+
+    ``dispatch(packed, members, priority)`` receives the concatenated
+    flat numpy payload, ``[(name, shape, num_elems), ...]`` scatter
+    metadata, and the bucket priority (max member priority).  Leaves at/
+    above the threshold dispatch immediately on their own.
+    """
+
+    def __init__(self, dispatch: Callable[[Any, list, int], None],
+                 fusion_bytes: Optional[int] = None,
+                 flush_ms: Optional[float] = None):
+        import numpy as np
+        from .config import get_config
+        cfg = get_config()
+        self._np = np
+        self.dispatch = dispatch
+        self.fusion_bytes = (cfg.fusion_bytes if fusion_bytes is None
+                             else int(fusion_bytes))
+        self.flush_ms = (cfg.fusion_flush_ms if flush_ms is None
+                         else float(flush_ms))
+        # dtype -> [(name, flat, orig_shape, priority)]
+        self._open: Dict[str, list] = {}
+        self._open_bytes: Dict[str, int] = {}
+        self._opened_at: Dict[str, float] = {}
+        self._cv = threading.Condition()
+        self._closed = False
+        self._flusher = None
+        if self.flush_ms > 0 and self.fusion_bytes > 0:
+            self._flusher = threading.Thread(
+                target=self._deadline_loop, daemon=True,
+                name="bps-fusion-flush")
+            self._flusher.start()
+
+    def add(self, name: str, array, priority: int = 0) -> None:
+        np = self._np
+        arr = np.asarray(array)
+        flat = arr.ravel()
+        if self.fusion_bytes <= 0 or flat.nbytes >= self.fusion_bytes:
+            _bump(leaves_solo=1, solo_bytes=int(flat.nbytes))
+            self.dispatch(flat, [(name, arr.shape, flat.size)], priority)
+            return
+        dtype = str(flat.dtype)
+        flushed = None
+        with self._cv:
+            if self._closed:
+                raise RuntimeError("FusionBuffer is closed")
+            if (dtype in self._open
+                    and self._open_bytes[dtype] + flat.nbytes
+                    > self.fusion_bytes):
+                flushed = self._take_locked(dtype, "full_flushes")
+            if dtype not in self._open:
+                self._open[dtype] = []
+                self._open_bytes[dtype] = 0
+                self._opened_at[dtype] = time.monotonic()
+                self._cv.notify_all()     # wake the deadline flusher
+            self._open[dtype].append((name, flat, arr.shape, priority))
+            self._open_bytes[dtype] += flat.nbytes
+        if flushed is not None:
+            self.dispatch(*flushed)
+
+    def _take_locked(self, dtype: str, counter: str) -> tuple:
+        """Pop one open bucket and build its dispatch payload.  Caller
+        MUST invoke self.dispatch(*result) AFTER releasing the lock — a
+        dispatch callback can block on the wire (or the sequential-use
+        guard) for seconds, and holding _cv through that would stall
+        every concurrent add() and the deadline flusher."""
+        members = self._open.pop(dtype)
+        self._open_bytes.pop(dtype)
+        self._opened_at.pop(dtype)
+        np = self._np
+        flats = [f for _, f, _, _ in members]
+        packed = np.concatenate(flats) if len(flats) > 1 else flats[0]
+        meta = [(nm, shape, f.size) for nm, f, shape, _ in members]
+        prio = max(p for _, _, _, p in members)
+        _bump(buckets_built=1, leaves_fused=len(members),
+              fused_bytes=int(packed.nbytes),
+              wire_messages_saved=len(members) - 1, **{counter: 1})
+        return packed, meta, prio
+
+    def flush(self) -> None:
+        """Flush every open bucket now (end of the backward pass)."""
+        with self._cv:
+            flushed = [self._take_locked(d, "drain_flushes")
+                       for d in list(self._open)]
+        for f in flushed:
+            self.dispatch(*f)
+
+    def _deadline_loop(self) -> None:
+        while True:
+            flushed = []
+            with self._cv:
+                while not self._closed and not self._opened_at:
+                    self._cv.wait()
+                if self._closed:
+                    return
+                now = time.monotonic()
+                deadline = min(self._opened_at.values()) \
+                    + self.flush_ms / 1e3
+                if now < deadline:
+                    self._cv.wait(timeout=deadline - now)
+                    continue
+                for dtype in [d for d, t in list(self._opened_at.items())
+                              if now >= t + self.flush_ms / 1e3]:
+                    flushed.append(
+                        self._take_locked(dtype, "deadline_flushes"))
+            for f in flushed:
+                self.dispatch(*f)
+
+    def close(self) -> None:
+        with self._cv:
+            if self._closed:
+                flushed = []
+            else:
+                flushed = [self._take_locked(d, "drain_flushes")
+                           for d in list(self._open)]
+                self._closed = True
+                self._cv.notify_all()
+        for f in flushed:
+            self.dispatch(*f)
+        if self._flusher is not None:
+            self._flusher.join(timeout=5)

@@ -106,29 +106,15 @@ class BucketPlan:
         # Leaf order is declaration order. The backward pass produces
         # gradients roughly in reverse declaration order, so communicating
         # buckets from the tail end first overlaps best — this is the
-        # reference's priority = -declared_key in bucket form.
+        # reference's priority = -declared_key in bucket form.  The
+        # segment packing itself lives in the shared fusion planner
+        # (common/fusion.py plan_segments), so the in-graph and PS-wire
+        # planes agree on one bucket-composition algorithm.
+        from ..common.fusion import plan_segments
         part_elems = max(1, partition_bytes // max(1, itemsize))
-        order = list(range(len(sizes)))
-        if reverse:
-            order.reverse()
         # Each bucket is a list of (leaf_idx, start, length) segments.
-        self.buckets: List[List[Tuple[int, int, int]]] = []
-        cur: List[Tuple[int, int, int]] = []
-        cur_n = 0
-        for li in order:
-            remaining = sizes[li]
-            start = 0
-            while remaining > 0:
-                take = min(remaining, part_elems - cur_n)
-                cur.append((li, start, take))
-                start += take
-                remaining -= take
-                cur_n += take
-                if cur_n >= part_elems:
-                    self.buckets.append(cur)
-                    cur, cur_n = [], 0
-        if cur:
-            self.buckets.append(cur)
+        self.buckets: List[List[Tuple[int, int, int]]] = plan_segments(
+            sizes, part_elems, reverse)
         self.sizes = list(sizes)
 
     def num_buckets(self) -> int:

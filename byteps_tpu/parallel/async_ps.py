@@ -14,6 +14,16 @@ TPU-native shape: the functional equivalent of the reference's in-place
 the param pytree once, tracks the last adopted global weights, and exposes
 one `step(updated_params)` call.
 
+Wire layout: with fusion enabled (BYTEPS_TPU_FUSION_BYTES > 0, the
+default) the delta no longer rides one monolithic key — the fusion
+planner (common/fusion.py) packs small param leaves into size-capped
+buckets in reverse backprop order and leaves large params on their own
+keys, each dispatched at its backprop-position priority through
+PSSession.push_pull_group.  Last-layer buckets hit the wire first and the
+session can overlap their round-trips instead of serializing one giant
+transfer; BYTEPS_TPU_FUSION_BYTES=0 (or a session without
+push_pull_group) restores the single flat vector.
+
 Pipelining: by default the trainer double-buffers — `step()` dispatches the
 new delta and waits only for the *previous* round, never its own, so each
 round's network round-trip overlaps the local compute of the NEXT step
@@ -53,7 +63,8 @@ class AsyncPSTrainer:
     """
 
     def __init__(self, session, params: PyTree, name: str = "async_param",
-                 declared_key: Optional[int] = None, pipeline: bool = True):
+                 declared_key: Optional[int] = None, pipeline: bool = True,
+                 fusion_bytes: Optional[int] = None):
         import jax
 
         if getattr(session, "server_async", True) is False:
@@ -72,6 +83,7 @@ class AsyncPSTrainer:
             from ..core.native import get_core
             declared_key = get_core().declare_tensor(f"AsyncParam.{name}")
         self._key = declared_key
+        self._chunks = self._plan_chunks(name, fusion_bytes)
         self._flat = self._flatten(params)
         # Outstanding round: (handle, in-flight movement) — at most one.
         self._pending = None
@@ -81,8 +93,63 @@ class AsyncPSTrainer:
         # instead of resetting them (the analog of the reference's init
         # push populating the store before deltas flow,
         # reference: operations.cc:369-378).
-        h = session.push_pull_async(self._key, self._flat, seed=True)
-        self._flat = h.wait().astype(np.float32)
+        self._flat = self._dispatch(self._flat, seed=True).wait() \
+            .astype(np.float32)
+
+    def _plan_chunks(self, name: str, fusion_bytes: Optional[int]):
+        """[(declared_key, flat_ranges, priority)] in priority-descending
+        dispatch order, or None for the single-key layout.
+
+        Routes the flat f32 param vector through the fusion planner:
+        small leaves pack into buckets (reverse backprop order, bucket
+        priority = max member position), large leaves go solo at their
+        own position.  Chunk keys are derived from the deterministic
+        bucket tags, so every worker — and a restarted worker after
+        re-declare — maps the same params to the same wire keys.
+        """
+        from ..common import fusion
+        from ..common.config import get_config
+        from ..core.native import get_core
+
+        fb = (get_config().fusion_bytes if fusion_bytes is None
+              else int(fusion_bytes))
+        if fb <= 0 or len(self._sizes) < 2 \
+                or not hasattr(self._session, "push_pull_group"):
+            return None
+        plan = fusion.plan_buckets(
+            tuple((i, n, "float32", 4) for i, n in enumerate(self._sizes)),
+            fb)
+        plan.record_use()
+        offs = np.concatenate([[0], np.cumsum(self._sizes)]).astype(np.int64)
+        core = get_core()
+        # Chunk names incorporate the trainer's resolved key so trainers
+        # kept distinct by an explicit declared_key (same `name`) stay
+        # distinct on the wire, exactly as their single-key layouts would.
+        base = f"AsyncParam.{name}.k{self._key}"
+        chunks = []
+        for b in plan.buckets:
+            ranges = [(int(offs[li]), int(offs[li]) + n)
+                      for li, n in b.members]
+            chunks.append((core.declare_tensor(f"{base}.{b.tag}"),
+                           ranges, b.priority))
+        for li, prio in plan.solo:
+            chunks.append((
+                core.declare_tensor(f"{base}.leaf{li}"),
+                [(int(offs[li]), int(offs[li + 1]))], prio))
+        if len(chunks) < 2:
+            return None
+        chunks.sort(key=lambda c: -c[2])
+        return chunks
+
+    def _dispatch(self, flat: np.ndarray, seed: bool = False):
+        """Push one round's flat payload; returns an object whose
+        .wait(timeout) yields the assembled global flat vector."""
+        if self._chunks is None:
+            return self._session.push_pull_async(self._key, flat, seed=seed)
+        items = [(key, _gather(flat, ranges), prio)
+                 for key, ranges, prio in self._chunks]
+        handles = self._session.push_pull_group(items, seed=seed)
+        return _GroupRoundHandle(handles, self._chunks, len(flat))
 
     def _flatten(self, params: PyTree) -> np.ndarray:
         import jax
@@ -121,7 +188,7 @@ class AsyncPSTrainer:
         """
         new_flat = self._flatten(updated_params)
         delta = new_flat - self._flat
-        handle = self._session.push_pull_async(self._key, delta)
+        handle = self._dispatch(delta)
         if not self._pipeline:
             self._flat = handle.wait().astype(np.float32)
             return self.params
@@ -143,3 +210,43 @@ class AsyncPSTrainer:
             self._pending = None
             self._flat = handle.wait(timeout).astype(np.float32)
         return self.params
+
+
+def _gather(flat: np.ndarray, ranges) -> np.ndarray:
+    """Concatenate the flat-vector slices a chunk covers (a zero-copy view
+    for the common single-run case)."""
+    if len(ranges) == 1:
+        a, b = ranges[0]
+        return flat[a:b]
+    return np.concatenate([flat[a:b] for a, b in ranges])
+
+
+class _GroupRoundHandle:
+    """Completion handle over one round's chunked dispatch: waits every
+    chunk and scatters the pulled global values back into one flat f32
+    vector (the single-key handle's .wait() contract)."""
+
+    def __init__(self, handles, chunks, n: int):
+        self._handles = handles
+        self._chunks = chunks
+        self._n = n
+
+    def done(self) -> bool:
+        return all(h.done() for h in self._handles)
+
+    def wait(self, timeout: Optional[float] = 300.0) -> np.ndarray:
+        import time
+        # One deadline for the WHOLE round (the single-key contract), not
+        # per chunk — num_chunks x timeout against a hung server would
+        # stretch a 30s budget into minutes.
+        deadline = None if timeout is None else time.monotonic() + timeout
+        out = np.empty(self._n, np.float32)
+        for h, (_key, ranges, _prio) in zip(self._handles, self._chunks):
+            left = (None if deadline is None
+                    else max(0.001, deadline - time.monotonic()))
+            got = np.asarray(h.wait(left), np.float32).ravel()
+            off = 0
+            for a, b in ranges:
+                out[a:b] = got[off:off + (b - a)]
+                off += b - a
+        return out

@@ -10,6 +10,13 @@ mesh is two-level), then applies the inner transform.  Everything is traced
 under jit — XLA overlaps the bucket collectives with backward compute, which
 is the cross-barrier effect the reference builds by hand with threads + locks
 (reference: torch/cross_barrier.py).
+
+Bucket composition routes through the shared fusion planner
+(common/fusion.py, via ops.collectives.BucketPlan): the in-graph plane and
+the PS wire plane (push_pull_tree / AsyncPSTrainer) pack small leaves with
+the same reverse-backprop-order algorithm, so a model's overlap behavior is
+the same story on both planes and `bps.get_fusion_stats()` sees plan
+activity from either.
 """
 
 from __future__ import annotations

@@ -753,6 +753,60 @@ class PSSession:
         never been pushed — idempotent initial-weight seeding that cannot
         reset a live run when a worker joins late or rejoins.
         """
+        handle, parts = self._stage(declared_key, tensor, priority, raw,
+                                    seed, copy)
+        self._enqueue([(parts, priority)])
+        return handle
+
+    def push_pull_group(self, items, raw: bool = False, seed: bool = False,
+                        copy: bool = False) -> List[PSHandle]:
+        """Grouped staging: stage EVERY (declared_key, tensor, priority)
+        item, then enqueue them all under one dispatcher wakeup.
+
+        This is the fusion layer's dispatch face (common/fusion.py): the
+        priority ScheduledQueue sees the whole bucket set before the
+        dispatcher picks, so buckets leave in strict (priority desc, key
+        asc) order even without a credit limit slowing the first pick —
+        and N buckets cost one lock round-trip instead of N.  Each item
+        follows the same zero-copy contract as push_pull_async.
+        """
+        staged: List[tuple] = []
+        handles: List[PSHandle] = []
+        seen: set = set()
+        try:
+            for declared_key, tensor, priority in items:
+                if declared_key in seen:
+                    # A repeated key inside one group would deadlock: its
+                    # _stage blocks on the earlier round's completion,
+                    # which can't happen until that round is enqueued.
+                    # Flush what's staged so the guard can make progress.
+                    self._enqueue(staged)
+                    staged, seen = [], set()
+                h, parts = self._stage(declared_key, tensor, priority, raw,
+                                       seed, copy)
+                handles.append(h)
+                staged.append((parts, priority))
+                seen.add(declared_key)
+        except Exception:
+            # The failing item rolled back its own parts in _stage; the
+            # EARLIER items are staged but will never be enqueued — unpin
+            # them too, or their keys wedge every later push (the
+            # sequential-use guard would wait on done_evts nothing sets).
+            with self._inflight_lock:
+                for parts, _ in staged:
+                    for p in parts:
+                        if self._inflight.get(p.pkey) is p:
+                            del self._inflight[p.pkey]
+                        p.done_evt.set()
+            raise
+        self._enqueue(staged)
+        return handles
+
+    def _stage(self, declared_key: int, tensor, priority: int, raw: bool,
+               seed: bool, copy: bool) -> tuple:
+        """Partition + stage one tensor into _inflight (INITs included)
+        WITHOUT enqueueing — the caller batches the queue adds so grouped
+        pushes enter the scheduler atomically."""
         arr = np.asarray(tensor)
         payload = np.ascontiguousarray(arr, dtype=np.float32).ravel()
         if copy and np.may_share_memory(payload, arr):
@@ -785,19 +839,24 @@ class PSSession:
                         del self._inflight[p.pkey]
                     p.done_evt.set()
             raise
+        return handle, parts
+
+    def _enqueue(self, staged) -> None:
+        """Enqueue staged partitions ([(parts, priority), ...]) into the
+        scheduler under ONE condition-variable hold."""
         core = get_core()
         enq = core.trace_now_us() if core.trace_on else 0
         with self._cv:
-            for p in parts:
-                p.enq_ts = enq
-                # credit_ln: actual wire bytes for ready parts; the
-                # codec's worst-case bound for pipelined encodes (their
-                # true size doesn't exist yet and p.wire_ln is racing the
-                # encoder).  The queue returns the same figure at get(),
-                # so report_finish stays symmetric either way.
-                self._queue.add(p.pkey, priority, p.credit_ln)
+            for parts, priority in staged:
+                for p in parts:
+                    p.enq_ts = enq
+                    # credit_ln: actual wire bytes for ready parts; the
+                    # codec's worst-case bound for pipelined encodes (their
+                    # true size doesn't exist yet and p.wire_ln is racing
+                    # the encoder).  The queue returns the same figure at
+                    # get(), so report_finish stays symmetric either way.
+                    self._queue.add(p.pkey, priority, p.credit_ln)
             self._cv.notify_all()
-        return handle
 
     def _label(self, declared_key: int) -> str:
         """Tensor name for trace rows (falls back to the numeric key for

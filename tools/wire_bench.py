@@ -1,7 +1,7 @@
 #!/usr/bin/env python
 """PS-wire codec microbenchmark.
 
-Two sections, both CPU-only (no JAX, no accelerator):
+Three sections, all CPU-only (no JAX, no accelerator):
 
   1. codec throughput — raw encode/decode MB/s and compression ratio per
      wire codec (`server/wire.py`, riding the C codec when built);
@@ -14,11 +14,18 @@ Two sections, both CPU-only (no JAX, no accelerator):
      overlap its own step compute (inline pays every partition's encode
      there; the pipeline hands it to pool threads and returns in ~ms).
      Full sync round-trips are reported alongside (see pipeline_ab's
-     docstring for the colocated-server caveat on small hosts).
+     docstring for the colocated-server caveat on small hosts);
+  3. fusion A/B — the many-small-tensors regime (hundreds of layernorm
+     scales / biases): per-leaf push_pull (one declare/push/ack chain per
+     leaf) vs the fusion-bucket layer (common/fusion.py packing small
+     leaves into ~BYTEPS_TPU_FUSION_BYTES buckets dispatched through
+     PSSession.push_pull_group in priority-descending order).  Reports
+     wire messages, caller-block time, and sync-round time per mode.
 
 Usage:
     python tools/wire_bench.py [--quick] [--json] [--threads N]
                                [--mb MB] [--part-kb KB] [--rounds R]
+                               [--fusion-only] [--fusion-leaves N]
 
 --json prints a machine-readable result document on stdout (progress
 lines go to stderr); tests/test_wire_bench.py runs `--quick --json` as
@@ -222,6 +229,136 @@ def pipeline_ab(nbytes: int, part_bytes: int, rounds: int,
         proc.wait()
 
 
+def fusion_ab(num_leaves: int, min_kb: int, max_kb: int, rounds: int,
+              fusion_bytes: int) -> dict:
+    """Many-small-tensors A/B: per-leaf push_pull vs fused buckets.
+
+    The regime the fusion layer exists for: `num_leaves` gradients of
+    min_kb-max_kb each (a transformer's layernorm scales and biases).
+    Unfused, every leaf pays its own declare/push/ack chain — per-message
+    overhead dominates at these sizes.  Fused, the planner packs them
+    into ~fusion_bytes buckets, each riding ONE partition key through
+    push_pull_group at the max member priority.
+
+    Reported per mode: wire messages per round (PUSH dispatches; PULLs
+    mirror them 1:1), caller-block wall time (issue-all duration — what
+    the training loop pays before it can overlap its own compute; the
+    fused figure honestly includes the bucket packing), and the full
+    sync round.  `priority_descending` asserts the fused dispatch order
+    the trace spans show: bucket 0 (last-layer grads) first.
+    """
+    from byteps_tpu.common import fusion
+
+    rng = np.random.RandomState(3)
+    sizes = [int(n) for n in rng.randint(
+        min_kb * 1024 // 4, max_kb * 1024 // 4 + 1, num_leaves)]
+    leaves = [rng.randn(n).astype(np.float32) for n in sizes]
+    total_mb = sum(sizes) * 4 / 1e6
+    proc, port = boot_server()
+    try:
+        res = {}
+        s = PSSession(["127.0.0.1"], [port], worker_id=0, num_servers=1)
+
+        # ---- unfused: one key chain per leaf, per-leaf priorities.
+        base = 1000
+        for i, l in enumerate(leaves):      # warm: INITs + first merge
+            s.push_pull(base + i, l, priority=i)
+        s.push_order = []
+        s.record_push_order = True
+        blocks, syncs = [], []
+        for _ in range(rounds):
+            t0 = time.perf_counter()
+            hs = [s.push_pull_async(base + i, leaves[i], priority=i)
+                  for i in range(num_leaves)]
+            t1 = time.perf_counter()
+            for h in hs:
+                h.wait()
+            blocks.append(t1 - t0)
+            syncs.append(time.perf_counter() - t0)
+        s.record_push_order = False
+        res["unfused"] = {
+            "wire_messages_per_round": len(s.push_order) // rounds,
+            "caller_block_best_s": round(min(blocks), 5),
+            "caller_block_median_s": round(statistics.median(blocks), 5),
+            "sync_round_best_s": round(min(syncs), 4),
+            "sync_round_median_s": round(statistics.median(syncs), 4),
+        }
+
+        # ---- fused: planner buckets through grouped staging.
+        plan = fusion.plan_buckets(
+            tuple((i, sizes[i], "float32", 4) for i in range(num_leaves)),
+            fusion_bytes)
+        bkey = {b.index: 2000 + b.index for b in plan.buckets}
+        prio_of_key = {bkey[b.index]: b.priority for b in plan.buckets}
+        solo_items = [(3000 + li, li) for li, _ in plan.solo]
+        prio_of_key.update({k: p for k, p in solo_items})
+
+        def build_items():
+            items = [(bkey[b.index],
+                      np.concatenate([leaves[li] for li, _ in b.members])
+                      if len(b.members) > 1 else leaves[b.members[0][0]],
+                      b.priority) for b in plan.buckets]
+            items += [(k, leaves[li], p)
+                      for (k, p), (li, _) in zip(solo_items, plan.solo)]
+            items.sort(key=lambda it: -it[2])
+            return items
+
+        for h in s.push_pull_group(build_items()):    # warm
+            h.wait()
+        s.push_order = []
+        s.record_push_order = True
+        blocks, syncs = [], []
+        for _ in range(rounds):
+            t0 = time.perf_counter()
+            hs = s.push_pull_group(build_items())
+            t1 = time.perf_counter()
+            for h in hs:
+                h.wait()
+            blocks.append(t1 - t0)
+            syncs.append(time.perf_counter() - t0)
+        s.record_push_order = False
+        first_round = s.push_order[:len(s.push_order) // rounds]
+        prios = [prio_of_key.get(pk >> 16, -1) for pk in first_round]
+        res["fused"] = {
+            "wire_messages_per_round": len(s.push_order) // rounds,
+            "caller_block_best_s": round(min(blocks), 5),
+            "caller_block_median_s": round(statistics.median(blocks), 5),
+            "sync_round_best_s": round(min(syncs), 4),
+            "sync_round_median_s": round(statistics.median(syncs), 4),
+            "buckets": len(plan.buckets),
+            "solo_leaves": len(plan.solo),
+        }
+        s.close()
+        uf, fu = res["unfused"], res["fused"]
+        for label, r in res.items():
+            _log(f"  {label:8s} msgs/round {r['wire_messages_per_round']:4d}"
+                 f"   caller-block best "
+                 f"{r['caller_block_best_s'] * 1e3:8.2f} ms   sync best "
+                 f"{r['sync_round_best_s'] * 1e3:8.2f} ms")
+        return {
+            "num_leaves": num_leaves,
+            "leaf_kb": [min_kb, max_kb],
+            "total_mb": round(total_mb, 2),
+            "fusion_bytes": fusion_bytes,
+            "rounds": rounds,
+            "wire_message_reduction": round(
+                uf["wire_messages_per_round"]
+                / max(1, fu["wire_messages_per_round"]), 2),
+            "caller_block_speedup": round(
+                uf["caller_block_best_s"]
+                / max(1e-9, fu["caller_block_best_s"]), 2),
+            "sync_round_speedup": round(
+                uf["sync_round_best_s"]
+                / max(1e-9, fu["sync_round_best_s"]), 2),
+            "priority_descending": all(
+                a >= b for a, b in zip(prios, prios[1:])),
+            **res,
+        }
+    finally:
+        proc.kill()
+        proc.wait()
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--quick", action="store_true",
@@ -236,6 +373,14 @@ def main(argv=None) -> int:
                     help="partition size in KB")
     ap.add_argument("--rounds", type=int, default=None,
                     help="timed push_pull rounds per mode")
+    ap.add_argument("--fusion-only", action="store_true",
+                    help="run only the many-small-tensors fusion A/B")
+    ap.add_argument("--no-fusion", action="store_true",
+                    help="skip the fusion A/B (codec/pipeline sections "
+                         "only, the pre-fusion bench surface)")
+    ap.add_argument("--fusion-leaves", type=int, default=None,
+                    help="leaf count for the fusion A/B (default 512, "
+                         "128 with --quick)")
     args = ap.parse_args(argv)
 
     quick = args.quick
@@ -244,6 +389,27 @@ def main(argv=None) -> int:
     mb = args.mb if args.mb is not None else (8.0 if quick else 32.0)
     part_kb = args.part_kb or (512 if quick else 1024)
     rounds = args.rounds or (9 if quick else 15)
+
+    # Many-small-tensors fusion A/B (the transformer layernorm/bias tail):
+    # 512 leaves of 4-64 KiB, fused at the 1 MiB default threshold.
+    fus = None
+    if not args.no_fusion:
+        fus_leaves = args.fusion_leaves or (128 if quick else 512)
+        fus_rounds = args.rounds or (5 if quick else 9)
+        _log(f"wire_bench: fusion A/B ({fus_leaves} leaves of 4-64 KiB, "
+             f"{fus_rounds} rounds)")
+        fus = fusion_ab(fus_leaves, 4, 64, fus_rounds, 1 << 20)
+        _log(f"  wire-message reduction "
+             f"{fus['wire_message_reduction']:.1f}x   caller-block speedup "
+             f"{fus['caller_block_speedup']:.1f}x   sync speedup "
+             f"{fus['sync_round_speedup']:.1f}x   "
+             f"priority_descending={fus['priority_descending']}")
+    if args.fusion_only:
+        doc = {"fusion": fus,
+               "config": {"quick": quick, "cpus": os.cpu_count()}}
+        if args.json:
+            print(json.dumps(doc, indent=1))
+        return 0
 
     _log(f"wire_bench: codec throughput ({n_codec} f32, {reps} reps)")
     codec = codec_throughput(n_codec, reps)
@@ -275,6 +441,7 @@ def main(argv=None) -> int:
 
     doc = {"codec": codec, "pipeline": pipeline,
            "pipeline_bidirectional": bidi,
+           **({"fusion": fus} if fus is not None else {}),
            "config": {"quick": quick, "threads": args.threads,
                       "cpus": os.cpu_count()}}
     if args.json:
