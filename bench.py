@@ -24,6 +24,8 @@ Modes:
   BENCH_PS=1         PS wire goodput through the real C++ server over
                      loopback TCP (reference analog: the ps-lite transport
                      benchmark in .travis.yml:29-34)
+  BENCH_FAULT=1      fault-tolerance bench: mid-round connection reset via
+                     tools/chaos_proxy.py; emits fault_reconnect_recovery_ms
   BENCH_FUSION=1     fusion-layer wire bench: many small tensors, per-leaf
                      vs fused-bucket dispatch through the real PS server
                      (emits fusion_small_tensor_caller_block)
@@ -534,6 +536,142 @@ def bench_fusion():
     }))
 
 
+def _boot_ps_server(engine_threads: int):
+    """Start the native PS server on a freshly-probed free port, retrying
+    on a new port if another process snatches it (bind/close-then-launch
+    is inherently TOCTOU on a busy host).  Returns (proc, port); shared by
+    the PS-tier benches (BENCH_PS / BENCH_FAULT)."""
+    import socket
+    import subprocess
+    import sys
+    import tempfile
+
+    from byteps_tpu.utils.hermetic import cpu_subprocess_env
+
+    for _ in range(4):
+        # The server binds root_port + 1 + server_id; only the data
+        # port is ever bound here (no scheduler process), so probe THAT
+        # one free and derive the root port from it.
+        with socket.socket() as sk:
+            sk.bind(("127.0.0.1", 0))
+            port = sk.getsockname()[1]      # the server's data port
+        env = cpu_subprocess_env({
+            "DMLC_PS_ROOT_PORT": str(port - 1),
+            "DMLC_NUM_WORKER": "1",
+            "BYTEPS_SERVER_ENGINE_THREAD": str(engine_threads),
+        })
+        errf = tempfile.TemporaryFile(mode="w+")
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "byteps_tpu.server"],
+            env=env, stdout=subprocess.DEVNULL, stderr=errf)
+        deadline = time.time() + 30
+        while True:
+            try:
+                socket.create_connection(
+                    ("127.0.0.1", port), 0.5).close()
+                return proc, port
+            except OSError:
+                if proc.poll() is not None:
+                    # Only an actual bind conflict is worth a retry on
+                    # a fresh port; any other startup death (import
+                    # error, missing native lib) must surface.
+                    errf.seek(0)
+                    stderr = errf.read()[-500:]
+                    errf.close()
+                    if "in use" not in stderr.lower():
+                        raise RuntimeError(
+                            f"PS server died at startup "
+                            f"(rc={proc.returncode}): {stderr}")
+                    break           # lost the port race — retry fresh
+                if time.time() > deadline:
+                    proc.kill()
+                    proc.wait()
+                    raise RuntimeError("PS server did not come up")
+                time.sleep(0.1)
+    raise RuntimeError("PS server lost the port race 4 times")
+
+
+def bench_fault():
+    """Fault-tolerance benchmark: wall-clock cost of a mid-round
+    connection reset through the chaos proxy (tools/chaos_proxy.py).
+
+    value = `fault_reconnect_recovery_ms`: the extra time a push_pull
+    round takes when its connection is RST mid-payload and the transport
+    must park, re-dial, re-handshake, and replay — versus a healthy round
+    (vs_baseline = faulted / healthy round time).  Measures the real
+    client + real C++ server + real backoff path, loopback TCP.
+    Host-only, like BENCH_PS.
+    """
+    import socket
+    import subprocess
+    import sys
+
+    import numpy as np
+
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "tools"))
+    from chaos_proxy import ChaosProxy
+
+    from byteps_tpu.server.client import PSSession
+    from byteps_tpu.utils.hermetic import cpu_subprocess_env
+
+
+    backoff_ms = float(os.environ.get("BENCH_FAULT_BACKOFF_MS", "20"))
+    reps = int(os.environ.get("BENCH_FAULT_REPS", "5"))
+    proc, port = _boot_ps_server(engine_threads=2)
+    proxy = ChaosProxy("127.0.0.1", port).start()
+    try:
+        sess = PSSession(["127.0.0.1"], [proxy.port], worker_id=0,
+                         num_servers=1, wire_conns=1,
+                         reconnect_attempts=8,
+                         reconnect_backoff_ms=backoff_ms)
+        x = np.random.default_rng(0).standard_normal(
+            1 << 20, dtype=np.float32)            # 4 MB, one partition
+        sess.push_pull(1, x)                      # init + warm
+        healthy = []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            sess.push_pull(1, x)
+            healthy.append(time.perf_counter() - t0)
+        faulted = []
+        for _ in range(reps):
+            proxy.reset_after(1 << 20)            # RST 1 MB into the push
+            t0 = time.perf_counter()
+            sess.push_pull(1, x)                  # parks, re-dials, replays
+            faulted.append(time.perf_counter() - t0)
+        stats = sess.transport_stats()
+        sess.close()
+        healthy_best = min(healthy)
+        faulted_med = sorted(faulted)[len(faulted) // 2]
+        recovery_ms = (faulted_med - healthy_best) * 1e3
+        print(json.dumps({
+            "metric": "fault_reconnect_recovery_ms",
+            "value": round(recovery_ms, 1),
+            "unit": "ms",
+            "vs_baseline": round(faulted_med / healthy_best, 2),
+            "detail": {
+                "healthy_round_best_ms": round(healthy_best * 1e3, 1),
+                "faulted_round_median_ms": round(faulted_med * 1e3, 1),
+                "reps": reps,
+                "reconnect_backoff_ms": backoff_ms,
+                "reconnects": stats["reconnects"],
+                "replayed_pushes": stats["replayed_pushes"],
+                "replayed_pulls": stats["replayed_pulls"],
+                "parked_total": stats["parked_total"],
+                "fault": "RST 1 MiB into a 4 MiB push, one-shot, "
+                         "via tools/chaos_proxy.py",
+                "note": "value = median faulted round minus best healthy "
+                        "round: park + backoff + re-dial + HELLO/INIT "
+                        "re-handshake + replay",
+                **_note(),
+            },
+        }))
+    finally:
+        proxy.stop()
+        proc.kill()
+        proc.wait()
+
+
 def bench_ps():
     """PS-tier wire benchmark: push_pull goodput through the real native
     KV server over loopback TCP.
@@ -602,56 +740,6 @@ def bench_ps():
 
     from byteps_tpu.utils.hermetic import cpu_subprocess_env
 
-    def boot_server():
-        """Start the PS server on a freshly-probed free port, retrying on a
-        new port if another process snatches it (bind/close-then-launch is
-        inherently TOCTOU on a busy host)."""
-        for _ in range(4):
-            # The server binds root_port + 1 + server_id; only the data
-            # port is ever bound here (no scheduler process), so probe THAT
-            # one free and derive the root port from it.
-            with socket.socket() as sk:
-                sk.bind(("127.0.0.1", 0))
-                port = sk.getsockname()[1]      # the server's data port
-            env = cpu_subprocess_env({
-                "DMLC_PS_ROOT_PORT": str(port - 1),
-                "DMLC_NUM_WORKER": "1",
-                # Engines beyond the core count only add context
-                # switches to the serve path (measured -10% goodput at
-                # 4 engines on a 1-core host).
-                "BYTEPS_SERVER_ENGINE_THREAD":
-                    str(min(4, os.cpu_count() or 4)),
-            })
-            import tempfile
-            errf = tempfile.TemporaryFile(mode="w+")
-            proc = subprocess.Popen(
-                [sys.executable, "-m", "byteps_tpu.server"],
-                env=env, stdout=subprocess.DEVNULL, stderr=errf)
-            deadline = time.time() + 30
-            while True:
-                try:
-                    socket.create_connection(
-                        ("127.0.0.1", port), 0.5).close()
-                    return proc, port
-                except OSError:
-                    if proc.poll() is not None:
-                        # Only an actual bind conflict is worth a retry on
-                        # a fresh port; any other startup death (import
-                        # error, missing native lib) must surface.
-                        errf.seek(0)
-                        stderr = errf.read()[-500:]
-                        errf.close()
-                        if "in use" not in stderr.lower():
-                            raise RuntimeError(
-                                f"PS server died at startup "
-                                f"(rc={proc.returncode}): {stderr}")
-                        break           # lost the port race — retry fresh
-                    if time.time() > deadline:
-                        proc.kill()
-                        proc.wait()
-                        raise RuntimeError("PS server did not come up")
-                    time.sleep(0.1)
-        raise RuntimeError("PS server lost the port race 4 times")
 
     # BENCH_PS_COMPRESSOR: measure EFFECTIVE goodput with a compressed
     # wire — logical gradient bytes synced per second while the TCP link
@@ -672,7 +760,10 @@ def bench_ps():
         comp_kw = comp_presets.get(comp_env) or dict(
             kv.split("=", 1) for kv in comp_env.split(","))
 
-    proc, port = boot_server()
+    # Engines beyond the core count only add context switches to the
+    # serve path (measured -10% goodput at 4 engines on a 1-core host).
+    proc, port = _boot_ps_server(
+        engine_threads=min(4, os.cpu_count() or 4))
     try:
         sess = PSSession(["127.0.0.1"], [port], worker_id=0, num_servers=1,
                          wire_conns=int(os.environ.get(
@@ -926,6 +1017,8 @@ def main():
         bench_ps()           # host-only: no device backend involved
     elif os.environ.get("BENCH_FUSION", "0") == "1":
         bench_fusion()       # host-only: no device backend involved
+    elif os.environ.get("BENCH_FAULT", "0") == "1":
+        bench_fault()        # host-only: no device backend involved
     elif os.environ.get("BENCH_CNN", ""):
         # Validate the name BEFORE the (possibly minutes-long) backend
         # probe so a typo still honors the one-JSON-line contract.

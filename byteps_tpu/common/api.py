@@ -666,6 +666,20 @@ def get_codec_stats() -> Dict[str, int]:
     return dict(CompressionPool.ZERO_STATS)
 
 
+def get_transport_stats() -> Dict[str, int]:
+    """Counters from the fault-tolerant PS transport
+    (BYTEPS_TPU_RECONNECT_ATTEMPTS / _STALL_TIMEOUT_S): successful
+    reconnects, exhausted backoff budgets, partitions replayed (push leg /
+    pull leg), partitions parked (currently / ever), and stall-watchdog
+    trips.  The get_codec_stats() analog for the transport layer; all-zero
+    outside PS mode.  Used by the chaos tests and BENCH_FAULT=1 bench.py
+    to prove recovery actually exercised the replay path."""
+    if _state.ps_session is not None:
+        return _state.ps_session.transport_stats()
+    from ..server.client import PSSession
+    return dict(PSSession.TRANSPORT_ZERO_STATS)
+
+
 def get_fusion_stats() -> Dict[str, int]:
     """Counters from the fusion-bucket layer (BYTEPS_TPU_FUSION_BYTES):
     buckets built, leaves fused vs solo, payload bytes per class, wire

@@ -78,6 +78,20 @@ class Config:
     # not-yet-full bucket, so straggler leaves never wait on members that
     # aren't coming.  0 = flush only when full / at end of pass.
     fusion_flush_ms: float = 5.0             # BYTEPS_TPU_FUSION_FLUSH_MS
+    # Fault-tolerant PS transport (server/client.py).  reconnect_attempts=0
+    # keeps the historical fail-fast contract: a dropped connection fails
+    # every pending request.  >0 parks in-flight partitions, re-dials under
+    # bounded exponential backoff (base reconnect_backoff_ms, jittered,
+    # capped at 10s/attempt) and replays them idempotently.
+    reconnect_attempts: int = 0              # BYTEPS_TPU_RECONNECT_ATTEMPTS
+    reconnect_backoff_ms: float = 100.0      # BYTEPS_TPU_RECONNECT_BACKOFF_MS
+    # Round-stall watchdog: with no partition completing for this many
+    # seconds while work is outstanding, dump a transport snapshot and fail
+    # the stuck handles loudly.  0 = disabled.
+    stall_timeout_s: float = 0.0             # BYTEPS_TPU_STALL_TIMEOUT_S
+    # bps.barrier() deadline; 0 = wait forever (the historical default,
+    # with a periodic "still waiting" warning either way).
+    barrier_timeout_s: float = 0.0           # BYTEPS_TPU_BARRIER_TIMEOUT_S
     server_engine_threads: int = 4           # BYTEPS_SERVER_ENGINE_THREAD
     server_enable_schedule: bool = False     # BYTEPS_SERVER_ENABLE_SCHEDULE
     enable_async: bool = False               # BYTEPS_ENABLE_ASYNC
@@ -134,6 +148,13 @@ class Config:
             fusion_bytes=_env_int("BYTEPS_TPU_FUSION_BYTES", 1024 * 1024),
             fusion_flush_ms=float(
                 os.environ.get("BYTEPS_TPU_FUSION_FLUSH_MS") or 5.0),
+            reconnect_attempts=_env_int("BYTEPS_TPU_RECONNECT_ATTEMPTS", 0),
+            reconnect_backoff_ms=float(
+                os.environ.get("BYTEPS_TPU_RECONNECT_BACKOFF_MS") or 100.0),
+            stall_timeout_s=float(
+                os.environ.get("BYTEPS_TPU_STALL_TIMEOUT_S") or 0.0),
+            barrier_timeout_s=float(
+                os.environ.get("BYTEPS_TPU_BARRIER_TIMEOUT_S") or 0.0),
             server_engine_threads=_env_int("BYTEPS_SERVER_ENGINE_THREAD", 4),
             server_enable_schedule=_env_bool("BYTEPS_SERVER_ENABLE_SCHEDULE"),
             enable_async=_env_bool("BYTEPS_ENABLE_ASYNC"),

@@ -1233,6 +1233,20 @@ class Server {
         return;
       }
     }
+    if (!async_ && t.flags != (ks.completed_round & 0xFFFF)) {
+      // Stale-round replay guard: a push's u16 flags carry the round the
+      // worker staged it for; one that is not the round currently merging
+      // belongs to an already-PUBLISHED round — a reconnecting worker
+      // replaying a push whose ack (or whose round's completion) raced the
+      // connection drop (client.py _replay_part).  Its contribution was
+      // already counted, so ack-and-drop: merging it into the current
+      // round would double-count this worker.  Correct clients always
+      // push flags == completed_round (round counters are seeded from the
+      // INIT response and advance only after the round publishes), so
+      // only replays and protocol violators can land here.
+      Respond(t.conn, kOk, t.req_id, t.key, nullptr, 0);
+      return;
+    }
     if (!async_ && ks.seen.count(t.worker_id) &&
         ks.store.size() == static_cast<size_t>(want)) {
       // Duplicate within a round — ignore merge, still ack (reference dedups

@@ -25,14 +25,28 @@ TPU-host redesign of that data path:
     dispatcher in the same (priority desc, key asc) order, so the wire
     send of partition k overlaps the encode of k+1, and compressed pull
     payloads are decoded off the receiver thread, so one slow decode
-    never stalls other partitions' responses on the same socket.
+    never stalls other partitions' responses on the same socket,
+  - the transport is fault-tolerant when BYTEPS_TPU_RECONNECT_ATTEMPTS > 0
+    (default 0 = fail-fast): a dropped connection parks its in-flight
+    partitions, re-dials under bounded exponential backoff with jitter,
+    re-runs the HELLO mode check and the idempotent CMD_INIT re-declare
+    (re-seeding rounds from server `completed_round` state so a replayed
+    push can never double-count and a pull can never return a stale
+    round), then replays parked pushes through the dispatcher and
+    re-issues parked pull legs, in (priority desc, key asc) order.  A
+    round-stall watchdog (BYTEPS_TPU_STALL_TIMEOUT_S) dumps a diagnostic
+    snapshot and fails stuck handles loudly — the worker-side analog of
+    server.cc's ORDERING INVARIANT guard.  bps.get_transport_stats()
+    exposes the counters.
 """
 
 from __future__ import annotations
 
+import random
 import socket
 import struct
 import threading
+import time
 from typing import Callable, Dict, List, Optional, Tuple
 
 import numpy as np
@@ -51,14 +65,38 @@ CMD_HELLO, CMD_INIT, CMD_PUSH, CMD_PULL, CMD_BARRIER, CMD_SHUTDOWN, \
 # dtype byte on the wire (server.cc WireDtype)
 DT_F32, DT_RAW, DT_COMPRESSED, DT_SEED = 0, 1, 2, 3
 
+_CMD_NAMES = {0: "HELLO", 1: "INIT", 2: "PUSH", 3: "PULL", 4: "BARRIER",
+              5: "SHUTDOWN", 6: "PING", 7: "LR_SCALE"}
+
+# How often the barrier wait logs a "still waiting" warning; module-level so
+# tests can shrink it (bps.barrier legitimately blocks on peers for a long
+# time — silence is the failure mode being fixed, not the waiting itself).
+BARRIER_WARN_INTERVAL_S = 10.0
+
+
+class _ConnLost(ConnectionError):
+    """The connection dropped with a request outstanding.
+
+    ``will_reconnect`` distinguishes a drop the transport is actively
+    recovering from (BYTEPS_TPU_RECONNECT_ATTEMPTS > 0: the owner may PARK
+    the request and replay it after the re-dial) from a terminal loss,
+    which must fail the request exactly like the pre-reconnect transport.
+    """
+
+    def __init__(self, msg: str, will_reconnect: bool = False):
+        super().__init__(msg)
+        self.will_reconnect = will_reconnect
+
 
 class _Future:
     """Completion slot for one outstanding request."""
 
-    __slots__ = ("event", "data", "error", "callback", "sink")
+    __slots__ = ("event", "data", "error", "callback", "sink", "sink_live",
+                 "cmd", "key", "req_id", "t0")
 
     def __init__(self, callback: Optional[Callable] = None,
-                 sink: Optional[memoryview] = None):
+                 sink: Optional[memoryview] = None,
+                 sink_live: Optional[Callable[[], bool]] = None):
         self.event = None if callback else threading.Event()
         self.data: bytes = b""
         self.error: Optional[Exception] = None
@@ -67,6 +105,15 @@ class _Future:
         # matches len(sink) is received straight into it (no intermediate
         # buffer — the ZPull-into-shm stance, reference core_loops.cc:582-616).
         self.sink = sink
+        # Guard consulted just before the receiver commits to the sink: a
+        # False return (e.g. the owning handle timed out and the caller may
+        # be reusing the buffer) diverts the payload to a scratch buffer.
+        self.sink_live = sink_live
+        # Request context for diagnosable timeouts (filled in by send()).
+        self.cmd = -1
+        self.key = 0
+        self.req_id = 0
+        self.t0 = time.monotonic()
 
     def resolve(self, data: bytes, error: Optional[Exception]) -> None:
         self.data, self.error = data, error
@@ -77,7 +124,11 @@ class _Future:
 
     def wait(self, timeout: Optional[float] = None) -> bytes:
         if not self.event.wait(timeout):
-            raise TimeoutError("PS request timed out")
+            raise TimeoutError(
+                f"PS request timed out: cmd={_CMD_NAMES.get(self.cmd, self.cmd)}"
+                f" key={self.key} req_id={self.req_id}"
+                f" elapsed={time.monotonic() - self.t0:.1f}s"
+                f" (timeout={timeout}s)")
         if self.error is not None:
             raise self.error
         return self.data
@@ -89,34 +140,84 @@ class _ServerConn:
     Any thread may `send`; a dedicated receiver thread matches responses to
     futures by req_id and runs completion callbacks (the ZPush/ZPull
     callback model, reference: core_loops.cc:564-616).
+
+    With ``reconnect_attempts > 0`` the connection survives transport
+    faults: on a drop the receiver resolves every pending future with a
+    `_ConnLost(will_reconnect=True)` (the session parks its partitions for
+    replay), re-dials ``host:port`` under bounded exponential backoff with
+    jitter, then runs ``on_reconnect`` (the session's handshake + replay)
+    on a fresh thread while the receiver resumes on the new socket.  With
+    the default 0, a drop fails all pending requests permanently — the
+    pre-reconnect fail-fast contract, unchanged.
     """
 
-    def __init__(self, host: str, port: int, timeout: float = 60.0):
-        self.sock = socket.create_connection((host, port), timeout=timeout)
-        self.sock.settimeout(None)  # receiver blocks until data or close
-        self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    def __init__(self, host: str, port: int, timeout: float = 60.0,
+                 reconnect_attempts: int = 0,
+                 reconnect_backoff_ms: float = 100.0,
+                 on_reconnect: Optional[Callable] = None,
+                 on_give_up: Optional[Callable] = None):
+        self.host, self.port = host, port
+        self.timeout = timeout
+        self.reconnect_attempts = max(0, int(reconnect_attempts))
+        self.reconnect_backoff_ms = max(1.0, float(reconnect_backoff_ms))
+        self.on_reconnect = on_reconnect
+        self.on_give_up = on_give_up
+        self.reconnects = 0          # successful re-dials, for stats
+        self.sock = self._dial()
         self.lock = threading.Lock()          # send serialization
+        self.replay_lock = threading.Lock()   # serializes on_reconnect runs
         self._pending: Dict[int, _Future] = {}
         self._pending_lock = threading.Lock()
         self._req_counter = 0
         self._closed = False
+        self._down = False           # dropped, re-dial in progress
         self._recv_thread = threading.Thread(
             target=self._recv_loop, daemon=True, name="bps-ps-recv")
         self._recv_thread.start()
 
+    def _dial(self) -> socket.socket:
+        sock = socket.create_connection((self.host, self.port),
+                                        timeout=self.timeout)
+        sock.settimeout(None)  # receiver blocks until data or close
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        return sock
+
+    def state(self) -> str:
+        """'up' | 'reconnecting' | 'closed' — for watchdog dumps/stats."""
+        with self._pending_lock:
+            if self._closed:
+                return "closed"
+            return "reconnecting" if self._down else "up"
+
+    def _lost_exc(self, msg: str) -> _ConnLost:
+        """A connection-lost error tagged with whether this conn will try
+        to recover (so the session knows to park instead of fail)."""
+        return _ConnLost(msg, will_reconnect=self.reconnect_attempts > 0
+                         and not self._closed)
+
     def send(self, cmd: int, key: int = 0, payload: bytes = b"",
              worker_id: int = 0, dtype: int = 0, flags: int = 0,
              callback: Optional[Callable] = None,
-             sink: Optional[memoryview] = None) -> _Future:
-        fut = _Future(callback, sink)
+             sink: Optional[memoryview] = None,
+             sink_live: Optional[Callable[[], bool]] = None) -> _Future:
+        fut = _Future(callback, sink, sink_live)
         with self._pending_lock:
             if self._closed:
                 raise ConnectionError("PS connection closed")
+            if self._down:
+                # Mid-reconnect: nothing can go on the wire right now.  The
+                # tagged error lets the dispatcher park the partition for
+                # replay instead of failing the handle.
+                raise self._lost_exc(
+                    f"PS connection to {self.host}:{self.port} is "
+                    f"reconnecting")
             self._req_counter = (self._req_counter + 1) & 0xFFFFFFFF
             req_id = self._req_counter
+            fut.cmd, fut.key, fut.req_id = cmd, key, req_id
             self._pending[req_id] = fut
         hdr = _REQ.pack(cmd, dtype, flags & 0xFFFF, req_id, worker_id, key,
                         len(payload))
+        sock = self.sock   # the socket this send commits to (see except arm)
         try:
             with self.lock:
                 if len(payload) >= 65536:
@@ -128,26 +229,38 @@ class _ServerConn:
                     # sendall is its own packet + syscall + server-reader
                     # wakeup per partition (mirror of the server-side
                     # Respond coalescing).
-                    self._send_gather(hdr, payload)
+                    self._send_gather(sock, hdr, payload)
                 else:
-                    self.sock.sendall(hdr + bytes(payload))
+                    sock.sendall(hdr + bytes(payload))
         except OSError as e:
+            # Wake the receiver so IT drives the reconnect (single owner):
+            # shut down the exact socket this send wrote to — if a re-dial
+            # already swapped in a healthy one, this is a no-op on a dead fd.
+            try:
+                sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
             with self._pending_lock:
-                self._pending.pop(req_id, None)
-            raise ConnectionError(f"PS send failed: {e}") from e
+                popped = self._pending.pop(req_id, None)
+            if popped is None:
+                # The drop handler already took (and resolved/parked) this
+                # future — it owns the error path; raising here too would
+                # double-handle it (e.g. return scheduler credit twice).
+                return fut
+            raise self._lost_exc(f"PS send failed: {e}") from e
         return fut
 
-    def _send_gather(self, hdr: bytes, payload) -> None:
+    def _send_gather(self, sock: socket.socket, hdr: bytes, payload) -> None:
         """header+payload in one gather syscall, with the partial-write
         loop sendmsg needs (unlike sendall it returns after one write)."""
         mv_h, mv_p = memoryview(hdr), memoryview(payload)
         total = len(mv_h) + len(mv_p)
-        sent = self.sock.sendmsg([mv_h, mv_p])
+        sent = sock.sendmsg([mv_h, mv_p])
         while sent < total:
             if sent < len(mv_h):
-                sent += self.sock.sendmsg([mv_h[sent:], mv_p])
+                sent += sock.sendmsg([mv_h[sent:], mv_p])
             else:
-                self.sock.sendall(mv_p[sent - len(mv_h):])
+                sock.sendall(mv_p[sent - len(mv_h):])
                 sent = total
 
     def request(self, cmd: int, key: int = 0, payload: bytes = b"",
@@ -155,55 +268,183 @@ class _ServerConn:
                 timeout: Optional[float] = 60.0) -> bytes:
         """Blocking request/response (INIT, BARRIER, control commands).
 
-        BARRIER legitimately blocks on peers, so it is sent without a
-        deadline; everything else fails loudly after `timeout` instead of
-        hanging a training job on a wedged server.
+        BARRIER legitimately blocks on peers, so its default deadline is
+        infinite (`timeout=None`; `BYTEPS_TPU_BARRIER_TIMEOUT_S` routes a
+        finite one through PSSession.barrier) — but it logs a periodic
+        "still waiting" warning so a dead peer is never silent.  Everything
+        else fails loudly after `timeout` instead of hanging a training job
+        on a wedged server.
         """
+        fut = self.send(cmd, key, payload, worker_id, dtype, flags)
         if cmd == CMD_BARRIER:
+            return self._wait_barrier(fut, key, timeout)
+        return fut.wait(timeout)
+
+    def _wait_barrier(self, fut: _Future, gen: int,
+                      timeout: Optional[float]) -> bytes:
+        """Barrier wait with periodic progress warnings and an optional
+        overall deadline (0/None = wait forever, the historical default)."""
+        if not timeout or timeout <= 0:
             timeout = None
-        return self.send(cmd, key, payload, worker_id, dtype,
-                         flags).wait(timeout)
+        deadline = None if timeout is None else time.monotonic() + timeout
+        t0 = time.monotonic()
+        while True:
+            chunk = BARRIER_WARN_INTERVAL_S
+            if deadline is not None:
+                chunk = min(chunk, max(0.0, deadline - time.monotonic()))
+            if fut.event.wait(chunk):
+                break
+            elapsed = time.monotonic() - t0
+            if deadline is not None and time.monotonic() >= deadline:
+                raise TimeoutError(
+                    f"PS barrier timed out: gen={gen} elapsed={elapsed:.1f}s"
+                    f" (BYTEPS_TPU_BARRIER_TIMEOUT_S={timeout}); a peer is"
+                    f" down or DMLC_NUM_WORKER over-counts the world")
+            get_logger().warning(
+                "still waiting on barrier gen=%d after %.1fs (server %s:%d;"
+                " a peer may be down or slow)", gen, elapsed, self.host,
+                self.port)
+        if fut.error is not None:
+            raise fut.error
+        return fut.data
 
     def _recv_loop(self) -> None:
+        while True:
+            try:
+                self._recv_pump()
+                return      # unreachable: _recv_pump only exits by raising
+            except (ConnectionError, OSError) as e:
+                if not self._begin_reconnect(e):
+                    self._fail_pending(e)
+                    return
+
+    def _recv_pump(self) -> None:
+        while True:
+            buf = self._recv_exact(_RESP.size)
+            status, req_id, rkey, length = _RESP.unpack(buf)
+            # Pop BEFORE the payload read: this thread owns the future
+            # (and its sink buffer) exclusively, so a concurrent
+            # _fail_pending can neither resolve it mid-write nor race a
+            # retry into the same sink.  The except arm below resolves
+            # it if the connection dies mid-payload — no orphaning.
+            with self._pending_lock:
+                fut = self._pending.pop(req_id, None)
+            try:
+                if (fut is not None and fut.sink is not None
+                        and status == 0 and length == len(fut.sink)
+                        and (fut.sink_live is None or fut.sink_live())):
+                    # Matched sink: payload lands in the caller's buffer.
+                    self._recv_into(fut.sink)
+                    data = fut.sink
+                else:
+                    data = self._recv_exact(length) if length else b""
+            except (ConnectionError, OSError) as e:
+                if fut is not None:
+                    try:
+                        fut.resolve(
+                            b"", self._lost_exc(f"PS connection lost "
+                                                f"mid-payload: {e}"))
+                    except Exception:
+                        get_logger().exception(
+                            "PS completion callback failed")
+                raise
+            if fut is None:
+                continue  # response for a cancelled request
+            err = (RuntimeError(f"PS server error for key {rkey}")
+                   if status != 0 else None)
+            try:
+                fut.resolve(data, err)
+            except Exception:
+                get_logger().exception("PS completion callback failed")
+
+    def _begin_reconnect(self, exc: Exception) -> bool:
+        """Runs on the receiver thread after a transport fault.  Returns
+        True once a new socket is live (the receive loop resumes on it);
+        False when reconnect is disabled/exhausted or the conn was closed
+        deliberately — the caller then fails pending requests for good."""
+        if self.reconnect_attempts <= 0:
+            return False
+        with self._pending_lock:
+            if self._closed:
+                return False
+            self._down = True
+            dropped, self._pending = self._pending, {}
+        # Park-don't-fail: pending futures resolve with a reconnect-tagged
+        # loss so the session can stash their partitions for replay.
+        lost = _ConnLost(f"PS connection to {self.host}:{self.port} "
+                         f"dropped: {exc}", will_reconnect=True)
+        for fut in dropped.values():
+            try:
+                fut.resolve(b"", lost)
+            except Exception:
+                get_logger().exception("PS completion callback failed")
         try:
-            while True:
-                buf = self._recv_exact(_RESP.size)
-                status, req_id, rkey, length = _RESP.unpack(buf)
-                # Pop BEFORE the payload read: this thread owns the future
-                # (and its sink buffer) exclusively, so a concurrent
-                # _fail_pending can neither resolve it mid-write nor race a
-                # retry into the same sink.  The except arm below resolves
-                # it if the connection dies mid-payload — no orphaning.
-                with self._pending_lock:
-                    fut = self._pending.pop(req_id, None)
-                try:
-                    if (fut is not None and fut.sink is not None
-                            and status == 0 and length == len(fut.sink)):
-                        # Matched sink: payload lands in the caller's buffer.
-                        self._recv_into(fut.sink)
-                        data = fut.sink
-                    else:
-                        data = self._recv_exact(length) if length else b""
-                except (ConnectionError, OSError) as e:
-                    if fut is not None:
-                        try:
-                            fut.resolve(
-                                b"", ConnectionError(f"PS connection lost "
-                                                     f"mid-payload: {e}"))
-                        except Exception:
-                            get_logger().exception(
-                                "PS completion callback failed")
-                    raise
-                if fut is None:
-                    continue  # response for a cancelled request
-                err = (RuntimeError(f"PS server error for key {rkey}")
-                       if status != 0 else None)
-                try:
-                    fut.resolve(data, err)
-                except Exception:
-                    get_logger().exception("PS completion callback failed")
-        except (ConnectionError, OSError) as e:
-            self._fail_pending(e)
+            self.sock.close()
+        except OSError:
+            pass
+        get_logger().warning(
+            "PS connection to %s:%d dropped (%s); reconnecting "
+            "(attempts=%d, backoff=%.0fms, %d requests parked/failed)",
+            self.host, self.port, exc, self.reconnect_attempts,
+            self.reconnect_backoff_ms, len(dropped))
+        for attempt in range(1, self.reconnect_attempts + 1):
+            # Bounded exponential backoff with jitter (0.5x-1.5x), capped
+            # at 10s per attempt, so a worker fleet never re-dials a
+            # restarting server in lockstep.
+            backoff = min(10.0, self.reconnect_backoff_ms / 1000.0
+                          * (2.0 ** (attempt - 1)))
+            time.sleep(backoff * (0.5 + random.random()))
+            with self._pending_lock:
+                if self._closed:
+                    return False
+            try:
+                sock = self._dial()
+            except OSError as e:
+                get_logger().warning(
+                    "PS reconnect to %s:%d attempt %d/%d failed: %s",
+                    self.host, self.port, attempt,
+                    self.reconnect_attempts, e)
+                continue
+            self.sock = sock
+            with self._pending_lock:
+                if self._closed:        # closed while dialing
+                    try:
+                        sock.close()
+                    except OSError:
+                        pass
+                    return False
+                self._down = False
+            self.reconnects += 1
+            get_logger().warning(
+                "PS connection to %s:%d re-established (attempt %d/%d)",
+                self.host, self.port, attempt, self.reconnect_attempts)
+            if self.on_reconnect is not None:
+                # The handshake/replay sends requests over THIS conn and
+                # waits on their futures — which needs the receive loop
+                # running — so it rides its own thread.
+                threading.Thread(
+                    target=self._run_on_reconnect, daemon=True,
+                    name="bps-ps-replay").start()
+            return True
+        with self._pending_lock:
+            self._closed = True
+        get_logger().error(
+            "PS reconnect to %s:%d gave up after %d attempts",
+            self.host, self.port, self.reconnect_attempts)
+        if self.on_give_up is not None:
+            try:
+                self.on_give_up(self, exc)
+            except Exception:
+                get_logger().exception("PS reconnect give-up hook failed")
+        return False
+
+    def _run_on_reconnect(self) -> None:
+        with self.replay_lock:    # serialize overlapping reconnect cycles
+            try:
+                self.on_reconnect(self)
+            except Exception:
+                get_logger().exception(
+                    "PS post-reconnect handshake/replay failed")
 
     def _fail_pending(self, exc: Exception) -> None:
         with self._pending_lock:
@@ -233,6 +474,8 @@ class _ServerConn:
             got += r
 
     def close(self):
+        with self._pending_lock:
+            self._closed = True   # stops any in-progress re-dial loop
         try:
             self.sock.shutdown(socket.SHUT_RDWR)
         except OSError:
@@ -256,9 +499,18 @@ class PSHandle:
         self._lock = threading.Lock()
         self._event = threading.Event()
         self._error: Optional[Exception] = None
+        self._outstanding: set = set()      # pkeys not yet completed
+        self._timed_out = False             # wait() gave up: discard late
 
-    def _part_done(self, error: Optional[Exception] = None) -> None:
+    def _register_part(self, pkey: int) -> None:
         with self._lock:
+            self._outstanding.add(pkey)
+
+    def _part_done(self, error: Optional[Exception] = None,
+                   pkey: Optional[int] = None) -> None:
+        with self._lock:
+            if pkey is not None:
+                self._outstanding.discard(pkey)
             if error is not None and self._error is None:
                 self._error = error
             self._remaining -= 1
@@ -266,12 +518,50 @@ class PSHandle:
         if done or error is not None:
             self._event.set()
 
+    def _store_result(self, off_f32: int, got: np.ndarray) -> bool:
+        """Land one partition's pulled values in `out` — unless the handle
+        already failed (wait() timed out, or another partition errored /
+        was failed by the watchdog), in which case the result is dead and
+        a late write could corrupt a buffer the owner stopped tracking.
+        The check-and-write runs under the handle lock so a concurrent
+        timeout can't interleave with it.  (The zero-copy sink path checks
+        `failed()` before committing to the in-place receive instead; a
+        failure arriving DURING that receive can still land bytes in
+        `out`, which is safe because `out` is session-allocated and wait()
+        never returns it after a failure.)"""
+        with self._lock:
+            if self.failed():
+                return False
+            self.out[off_f32:off_f32 + got.size] = got
+            return True
+
+    def failed(self) -> bool:
+        """True once the handle can no longer succeed (wait() timeout, a
+        partition error, or a watchdog/give-up failure): late resolutions
+        must be discarded."""
+        return self._timed_out or self._error is not None
+
     def done(self) -> bool:
         return self._event.is_set()
 
     def wait(self, timeout: Optional[float] = 300.0) -> np.ndarray:
+        with self._lock:
+            if self._timed_out:
+                # A handle that timed out once stays failed: a later wait()
+                # must not hand out a buffer that late partitions may have
+                # partially filled.
+                raise TimeoutError(
+                    "PS push_pull handle already timed out")
         if not self._event.wait(timeout):
-            raise TimeoutError("PS push_pull timed out")
+            with self._lock:
+                self._timed_out = True
+                stuck = sorted(self._outstanding)
+            shown = ", ".join(str(k) for k in stuck[:16])
+            if len(stuck) > 16:
+                shown += f", ... ({len(stuck)} total)"
+            raise TimeoutError(
+                f"PS push_pull timed out after {timeout}s; outstanding "
+                f"partition keys: [{shown}]")
         if self._error is not None:
             raise self._error
         return self.out.reshape(self.shape).astype(self.dtype, copy=False)
@@ -284,7 +574,7 @@ class _PartTask:
     __slots__ = ("pkey", "payload", "off", "ln", "round", "conn", "handle",
                  "dtype", "done_evt", "wire_ln", "bidirectional",
                  "label", "priority", "enq_ts", "push_ts", "pull_ts",
-                 "ready", "enc_err", "credit_ln")
+                 "ready", "enc_err", "credit_ln", "phase", "parked")
 
     def __init__(self, pkey, payload, off, ln, rnd, conn, handle,
                  dtype=DT_F32, bidirectional=False, label=""):
@@ -317,6 +607,12 @@ class _PartTask:
         # the codec's worst-case bound (set by _stage_parts for pipelined
         # encodes, whose true size doesn't exist at enqueue time).
         self.credit_ln = self.wire_ln
+        # Fault-tolerance state: `phase` records how far this partition got
+        # ("push" = the push must (still/again) be issued, "pull" = the push
+        # was acked and only the pull leg is outstanding); `parked` marks a
+        # partition stashed for replay while its connection reconnects.
+        self.phase = "push"
+        self.parked = False
 
 
 class PSSession:
@@ -328,13 +624,30 @@ class PSSession:
     (reference: core_loops.cc:536-616, operations.cc:429-485).
     """
 
+    # Canonical transport-stats schema — the all-zero shape returned by
+    # bps.get_transport_stats() outside PS mode, mirroring
+    # CompressionPool.ZERO_STATS so the surfaces can never drift apart.
+    TRANSPORT_ZERO_STATS = {
+        "reconnects": 0,          # successful re-dials across all conns
+        "reconnects_failed": 0,   # conns whose backoff budget ran out
+        "replayed_pushes": 0,     # partitions re-pushed after a reconnect
+        "replayed_pulls": 0,      # pull legs re-issued after a reconnect
+        "parked_parts": 0,        # partitions currently parked for replay
+        "parked_total": 0,        # partitions ever parked
+        "watchdog_trips": 0,      # stall-watchdog dumps fired
+    }
+
     def __init__(self, hosts: List[str], ports: List[int], worker_id: int,
                  num_servers: int, hash_fn: str = "djb2",
                  partition_bytes: int = 4 * 1024 * 1024,
                  scheduling_credit: int = 0,
                  min_compress_bytes: int = 65536,
                  wire_conns: int = 2,
-                 compress_threads: int = 2):
+                 compress_threads: int = 2,
+                 reconnect_attempts: int = 0,
+                 reconnect_backoff_ms: float = 100.0,
+                 stall_timeout_s: float = 0.0,
+                 barrier_timeout_s: float = 0.0):
         self.worker_id = worker_id
         self.num_servers = max(1, num_servers)
         self.hash_fn = hash_fn
@@ -347,12 +660,19 @@ class PSSession:
         # fallback: encode on the caller thread, decode on the receiver
         # thread, exactly the pre-pipeline data path.
         self.compress_threads = max(0, compress_threads)
+        # Fault tolerance (BYTEPS_TPU_RECONNECT_* / _STALL_ / _BARRIER_):
+        # 0 attempts = fail-fast on a drop, the pre-reconnect behavior.
+        self.reconnect_attempts = max(0, int(reconnect_attempts))
+        self.reconnect_backoff_ms = float(reconnect_backoff_ms)
+        self.stall_timeout_s = max(0.0, float(stall_timeout_s))
+        self.barrier_timeout_s = max(0.0, float(barrier_timeout_s))
         # Any failure before __init__ returns (a connect, the dispatcher,
         # the HELLO mode check) must tear down every socket and receiver
         # thread already created — the caller gets an exception, not a
         # session, so nothing else can ever close them.
         self.conns: List[_ServerConn] = []
         self._data_conns: List[List[_ServerConn]] = []
+        self._session_ready = False
         try:
             self._init_connections(hosts, ports, max(1, wire_conns))
             self._init_state(scheduling_credit)
@@ -360,6 +680,7 @@ class PSSession:
         except Exception:
             self._abort_init()
             raise
+        self._session_ready = True
 
     def _init_connections(self, hosts, ports, wire_conns: int) -> None:
         """Primary conn per server + optional extra data connections.
@@ -368,24 +689,35 @@ class PSSession:
         and receive-thread work over more sockets (the reference gets the
         same effect from ps-lite's per-connection threads).  Control
         traffic (barrier/hello/shutdown) stays on the primary."""
+        def conn(h, p):
+            return _ServerConn(
+                h, p,
+                reconnect_attempts=self.reconnect_attempts,
+                reconnect_backoff_ms=self.reconnect_backoff_ms,
+                on_reconnect=self._on_conn_reconnected,
+                on_give_up=self._on_conn_gave_up)
+
         for h, p in zip(hosts, ports):
-            c = _ServerConn(h, p)
+            c = conn(h, p)
             self.conns.append(c)
             self._data_conns.append([c])
         for pool, (h, p) in zip(self._data_conns, zip(hosts, ports)):
             for _ in range(wire_conns - 1):
-                pool.append(_ServerConn(h, p))
+                pool.append(conn(h, p))
         # Per-server round-robin cursor, persistent across plans: a
         # per-plan counter would pin every single-partition tensor (the
         # common case for DL gradients) to the primary socket.
         self._conn_rr = [0] * len(self.conns)
 
     def _abort_init(self) -> None:
+        if getattr(self, "_watchdog_stop", None) is not None:
+            self._watchdog_stop.set()
         if getattr(self, "_dispatcher", None) is not None:
             with self._cv:
                 self._closed = True
                 self._cv.notify_all()
             self._dispatcher.join(timeout=5)
+            self._warn_if_wedged(self._dispatcher)
         if getattr(self, "_codec_pool", None) is not None:
             self._codec_pool.close()
         for pool in self._data_conns:
@@ -429,9 +761,28 @@ class PSSession:
         # and only priority-order tests/tracing read it.
         self.record_push_order = False
         self.push_order: List[int] = []
+        # Fault-tolerance bookkeeping: wire-key -> conn (for re-declare
+        # invalidation after a reconnect) and the transport counter surface
+        # (bps.get_transport_stats, the codec/fusion-stats analog).
+        self._pkey_conn: Dict[int, _ServerConn] = {}
+        self._transport_lock = threading.Lock()
+        self._tstats = dict(self.TRANSPORT_ZERO_STATS)
+        # Round-stall watchdog (BYTEPS_TPU_STALL_TIMEOUT_S > 0): the
+        # worker-side analog of server.cc's ORDERING INVARIANT guard — no
+        # partition completing for the window with work outstanding dumps
+        # a diagnostic snapshot, then fails the stuck handles loudly.
+        self._last_progress = time.monotonic()
+        self._watchdog_stop = threading.Event()
+        self._watchdog: Optional[threading.Thread] = None
+        self._join_timeout_s = 10.0   # close()'s thread-join budget
         self._dispatcher = threading.Thread(
             target=self._dispatch_loop, daemon=True, name="bps-ps-dispatch")
         self._dispatcher.start()
+        if self.stall_timeout_s > 0:
+            self._watchdog = threading.Thread(
+                target=self._watchdog_loop, daemon=True,
+                name="bps-ps-watchdog")
+            self._watchdog.start()
 
     def _hello_mode_check(self, worker_id: int) -> None:
         # HELLO returns the server's mode flags (u8 async | u8 schedule).
@@ -468,7 +819,11 @@ class PSSession:
                    scheduling_credit=cfg.scheduling_credit,
                    min_compress_bytes=cfg.min_compress_bytes,
                    wire_conns=cfg.wire_conns,
-                   compress_threads=cfg.compress_threads)
+                   compress_threads=cfg.compress_threads,
+                   reconnect_attempts=cfg.reconnect_attempts,
+                   reconnect_backoff_ms=cfg.reconnect_backoff_ms,
+                   stall_timeout_s=cfg.stall_timeout_s,
+                   barrier_timeout_s=cfg.barrier_timeout_s)
 
     def set_lr_scale(self, scale: float) -> None:
         """One-shot EF-error rescale after a learning-rate change;
@@ -528,8 +883,9 @@ class PSSession:
                 srv = core.key_to_server(pkey, len(self.conns), self.hash_fn)
                 self._server_load[srv] += ln
                 pool = self._data_conns[srv]
-                plan.append((pkey, off, ln,
-                             pool[self._conn_rr[srv] % len(pool)]))
+                conn = pool[self._conn_rr[srv] % len(pool)]
+                plan.append((pkey, off, ln, conn))
+                self._pkey_conn[pkey] = conn
                 self._conn_rr[srv] += 1
             self._plans[(declared_key, nbytes)] = plan
             total = sum(self._server_load) or 1
@@ -592,7 +948,8 @@ class PSSession:
                         self._on_push_ack(pkey, nbytes, err))
             except ConnectionError as e:
                 self._queue.report_finish(nbytes)
-                self._finish_part(pkey, e)
+                if not self._park_part(pkey, "push", e):
+                    self._finish_part(pkey, e)
 
     def _on_push_ack(self, pkey: int, nbytes: int,
                      error: Optional[Exception]) -> None:
@@ -602,10 +959,18 @@ class PSSession:
         with self._cv:
             self._cv.notify_all()
         if error is not None:
-            self._finish_part(pkey, error)
+            # A reconnect-tagged loss parks the partition for replay (the
+            # ack never arrived, so the push phase must be re-run — the
+            # server's seen-dedup and the stale-round push guard make the
+            # replay idempotent); anything else fails the handle as before.
+            if not self._park_part(pkey, "push", error):
+                self._finish_part(pkey, error)
             return
+        self._mark_progress()
         with self._inflight_lock:
             part = self._inflight.get(pkey)
+            if part is not None:
+                part.phase = "pull"   # push acked: only the pull remains
         if part is None:
             return
         core = get_core()
@@ -615,27 +980,40 @@ class PSSession:
                                    part.pull_ts - part.push_ts, pkey,
                                    part.wire_ln, part.priority)
         try:
-            # Non-compressed pulls land straight in the output buffer (the
-            # receiver matches on length); bidirectional compressed pulls
-            # come back re-encoded at a different length and take the
-            # allocating path + wire_decode.
-            sink = None
-            if not part.bidirectional:
-                sink = memoryview(part.handle.out).cast("B")[
-                    part.off:part.off + part.ln]
-            part.conn.send(
-                CMD_PULL, pkey, worker_id=self.worker_id, flags=part.round,
-                sink=sink,
-                callback=lambda data, err, pkey=pkey:
-                    self._on_pull(pkey, data, err))
+            self._issue_pull(part)
         except ConnectionError as e:
-            self._finish_part(pkey, e)
+            if not self._park_part(pkey, "pull", e):
+                self._finish_part(pkey, e)
+
+    def _issue_pull(self, part: "_PartTask") -> None:
+        """Send one partition's pull leg (first issue and replay share
+        this).  Raises ConnectionError if the conn can't take it."""
+        # Non-compressed pulls land straight in the output buffer (the
+        # receiver matches on length); bidirectional compressed pulls
+        # come back re-encoded at a different length and take the
+        # allocating path + wire_decode.  sink_live guards the in-place
+        # write against a handle whose wait() already timed out.
+        sink = None
+        if not part.bidirectional:
+            sink = memoryview(part.handle.out).cast("B")[
+                part.off:part.off + part.ln]
+        part.conn.send(
+            CMD_PULL, part.pkey, worker_id=self.worker_id, flags=part.round,
+            sink=sink,
+            sink_live=lambda h=part.handle: not h.failed(),
+            callback=lambda data, err, pkey=part.pkey:
+                self._on_pull(pkey, data, err))
 
     def _on_pull(self, pkey: int, data: bytes,
                  error: Optional[Exception]) -> None:
         if error is not None:
-            self._finish_part(pkey, error)
+            # Pull leg lost to a recoverable drop: the push WAS acked, so
+            # replay re-issues only the pull (round flags unchanged — the
+            # server serves completed_round or pends until it publishes).
+            if not self._park_part(pkey, "pull", error):
+                self._finish_part(pkey, error)
             return
+        self._mark_progress()
         with self._inflight_lock:
             part = self._inflight.pop(pkey, None)
             if part is not None:
@@ -707,10 +1085,13 @@ class PSSession:
                     raise ValueError(
                         f"PS pull size mismatch for key {part.pkey}: "
                         f"got {got.size} f32, want {n}")
-                part.handle.out[part.off // 4:part.off // 4 + n] = got
-            part.handle._part_done()
+                if not part.handle._store_result(part.off // 4, got):
+                    get_logger().debug(
+                        "discarding late pull for key %d: handle already "
+                        "timed out", part.pkey)
+            part.handle._part_done(pkey=part.pkey)
         except Exception as e:
-            part.handle._part_done(e)
+            part.handle._part_done(e, pkey=part.pkey)
         finally:
             part.done_evt.set()
 
@@ -718,8 +1099,270 @@ class PSSession:
         with self._inflight_lock:
             part = self._inflight.pop(pkey, None)
         if part is not None:
-            part.handle._part_done(error)
+            part.handle._part_done(error, pkey=pkey)
             part.done_evt.set()
+
+    # -- fault tolerance: parking, replay, watchdog -------------------------
+    def _mark_progress(self) -> None:
+        self._last_progress = time.monotonic()
+
+    def _park_part(self, pkey: int, phase: str,
+                   error: Exception) -> bool:
+        """Stash an in-flight partition for post-reconnect replay instead
+        of failing its handle.  Only recoverable drops park (`_ConnLost`
+        with an active reconnect policy); returns False when the caller
+        should fail the partition as before.  Idempotent: the send-raise
+        and drop-resolution paths can both observe one loss."""
+        if not (self.reconnect_attempts > 0
+                and isinstance(error, _ConnLost) and error.will_reconnect):
+            return False
+        if getattr(self, "server_async", False) and phase == "push":
+            # Async mode has no rounds: the server can't tell a replayed
+            # push (whose ack was lost AFTER the sum applied) from a new
+            # delta — neither the seen-dedup nor the stale-round guard is
+            # active.  An at-least-once push would silently double-apply
+            # the gradient, so async push losses fail loudly instead of
+            # parking (pull legs are idempotent and still replay).
+            return False
+        with self._inflight_lock:
+            part = self._inflight.get(pkey)
+            if part is None:
+                return True     # already finished/cancelled elsewhere
+            if part.parked:
+                return True     # the other path got here first
+            part.parked = True
+            part.phase = phase
+        with self._transport_lock:
+            self._tstats["parked_parts"] += 1
+            self._tstats["parked_total"] += 1
+        get_logger().debug("parked partition key=%d phase=%s (%s)",
+                           pkey, phase, error)
+        if part.conn.state() == "up" and part.conn.on_reconnect is not None:
+            # The conn finished re-dialing before this parking landed (a
+            # fast re-dial can beat the thread that observed the loss), so
+            # the post-reconnect replay scan ran too early to see this
+            # part and no future drop is guaranteed — kick another pass.
+            # Idempotent: replay_lock serializes passes and _unpark lets
+            # exactly one claim each part.
+            threading.Thread(target=part.conn._run_on_reconnect,
+                             daemon=True, name="bps-ps-replay").start()
+        return True
+
+    def _unpark(self, part: "_PartTask") -> bool:
+        """Atomically claim a parked part for replay (False if another
+        replay pass already took it or it finished meanwhile)."""
+        with self._inflight_lock:
+            if self._inflight.get(part.pkey) is not part or not part.parked:
+                return False
+            part.parked = False
+        with self._transport_lock:
+            self._tstats["parked_parts"] -= 1
+        return True
+
+    def _on_conn_gave_up(self, conn: "_ServerConn", exc: Exception) -> None:
+        """Reconnect budget exhausted: everything parked on this conn fails
+        loudly now (the fail-fast contract, just delayed by the backoff)."""
+        with self._transport_lock:
+            self._tstats["reconnects_failed"] += 1
+        with self._inflight_lock:
+            mine = [p for p in self._inflight.values()
+                    if p.conn is conn and p.parked]
+        err = ConnectionError(
+            f"PS reconnect to {conn.host}:{conn.port} gave up after "
+            f"{conn.reconnect_attempts} attempts: {exc}")
+        for p in mine:
+            self._finish_part(p.pkey, err)
+
+    def _on_conn_reconnected(self, conn: "_ServerConn") -> None:
+        """Post-reconnect handshake + replay (runs on the conn's replay
+        thread, serialized by conn.replay_lock).
+
+        Order matters: (1) HELLO re-checks the server's mode flags — a
+        replacement server booted with different async/schedule settings
+        would silently corrupt training; (2) the conn's keys drop out of
+        `_inited` so the next stage re-declares and re-seeds rounds from
+        server state; (3) every parked partition is re-declared via
+        CMD_INIT, reconciled against the server's completed_round (skip
+        the push if its round already published — never double-count;
+        rebase the round if the server restarted and lost it), then
+        replayed in (priority desc, key asc) order — pushes through the
+        scheduler/dispatcher, pull legs directly.
+        """
+        if not getattr(self, "_session_ready", False):
+            return      # drop during __init__: nothing staged to replay yet
+        try:
+            mode = conn.request(CMD_HELLO, worker_id=self.worker_id)
+            modes = ((bool(mode[0]), bool(mode[1]))
+                     if len(mode) >= 2 else (False, False))
+            if modes != (self.server_async, self.server_schedule):
+                raise RuntimeError(
+                    f"PS server at {conn.host}:{conn.port} came back with "
+                    f"different mode flags (async, schedule): {modes} vs "
+                    f"{(self.server_async, self.server_schedule)} — a "
+                    f"replacement server must share BYTEPS_ENABLE_ASYNC / "
+                    f"BYTEPS_SERVER_ENABLE_SCHEDULE settings")
+        except ConnectionError as e:
+            # Dropped again before the handshake finished: the next
+            # reconnect cycle re-runs this whole procedure.
+            get_logger().warning("PS reconnect handshake interrupted: %s", e)
+            return
+        except Exception as e:
+            get_logger().error("PS reconnect handshake failed: %s", e)
+            self._fail_parked_on(conn, e)
+            return
+        # Invalidate the re-declare cache for every key planned on this
+        # conn: a server restart lost its store sizes and round counters,
+        # and the next _init_parts must re-seed from live state.  (Keys
+        # whose state survived just get a cheap idempotent re-INIT.)
+        stale = [pkey for pkey, c in list(self._pkey_conn.items())
+                 if c is conn]
+        for pkey in stale:
+            self._inited.pop(pkey, None)
+        with self._inflight_lock:
+            mine = [p for p in self._inflight.values()
+                    if p.conn is conn and p.parked]
+        mine.sort(key=lambda p: (-p.priority, p.pkey))
+        if mine:
+            get_logger().warning(
+                "replaying %d parked partition(s) on %s:%d",
+                len(mine), conn.host, conn.port)
+        for part in mine:
+            try:
+                self._replay_part(conn, part)
+            except ConnectionError as e:
+                # Dropped mid-replay: re-park; the next reconnect cycle
+                # picks the remainder up.  (The part was already claimed
+                # by _unpark, so re-park it explicitly.)  If the conn
+                # meanwhile gave up for good, parking is refused — fail
+                # the part so its handle never dangles.
+                err = (e if isinstance(e, _ConnLost)
+                       else conn._lost_exc(str(e)))
+                if not self._park_part(part.pkey, part.phase, err):
+                    self._finish_part(part.pkey, err)
+                get_logger().warning(
+                    "replay interrupted on %s:%d: %s", conn.host,
+                    conn.port, e)
+                return
+            except Exception as e:
+                self._finish_part(part.pkey, e)
+
+    def _fail_parked_on(self, conn: "_ServerConn", exc: Exception) -> None:
+        with self._inflight_lock:
+            mine = [p for p in self._inflight.values()
+                    if p.conn is conn and p.parked]
+        for p in mine:
+            self._finish_part(p.pkey, exc)
+
+    def _replay_part(self, conn: "_ServerConn", part: "_PartTask") -> None:
+        """Reconcile one parked partition against server state and replay
+        the outstanding leg(s).  Never double-counts a push: the server's
+        completed_round (from the idempotent re-INIT) tells whether the
+        partition's round already published, the per-worker `seen` dedup
+        absorbs a replay into a still-open round, and the server drops
+        pushes whose round flag is stale."""
+        if not self._unpark(part):
+            return      # another replay pass or a failure beat us to it
+        comp = self._compressors.get(part.pkey >> 16)
+        kw_bytes = comp.kwargs_string().encode() if comp else b""
+        init_payload = struct.pack("<QI", part.ln, len(kw_bytes)) + kw_bytes
+        resp = conn.send(CMD_INIT, part.pkey, init_payload,
+                         worker_id=self.worker_id).wait(60.0)
+        (completed,) = struct.unpack("<Q", resp)
+        self._inited[part.pkey] = (part.ln, kw_bytes)
+        replay_push = part.phase == "push"
+        if not self.server_async:
+            if completed == part.round + 1:
+                # The round published while we were away: our push WAS
+                # counted (sync rounds publish only with all workers in),
+                # so re-pushing would pollute the next round — pull only.
+                replay_push = False
+                part.phase = "pull"
+            elif completed < part.round:
+                # The server lost state (restart): rebase this partition
+                # onto the server's round and re-push — the store is gone,
+                # so the push must be re-applied regardless of phase.
+                get_logger().warning(
+                    "PS server %s:%d lost round state for key %d "
+                    "(completed=%d < round=%d): rebasing and re-pushing",
+                    conn.host, conn.port, part.pkey, completed, part.round)
+                with self._inflight_lock:
+                    part.round = completed
+                    self._round[part.pkey] = completed
+                replay_push = True
+                part.phase = "push"
+            elif completed > part.round + 1:
+                raise RuntimeError(
+                    f"PS server round state for key {part.pkey} is ahead "
+                    f"of this worker by {completed - part.round} rounds "
+                    f"(completed={completed}, staged round={part.round}) — "
+                    f"another worker is reusing this worker_id?")
+        if replay_push:
+            # Back through the scheduler: replays dispatch in the same
+            # (priority desc, key asc) order as first sends, and re-charge
+            # the same credit (returned when the original send failed).
+            with self._transport_lock:
+                self._tstats["replayed_pushes"] += 1
+            with self._cv:
+                self._queue.add(part.pkey, part.priority, part.credit_ln)
+                self._cv.notify_all()
+        else:
+            with self._transport_lock:
+                self._tstats["replayed_pulls"] += 1
+            self._issue_pull(part)
+
+    def _watchdog_loop(self) -> None:
+        interval = max(0.2, min(self.stall_timeout_s / 4.0, 5.0))
+        while not self._watchdog_stop.wait(interval):
+            with self._inflight_lock:
+                outstanding = list(self._inflight.values())
+            if not outstanding:
+                self._mark_progress()   # idle ≠ stalled
+                continue
+            elapsed = time.monotonic() - self._last_progress
+            if elapsed < self.stall_timeout_s:
+                continue
+            self._dump_stall(outstanding, elapsed)
+            with self._transport_lock:
+                self._tstats["watchdog_trips"] += 1
+            err = RuntimeError(
+                f"PS round stalled: no partition completed for "
+                f"{elapsed:.1f}s (BYTEPS_TPU_STALL_TIMEOUT_S="
+                f"{self.stall_timeout_s}); stuck keys: "
+                f"{sorted(p.pkey for p in outstanding)[:16]}")
+            for p in outstanding:
+                self._finish_part(p.pkey, err)
+            self._mark_progress()
+
+    def _dump_stall(self, outstanding, elapsed: float) -> None:
+        """Diagnostic snapshot before failing loudly — the worker-side
+        analog of the ORDERING INVARIANT guard in server.cc."""
+        lines = [
+            f"PS STALL: no partition completed for {elapsed:.1f}s "
+            f"(timeout={self.stall_timeout_s}s); "
+            f"{len(outstanding)} partition(s) outstanding, "
+            f"queue pending={self._queue.pending()}",
+        ]
+        for p in sorted(outstanding, key=lambda p: p.pkey):
+            lines.append(
+                f"  key={p.pkey} round={p.round} phase={p.phase}"
+                f" parked={p.parked} priority={p.priority}"
+                f" bytes={p.wire_ln} conn={p.conn.host}:{p.conn.port}"
+                f"[{p.conn.state()}]")
+        for i, pool in enumerate(self._data_conns):
+            states = ",".join(c.state() for c in pool)
+            lines.append(f"  server[{i}] conns: {states}")
+        with self._transport_lock:
+            lines.append(f"  transport stats: {dict(self._tstats)}")
+        get_logger().error("%s", "\n".join(lines))
+
+    def transport_stats(self) -> dict:
+        """Fault-tolerance counters (reconnects, replayed/parked parts,
+        watchdog trips) — the get_codec_stats() analog for the transport."""
+        with self._transport_lock:
+            s = dict(self._tstats)
+        s["reconnects"] = sum(c.reconnects for pool in self._data_conns
+                              for c in pool)
+        return s
 
     # -- test/introspection hooks -------------------------------------------
     def pause_dispatch(self) -> None:
@@ -846,6 +1489,9 @@ class PSSession:
         scheduler under ONE condition-variable hold."""
         core = get_core()
         enq = core.trace_now_us() if core.trace_on else 0
+        # New work resets the stall clock: an idle session's age must not
+        # count against the first round staged after the lull.
+        self._mark_progress()
         with self._cv:
             for parts, priority in staged:
                 for p in parts:
@@ -875,21 +1521,46 @@ class PSSession:
         tensor's first push used to pay 64 serial RTTs here).  All futures
         resolve before any partition is staged, so the PUSH of a key can
         never beat its INIT to the server."""
+        deadline = time.monotonic() + 60.0
         inits = []
         for pkey, off, ln, conn in plan:
             if self._inited.get(pkey) != (ln, kw_bytes):
                 init_payload = struct.pack(
                     "<QI", ln, len(kw_bytes)) + kw_bytes
-                inits.append((pkey, ln,
-                              conn.send(CMD_INIT, pkey, init_payload,
-                                        worker_id=self.worker_id)))
-        for pkey, ln, fut in inits:
-            resp = fut.wait(60.0)
+                inits.append((pkey, ln, conn, init_payload,
+                              self._send_init(conn, pkey, init_payload,
+                                              deadline)))
+        for pkey, ln, conn, init_payload, fut in inits:
+            while True:
+                try:
+                    resp = fut.wait(max(0.1, deadline - time.monotonic()))
+                    break
+                except _ConnLost as e:
+                    # Dropped mid-outage with reconnect active: INIT is
+                    # idempotent, so ride out the re-dial and re-issue it
+                    # until the deadline — a staging caller should survive
+                    # the same faults the in-flight parts do.
+                    if not e.will_reconnect or time.monotonic() > deadline:
+                        raise
+                    fut = self._send_init(conn, pkey, init_payload, deadline)
             # Seed the round counter from server state so a reconnected
             # worker can never pull a stale previous round.
             (completed,) = struct.unpack("<Q", resp)
             self._round[pkey] = completed
             self._inited[pkey] = (ln, kw_bytes)
+
+    def _send_init(self, conn: "_ServerConn", pkey: int, payload: bytes,
+                   deadline: float) -> "_Future":
+        """Send one CMD_INIT, waiting out a mid-reconnect window (sends
+        raise `_ConnLost(will_reconnect=True)` while the conn re-dials)."""
+        while True:
+            try:
+                return conn.send(CMD_INIT, pkey, payload,
+                                 worker_id=self.worker_id)
+            except _ConnLost as e:
+                if not e.will_reconnect or time.monotonic() > deadline:
+                    raise
+                time.sleep(0.05)
 
     def _encode_part(self, part: "_PartTask", comp, seg) -> None:
         """Produce one partition's compressed wire payload on a codec pool
@@ -969,6 +1640,7 @@ class PSSession:
                                 ln, comp.wire_cap_bytes(ln // 4))
                         self._inflight[pkey] = part
                         parts.append(part)
+                        handle._register_part(pkey)
                         break
                 prev.done_evt.wait(timeout=60.0)
             if part.ready is not None:
@@ -989,9 +1661,15 @@ class PSSession:
 
     def barrier(self, generation: int = 0) -> None:
         """Global barrier across workers (reference: Postoffice::Barrier via
-        the scheduler; here server 0 plays the rendezvous role)."""
+        the scheduler; here server 0 plays the rendezvous role).
+
+        Waits forever by default (peers are allowed to be slow), logging a
+        periodic "still waiting" warning; BYTEPS_TPU_BARRIER_TIMEOUT_S > 0
+        turns a dead peer into a loud TimeoutError instead of a silent
+        hang."""
         self.conns[0].request(CMD_BARRIER, generation,
-                              worker_id=self.worker_id)
+                              worker_id=self.worker_id,
+                              timeout=self.barrier_timeout_s or None)
 
     def shutdown_servers(self) -> None:
         for c in self.conns:
@@ -1012,12 +1690,29 @@ class PSSession:
         with self._cv:
             self._closed = True
             self._cv.notify_all()
+        self._watchdog_stop.set()
         # Dispatcher first (it may be waiting on an encode the pool still
         # owes), then the codec pool (drains queued jobs so every staged
         # handle resolves), then the sockets.
-        self._dispatcher.join(timeout=10)
+        self._dispatcher.join(timeout=self._join_timeout_s)
+        self._warn_if_wedged(self._dispatcher)
         if self._codec_pool is not None:
             self._codec_pool.close()
         for pool in self._data_conns:
             for c in pool:
                 c.close()
+        if self._watchdog is not None:
+            self._watchdog.join(timeout=5)
+
+    def _warn_if_wedged(self, thread: threading.Thread) -> None:
+        """A join() that expired used to leak the thread silently; name it
+        and what it was blocked on so a shutdown hang is diagnosable."""
+        if not thread.is_alive():
+            return
+        with self._inflight_lock:
+            keys = sorted(self._inflight)
+        get_logger().warning(
+            "PS session close: thread %s did not exit within its join "
+            "timeout and is being leaked (daemon); in-flight partition "
+            "keys it may be blocked on: %s%s", thread.name, keys[:16],
+            f" (+{len(keys) - 16} more)" if len(keys) > 16 else "")

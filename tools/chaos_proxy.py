@@ -1,0 +1,332 @@
+#!/usr/bin/env python
+"""Programmable TCP chaos proxy for PS-transport fault injection.
+
+A thin forwarder between a `PSSession` and a real PS server that can
+inject the transport faults a long-running TPU job actually sees —
+connection resets mid-payload, silent blackholes, added latency, flapping
+links — deterministically and in-process, so tests drive the *real*
+client/server wire code through a fault instead of mocking sockets.
+
+    proxy = ChaosProxy("127.0.0.1", server_port)
+    proxy.start()
+    sess = PSSession(["127.0.0.1"], [proxy.port], ...)   # via the proxy
+    ...
+    proxy.reset_after(4096)        # RST both sides after 4 KiB upstream
+    proxy.blackhole(True)          # swallow everything, answer nothing
+    proxy.kill_connections()       # drop every live conn right now
+    proxy.pass_through()           # clear all faults
+
+Faults are **one-shot** by default (fire once, then the link heals —
+the reconnect-and-replay scenario); `once=False` makes them **flapping**
+(every new connection trips the same fault — the give-up scenario).
+
+Also runs standalone for manual chaos testing:
+
+    python tools/chaos_proxy.py --upstream 127.0.0.1:9001 \
+        --listen-port 9101 --reset-after 65536 --flap
+"""
+
+from __future__ import annotations
+
+import argparse
+import socket
+import struct
+import threading
+import time
+from typing import Optional
+
+_CHUNK = 65536
+
+
+class _Fault:
+    """One armed fault: kind in {'reset', 'drop'}, triggered after the
+    proxy has forwarded `after_bytes` upstream-bound bytes (0 = on the
+    next byte)."""
+
+    def __init__(self, kind: str, after_bytes: int, once: bool):
+        self.kind = kind
+        self.after_bytes = int(after_bytes)
+        self.once = once
+
+
+class ChaosProxy:
+    """A programmable TCP forwarder (see module docstring)."""
+
+    def __init__(self, upstream_host: str, upstream_port: int,
+                 listen_host: str = "127.0.0.1", listen_port: int = 0):
+        self.upstream = (upstream_host, int(upstream_port))
+        self._listen_addr = (listen_host, int(listen_port))
+        self._lsock: Optional[socket.socket] = None
+        self.port: int = 0
+        self._lock = threading.Lock()
+        self._fault: Optional[_Fault] = None
+        self._delay_s = 0.0
+        self._blackhole = False
+        self._closing = False
+        self._conns: list = []        # [(client_sock, server_sock)]
+        self._accept_thread: Optional[threading.Thread] = None
+        # Counters (read via stats()).
+        self._bytes_up = 0            # client -> server, forwarded
+        self._bytes_down = 0          # server -> client, forwarded
+        self._bytes_eaten = 0         # swallowed by blackhole
+        self._connections = 0
+        self._faults_fired = 0
+
+    # -- lifecycle ----------------------------------------------------------
+    def start(self) -> "ChaosProxy":
+        self._lsock = socket.socket()
+        self._lsock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._lsock.bind(self._listen_addr)
+        self._lsock.listen(64)
+        self.port = self._lsock.getsockname()[1]
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, daemon=True, name="chaos-accept")
+        self._accept_thread.start()
+        return self
+
+    def stop(self) -> None:
+        with self._lock:
+            self._closing = True
+        if self._lsock is not None:
+            try:
+                self._lsock.close()
+            except OSError:
+                pass
+        self.kill_connections(rst=False)
+
+    def __enter__(self) -> "ChaosProxy":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # -- fault programming --------------------------------------------------
+    def reset_after(self, nbytes: int = 0, once: bool = True) -> None:
+        """RST both legs after `nbytes` further upstream-bound bytes — the
+        mid-payload connection-reset fault (SO_LINGER 0 close)."""
+        with self._lock:
+            self._fault = _Fault("reset", nbytes, once)
+
+    def drop_after(self, nbytes: int = 0, once: bool = True) -> None:
+        """Cleanly FIN both legs after `nbytes` further upstream-bound
+        bytes — the peer-went-away fault."""
+        with self._lock:
+            self._fault = _Fault("drop", nbytes, once)
+
+    def delay(self, ms: float) -> None:
+        """Add per-chunk latency in both directions (crude WAN emulation)."""
+        with self._lock:
+            self._delay_s = max(0.0, ms) / 1000.0
+
+    def blackhole(self, enabled: bool = True) -> None:
+        """Swallow all traffic silently in both directions: bytes are read
+        and discarded, nothing is forwarded, no error is surfaced — the
+        stall fault a watchdog exists for.  Applies to live and new
+        connections until disabled."""
+        with self._lock:
+            self._blackhole = enabled
+
+    def pass_through(self) -> None:
+        """Clear every armed fault (delay, blackhole, reset/drop)."""
+        with self._lock:
+            self._fault = None
+            self._delay_s = 0.0
+            self._blackhole = False
+
+    def kill_connections(self, rst: bool = True) -> None:
+        """Immediately drop every live proxied connection (RST by default);
+        new connections keep working — the transient-outage fault."""
+        with self._lock:
+            conns, self._conns = self._conns, []
+        for pair in conns:
+            for s in pair:
+                self._hard_close(s, rst)
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "connections": self._connections,
+                "live_connections": len(self._conns),
+                "bytes_up": self._bytes_up,
+                "bytes_down": self._bytes_down,
+                "bytes_eaten": self._bytes_eaten,
+                "faults_fired": self._faults_fired,
+            }
+
+    # -- data path ----------------------------------------------------------
+    def _accept_loop(self) -> None:
+        while True:
+            try:
+                client, _ = self._lsock.accept()
+            except OSError:
+                return          # listener closed
+            with self._lock:
+                if self._closing:
+                    client.close()
+                    return
+                self._connections += 1
+                hole = self._blackhole
+            if hole:
+                # Accept but never dial upstream: the connection looks
+                # alive to the client while everything it sends vanishes.
+                threading.Thread(target=self._swallow, args=(client,),
+                                 daemon=True, name="chaos-swallow").start()
+                continue
+            try:
+                server = socket.create_connection(self.upstream, timeout=30)
+            except OSError:
+                client.close()
+                continue
+            for s in (client, server):
+                s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            with self._lock:
+                self._conns.append((client, server))
+            threading.Thread(target=self._pump, args=(client, server, True),
+                             daemon=True, name="chaos-up").start()
+            threading.Thread(target=self._pump, args=(server, client, False),
+                             daemon=True, name="chaos-down").start()
+
+    def _swallow(self, sock: socket.socket) -> None:
+        with self._lock:
+            self._conns.append((sock,))
+        try:
+            while True:
+                data = sock.recv(_CHUNK)
+                if not data:
+                    return
+                with self._lock:
+                    self._bytes_eaten += len(data)
+        except OSError:
+            return
+        finally:
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+    def _pump(self, src: socket.socket, dst: socket.socket,
+              upstream: bool) -> None:
+        try:
+            while True:
+                data = src.recv(_CHUNK)
+                if not data:
+                    break
+                with self._lock:
+                    delay = self._delay_s
+                    hole = self._blackhole
+                    fault = self._fault
+                    fire, cut = None, 0
+                    if upstream and fault is not None:
+                        if fault.after_bytes < len(data):
+                            # Fires INSIDE this chunk: forward the prefix
+                            # so the break lands mid-payload, not politely
+                            # on a frame boundary.
+                            fire, cut = fault.kind, fault.after_bytes
+                            self._faults_fired += 1
+                            if fault.once:
+                                self._fault = None
+                            else:
+                                fault.after_bytes = 0
+                        else:
+                            fault.after_bytes -= len(data)
+                if delay:
+                    time.sleep(delay)
+                if hole:
+                    with self._lock:
+                        self._bytes_eaten += len(data)
+                    continue    # keep reading, forward nothing
+                if fire is not None:
+                    if cut:
+                        try:
+                            dst.sendall(data[:cut])
+                        except OSError:
+                            pass
+                    self._kill_pair(src, dst, rst=(fire == "reset"))
+                    return
+                dst.sendall(data)
+                with self._lock:
+                    if upstream:
+                        self._bytes_up += len(data)
+                    else:
+                        self._bytes_down += len(data)
+        except OSError:
+            pass
+        finally:
+            # Half-close propagation: a dead leg takes the pair with it
+            # (the PS wire is request/response — a one-legged conn only
+            # wedges the client).
+            self._kill_pair(src, dst, rst=False)
+
+    def _kill_pair(self, a: socket.socket, b: socket.socket,
+                   rst: bool) -> None:
+        with self._lock:
+            self._conns = [pair for pair in self._conns
+                           if a not in pair and b not in pair]
+        for s in (a, b):
+            self._hard_close(s, rst)
+
+    @staticmethod
+    def _hard_close(s: socket.socket, rst: bool) -> None:
+        """Close that actually lands while pump threads sit in recv():
+        CPython defers the real close while another thread blocks on the
+        socket, so shutdown() first — it wakes the pump, whose exit lets
+        the close (and the SO_LINGER-0 RST) go out."""
+        try:
+            if rst:
+                s.setsockopt(socket.SOL_SOCKET, socket.SO_LINGER,
+                             struct.pack("ii", 1, 0))
+        except OSError:
+            pass
+        try:
+            s.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
+            s.close()
+        except OSError:
+            pass
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--upstream", required=True, metavar="HOST:PORT",
+                    help="real server address to forward to")
+    ap.add_argument("--listen-port", type=int, default=0,
+                    help="local port to listen on (0 = ephemeral)")
+    ap.add_argument("--listen-host", default="127.0.0.1")
+    ap.add_argument("--delay-ms", type=float, default=0.0,
+                    help="per-chunk latency, both directions")
+    ap.add_argument("--reset-after", type=int, default=None, metavar="N",
+                    help="RST connections after N upstream bytes")
+    ap.add_argument("--drop-after", type=int, default=None, metavar="N",
+                    help="FIN connections after N upstream bytes")
+    ap.add_argument("--blackhole", action="store_true",
+                    help="swallow all traffic silently")
+    ap.add_argument("--flap", action="store_true",
+                    help="re-arm the reset/drop fault for every connection "
+                         "(default: fire once, then heal)")
+    args = ap.parse_args()
+    host, port = args.upstream.rsplit(":", 1)
+    proxy = ChaosProxy(host, int(port), args.listen_host, args.listen_port)
+    proxy.start()
+    if args.delay_ms:
+        proxy.delay(args.delay_ms)
+    if args.reset_after is not None:
+        proxy.reset_after(args.reset_after, once=not args.flap)
+    if args.drop_after is not None:
+        proxy.drop_after(args.drop_after, once=not args.flap)
+    if args.blackhole:
+        proxy.blackhole(True)
+    print(f"chaos proxy: {args.listen_host}:{proxy.port} -> "
+          f"{host}:{port}", flush=True)
+    try:
+        while True:
+            time.sleep(5)
+            print(f"chaos proxy stats: {proxy.stats()}", flush=True)
+    except KeyboardInterrupt:
+        proxy.stop()
+        return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
