@@ -19,7 +19,7 @@ from .common.api import (
     push_pull, push_pull_async, push_pull_tree, synchronize, poll,
     broadcast_parameters, broadcast_optimizer_state,
     get_pushpull_speed, get_codec_stats, get_fusion_stats,
-    get_transport_stats,
+    get_transport_stats, get_metrics, get_server_stats,
     mark_step, current_step,
 )
 from .parallel.async_ps import AsyncPSTrainer
@@ -61,7 +61,7 @@ __all__ = [
     "poll", "AsyncPSTrainer",
     "broadcast_parameters", "broadcast_optimizer_state",
     "get_pushpull_speed", "get_codec_stats", "get_fusion_stats",
-    "get_transport_stats",
+    "get_transport_stats", "get_metrics", "get_server_stats",
     "mark_step", "current_step",
     "Compression", "collectives",
     "DistributedOptimizer", "DistributedGradientTransformation",

@@ -32,8 +32,9 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from . import telemetry
 from .config import Config, get_config
-from .logging import get_logger, set_level
+from .logging import get_logger, set_level, set_rank
 from ..core.native import get_core
 
 PyTree = Any
@@ -49,6 +50,7 @@ class _State:
     handles: Dict[int, Any] = dataclasses.field(default_factory=dict)
     lock: threading.Lock = dataclasses.field(default_factory=threading.Lock)
     ps_session: Optional[Any] = None  # PS-mode client session, when enabled
+    exporter: Optional[Any] = None    # TelemetryExporter, when enabled
 
 
 _state = _State()
@@ -131,6 +133,27 @@ def init(lazy: bool = True) -> None:
         _state.ps_session = PSSession.from_config(cfg)
         _state.ps_session.barrier()
     _state.initialized = True
+    if size() > 1:
+        # Rank-tag the log prefix now that init() knows it: multi-worker
+        # stderr interleaves indistinguishably otherwise.  Single-worker
+        # runs (and everything logged before init) keep the old format.
+        set_rank(rank())
+    _register_builtin_collectors()
+    if cfg.metrics_port > 0 or cfg.metrics_log:
+        try:
+            _state.exporter = telemetry.TelemetryExporter(
+                telemetry.get_registry(), port=cfg.metrics_port,
+                jsonl_path=cfg.metrics_log,
+                refresh=_refresh_server_metrics).start()
+        except OSError as e:
+            # A taken port / unwritable log path must not kill training —
+            # the metrics plane is an observer, never a dependency.
+            get_logger().error(
+                "metrics exporter failed to start "
+                "(BYTEPS_TPU_METRICS_PORT=%d, BYTEPS_TPU_METRICS_LOG=%r): "
+                "%s — continuing without it", cfg.metrics_port,
+                cfg.metrics_log, e)
+            _state.exporter = None
     get_logger().info(
         "byteps_tpu initialized: role=%s rank=%d/%d local_size=%d devices=%d",
         cfg.role, rank(), size(), local_size(), jax.device_count())
@@ -139,6 +162,11 @@ def init(lazy: bool = True) -> None:
 def shutdown() -> None:
     if not _state.initialized:
         return
+    if _state.exporter is not None:
+        # Before the session teardown: the exporter's refresh hook polls
+        # the live session for CMD_STATS.
+        _state.exporter.stop()
+        _state.exporter = None
     if _state.ps_session is not None:
         _state.ps_session.close()
         _state.ps_session = None
@@ -498,7 +526,7 @@ def _fused_tree_push_pull(name, leaves, metas, sep_idx, batch_idx,
             _debug_sample("pull", nm, out)
         cfg = _state.config or get_config()
         if cfg.telemetry_on:
-            get_core().telemetry_record(
+            telemetry.record_pushpull(
                 sum(int(p.size * p.dtype.itemsize)
                     for _, p, _, _, _ in units))
     else:
@@ -575,7 +603,7 @@ def push_pull_async(tensor: jax.Array, name: Optional[str] = None,
             out = out / size()
     cfg = _state.config or get_config()
     if cfg.telemetry_on:
-        core.telemetry_record(tensor.size * tensor.dtype.itemsize)
+        telemetry.record_pushpull(tensor.size * tensor.dtype.itemsize)
     with _state.lock:
         _state.handles[handle] = (out, name, t0)
     return handle
@@ -649,9 +677,92 @@ def broadcast_optimizer_state(opt_state: PyTree, root_rank: int = 0) -> PyTree:
 # ---------------------------------------------------------------------------
 # Telemetry & tracing (reference: global.cc:712-767, 463-579)
 # ---------------------------------------------------------------------------
+def _register_builtin_collectors() -> None:
+    """Attach the legacy stats surfaces to the registry as collectors.
+
+    snapshot()/the Prometheus endpoint then export bps_codec_*,
+    bps_transport_* and bps_fusion_* values that are *identical by
+    construction* to get_codec_stats()/get_transport_stats()/
+    get_fusion_stats() — the registry reads through the same accessors at
+    snapshot time instead of keeping shadow counters that could drift.
+    Idempotent (re-registering replaces the same name).
+    """
+    reg = telemetry.get_registry()
+    # Late-bound lambdas: the accessors are defined further down this
+    # module and only need to exist at snapshot time.
+    reg.register_collector("codec", lambda: get_codec_stats())
+    reg.register_collector("transport", lambda: get_transport_stats())
+    reg.register_collector("fusion", lambda: get_fusion_stats())
+
+
+_register_builtin_collectors()
+
+
+def _refresh_server_metrics() -> None:
+    """Exporter refresh hook: fold a fresh CMD_STATS poll into the
+    registry (round-lag gauges + straggler warning) so every scrape and
+    JSONL line carries scrape-fresh server state.  Quiet outside PS mode
+    and while the server is unreachable — the endpoint must keep serving
+    worker-side metrics even when the PS tier is the thing that broke."""
+    if _state.ps_session is None:
+        return
+    try:
+        get_server_stats()
+    except Exception as e:
+        get_logger().debug("CMD_STATS poll failed: %s", e)
+
+
+def get_metrics() -> dict:
+    """One isolated snapshot of the unified metrics registry.
+
+    Includes every registered counter/gauge/histogram (push RTT,
+    dispatcher queue wait/depth, codec encode/decode latency, step time,
+    push-pull bytes, round-lag gauges) plus the collector-backed
+    bps_codec_* / bps_transport_* / bps_fusion_* values, which match the
+    legacy ``get_*_stats()`` accessors exactly.  Purely local — it never
+    touches the network; use :func:`get_server_stats` for a live
+    CMD_STATS poll.
+    """
+    return telemetry.get_registry().snapshot()
+
+
+def get_server_stats() -> dict:
+    """Live server-side stats over the wire (CMD_STATS), merged across
+    servers: per-key merge counts / completed rounds / pending-pull
+    depth / pushed bytes, per-worker push counts and round position, and
+    server wire bytes in/out.  Also folds per-worker round lag into the
+    ``bps_worker_round_lag`` gauges and logs a straggler warning for any
+    worker trailing by more than ``BYTEPS_TPU_STRAGGLER_ROUNDS``.
+
+    Returns the all-zero shape outside PS mode.  Raises a "server too
+    old" RuntimeError against a pre-CMD_STATS server (the unknown
+    command draws an error status, never a hang).
+    """
+    if _state.ps_session is None:
+        return {"bytes_in": 0, "bytes_out": 0, "async": False,
+                "num_workers": 0, "keys": {}, "workers": {},
+                "round_lag": {}}
+    cfg = _state.config or get_config()
+    stats = _state.ps_session.server_stats()
+    stats["round_lag"] = telemetry.update_round_lag(
+        stats, cfg.straggler_rounds)
+    return stats
+
+
 def get_pushpull_speed() -> tuple:
-    """(timestamp, MB/s) moving average, like byteps_get_pushpull_speed."""
-    return (time.time(), get_core().telemetry_speed_mbps())
+    """(timestamp, MB/s) moving average, like byteps_get_pushpull_speed.
+
+    Reimplemented on the telemetry registry: every push_pull records its
+    logical tensor bytes via ``telemetry.record_pushpull``, which feeds
+    both the cumulative ``bps_pushpull_bytes_total`` counter and a
+    10-second moving window; this returns ``bytes_in_window / 1e6 /
+    window_seconds`` — numerically equivalent to the retired native-core
+    window (core.cc bps_telemetry_speed_mbps: same window length, same
+    sum-over-window-divided-by-window definition), but served from the
+    same registry the /metrics endpoint exports, so the two can never
+    disagree.
+    """
+    return (time.time(), telemetry.pushpull_speed_mbps())
 
 
 def get_codec_stats() -> Dict[str, int]:
@@ -713,6 +824,14 @@ def mark_step() -> None:
             and cfg.trace_start_step <= _state.step <= cfg.trace_end_step:
         core.trace_record(f"step_{_state.step}", "STEP",
                           _state.step_start_us, now - _state.step_start_us)
+    if cfg.telemetry_on and _state.step_start_us is not None:
+        # Per-step wall time: the trace only keeps this inside its window;
+        # the registry keeps the full-run distribution live.
+        telemetry.get_registry().histogram(
+            "bps_step_time_seconds",
+            bounds=telemetry.STEP_TIME_BUCKETS,
+            help="wall time between consecutive mark_step() calls"
+        ).observe((now - _state.step_start_us) / 1e6)
     _state.step += 1
     _state.step_start_us = now
     if cfg.trace_on:

@@ -109,6 +109,17 @@ class Config:
     # server-side analog is BYTEPS_SERVER_DEBUG(_KEY), read by the C++
     # server directly).
     debug_sample_tensor: str = ""        # BYTEPS_DEBUG_SAMPLE_TENSOR
+    # Unified metrics plane (common/telemetry.py).  metrics_port > 0 serves
+    # Prometheus text format at http://<host>:<port>/metrics from a
+    # background thread; metrics_log appends periodic JSONL registry
+    # snapshots to the given path.  Both default off — the registry itself
+    # always collects (its fast path is lock-free and O(ns)).
+    metrics_port: int = 0                # BYTEPS_TPU_METRICS_PORT
+    metrics_log: str = ""                # BYTEPS_TPU_METRICS_LOG
+    # Straggler detection: warn when any worker's per-worker round position
+    # (from CMD_STATS) trails the lead worker by more than this many sync
+    # rounds.  0 disables the warning (the lag gauges still export).
+    straggler_rounds: int = 10           # BYTEPS_TPU_STRAGGLER_ROUNDS
 
     # ---- logging ----
     log_level: str = "WARNING"           # BYTEPS_LOG_LEVEL
@@ -165,6 +176,9 @@ class Config:
             trace_dir=_env_str("BYTEPS_TRACE_DIR", "./traces"),
             telemetry_on=_env_bool("BYTEPS_TELEMETRY_ON", True),
             debug_sample_tensor=_env_str("BYTEPS_DEBUG_SAMPLE_TENSOR", ""),
+            metrics_port=_env_int("BYTEPS_TPU_METRICS_PORT", 0),
+            metrics_log=_env_str("BYTEPS_TPU_METRICS_LOG", ""),
+            straggler_rounds=_env_int("BYTEPS_TPU_STRAGGLER_ROUNDS", 10),
             log_level=_env_str("BYTEPS_LOG_LEVEL", "WARNING"),
             mesh_dp=_env_int("BYTEPS_TPU_MESH_DP", 0),
             mesh_tp=_env_int("BYTEPS_TPU_MESH_TP", 1),

@@ -38,6 +38,25 @@ def set_level(name: str) -> None:
     get_logger().setLevel(_resolve(name))
 
 
+_FMT = "[%(asctime)s] [%(levelname)s] byteps_tpu: %(message)s"
+
+
+def set_rank(rank: int | None) -> None:
+    """Stamp the worker rank into the log prefix once init() knows it.
+
+    Multi-worker runs interleave every worker's stderr into one stream;
+    without the rank tag a "still waiting on barrier" line is
+    unattributable.  Called with the rank after init() (and again on
+    elastic resume, where the rank can change); `None` restores the
+    pre-init format, which is deliberately unchanged for everything
+    logged before init().
+    """
+    fmt = _FMT if rank is None else _FMT.replace(
+        "byteps_tpu:", f"byteps_tpu[{int(rank)}]:")
+    for h in get_logger().handlers:
+        h.setFormatter(logging.Formatter(fmt, datefmt="%H:%M:%S"))
+
+
 def get_logger() -> logging.Logger:
     global _logger
     if _logger is None:
@@ -45,9 +64,7 @@ def get_logger() -> logging.Logger:
         lg.setLevel(_resolve(os.environ.get("BYTEPS_LOG_LEVEL", "WARNING")))
         if not lg.handlers:
             h = logging.StreamHandler(sys.stderr)
-            h.setFormatter(logging.Formatter(
-                "[%(asctime)s] [%(levelname)s] byteps_tpu: %(message)s",
-                datefmt="%H:%M:%S"))
+            h.setFormatter(logging.Formatter(_FMT, datefmt="%H:%M:%S"))
             lg.addHandler(h)
         lg.propagate = False
         _logger = lg

@@ -13,7 +13,8 @@
 // Request : u8 cmd | u8 dtype | u16 flags | u32 req_id | u32 worker_id
 //           | u64 key | u64 len | payload[len]
 // Response: u8 status | u32 req_id | u64 key | u64 len | payload[len]
-// cmds: 0 HELLO, 1 INIT, 2 PUSH, 3 PULL, 4 BARRIER, 5 SHUTDOWN, 6 PING
+// cmds: 0 HELLO, 1 INIT, 2 PUSH, 3 PULL, 4 BARRIER, 5 SHUTDOWN, 6 PING,
+//       7 LR_SCALE, 8 STATS
 //
 // req_id is client-chosen and echoed back, so one connection multiplexes
 // many outstanding requests — the redesign of ps-lite's ZPush/ZPull
@@ -73,6 +74,15 @@ enum Cmd : uint8_t {
                  // error on every key (the reference's lr.s mechanism for
                  // the server-side VanillaErrorFeedback; rank 0 sends it
                  // once per LR change)
+  kStats = 8,    // server-side telemetry (CMD_STATS): responds with a JSON
+                 // snapshot of per-key merge counts / completed rounds /
+                 // pending-pull depth, per-worker push counts and round
+                 // position (the straggler-lag signal), and total wire
+                 // bytes in/out.  Handled on the reader thread so stats
+                 // never queue behind a wedged engine; an OLD server that
+                 // predates this command routes it to an engine whose
+                 // default arm responds kError — clients turn that into a
+                 // "server too old" error, never a hang.
 };
 enum Status : uint8_t { kOk = 0, kError = 1 };
 enum WireDtype : uint8_t {
@@ -910,8 +920,14 @@ class Server {
     return true;
   }
 
-  static void Respond(Conn* c, uint8_t status, uint32_t req_id, uint64_t key,
-                      const char* data, uint64_t len) {
+  void Respond(Conn* c, uint8_t status, uint32_t req_id, uint64_t key,
+               const char* data, uint64_t len) {
+    // Member (not static) for the wire-bytes-out stat: counted at frame
+    // build time — close enough for an operator-facing gauge, and the
+    // alternative (counting the sendmsg return) would misreport dropped
+    // peers anyway.
+    bytes_out_.fetch_add(sizeof(RespHeader) + len,
+                         std::memory_order_relaxed);
     std::lock_guard<std::mutex> lk(c->write_mu);
     RespHeader h{status, req_id, key, len};
     // One sendmsg for header+payload: two send() calls under TCP_NODELAY
@@ -976,6 +992,115 @@ class Server {
     return best;
   }
 
+  // --- CMD_STATS telemetry -------------------------------------------
+  // Engine threads fold per-key / per-worker deltas in under stats_mu_
+  // (a few int stores per push — noise next to the 4MB f32 merge the
+  // same task just did); the reader thread serializes the whole table
+  // to JSON under the same mutex.  Kept separate from KeyState on
+  // purpose: KeyState is engine-owned and reading it from a reader
+  // thread would race the merge loop.
+  struct KeyStat {
+    uint64_t pushes = 0;          // frames accepted (incl. dups/stale acks)
+    uint64_t merges = 0;          // frames actually merged into a round
+    uint64_t completed_round = 0; // rounds published
+    uint64_t round_pushes = 0;    // workers merged into the OPEN round —
+                                  // pending-push depth = num_workers minus
+                                  // this (how many pushes the round still
+                                  // waits on)
+    uint64_t pending_pulls = 0;   // pulls parked for an unpublished round
+    uint64_t bytes = 0;           // wire payload bytes pushed
+  };
+  struct WorkerStat {
+    uint64_t pushes = 0;  // accepted merges from this worker
+    uint64_t round = 0;   // round position: sync = the round index this
+                          // worker is pushing INTO + 1 (so equal workers
+                          // report equal numbers); async = push count
+  };
+
+  void StatPush(uint64_t key, uint32_t worker, uint64_t wire_bytes,
+                bool merged, uint64_t round_pos, uint64_t round_pushes = 0) {
+    std::lock_guard<std::mutex> lk(stats_mu_);
+    KeyStat& ks = key_stats_[key];
+    ks.pushes++;
+    if (merged) {
+      ks.merges++;
+      ks.bytes += wire_bytes;
+      ks.round_pushes = round_pushes;
+      WorkerStat& ws = worker_stats_[worker];
+      ws.pushes++;
+      // round_pos = 0 means "no sync round" (async / seed): a worker's
+      // progress signal degrades to its accepted-push count there.
+      uint64_t rp = round_pos ? round_pos : ws.pushes;
+      if (rp > ws.round) ws.round = rp;
+    }
+  }
+
+  void StatPublish(uint64_t key, uint64_t completed_round) {
+    std::lock_guard<std::mutex> lk(stats_mu_);
+    KeyStat& ks = key_stats_[key];
+    ks.completed_round = completed_round;
+    ks.round_pushes = 0;   // fresh round: no one has pushed into it yet
+  }
+
+  void StatPendingPulls(uint64_t key, int64_t delta) {
+    std::lock_guard<std::mutex> lk(stats_mu_);
+    uint64_t& p = key_stats_[key].pending_pulls;
+    p = (delta < 0 && p < static_cast<uint64_t>(-delta))
+            ? 0 : p + delta;
+  }
+
+  std::string StatsJson() {
+    // Worst-case keys row: 6 u64 fields at 20 digits + ~110 chars of
+    // labels — keep comfortable headroom (snprintf truncation would
+    // silently corrupt the JSON).
+    char buf[320];
+    std::string js;
+    js.reserve(4096);
+    std::snprintf(buf, sizeof(buf),
+                  "{\"bytes_in\":%llu,\"bytes_out\":%llu,\"async\":%d,"
+                  "\"num_workers\":%d,\"keys\":{",
+                  static_cast<unsigned long long>(
+                      bytes_in_.load(std::memory_order_relaxed)),
+                  static_cast<unsigned long long>(
+                      bytes_out_.load(std::memory_order_relaxed)),
+                  async_ ? 1 : 0, num_workers_);
+    js += buf;
+    std::lock_guard<std::mutex> lk(stats_mu_);
+    bool first = true;
+    for (auto& kv : key_stats_) {
+      std::snprintf(buf, sizeof(buf),
+                    "%s\"%llu\":{\"pushes\":%llu,\"merges\":%llu,"
+                    "\"completed_round\":%llu,\"round_pushes\":%llu,"
+                    "\"pending_pulls\":%llu,\"bytes\":%llu}",
+                    first ? "" : ",",
+                    static_cast<unsigned long long>(kv.first),
+                    static_cast<unsigned long long>(kv.second.pushes),
+                    static_cast<unsigned long long>(kv.second.merges),
+                    static_cast<unsigned long long>(
+                        kv.second.completed_round),
+                    static_cast<unsigned long long>(
+                        kv.second.round_pushes),
+                    static_cast<unsigned long long>(
+                        kv.second.pending_pulls),
+                    static_cast<unsigned long long>(kv.second.bytes));
+      js += buf;
+      first = false;
+    }
+    js += "},\"workers\":{";
+    first = true;
+    for (auto& kv : worker_stats_) {
+      std::snprintf(buf, sizeof(buf),
+                    "%s\"%u\":{\"pushes\":%llu,\"round\":%llu}",
+                    first ? "" : ",", kv.first,
+                    static_cast<unsigned long long>(kv.second.pushes),
+                    static_cast<unsigned long long>(kv.second.round));
+      js += buf;
+      first = false;
+    }
+    js += "}}";
+    return js;
+  }
+
   void ReaderLoop(Conn* conn) {
     ReaderBody(conn);
     // Reader exit (peer hung up, we rejected an oversize frame, or a
@@ -1009,6 +1134,7 @@ class Server {
       if (h.len > max_msg_) break;  // corrupt/hostile frame: drop the conn
       std::vector<char> payload(h.len);
       if (h.len && !ReadFull(conn->fd, payload.data(), h.len)) break;
+      bytes_in_.fetch_add(sizeof(h) + h.len, std::memory_order_relaxed);
       switch (h.cmd) {
         case kHello: {
           // HELLO advertises server mode: u8 async | u8 schedule.  Lets
@@ -1022,6 +1148,15 @@ class Server {
         case kPing:
           Respond(conn, kOk, h.req_id, h.key, nullptr, 0);
           break;
+        case kStats: {
+          // Reader-thread stats snapshot: never queues behind a busy (or
+          // wedged) engine, so an operator can still scrape a server
+          // that stopped making round progress — the exact situation
+          // stats exist for.
+          std::string js = StatsJson();
+          Respond(conn, kOk, h.req_id, h.key, js.data(), js.size());
+          break;
+        }
         case kLrScale: {
           // Fan out to every engine: per-key state is engine-owned, so
           // each engine rescales the ef_err of the keys assigned to it.
@@ -1187,6 +1322,8 @@ class Server {
 
   void HandlePush(Task& t) {
     KeyState& ks = StateFor(t.key);
+    // Captured before the COPY_FIRST move below can gut t.payload.
+    const uint64_t wire_len = t.payload.size();
     if (t.dtype == kSeed) {
       // Store seeding for async weight-delta training: applied only if the
       // key has never been pushed, so a late-joining/rejoining worker
@@ -1204,6 +1341,7 @@ class Server {
         ks.dtype = kF32;
       }
       ks.out = ks.store;
+      StatPush(t.key, t.worker_id, wire_len, true, 0);
       Respond(t.conn, kOk, t.req_id, t.key, nullptr, 0);
       FlushPulls(ks, t.key);
       return;
@@ -1244,6 +1382,7 @@ class Server {
       // push flags == completed_round (round counters are seeded from the
       // INIT response and advance only after the round publishes), so
       // only replays and protocol violators can land here.
+      StatPush(t.key, t.worker_id, wire_len, false, 0);
       Respond(t.conn, kOk, t.req_id, t.key, nullptr, 0);
       return;
     }
@@ -1259,6 +1398,7 @@ class Server {
       // merge permanently one push short once the reset clears `seen`
       // (already-acked workers never re-push), wedging every pull.
       ks.push_count.fetch_add(1, std::memory_order_relaxed);
+      StatPush(t.key, t.worker_id, wire_len, false, 0);
       Respond(t.conn, kOk, t.req_id, t.key, nullptr, 0);
       return;
     }
@@ -1319,6 +1459,7 @@ class Server {
       ks.out = ks.store;
       DebugLog("async_merge", t.key, t.worker_id, ks.completed_round,
                ks.store);
+      StatPush(t.key, t.worker_id, wire_len, true, 0);
       Respond(t.conn, kOk, t.req_id, t.key, nullptr, 0);
       FlushPulls(ks, t.key);
       return;
@@ -1342,6 +1483,11 @@ class Server {
       SumInto(ks, *data);  // SUM_RECV
     }
     ks.seen.insert(t.worker_id);
+    // round_pos = completed_round + 1: "this worker has contributed
+    // through round completed_round" — equal across workers when they
+    // are in step, and the lead-minus-lagger delta IS the straggler lag.
+    StatPush(t.key, t.worker_id, wire_len, true, ks.completed_round + 1,
+             ks.seen.size());
     Respond(t.conn, kOk, t.req_id, t.key, nullptr, 0);
     if (static_cast<int>(ks.seen.size()) >= num_workers_) {
       // ALL_RECV: publish the completed round and start a fresh merge.
@@ -1384,6 +1530,7 @@ class Server {
       ks.completed_round++;
       ks.seen.clear();
       ks.round_compressed = false;
+      StatPublish(t.key, ks.completed_round);
       FlushPulls(ks, t.key);
     }
   }
@@ -1445,20 +1592,24 @@ class Server {
     } else {
       AddRef(t.conn);   // the stash outlives the task's own hold
       ks.pending.push_back({t.conn, t.req_id, t.key, t.flags});
+      StatPendingPulls(t.key, 1);
     }
   }
 
   void FlushPulls(KeyState& ks, uint64_t key) {
     std::vector<PendingPull> still;
+    int64_t flushed = 0;
     for (auto& p : ks.pending) {
       if (async_ || (ks.completed_round & 0xFFFF) != p.want_round) {
         Respond(p.conn, kOk, p.req_id, key, ks.out.data(), ks.out.size());
         ReleaseRef(p.conn);
+        ++flushed;
       } else {
         still.push_back(p);
       }
     }
     ks.pending.swap(still);
+    if (flushed) StatPendingPulls(key, -flushed);
   }
 
   int port_;
@@ -1488,6 +1639,13 @@ class Server {
 
   std::mutex barrier_mu_;
   std::map<uint64_t, std::vector<PendingPull>> barrier_waiters_;
+
+  // CMD_STATS telemetry (see StatsJson).
+  std::mutex stats_mu_;
+  std::map<uint64_t, KeyStat> key_stats_;
+  std::map<uint32_t, WorkerStat> worker_stats_;
+  std::atomic<uint64_t> bytes_in_{0};
+  std::atomic<uint64_t> bytes_out_{0};
 
   std::mutex conns_mu_;
   std::vector<Conn*> conns_;

@@ -60,13 +60,13 @@ _REQ = struct.Struct("<BBHIIQQ")   # cmd dtype flags req_id worker_id key len
 _RESP = struct.Struct("<BIQQ")     # status req_id key len
 
 CMD_HELLO, CMD_INIT, CMD_PUSH, CMD_PULL, CMD_BARRIER, CMD_SHUTDOWN, \
-    CMD_PING, CMD_LR_SCALE = range(8)
+    CMD_PING, CMD_LR_SCALE, CMD_STATS = range(9)
 
 # dtype byte on the wire (server.cc WireDtype)
 DT_F32, DT_RAW, DT_COMPRESSED, DT_SEED = 0, 1, 2, 3
 
 _CMD_NAMES = {0: "HELLO", 1: "INIT", 2: "PUSH", 3: "PULL", 4: "BARRIER",
-              5: "SHUTDOWN", 6: "PING", 7: "LR_SCALE"}
+              5: "SHUTDOWN", 6: "PING", 7: "LR_SCALE", 8: "STATS"}
 
 # How often the barrier wait logs a "still waiting" warning; module-level so
 # tests can shrink it (bps.barrier legitimately blocks on peers for a long
@@ -574,7 +574,8 @@ class _PartTask:
     __slots__ = ("pkey", "payload", "off", "ln", "round", "conn", "handle",
                  "dtype", "done_evt", "wire_ln", "bidirectional",
                  "label", "priority", "enq_ts", "push_ts", "pull_ts",
-                 "ready", "enc_err", "credit_ln", "phase", "parked")
+                 "ready", "enc_err", "credit_ln", "phase", "parked",
+                 "enq_mono", "send_mono")
 
     def __init__(self, pkey, payload, off, ln, rnd, conn, handle,
                  dtype=DT_F32, bidirectional=False, label=""):
@@ -613,6 +614,11 @@ class _PartTask:
         # partition stashed for replay while its connection reconnects.
         self.phase = "push"
         self.parked = False
+        # Telemetry timestamps (time.monotonic; always set, unlike the
+        # trace-gated *_ts fields): enqueue -> dispatch feeds the queue-wait
+        # histogram, dispatch -> ack the push-RTT histogram.
+        self.enq_mono = 0.0
+        self.send_mono = 0.0
 
 
 class PSSession:
@@ -774,6 +780,24 @@ class PSSession:
         self._last_progress = time.monotonic()
         self._watchdog_stop = threading.Event()
         self._watchdog: Optional[threading.Thread] = None
+        # Metrics-registry feeds (common/telemetry.py).  The objects are
+        # resolved once here; the per-partition hot path then pays only a
+        # lock-free observe()/set() per event.  The queue-depth gauge
+        # samples the scheduler lazily at snapshot time (detached again in
+        # close() so a dead session can't pin itself via the registry).
+        from ..common import telemetry as _tm
+        reg = _tm.get_registry()
+        self._m_push_rtt = reg.histogram(
+            "bps_push_rtt_seconds",
+            help="per-partition push dispatch -> server ack round trip")
+        self._m_queue_wait = reg.histogram(
+            "bps_dispatch_queue_wait_seconds",
+            help="per-partition time from enqueue to dispatcher pick")
+        self._queue_depth_fn = lambda: self._queue.pending()
+        self._m_queue_depth = reg.gauge(
+            "bps_dispatch_queue_depth",
+            help="partitions waiting in the priority scheduler",
+            fn=self._queue_depth_fn)
         self._join_timeout_s = 10.0   # close()'s thread-join budget
         self._dispatcher = threading.Thread(
             target=self._dispatch_loop, daemon=True, name="bps-ps-dispatch")
@@ -940,6 +964,9 @@ class PSSession:
                 core.trace_record_part(part.label, "QUEUE", part.enq_ts,
                                        part.push_ts - part.enq_ts, pkey,
                                        part.wire_ln, part.priority)
+            part.send_mono = time.monotonic()
+            if part.enq_mono:
+                self._m_queue_wait.observe(part.send_mono - part.enq_mono)
             try:
                 part.conn.send(
                     CMD_PUSH, pkey, part.payload, worker_id=self.worker_id,
@@ -973,6 +1000,8 @@ class PSSession:
                 part.phase = "pull"   # push acked: only the pull remains
         if part is None:
             return
+        if part.send_mono:
+            self._m_push_rtt.observe(time.monotonic() - part.send_mono)
         core = get_core()
         if core.trace_on and part.push_ts:
             part.pull_ts = core.trace_now_us()
@@ -1364,6 +1393,57 @@ class PSSession:
                               for c in pool)
         return s
 
+    def server_stats(self, timeout: float = 10.0) -> dict:
+        """Server-side CMD_STATS snapshot, merged across all servers.
+
+        Returns {"bytes_in", "bytes_out", "async", "num_workers",
+        "keys": {wire_key: {pushes, merges, completed_round,
+        round_pushes, pending_pulls, bytes}}, "workers": {worker_id:
+        {pushes, round}}}.  `round_pushes` is how many workers have
+        merged into the key's OPEN round — pending-push depth is
+        num_workers - round_pushes, the "who is the round waiting on"
+        signal; `pending_pulls` counts pulls parked for a round that
+        has not published yet.
+        Keys are disjoint across servers (hash placement) so their maps
+        union; per-worker rounds take the MIN across servers — a worker
+        lagging on any server gates every sync round it participates in.
+
+        A pre-CMD_STATS server routes the unknown command to an engine
+        whose default arm answers with an error status, which surfaces
+        here as a clean "server too old" RuntimeError — never a hang.
+        """
+        merged = {"bytes_in": 0, "bytes_out": 0, "async": False,
+                  "num_workers": 0, "keys": {}, "workers": {}}
+        import json as _json
+        for c in self.conns:
+            try:
+                raw = c.request(CMD_STATS, worker_id=self.worker_id,
+                                timeout=timeout)
+            except RuntimeError as e:
+                raise RuntimeError(
+                    f"PS server at {c.host}:{c.port} does not support "
+                    f"CMD_STATS (server too old — rebuild/redeploy the "
+                    f"server tier to match this client): {e}") from e
+            st = _json.loads(bytes(raw).decode())
+            merged["bytes_in"] += int(st.get("bytes_in", 0))
+            merged["bytes_out"] += int(st.get("bytes_out", 0))
+            merged["async"] = merged["async"] or bool(st.get("async"))
+            merged["num_workers"] = max(merged["num_workers"],
+                                        int(st.get("num_workers", 0)))
+            for k, v in (st.get("keys") or {}).items():
+                merged["keys"][int(k)] = v
+            for w, v in (st.get("workers") or {}).items():
+                w = int(w)
+                prev = merged["workers"].get(w)
+                if prev is None:
+                    merged["workers"][w] = dict(v)
+                else:
+                    prev["pushes"] = (int(prev.get("pushes", 0))
+                                      + int(v.get("pushes", 0)))
+                    prev["round"] = min(int(prev.get("round", 0)),
+                                        int(v.get("round", 0)))
+        return merged
+
     # -- test/introspection hooks -------------------------------------------
     def pause_dispatch(self) -> None:
         """Hold dispatch so several push_pull_async calls can enqueue before
@@ -1492,10 +1572,12 @@ class PSSession:
         # New work resets the stall clock: an idle session's age must not
         # count against the first round staged after the lull.
         self._mark_progress()
+        enq_mono = time.monotonic()
         with self._cv:
             for parts, priority in staged:
                 for p in parts:
                     p.enq_ts = enq
+                    p.enq_mono = enq_mono
                     # credit_ln: actual wire bytes for ready parts; the
                     # codec's worst-case bound for pipelined encodes (their
                     # true size doesn't exist yet and p.wire_ln is racing
@@ -1691,6 +1773,14 @@ class PSSession:
             self._closed = True
             self._cv.notify_all()
         self._watchdog_stop.set()
+        # Detach the queue-depth gauge's sampler: the registry outlives the
+        # session, and a lazy gauge holding `self` would both leak the
+        # session and report a dead scheduler's depth.  Only if the gauge
+        # still carries OUR sampler — a later session owns it otherwise,
+        # and zeroing here would silence a live scheduler's depth.
+        if self._m_queue_depth._fn is self._queue_depth_fn:
+            self._m_queue_depth.set_fn(None)
+            self._m_queue_depth.set(0)
         # Dispatcher first (it may be waiting on an encode the pool still
         # owes), then the codec pool (drains queued jobs so every staged
         # handle resolves), then the sockets.

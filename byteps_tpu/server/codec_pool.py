@@ -58,6 +58,20 @@ class CompressionPool:
         # bps.get_codec_stats for tooling like tools/wire_bench.py).
         self._counts = {"ENCODE": 0, "DECODE": 0}
         self._busy_us = {"ENCODE": 0, "DECODE": 0}
+        # Registry histograms for per-job codec latency (the busy-time
+        # counters above only expose totals; operators alerting on a codec
+        # regression need the distribution).  Resolved once; observe() is
+        # lock-free.
+        from ..common import telemetry as _tm
+        reg = _tm.get_registry()
+        self._m_lat = {
+            "ENCODE": reg.histogram(
+                "bps_codec_encode_seconds",
+                help="per-partition wire-compressor encode latency"),
+            "DECODE": reg.histogram(
+                "bps_codec_decode_seconds",
+                help="per-partition wire-compressor decode latency"),
+        }
         self.num_threads = threads
         self._threads: List[threading.Thread] = [
             threading.Thread(target=self._loop, daemon=True,
@@ -83,6 +97,9 @@ class CompressionPool:
         work" (inline mode does its codec work uncounted on the
         caller/receiver threads).  The receiver-thread fallback decode
         during shutdown is the one non-pool-thread path that records."""
+        m = self._m_lat.get(stage)
+        if m is not None:
+            m.observe(max(0, int(dur_us)) / 1e6)
         with self._cv:
             self._counts[stage] = self._counts.get(stage, 0) + 1
             self._busy_us[stage] = self._busy_us.get(stage, 0) + max(

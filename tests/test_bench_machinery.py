@@ -88,6 +88,29 @@ def test_ps_bench_compressed_mode_emits_json():
     assert out["value"] > 0
 
 
+def test_telemetry_bench_emits_json():
+    """BENCH_TELEMETRY: one JSON line with the overhead delta and the
+    measured per-inc registry cost (host-only, small rep count).  The
+    O(ns)-class fast-path bound itself is asserted by
+    tests/test_telemetry.py::test_counter_fast_path_cost; this checks the
+    bench contract (keys present, sane values) without timing-sensitive
+    assertions that would flake on a loaded CI host."""
+    env = dict(os.environ)
+    env.update({"BENCH_TELEMETRY": "1", "BENCH_TELEMETRY_REPS": "4",
+                "BYTEPS_LOG_LEVEL": "ERROR"})
+    env.pop("XLA_FLAGS", None)
+    r = subprocess.run([sys.executable, BENCH], env=env,
+                       capture_output=True, text=True, timeout=600)
+    assert r.returncode == 0, r.stderr[-2000:]
+    out = json.loads(r.stdout.strip().splitlines()[-1])
+    assert out["metric"] == "telemetry_overhead_ms"
+    d = out["detail"]
+    assert d["round_off_median_ms"] > 0
+    assert d["round_hot_median_ms"] > 0
+    assert d["registry_inc_ns"] > 0
+    assert out["vs_baseline"] > 0
+
+
 @pytest.mark.slow
 def test_machinery_bench_bucketed_beats_naive():
     """Wall-clock: bucketed >= naive in the small-leaves regime.  Retries

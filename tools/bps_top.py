@@ -1,0 +1,244 @@
+#!/usr/bin/env python
+"""bps_top — live terminal dashboard for the byteps_tpu metrics endpoint.
+
+Polls the Prometheus text endpoint a worker serves when launched with
+``BYTEPS_TPU_METRICS_PORT`` (see docs/monitoring.md) and renders the
+interesting slices: push-pull throughput, push RTT / dispatcher-queue
+latency percentiles, codec latency, per-worker round lag (straggler
+view), and the codec/transport/fusion counter panels.
+
+Usage:
+    python tools/bps_top.py --url http://host:9100/metrics
+    python tools/bps_top.py --port 9100                  # localhost
+    python tools/bps_top.py --port 9100 --plain          # no curses
+    python tools/bps_top.py --port 9100 --once           # one snapshot
+
+Curses is used when stdout is a tty (fall back with --plain); --once
+prints a single snapshot and exits (handy over ssh or in a pipeline).
+No dependencies beyond the stdlib — the parser speaks just enough of
+the exposition format for our own endpoint.
+"""
+
+from __future__ import annotations
+
+import argparse
+import re
+import sys
+import time
+import urllib.request
+
+_LINE = re.compile(r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{[^}]*\})?\s+(\S+)$")
+_LABEL = re.compile(r'(\w+)="([^"]*)"')
+
+
+def fetch(url: str, timeout: float = 3.0) -> str:
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return r.read().decode()
+
+
+def parse(text: str) -> dict:
+    """{name: {frozenset(label items) or (): float}} — enough structure
+    for gauges/counters and histogram _bucket/_sum/_count series."""
+    out: dict = {}
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        m = _LINE.match(line.strip())
+        if not m:
+            continue
+        name, labels, value = m.groups()
+        key = tuple(sorted(_LABEL.findall(labels))) if labels else ()
+        try:
+            out.setdefault(name, {})[key] = float(value)
+        except ValueError:
+            continue
+    return out
+
+
+def _get(metrics: dict, name: str, default: float = 0.0) -> float:
+    series = metrics.get(name)
+    if not series:
+        return default
+    return sum(series.values())
+
+
+def quantile(metrics: dict, hist: str, q: float) -> float:
+    """Linear-interpolated quantile from cumulative _bucket series."""
+    series = metrics.get(hist + "_bucket") or {}
+    buckets = []
+    for key, cum in series.items():
+        le = dict(key).get("le")
+        if le is None:
+            continue
+        buckets.append((float("inf") if le == "+Inf" else float(le), cum))
+    if not buckets:
+        return 0.0
+    buckets.sort()
+    total = buckets[-1][1]
+    if total <= 0:
+        return 0.0
+    target = q * total
+    prev_le, prev_cum = 0.0, 0.0
+    for le, cum in buckets:
+        if cum >= target:
+            if le == float("inf"):
+                return prev_le
+            span = cum - prev_cum
+            frac = (target - prev_cum) / span if span else 1.0
+            return prev_le + (le - prev_le) * frac
+        prev_le, prev_cum = le, cum
+    return buckets[-1][0]
+
+
+def _fmt_s(v: float) -> str:
+    if v >= 1.0:
+        return f"{v:6.2f}s"
+    if v >= 1e-3:
+        return f"{v * 1e3:6.2f}ms"
+    return f"{v * 1e6:6.0f}us"
+
+
+def _fmt_bytes(v: float) -> str:
+    for unit in ("B", "KB", "MB", "GB", "TB"):
+        if v < 1024 or unit == "TB":
+            return f"{v:8.1f}{unit}"
+        v /= 1024
+    return f"{v:8.1f}TB"
+
+
+def render(metrics: dict, prev: dict, dt: float) -> list:
+    """Dashboard lines from the current (and previous, for rates) poll."""
+    lines = []
+    now = time.strftime("%H:%M:%S")
+    pushed = _get(metrics, "bps_pushpull_bytes_total")
+    rate = ((pushed - _get(prev, "bps_pushpull_bytes_total")) / dt
+            if prev and dt > 0 else 0.0)
+    lines.append(f"bps_top  {now}   push_pull {_fmt_bytes(pushed)} total"
+                 f"   {_fmt_bytes(rate)}/s")
+    lines.append("")
+
+    lines.append("latency                 p50      p95      count")
+    for label, hist in (("push RTT", "bps_push_rtt_seconds"),
+                        ("queue wait", "bps_dispatch_queue_wait_seconds"),
+                        ("codec encode", "bps_codec_encode_seconds"),
+                        ("codec decode", "bps_codec_decode_seconds"),
+                        ("step time", "bps_step_time_seconds")):
+        count = _get(metrics, hist + "_count")
+        if count <= 0:
+            continue
+        lines.append(f"  {label:<18}{_fmt_s(quantile(metrics, hist, 0.5))}"
+                     f"  {_fmt_s(quantile(metrics, hist, 0.95))}"
+                     f"  {int(count):9d}")
+    depth = _get(metrics, "bps_dispatch_queue_depth")
+    lines.append(f"  dispatcher queue depth: {int(depth)}")
+    lines.append("")
+
+    lag = metrics.get("bps_worker_round_lag") or {}
+    if lag:
+        lines.append("workers (round lag — stragglers first)")
+        ranked = sorted(lag.items(), key=lambda kv: -kv[1])
+        for key, v in ranked:
+            wid = dict(key).get("worker", "?")
+            bar = "#" * min(40, int(v))
+            flag = "  <-- straggler" if v > 0 and v == ranked[0][1] else ""
+            lines.append(f"  worker {wid:>3}  lag {int(v):4d}  {bar}{flag}")
+        lines.append("")
+
+    for panel, prefix in (("transport", "bps_transport_"),
+                          ("codec", "bps_codec_"),
+                          ("fusion", "bps_fusion_")):
+        rows = [(n[len(prefix):], _get(metrics, n))
+                for n in sorted(metrics)
+                if n.startswith(prefix) and not n.endswith(
+                    ("_bucket", "_sum", "_count"))
+                and "_seconds" not in n]
+        rows = [(k, v) for k, v in rows if v]
+        if rows:
+            lines.append(panel)
+            for k, v in rows:
+                lines.append(f"  {k:<28}{int(v):>12d}")
+            lines.append("")
+    return lines
+
+
+def run_plain(url: str, interval: float, once: bool) -> int:
+    prev: dict = {}
+    t_prev = time.monotonic()
+    while True:
+        try:
+            metrics = parse(fetch(url))
+        except OSError as e:
+            print(f"bps_top: cannot reach {url}: {e}", file=sys.stderr)
+            if once:
+                return 1
+            time.sleep(interval)
+            continue
+        now = time.monotonic()
+        lines = render(metrics, prev, now - t_prev)
+        prev, t_prev = metrics, now
+        if once:
+            print("\n".join(lines))
+            return 0
+        # ANSI clear + home: a poor man's curses that survives pipes.
+        sys.stdout.write("\x1b[2J\x1b[H" + "\n".join(lines) + "\n")
+        sys.stdout.flush()
+        time.sleep(interval)
+
+
+def run_curses(url: str, interval: float) -> int:
+    import curses
+
+    def loop(scr):
+        curses.curs_set(0)
+        scr.nodelay(True)
+        prev: dict = {}
+        t_prev = time.monotonic()
+        while True:
+            try:
+                metrics = parse(fetch(url))
+                now = time.monotonic()
+                lines = render(metrics, prev, now - t_prev)
+                prev, t_prev = metrics, now
+            except OSError as e:
+                lines = [f"bps_top: cannot reach {url}", f"  {e}",
+                         "", "retrying... (q quits)"]
+            scr.erase()
+            h, w = scr.getmaxyx()
+            for i, line in enumerate(lines[:h - 1]):
+                scr.addnstr(i, 0, line, w - 1)
+            scr.refresh()
+            t_end = time.monotonic() + interval
+            while time.monotonic() < t_end:
+                if scr.getch() in (ord("q"), 27):
+                    return
+                time.sleep(0.05)
+
+    curses.wrapper(loop)
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--url", help="full metrics URL")
+    ap.add_argument("--port", type=int,
+                    help="shorthand for http://127.0.0.1:<port>/metrics")
+    ap.add_argument("--interval", type=float, default=2.0,
+                    help="poll interval seconds (default 2)")
+    ap.add_argument("--plain", action="store_true",
+                    help="ANSI refresh loop instead of curses")
+    ap.add_argument("--once", action="store_true",
+                    help="print one snapshot and exit")
+    args = ap.parse_args(argv)
+    if not args.url and not args.port:
+        ap.error("need --url or --port")
+    url = args.url or f"http://127.0.0.1:{args.port}/metrics"
+    if args.once or args.plain or not sys.stdout.isatty():
+        return run_plain(url, args.interval, args.once)
+    try:
+        return run_curses(url, args.interval)
+    except Exception:
+        return run_plain(url, args.interval, once=False)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
