@@ -29,6 +29,9 @@ Modes:
   BENCH_FUSION=1     fusion-layer wire bench: many small tensors, per-leaf
                      vs fused-bucket dispatch through the real PS server
                      (emits fusion_small_tensor_caller_block)
+  BENCH_TRACE=1      tracing-overhead bench: sync-round time with the
+                     distributed tracer hot (worker+server spans, traced
+                     wire flags) vs off (emits trace_overhead_ms)
   BENCH_TELEMETRY=1  telemetry-overhead bench: sync-round time with the
                      metrics endpoint scraped at 20Hz vs export plane off
                      (emits telemetry_overhead_ms; expected within noise)
@@ -781,6 +784,86 @@ def bench_telemetry():
         proc.wait()
 
 
+def bench_trace():
+    """Tracing-overhead benchmark: sync-round time with the distributed
+    tracer HOT (worker span recording + traced wire flags + server-side
+    span ring + clock sync) vs OFF (BYTEPS_TRACE_ON unset: untraced
+    frames are byte-identical to the pre-trace wire, asserted by
+    tests/test_trace.py).
+
+    `trace_overhead_ms` is the median per-round delta; expected within
+    round-to-round noise — the tracer's hot-path cost is a few clock
+    reads and a mutex-guarded ring append per partition per stage.
+    Host-only, like BENCH_PS; mirrors BENCH_TELEMETRY.
+    """
+    import tempfile
+
+    import numpy as np
+
+    from byteps_tpu.core.native import get_core
+    from byteps_tpu.server.client import PSSession
+
+    reps = int(os.environ.get("BENCH_TRACE_REPS", "30"))
+    proc, port = _boot_ps_server(engine_threads=2)
+    core = get_core()
+    try:
+        sess = PSSession(["127.0.0.1"], [port], worker_id=0, num_servers=1)
+        x = np.random.default_rng(0).standard_normal(
+            1 << 20, dtype=np.float32)            # 4 MB, one partition
+
+        def rounds(n):
+            times = []
+            for _ in range(n):
+                t0 = time.perf_counter()
+                sess.push_pull(1, x)
+                times.append(time.perf_counter() - t0)
+            return times
+
+        sess.push_pull(1, x)                      # init + warm
+        rounds(5)                                 # settle
+        off = rounds(reps)                        # tracer off
+
+        core.trace_enable(True)
+        sess.sync_clocks()                        # the trace-enable leg
+        rounds(5)                                 # settle traced
+        hot = rounds(reps)                        # tracer hot
+        worker_spans = core.trace_count()
+        server_spans = sess.fetch_server_trace()
+        core.trace_enable(False)
+        # Drain the worker buffer so a later bench in the same process
+        # never inherits this one's spans.
+        core.trace_dump(os.path.join(tempfile.gettempdir(),
+                                     "bps_bench_trace.json"), 0)
+        sess.close()
+
+        off_med = sorted(off)[len(off) // 2]
+        hot_med = sorted(hot)[len(hot) // 2]
+        delta_ms = (hot_med - off_med) * 1e3
+        print(json.dumps({
+            "metric": "trace_overhead_ms",
+            "value": round(delta_ms, 3),
+            "unit": "ms",
+            "vs_baseline": round(hot_med / off_med, 3),
+            "detail": {
+                "round_off_median_ms": round(off_med * 1e3, 2),
+                "round_hot_median_ms": round(hot_med * 1e3, 2),
+                "reps": reps,
+                "worker_spans": int(worker_spans),
+                "server_spans": len(server_spans),
+                "server_stages": sorted(
+                    {s["stage"] for s in server_spans}),
+                "note": "value = median 4MB sync round with worker+server "
+                        "span recording on (traced wire flags, server "
+                        "ring appends) minus median with tracing off; "
+                        "expected within round-to-round noise",
+                **_note(),
+            },
+        }))
+    finally:
+        proc.kill()
+        proc.wait()
+
+
 def _free_port() -> int:
     import socket
     with socket.socket() as sk:
@@ -1137,6 +1220,8 @@ def main():
         bench_fault()        # host-only: no device backend involved
     elif os.environ.get("BENCH_TELEMETRY", "0") == "1":
         bench_telemetry()    # host-only: no device backend involved
+    elif os.environ.get("BENCH_TRACE", "0") == "1":
+        bench_trace()        # host-only: no device backend involved
     elif os.environ.get("BENCH_CNN", ""):
         # Validate the name BEFORE the (possibly minutes-long) backend
         # probe so a typo still honors the one-JSON-line contract.

@@ -51,6 +51,7 @@ class _State:
     lock: threading.Lock = dataclasses.field(default_factory=threading.Lock)
     ps_session: Optional[Any] = None  # PS-mode client session, when enabled
     exporter: Optional[Any] = None    # TelemetryExporter, when enabled
+    trace_atexit: bool = False        # crash-flush guard registered
 
 
 _state = _State()
@@ -121,7 +122,20 @@ def init(lazy: bool = True) -> None:
     set_level(cfg.log_level)   # honor a refreshed level on init/resume
     core = get_core()
     if cfg.trace_on:
-        core.trace_enable(True)
+        # Honor the window from the start: with START_STEP > 0 the tracer
+        # (and the traced wire flags the server records spans for) stays
+        # off until mark_step enters the window — the same law mark_step
+        # applies at every boundary.
+        core.trace_enable(cfg.trace_start_step <= _state.step
+                          <= cfg.trace_end_step)
+        if not _state.trace_atexit:
+            # Crash flush: a run that dies mid-window (exception, failed
+            # watchdog) still leaves a usable trace file — atexit runs on
+            # interpreter teardown either way, and a clean shutdown()
+            # already drained the buffer so the guard is then a no-op.
+            import atexit
+            atexit.register(_dump_trace_on_exit)
+            _state.trace_atexit = True
     if cfg.ps_mode and cfg.role == "worker":
         try:
             from ..server.client import PSSession
@@ -132,6 +146,18 @@ def init(lazy: bool = True) -> None:
                 "build") from e
         _state.ps_session = PSSession.from_config(cfg)
         _state.ps_session.barrier()
+        if cfg.trace_on:
+            # Clock alignment at trace-enable (NTP midpoint over
+            # timestamped CMD_PINGs) + the periodic re-sync thread, so
+            # server spans land on this worker's timeline.  An old
+            # server only loses the server half of the trace.
+            try:
+                _state.ps_session.sync_clocks()
+                _state.ps_session.start_clock_sync()
+            except Exception as e:
+                get_logger().warning(
+                    "server clock sync unavailable (%s); trace will "
+                    "carry worker spans only", e)
     _state.initialized = True
     if size() > 1:
         # Rank-tag the log prefix now that init() knows it: multi-worker
@@ -167,10 +193,12 @@ def shutdown() -> None:
         # the live session for CMD_STATS.
         _state.exporter.stop()
         _state.exporter = None
+    # Dump BEFORE the session teardown: the merged export drains the
+    # server-side span ring over the live connections.
+    _maybe_dump_trace(final=True)
     if _state.ps_session is not None:
         _state.ps_session.close()
         _state.ps_session = None
-    _maybe_dump_trace(final=True)
     if _state.jax_dist_initialized:
         # Required for elastic resume: a second jax.distributed.initialize
         # raises unless the first is torn down.
@@ -510,11 +538,20 @@ def _fused_tree_push_pull(name, leaves, metas, sep_idx, batch_idx,
     if sess is not None:
         from ..ops.compression import Compression
         items, ctxs = [], []
-        for nm, payload, prio, comp, _ in units:
+        for nm, payload, prio, comp, members in units:
             _debug_sample("push", nm, payload)
             comp = comp or Compression.none
             wire, ctx = comp.compress(payload)
-            items.append((declare(nm), wire, prio))
+            dk = declare(nm)
+            if len(members) > 1 and get_core().trace_on:
+                # Fused bucket inside a trace window: record its
+                # member-leaf names so trace spans carry the real
+                # parameters in args.members (the analyzer's slow-bucket
+                # attribution).  Gated like every other trace feed — an
+                # untraced run must not build name lists per step.
+                sess.set_trace_members(
+                    dk, [leaf_name(li) for li, _ in members])
+            items.append((dk, wire, prio))
             ctxs.append((comp, ctx))
         handles = sess.push_pull_group(items)
         for (nm, _, _, _, members), h, (comp, ctx) in zip(
@@ -841,14 +878,102 @@ def mark_step() -> None:
             _maybe_dump_trace()
 
 
-def _maybe_dump_trace(final: bool = False) -> None:
+def _maybe_dump_trace(final: bool = False, exiting: bool = False) -> None:
     cfg = _state.config or get_config()
     core = get_core()
     if not cfg.trace_on or core.trace_count() == 0:
         return
     d = os.path.join(cfg.trace_dir, str(local_rank()))
     os.makedirs(d, exist_ok=True)
-    core.trace_dump(os.path.join(d, "comm.json"), rank())
+    path = os.path.join(d, "comm.json")
+    core.trace_dump(path, rank())
+    _merge_server_trace(path, exiting=exiting)
+
+
+def _dump_trace_on_exit() -> None:
+    """atexit guard: flush whatever the tracer still holds (crashed or
+    watchdog-failed runs never reach mark_step's window-end dump)."""
+    try:
+        _maybe_dump_trace(final=True, exiting=True)
+    except Exception:
+        pass
+
+
+def _merge_server_trace(path: str, exiting: bool = False) -> None:
+    """Fold server-side spans into the freshly-dumped worker trace file.
+
+    The result is ONE Chrome/Perfetto JSON per worker with a process lane
+    per host: this worker's spans on pid=rank, each PS server's
+    offset-corrected spans on pid=SERVER_PID_BASE+idx (named via
+    process_name metadata).  Fusion-bucket spans gain ``args.members``
+    (the real parameters riding the bucket), and the file is run through
+    the critical-path analyzer to feed the live
+    ``bps_step_critical_path_*`` gauges.  Every step is best-effort: a
+    dead server tier still leaves the plain worker trace behind.
+    """
+    import json
+    from . import trace_analysis
+    sess = _state.ps_session
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+        events = doc.get("traceEvents", [])
+        # tid present on metadata too: older consumers iterate e["tid"]
+        # over the whole file.
+        meta = [{"name": "process_name", "ph": "M", "pid": rank(),
+                 "tid": 0, "args": {"name": f"worker{rank()}"}}]
+        if sess is not None:
+            core = get_core()
+            try:
+                # Bounded budgets everywhere: a blackholed server must
+                # not pin shutdown() or a mid-training window-end dump
+                # for the API-default ping+fetch budget (~80s/server).
+                # Offset accuracy comes from min-RTT filtering, not
+                # sample count, so the smaller ping budget costs nothing
+                # on a healthy network.  The atexit (crash) path cuts
+                # harder still — fail fast, keep the worker half.
+                if exiting:
+                    spans = sess.fetch_server_trace(
+                        timeout=2.0, ping_timeout=1.0, ping_samples=2)
+                else:
+                    spans = sess.fetch_server_trace(
+                        timeout=5.0, ping_timeout=2.0, ping_samples=3)
+            except Exception as e:
+                get_logger().warning("server trace unavailable: %s", e)
+                spans = []
+            seen_servers = set()
+            for s in spans:
+                dk, pidx = s["key"] >> 16, s["key"] & 0xFFFF
+                nm = core.declared_name(dk) or f"key_{dk}"
+                seen_servers.add(s["server"])
+                events.append({
+                    "name": f"{nm}.part{pidx}", "cat": "comm", "ph": "X",
+                    "ts": s["ts_us"], "dur": s["dur_us"],
+                    "pid": trace_analysis.SERVER_PID_BASE + s["server"],
+                    "tid": s["stage"],
+                    "args": {"key": s["key"], "round": s["round"],
+                             "worker": s["worker"], "bytes": s["bytes"]}})
+            for i in sorted(seen_servers):
+                meta.append({"name": "process_name", "ph": "M",
+                             "pid": trace_analysis.SERVER_PID_BASE + i,
+                             "tid": 0, "args": {"name": f"server{i}"}})
+            members = sess.trace_members()
+            if members:
+                for e in events:
+                    k = (e.get("args") or {}).get("key")
+                    if k is not None and (k >> 16) in members:
+                        e["args"]["members"] = members[k >> 16]
+        doc["traceEvents"] = meta + events
+        with open(path, "w") as f:
+            json.dump(doc, f)
+    except Exception:
+        get_logger().exception("merged trace export failed")
+        return
+    try:
+        result = trace_analysis.analyze(doc["traceEvents"], worker=rank())
+        trace_analysis.update_critical_path_gauges(result)
+    except Exception:
+        get_logger().exception("critical-path analysis failed")
 
 
 def current_step() -> int:

@@ -102,6 +102,12 @@ class Config:
     trace_start_step: int = 10           # BYTEPS_TRACE_START_STEP
     trace_end_step: int = 20             # BYTEPS_TRACE_END_STEP
     trace_dir: str = "./traces"          # BYTEPS_TRACE_DIR
+    # Distributed-trace clock alignment: how often (seconds) the worker
+    # re-estimates each PS server's clock offset over timestamped
+    # CMD_PINGs while tracing is on, bounding drift across a long trace
+    # window.  Offsets are also estimated at trace-enable and at each
+    # server-trace fetch regardless.
+    clock_sync_s: float = 30.0           # BYTEPS_TPU_CLOCK_SYNC_S
     telemetry_on: bool = True            # BYTEPS_TELEMETRY_ON
     # Debug sampling: log norm + first values of any eager-path tensor
     # whose name contains this substring, at each host-visible stage
@@ -174,6 +180,8 @@ class Config:
             trace_start_step=_env_int("BYTEPS_TRACE_START_STEP", 10),
             trace_end_step=_env_int("BYTEPS_TRACE_END_STEP", 20),
             trace_dir=_env_str("BYTEPS_TRACE_DIR", "./traces"),
+            clock_sync_s=float(
+                os.environ.get("BYTEPS_TPU_CLOCK_SYNC_S") or 30.0),
             telemetry_on=_env_bool("BYTEPS_TELEMETRY_ON", True),
             debug_sample_tensor=_env_str("BYTEPS_DEBUG_SAMPLE_TENSOR", ""),
             metrics_port=_env_int("BYTEPS_TPU_METRICS_PORT", 0),

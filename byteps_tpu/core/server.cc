@@ -14,7 +14,7 @@
 //           | u64 key | u64 len | payload[len]
 // Response: u8 status | u32 req_id | u64 key | u64 len | payload[len]
 // cmds: 0 HELLO, 1 INIT, 2 PUSH, 3 PULL, 4 BARRIER, 5 SHUTDOWN, 6 PING,
-//       7 LR_SCALE, 8 STATS
+//       7 LR_SCALE, 8 STATS, 9 TRACE
 //
 // req_id is client-chosen and echoed back, so one connection multiplexes
 // many outstanding requests — the redesign of ps-lite's ZPush/ZPull
@@ -83,8 +83,37 @@ enum Cmd : uint8_t {
                  // predates this command routes it to an engine whose
                  // default arm responds kError — clients turn that into a
                  // "server too old" error, never a hang.
+  kTrace = 9,    // server-side span tracer (CMD_TRACE): drains the bounded
+                 // span ring (RECV / MERGE_WAIT / SUM / PUBLISH /
+                 // PULL_SEND per traced key+round) as JSON, plus the
+                 // server's monotonic clock for offset sanity.  Reader
+                 // thread, same rationale and same old-server error path
+                 // as kStats.  Spans are recorded ONLY for frames whose
+                 // header flags carry kFlagTraced — the worker's trace
+                 // window — so an untraced run records (and pays) nothing.
 };
 enum Status : uint8_t { kOk = 0, kError = 1 };
+
+// Header `flags` bit 15: this frame is inside the sending worker's trace
+// window.  PUSH/PULL frames carry their round in the LOW 15 BITS always;
+// bit 15 belongs exclusively to the marker — if untraced frames kept the
+// full 16-bit round, a key's round counter reaching 32768 would bleed
+// into the bit and make the server record (and pay for) spans across
+// 32768 consecutive untraced rounds.  A run with tracing off is
+// byte-identical to the pre-trace wire through round 32767 per key.
+// A traced PING additionally asks for the server's clock in the response
+// (the NTP-style offset estimation leg).  The round-aliasing distance
+// drops from 65536 to 32768 stale rounds — equally unreachable by
+// protocol (see HandlePull's invariant comment).
+enum : uint16_t { kFlagTraced = 0x8000, kRoundMask = 0x7FFF };
+
+// True when a frame's u16 round flags refer to `round`.  The ONE
+// comparison for the push stale-round guard, the pull round check, and
+// pending-pull flushes — worker round counters and server
+// completed_round advance in lockstep, so both sides mask identically.
+inline bool RoundMatch(uint16_t flags, uint64_t round) {
+  return (flags & kRoundMask) == (round & kRoundMask);
+}
 enum WireDtype : uint8_t {
   kF32 = 0,        // summed across workers
   kRaw = 1,        // last-write-wins bytes
@@ -654,6 +683,115 @@ inline int64_t EncodeDithering(const float* x, uint32_t n, uint32_t s,
 
 }  // namespace codec
 
+// ---------------------------------------------------------------------------
+// Server-side span tracer (CMD_TRACE) — the server half of the distributed
+// timeline (worker half: core.cc g_tracer; reference: the per-stage server
+// profiling the reference exposes via BYTEPS_SERVER_DEBUG, made structured).
+// Engine threads record spans for traced frames only (header kFlagTraced,
+// i.e. inside the worker's BYTEPS_TRACE_START/END_STEP window) into a
+// bounded ring; the reader thread drains it as JSON on CMD_TRACE.  All
+// timestamps are this host's steady_clock µs — the worker aligns them onto
+// its own clock via CMD_PING offset estimation (client.py
+// estimate_clock_offset), so cross-host spans land on one timeline.
+// ---------------------------------------------------------------------------
+inline int64_t NowUs() {
+  return std::chrono::duration_cast<std::chrono::microseconds>(
+             std::chrono::steady_clock::now().time_since_epoch())
+      .count();
+}
+
+struct TraceSpan {
+  const char* stage = "";  // static strings only ("RECV", "SUM", ...)
+  uint64_t key = 0;
+  uint64_t round = 0;
+  uint32_t worker = 0;
+  int64_t ts_us = 0;
+  int64_t dur_us = 0;
+  uint64_t bytes = 0;
+};
+
+class ServerTracer {
+ public:
+  ServerTracer() {
+    // Ring capacity (spans): BYTEPS_SERVER_TRACE_EVENTS, strict-parsed
+    // like BYTEPS_SERVER_MAX_MSG_BYTES.  65536 spans ≈ 5 MB of JSON and
+    // thousands of traced rounds between fetches; overflow drops the
+    // OLDEST spans and reports the count so the client can warn.
+    const char* cap = std::getenv("BYTEPS_SERVER_TRACE_EVENTS");
+    if (cap && cap[0]) {
+      char* end = nullptr;
+      uint64_t v = std::strtoull(cap, &end, 10);
+      if (end && *end == '\0' && v > 0) cap_ = static_cast<size_t>(v);
+    }
+    ring_.resize(cap_);
+  }
+
+  void Record(const char* stage, uint64_t key, uint64_t round,
+              uint32_t worker, int64_t ts_us, int64_t dur_us,
+              uint64_t bytes) {
+    std::lock_guard<std::mutex> lk(mu_);
+    ring_[head_] = TraceSpan{stage, key, round, worker, ts_us, dur_us,
+                             bytes};
+    head_ = (head_ + 1) % cap_;
+    if (count_ < cap_) ++count_;
+    else ++dropped_;
+  }
+
+  // Fetch-and-clear: each span is returned to exactly one fetcher (in a
+  // multi-worker run the fetching workers partition the stream — the
+  // offline analyzer merges files, tools/trace_analyze.py).  The ring is
+  // SWAPPED out under the mutex (O(1) + one pre-built allocation) and
+  // serialized outside it: formatting up to 65536 spans takes
+  // milliseconds, and holding mu_ for that would stall every engine
+  // thread's Record() mid-merge — an observability fetch must never
+  // inject a cross-engine pause into live rounds.
+  std::string DrainJson() {
+    std::vector<TraceSpan> taken(cap_);   // allocated outside the lock
+    size_t head, count;
+    uint64_t dropped;
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      std::swap(ring_, taken);
+      head = head_;
+      count = count_;
+      dropped = dropped_;
+      head_ = count_ = 0;
+      dropped_ = 0;
+    }
+    std::string js;
+    js.reserve(96 + count * 112);
+    char buf[224];
+    std::snprintf(buf, sizeof(buf),
+                  "{\"now_us\":%lld,\"dropped\":%llu,\"spans\":[",
+                  static_cast<long long>(NowUs()),
+                  static_cast<unsigned long long>(dropped));
+    js += buf;
+    size_t start = (head + cap_ - count) % cap_;
+    for (size_t i = 0; i < count; ++i) {
+      const TraceSpan& s = taken[(start + i) % cap_];
+      std::snprintf(buf, sizeof(buf),
+                    "%s{\"st\":\"%s\",\"k\":%llu,\"r\":%llu,\"w\":%u,"
+                    "\"ts\":%lld,\"d\":%lld,\"b\":%llu}",
+                    i ? "," : "", s.stage,
+                    static_cast<unsigned long long>(s.key),
+                    static_cast<unsigned long long>(s.round), s.worker,
+                    static_cast<long long>(s.ts_us),
+                    static_cast<long long>(s.dur_us),
+                    static_cast<unsigned long long>(s.bytes));
+      js += buf;
+    }
+    js += "]}";
+    return js;
+  }
+
+ private:
+  std::mutex mu_;
+  std::vector<TraceSpan> ring_;
+  size_t cap_ = 65536;
+  size_t head_ = 0, count_ = 0;
+  uint64_t dropped_ = 0;
+};
+
 #pragma pack(push, 1)
 struct ReqHeader {
   uint8_t cmd;
@@ -691,7 +829,11 @@ struct PendingPull {
   Conn* conn;
   uint32_t req_id = 0;
   uint64_t key;
-  uint16_t want_round = 0;  // pull round (mod 2^16) the worker expects
+  uint16_t want_round = 0;  // raw round flags the worker sent (traced
+                            // frames carry kFlagTraced + round mod 2^15,
+                            // untraced the round mod 2^16 — RoundMatch)
+  uint32_t worker = 0;      // for the PULL_SEND trace span
+  bool traced = false;      // record a span when the pull finally serves
 };
 
 // Per-key merge state — the reference's BytePSArray + update buffers
@@ -718,6 +860,12 @@ struct KeyState {
                                // compressor_registry.cc:39-56)
   std::vector<float> ef_err;   // requantization error, one slot per elem
   std::vector<PendingPull> pending;
+  // Traced merges of the OPEN round: (worker, merge-complete ts).  On
+  // publish each entry becomes a MERGE_WAIT span — the time that worker's
+  // contribution sat waiting for the round's remaining workers, i.e. the
+  // straggler signal.  Only traced pushes append, so an untraced run
+  // never allocates here.  Cleared wherever `seen` resets.
+  std::vector<std::pair<uint32_t, int64_t>> merge_ts;
   std::atomic<uint64_t> push_count{0};  // total pushes (schedule priority);
                                         // atomic: written by engine, read
                                         // by reader threads
@@ -734,6 +882,9 @@ struct Task {
   Conn* conn;
   uint64_t priority;  // higher = sooner when scheduling enabled
   uint64_t seq;       // FIFO tiebreak
+  int64_t recv_us = 0;  // frame-read timestamp, set only for traced
+                        // frames: engine-start minus this is the RECV
+                        // span (server-side queue wait)
 };
 
 struct TaskCmp {
@@ -1146,8 +1297,26 @@ class Server {
           break;
         }
         case kPing:
-          Respond(conn, kOk, h.req_id, h.key, nullptr, 0);
+          if (h.flags & kFlagTraced) {
+            // Traced ping: answer with this host's monotonic clock so
+            // the worker can estimate the cross-host offset (NTP-style
+            // midpoint, client.py estimate_clock_offset).  Untraced
+            // pings keep the historical empty response byte-for-byte.
+            int64_t now = NowUs();
+            Respond(conn, kOk, h.req_id, h.key,
+                    reinterpret_cast<const char*>(&now), sizeof(now));
+          } else {
+            Respond(conn, kOk, h.req_id, h.key, nullptr, 0);
+          }
           break;
+        case kTrace: {
+          // Reader-thread drain, like kStats: a trace fetch must answer
+          // even when an engine is wedged mid-round — that wedge is
+          // exactly what the spans exist to diagnose.
+          std::string js = tracer_.DrainJson();
+          Respond(conn, kOk, h.req_id, h.key, js.data(), js.size());
+          break;
+        }
         case kStats: {
           // Reader-thread stats snapshot: never queues behind a busy (or
           // wedged) engine, so an operator can still scrape a server
@@ -1207,6 +1376,9 @@ class Server {
           t.conn = conn;
           t.seq = seq_.fetch_add(1);
           t.priority = 0;
+          // Clock read only for traced frames: the untraced hot path
+          // stays exactly as cheap as before.
+          t.recv_us = (h.flags & kFlagTraced) ? NowUs() : 0;
           // h is #pragma pack(1): h.key sits at offset 12, so binding
           // unordered_map::operator[]'s `const key_type&` directly to it
           // is UB (misaligned 8-byte reference — UBSan catches it under
@@ -1313,6 +1485,7 @@ class Server {
     if (ks.store.size() != n) {
       ks.store.assign(n, 0);
       ks.seen.clear();
+      ks.merge_ts.clear();
     }
     ks.dtype = t.dtype;
     uint64_t round = ks.completed_round;
@@ -1371,7 +1544,14 @@ class Server {
         return;
       }
     }
-    if (!async_ && t.flags != (ks.completed_round & 0xFFFF)) {
+    const bool traced = (t.flags & kFlagTraced) != 0;
+    if (traced && t.recv_us) {
+      // RECV: frame fully read -> engine picked it up (server-side queue
+      // wait — an engine backed up behind other keys shows here).
+      tracer_.Record("RECV", t.key, ks.completed_round, t.worker_id,
+                     t.recv_us, NowUs() - t.recv_us, wire_len);
+    }
+    if (!async_ && !RoundMatch(t.flags, ks.completed_round)) {
       // Stale-round replay guard: a push's u16 flags carry the round the
       // worker staged it for; one that is not the round currently merging
       // belongs to an already-PUBLISHED round — a reconnecting worker
@@ -1402,6 +1582,10 @@ class Server {
       Respond(t.conn, kOk, t.req_id, t.key, nullptr, 0);
       return;
     }
+    // SUM span start: everything from here to the merge landing
+    // (decompress + validate + sum/copy-first) is this push's share of
+    // engine work.
+    const int64_t sum_t0 = traced ? NowUs() : 0;
     if (t.dtype == kCompressed) {
       if (!async_ && ks.seen.empty()) {
         // COPY_FIRST for compressed pushes: decompress straight into
@@ -1447,6 +1631,7 @@ class Server {
       // still advances on a wrong sum.
       ks.store.assign(want, 0);
       ks.seen.clear();
+      ks.merge_ts.clear();   // the discarded merges' waits died with it
     }
     ks.dtype = t.dtype == kCompressed ? kF32 : t.dtype;
     ks.push_count.fetch_add(1, std::memory_order_relaxed);
@@ -1459,6 +1644,9 @@ class Server {
       ks.out = ks.store;
       DebugLog("async_merge", t.key, t.worker_id, ks.completed_round,
                ks.store);
+      if (traced)
+        tracer_.Record("SUM", t.key, 0, t.worker_id, sum_t0,
+                       NowUs() - sum_t0, wire_len);
       StatPush(t.key, t.worker_id, wire_len, true, 0);
       Respond(t.conn, kOk, t.req_id, t.key, nullptr, 0);
       FlushPulls(ks, t.key);
@@ -1483,6 +1671,15 @@ class Server {
       SumInto(ks, *data);  // SUM_RECV
     }
     ks.seen.insert(t.worker_id);
+    if (traced) {
+      const int64_t merged_us = NowUs();
+      tracer_.Record("SUM", t.key, ks.completed_round, t.worker_id,
+                     sum_t0, merged_us - sum_t0, wire_len);
+      // Merge landed: the clock on this worker's MERGE_WAIT starts now
+      // and stops when the round publishes (below) — the span IS the
+      // time this push sat waiting for the round's remaining workers.
+      ks.merge_ts.emplace_back(t.worker_id, merged_us);
+    }
     // round_pos = completed_round + 1: "this worker has contributed
     // through round completed_round" — equal across workers when they
     // are in step, and the lead-minus-lagger delta IS the straggler lag.
@@ -1493,6 +1690,8 @@ class Server {
       // ALL_RECV: publish the completed round and start a fresh merge.
       // Bidirectional compressors re-compress the merged buffer for the
       // pull leg (reference: impl/onebit bidirectional, server engine).
+      const uint64_t pub_round = ks.completed_round;
+      const int64_t pub_t0 = ks.merge_ts.empty() ? 0 : NowUs();
       if (ks.round_compressed && ks.bidirectional) {
         size_t ne = ks.store.size() / 4;
         float* s = reinterpret_cast<float*>(ks.store.data());
@@ -1530,6 +1729,18 @@ class Server {
       ks.completed_round++;
       ks.seen.clear();
       ks.round_compressed = false;
+      if (pub_t0) {
+        // One MERGE_WAIT span per traced contributor: merge-complete ->
+        // publish.  The LAST arriver's wait is ~0; every other worker's
+        // wait is exactly how long the straggler(s) held the round open
+        // — the signal the critical-path analyzer attributes.
+        for (const auto& wt : ks.merge_ts)
+          tracer_.Record("MERGE_WAIT", t.key, pub_round, wt.first,
+                         wt.second, pub_t0 - wt.second, 0);
+        tracer_.Record("PUBLISH", t.key, pub_round, t.worker_id, pub_t0,
+                       NowUs() - pub_t0, ks.out.size());
+      }
+      ks.merge_ts.clear();
       StatPublish(t.key, ks.completed_round);
       FlushPulls(ks, t.key);
     }
@@ -1570,28 +1781,33 @@ class Server {
 
   void HandlePull(Task& t) {
     KeyState& ks = StateFor(t.key);
-    // t.flags = the round (mod 2^16) the worker just pushed; its result is
-    // ready once that round has been published.  The 16-bit compare (the
-    // wire header carries u16 flags) aliases only if a worker's pull were
-    // exactly 65,536 rounds stale — unreachable by protocol: the client's
+    // t.flags = the round (mod 2^15, low bits of the u16; bit 15 is the
+    // trace marker) the worker just pushed; its result is ready once that
+    // round has been published.  The 15-bit compare aliases only if a
+    // worker's pull were exactly 32,768 rounds stale — unreachable by
+    // protocol: the client's
     // sequential-use guard (client.py _stage_parts) serializes rounds per
     // key, so a pull's round is always completed_round or
     // completed_round - 1.  Asserted rather than assumed: a client that
     // violated the invariant would otherwise silently wait or read a
     // whole-epoch-stale buffer.
-    uint16_t cur = static_cast<uint16_t>(ks.completed_round & 0xFFFF);
-    uint16_t prev = static_cast<uint16_t>((ks.completed_round - 1) & 0xFFFF);
-    if (!async_ && t.flags != cur && t.flags != prev) {
+    const bool traced = (t.flags & kFlagTraced) != 0;
+    if (!async_ && !RoundMatch(t.flags, ks.completed_round) &&
+        !RoundMatch(t.flags, ks.completed_round - 1)) {
       Respond(t.conn, kError, t.req_id, t.key, nullptr, 0);
       return;
     }
-    bool ready = async_ ||
-        (ks.completed_round & 0xFFFF) != t.flags;
+    bool ready = async_ || !RoundMatch(t.flags, ks.completed_round);
     if (ready) {
+      const int64_t t0 = traced ? NowUs() : 0;
       Respond(t.conn, kOk, t.req_id, t.key, ks.out.data(), ks.out.size());
+      if (traced)
+        tracer_.Record("PULL_SEND", t.key, ks.completed_round,
+                       t.worker_id, t0, NowUs() - t0, ks.out.size());
     } else {
       AddRef(t.conn);   // the stash outlives the task's own hold
-      ks.pending.push_back({t.conn, t.req_id, t.key, t.flags});
+      ks.pending.push_back({t.conn, t.req_id, t.key, t.flags,
+                            t.worker_id, traced});
       StatPendingPulls(t.key, 1);
     }
   }
@@ -1600,8 +1816,12 @@ class Server {
     std::vector<PendingPull> still;
     int64_t flushed = 0;
     for (auto& p : ks.pending) {
-      if (async_ || (ks.completed_round & 0xFFFF) != p.want_round) {
+      if (async_ || !RoundMatch(p.want_round, ks.completed_round)) {
+        const int64_t t0 = p.traced ? NowUs() : 0;
         Respond(p.conn, kOk, p.req_id, key, ks.out.data(), ks.out.size());
+        if (p.traced)
+          tracer_.Record("PULL_SEND", key, ks.completed_round, p.worker,
+                         t0, NowUs() - t0, ks.out.size());
         ReleaseRef(p.conn);
         ++flushed;
       } else {
@@ -1639,6 +1859,9 @@ class Server {
 
   std::mutex barrier_mu_;
   std::map<uint64_t, std::vector<PendingPull>> barrier_waiters_;
+
+  // CMD_TRACE span ring (see ServerTracer).
+  ServerTracer tracer_;
 
   // CMD_STATS telemetry (see StatsJson).
   std::mutex stats_mu_;

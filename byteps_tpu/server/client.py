@@ -60,13 +60,53 @@ _REQ = struct.Struct("<BBHIIQQ")   # cmd dtype flags req_id worker_id key len
 _RESP = struct.Struct("<BIQQ")     # status req_id key len
 
 CMD_HELLO, CMD_INIT, CMD_PUSH, CMD_PULL, CMD_BARRIER, CMD_SHUTDOWN, \
-    CMD_PING, CMD_LR_SCALE, CMD_STATS = range(9)
+    CMD_PING, CMD_LR_SCALE, CMD_STATS, CMD_TRACE = range(10)
 
 # dtype byte on the wire (server.cc WireDtype)
 DT_F32, DT_RAW, DT_COMPRESSED, DT_SEED = 0, 1, 2, 3
 
+# Header `flags` bit 15 (server.cc kFlagTraced): this frame is inside the
+# worker's trace window.  PUSH/PULL frames now carry their round in the
+# LOW 15 BITS always — bit 15 belongs exclusively to the marker, traced
+# or not, so an untraced long run can never have a round counter bleed
+# into it (which would make the server record spans for 32768 consecutive
+# rounds).  A run with tracing off is byte-identical to the pre-trace
+# wire through round 32767 per key (beyond that the old 16-bit round
+# differed anyway each 65536 rounds; the guard-aliasing distance is
+# 32768 — see server.cc RoundMatch).  A traced PING asks the server for
+# its clock (the offset-estimation leg).
+FLAG_TRACED = 0x8000
+ROUND_MASK = 0x7FFF
+
 _CMD_NAMES = {0: "HELLO", 1: "INIT", 2: "PUSH", 3: "PULL", 4: "BARRIER",
-              5: "SHUTDOWN", 6: "PING", 7: "LR_SCALE", 8: "STATS"}
+              5: "SHUTDOWN", 6: "PING", 7: "LR_SCALE", 8: "STATS",
+              9: "TRACE"}
+
+
+def _round_flags(rnd: int, traced: bool) -> int:
+    """The u16 round flags for one PUSH/PULL frame: the round mod 2^15,
+    plus — inside a trace window — the marker bit the server records
+    spans for.  Bit 15 is never round data (see FLAG_TRACED)."""
+    return (rnd & ROUND_MASK) | (FLAG_TRACED if traced else 0)
+
+
+def estimate_clock_offset(samples) -> Tuple[float, float]:
+    """NTP-style offset of a server's clock relative to this worker's.
+
+    ``samples`` is a list of ``(t0_us, server_ts_us, t1_us)`` tuples from
+    timestamped pings: the worker read its clock at t0, the server stamped
+    server_ts somewhere inside the round trip, the worker read t1 on the
+    response.  Assuming a symmetric path, server_ts corresponds to the
+    midpoint (t0+t1)/2, so ``offset = server_ts - (t0+t1)/2`` with error
+    bounded by rtt/2 — the minimum-RTT sample is therefore the tightest
+    estimate and wins (classic NTP peer filtering).  Returns
+    ``(offset_us, rtt_us)`` of that best sample; ``server_ts - offset``
+    maps a server timestamp onto the worker's timeline.
+    """
+    if not samples:
+        raise ValueError("estimate_clock_offset: no samples")
+    t0, ts, t1 = min(samples, key=lambda s: s[2] - s[0])
+    return ts - (t0 + t1) / 2.0, float(t1 - t0)
 
 # How often the barrier wait logs a "still waiting" warning; module-level so
 # tests can shrink it (bps.barrier legitimately blocks on peers for a long
@@ -653,7 +693,8 @@ class PSSession:
                  reconnect_attempts: int = 0,
                  reconnect_backoff_ms: float = 100.0,
                  stall_timeout_s: float = 0.0,
-                 barrier_timeout_s: float = 0.0):
+                 barrier_timeout_s: float = 0.0,
+                 clock_sync_s: float = 30.0):
         self.worker_id = worker_id
         self.num_servers = max(1, num_servers)
         self.hash_fn = hash_fn
@@ -672,6 +713,10 @@ class PSSession:
         self.reconnect_backoff_ms = float(reconnect_backoff_ms)
         self.stall_timeout_s = max(0.0, float(stall_timeout_s))
         self.barrier_timeout_s = max(0.0, float(barrier_timeout_s))
+        # Cross-host clock-sync cadence (BYTEPS_TPU_CLOCK_SYNC_S): how
+        # often the background thread re-estimates server clock offsets
+        # while tracing is on, bounding drift across a long trace window.
+        self.clock_sync_s = max(1.0, float(clock_sync_s))
         # Any failure before __init__ returns (a connect, the dispatcher,
         # the HELLO mode check) must tear down every socket and receiver
         # thread already created — the caller gets an exception, not a
@@ -718,6 +763,8 @@ class PSSession:
     def _abort_init(self) -> None:
         if getattr(self, "_watchdog_stop", None) is not None:
             self._watchdog_stop.set()
+        if getattr(self, "_clock_sync_stop", None) is not None:
+            self._clock_sync_stop.set()
         if getattr(self, "_dispatcher", None) is not None:
             with self._cv:
                 self._closed = True
@@ -780,6 +827,19 @@ class PSSession:
         self._last_progress = time.monotonic()
         self._watchdog_stop = threading.Event()
         self._watchdog: Optional[threading.Thread] = None
+        # Distributed-trace state: per-server clock-offset HISTORY
+        # (NTP-style midpoint over timestamped CMD_PINGs; each entry is
+        # (server_clock_at_sync_us, offset_us)), fusion-bucket member
+        # names for span annotation, and the periodic re-sync thread
+        # (started lazily by sync_clocks, active only while tracing).
+        # fetch_server_trace corrects each span with the history entry
+        # nearest the span's own timestamp, so the periodic samples are
+        # what bounds clock drift across a long trace window.
+        self._clock_offsets: Dict[int, list] = {}
+        self._clock_lock = threading.Lock()
+        self._clock_sync_stop = threading.Event()
+        self._clock_sync_thread: Optional[threading.Thread] = None
+        self._trace_members: Dict[int, list] = {}    # declared_key -> names
         # Metrics-registry feeds (common/telemetry.py).  The objects are
         # resolved once here; the per-partition hot path then pays only a
         # lock-free observe()/set() per event.  The queue-depth gauge
@@ -847,7 +907,8 @@ class PSSession:
                    reconnect_attempts=cfg.reconnect_attempts,
                    reconnect_backoff_ms=cfg.reconnect_backoff_ms,
                    stall_timeout_s=cfg.stall_timeout_s,
-                   barrier_timeout_s=cfg.barrier_timeout_s)
+                   barrier_timeout_s=cfg.barrier_timeout_s,
+                   clock_sync_s=cfg.clock_sync_s)
 
     def set_lr_scale(self, scale: float) -> None:
         """One-shot EF-error rescale after a learning-rate change;
@@ -970,7 +1031,8 @@ class PSSession:
             try:
                 part.conn.send(
                     CMD_PUSH, pkey, part.payload, worker_id=self.worker_id,
-                    dtype=part.dtype, flags=part.round,
+                    dtype=part.dtype,
+                    flags=_round_flags(part.round, core.trace_on),
                     callback=lambda data, err, pkey=pkey, nbytes=nbytes:
                         self._on_push_ack(pkey, nbytes, err))
             except ConnectionError as e:
@@ -1027,7 +1089,8 @@ class PSSession:
             sink = memoryview(part.handle.out).cast("B")[
                 part.off:part.off + part.ln]
         part.conn.send(
-            CMD_PULL, part.pkey, worker_id=self.worker_id, flags=part.round,
+            CMD_PULL, part.pkey, worker_id=self.worker_id,
+            flags=_round_flags(part.round, get_core().trace_on),
             sink=sink,
             sink_live=lambda h=part.handle: not h.failed(),
             callback=lambda data, err, pkey=part.pkey:
@@ -1219,6 +1282,15 @@ class PSSession:
         """
         if not getattr(self, "_session_ready", False):
             return      # drop during __init__: nothing staged to replay yet
+        # The peer may be a RESTARTED process with a fresh steady_clock
+        # epoch: its pre-restart offset history would place post-restart
+        # trace spans wildly off the worker timeline.  Drop it; the next
+        # sync/fetch re-estimates against the live process.
+        for srv, pool in enumerate(self._data_conns):
+            if conn in pool:
+                with self._clock_lock:
+                    self._clock_offsets.pop(srv, None)
+                break
         try:
             mode = conn.request(CMD_HELLO, worker_id=self.worker_id)
             modes = ((bool(mode[0]), bool(mode[1]))
@@ -1443,6 +1515,163 @@ class PSSession:
                     prev["round"] = min(int(prev.get("round", 0)),
                                         int(v.get("round", 0)))
         return merged
+
+    # -- distributed tracing: clock sync + server span fetch ----------------
+    def _ping_server_clock(self, conn: "_ServerConn", samples: int = 5,
+                           timeout: float = 10.0) -> list:
+        """``samples`` timestamped ping exchanges with one server:
+        [(t0_us, server_ts_us, t1_us), ...] on the tracer clock.  Raises a
+        "server too old" RuntimeError against a server whose CMD_PING
+        predates the timestamped response (it answers 0 bytes)."""
+        core = get_core()
+        out = []
+        for _ in range(max(1, samples)):
+            t0 = core.trace_now_us()
+            raw = conn.request(CMD_PING, worker_id=self.worker_id,
+                               flags=FLAG_TRACED, timeout=timeout)
+            t1 = core.trace_now_us()
+            if len(raw) < 8:
+                raise RuntimeError(
+                    f"PS server at {conn.host}:{conn.port} does not answer "
+                    f"timestamped pings (server too old — rebuild/redeploy "
+                    f"the server tier to match this client)")
+            (ts,) = struct.unpack("<q", bytes(raw[:8]))
+            out.append((t0, ts, t1))
+        return out
+
+    def sync_clocks(self, samples: int = 5) -> dict:
+        """Estimate every server's clock offset (min-RTT NTP midpoint
+        over timestamped CMD_PINGs) and APPEND it to the per-server
+        offset history.  Called at trace-enable, by the periodic sync
+        thread (every ``clock_sync_s``), and again at each fetch; the
+        fetch corrects every span with the history entry nearest the
+        span's timestamp, so periodic samples are what bounds drift
+        across a long trace window.  Returns {server_idx: (offset_us,
+        rtt_us)} for the fresh estimates."""
+        est = {}
+        for i, c in enumerate(self.conns):
+            off, rtt = estimate_clock_offset(
+                self._ping_server_clock(c, samples))
+            self._append_clock_sample(i, off, rtt)
+            est[i] = (off, rtt)
+        return est
+
+    @staticmethod
+    def _server_clock_now(offset_us: float) -> float:
+        """The server's clock 'now' implied by an offset estimate."""
+        return get_core().trace_now_us() + offset_us
+
+    def _append_clock_sample(self, srv: int, off: float,
+                             rtt: float) -> list:
+        """Record one offset estimate in server `srv`'s history; returns a
+        snapshot of the history.  A jump far beyond what drift or RTT
+        noise explains means the server process RESTARTED (a fresh
+        steady_clock epoch) — the old entries would place post-restart
+        spans wildly off the timeline, so the history resets to the new
+        epoch instead of only logging."""
+        with self._clock_lock:
+            hist = self._clock_offsets.setdefault(srv, [])
+            if hist:
+                jump = abs(hist[-1][1] - off)
+                if jump > max(1e6, 100 * rtt):
+                    get_logger().warning(
+                        "server %d clock offset jumped %.0fms (restart/"
+                        "epoch change): resetting offset history",
+                        srv, jump / 1e3)
+                    hist.clear()
+                elif jump > 1000:
+                    get_logger().debug(
+                        "server %d clock offset drifted %.0fus since "
+                        "last sync", srv, jump)
+            # Keyed by the SERVER clock at sync time, so a span's own
+            # (server-clock) timestamp selects its nearest estimate
+            # without a correction chicken-and-egg.
+            hist.append((self._server_clock_now(off), off))
+            del hist[:-64]              # bounded history
+            return list(hist)
+
+    def start_clock_sync(self) -> None:
+        """Idempotently start the background re-sync thread: every
+        ``clock_sync_s`` (BYTEPS_TPU_CLOCK_SYNC_S) it re-estimates the
+        offsets — but only while the tracer is actually on, so an
+        untraced run sends no extra wire traffic."""
+        if self._clock_sync_thread is not None:
+            return
+        self._clock_sync_thread = threading.Thread(
+            target=self._clock_sync_loop, daemon=True,
+            name="bps-ps-clocksync")
+        self._clock_sync_thread.start()
+
+    def _clock_sync_loop(self) -> None:
+        while not self._clock_sync_stop.wait(self.clock_sync_s):
+            if not get_core().trace_on:
+                continue
+            try:
+                self.sync_clocks()
+            except Exception as e:
+                get_logger().debug("periodic clock sync failed: %s", e)
+
+    def set_trace_members(self, declared_key: int, names: list) -> None:
+        """Record a fusion bucket's member-leaf names so the merged trace
+        can annotate the bucket's spans with the real parameters riding
+        it (the analyzer's slow-bucket attribution)."""
+        self._trace_members[declared_key] = list(names)
+
+    def trace_members(self) -> dict:
+        return dict(self._trace_members)
+
+    def fetch_server_trace(self, timeout: float = 30.0,
+                           ping_timeout: float = 10.0,
+                           ping_samples: int = 5) -> list:
+        """Drain every server's span ring (CMD_TRACE) and return the
+        spans offset-corrected onto THIS worker's tracer clock.
+
+        Each span is ``{"server", "stage", "key", "round", "worker",
+        "ts_us", "dur_us", "bytes"}`` with stage one of RECV / SUM /
+        MERGE_WAIT / PUBLISH / PULL_SEND.  A fresh offset is estimated
+        at the drain, then each span is corrected with the offset-history
+        entry (trace-enable + periodic syncs + this one) NEAREST the
+        span's own timestamp — early-window spans use early estimates,
+        so clock drift across a long window is bounded by the sync
+        cadence, not the window length.  Fetch-and-clear on the server:
+        each span is returned to exactly one fetching worker.
+
+        A pre-CMD_TRACE server surfaces as a clean "server too old"
+        RuntimeError (the unknown command draws an error status from the
+        engine's default arm) — never a hang.
+        """
+        import json as _json
+        spans = []
+        for i, c in enumerate(self.conns):
+            off, rtt = estimate_clock_offset(self._ping_server_clock(
+                c, samples=ping_samples, timeout=ping_timeout))
+            hist = self._append_clock_sample(i, off, rtt)
+            try:
+                raw = c.request(CMD_TRACE, worker_id=self.worker_id,
+                                timeout=timeout)
+            except RuntimeError as e:
+                raise RuntimeError(
+                    f"PS server at {c.host}:{c.port} does not support "
+                    f"CMD_TRACE (server too old — rebuild/redeploy the "
+                    f"server tier to match this client): {e}") from e
+            st = _json.loads(bytes(raw).decode())
+            if st.get("dropped"):
+                get_logger().warning(
+                    "server %s:%d trace ring dropped %d spans — raise "
+                    "BYTEPS_SERVER_TRACE_EVENTS or fetch more often",
+                    c.host, c.port, st["dropped"])
+            for s in st.get("spans", ()):
+                ts = s["ts"]
+                # Nearest-in-time estimate: history is keyed by the
+                # server clock, as is the span's ts.
+                _, use_off = min(hist, key=lambda h: abs(h[0] - ts))
+                spans.append({
+                    "server": i, "stage": s["st"], "key": int(s["k"]),
+                    "round": int(s["r"]), "worker": int(s["w"]),
+                    "ts_us": int(round(ts - use_off)),
+                    "dur_us": int(s["d"]), "bytes": int(s["b"]),
+                })
+        return spans
 
     # -- test/introspection hooks -------------------------------------------
     def pause_dispatch(self) -> None:
@@ -1773,6 +2002,7 @@ class PSSession:
             self._closed = True
             self._cv.notify_all()
         self._watchdog_stop.set()
+        self._clock_sync_stop.set()
         # Detach the queue-depth gauge's sampler: the registry outlives the
         # session, and a lazy gauge holding `self` would both leak the
         # session and report a dead scheduler's depth.  Only if the gauge

@@ -264,10 +264,11 @@ def test_size_change_from_seen_worker_not_dropped_as_dup(ps_server):
 
 
 def test_pull_with_impossible_round_rejected(ps_server):
-    """The pull round compare is 16-bit on the wire (u16 flags); the server
-    asserts the sequential-use invariant (pull round == completed_round or
-    completed_round - 1) instead of silently pending on an aliased round
-    65,536 stale (core/server.cc HandlePull)."""
+    """The pull round rides the low 15 bits of the u16 flags (bit 15 is
+    the trace marker); the server asserts the sequential-use invariant
+    (pull round == completed_round or completed_round - 1) instead of
+    silently pending on an aliased round 32,768 stale
+    (core/server.cc HandlePull)."""
     port = ps_server(num_workers=1)
     a = np.ones(8, np.float32)
     s = _session(port, 0)

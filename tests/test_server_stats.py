@@ -8,7 +8,6 @@ runs as a subprocess, N PSSession workers drive it on threads.
 
 import json
 import socket
-import struct
 import subprocess
 import sys
 import threading
@@ -19,10 +18,9 @@ import numpy as np
 import pytest
 
 from byteps_tpu.common import telemetry as tm
-from byteps_tpu.server.client import (PSSession, _ServerConn, _REQ, _RESP,
-                                      CMD_HELLO, CMD_STATS)
+from byteps_tpu.server.client import PSSession, _ServerConn, CMD_HELLO
 
-from testutil import cpu_env, free_port
+from testutil import StubPSServer, cpu_env, free_port
 
 
 @pytest.fixture
@@ -201,62 +199,20 @@ def test_pending_pull_depth_visible(ps_server):
 def test_old_server_graceful_too_old_error():
     """Against a server that predates CMD_STATS (unknown command answers
     with an error status), server_stats() raises a clean 'server too old'
-    RuntimeError promptly — never a hang."""
-    srv = socket.socket()
-    srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-    srv.bind(("127.0.0.1", 0))
-    srv.listen(4)
-    port = srv.getsockname()[1]
-    stop = threading.Event()
-
-    def old_server():
-        """Speaks the pre-CMD_STATS protocol: HELLO answers mode flags,
-        anything unknown answers status=1 (the old engine default arm)."""
-        conns = []
-        srv.settimeout(0.2)
-        while not stop.is_set():
-            try:
-                c, _ = srv.accept()
-            except socket.timeout:
-                continue
-            conns.append(c)
-            threading.Thread(target=serve_conn, args=(c,),
-                             daemon=True).start()
-        for c in conns:
-            c.close()
-
-    def serve_conn(c):
-        try:
-            while True:
-                hdr = b""
-                while len(hdr) < _REQ.size:
-                    got = c.recv(_REQ.size - len(hdr))
-                    if not got:
-                        return
-                    hdr += got
-                cmd, dt, fl, req_id, wid, key, ln = _REQ.unpack(hdr)
-                while ln:
-                    ln -= len(c.recv(ln))
-                if cmd == CMD_HELLO:
-                    c.sendall(_RESP.pack(0, req_id, key, 2) + b"\x00\x00")
-                else:
-                    c.sendall(_RESP.pack(1, req_id, key, 0))
-        except OSError:
-            pass
-
-    th = threading.Thread(target=old_server, daemon=True)
-    th.start()
+    RuntimeError promptly — never a hang.  The stub speaks the
+    pre-CMD_STATS protocol: HELLO answers mode flags, anything unknown
+    answers status=1 (the old engine default arm)."""
+    srv = StubPSServer(lambda cmd, *a: (0, b"\x00\x00")
+                       if cmd == CMD_HELLO else (1, b""))
     try:
-        s = PSSession(["127.0.0.1"], [port], worker_id=0, num_servers=1,
-                      wire_conns=1)
+        s = PSSession(["127.0.0.1"], [srv.port], worker_id=0,
+                      num_servers=1, wire_conns=1)
         t0 = time.time()
         with pytest.raises(RuntimeError, match="too old"):
             s.server_stats(timeout=20.0)
         assert time.time() - t0 < 10, "error path took too long"
         s.close()
     finally:
-        stop.set()
-        th.join(timeout=5)
         srv.close()
 
 
@@ -415,6 +371,10 @@ def test_bps_top_parses_live_endpoint(ps_server):
     for v in (0.002, 0.002, 0.05):
         h.observe(v)
     reg.gauge("bps_worker_round_lag", labels={"worker": "1"}).set(3)
+    reg.gauge("bps_step_critical_path_seconds",
+              labels={"component": "merge_wait"}).set(0.2)
+    reg.gauge("bps_step_critical_path_seconds",
+              labels={"component": "push_wire"}).set(0.05)
     exp = tm.TelemetryExporter(reg, port=free_port()).start()
     try:
         text = bps_top.fetch(f"http://127.0.0.1:{exp.port}/metrics")
@@ -428,3 +388,6 @@ def test_bps_top_parses_live_endpoint(ps_server):
     joined = "\n".join(lines)
     assert "push RTT" in joined
     assert "worker   1  lag    3" in joined
+    # Critical-path panel (bps_step_critical_path_* gauges, ISSUE-5).
+    assert "step critical path" in joined
+    assert "merge_wait" in joined and "push_wire" in joined

@@ -1,16 +1,25 @@
 """Per-partition trace events (reference: global.cc:463-579 closes one span
-per partition per pipeline stage; docs/timeline.md documents the schema)."""
+per partition per pipeline stage; docs/timeline.md documents the schema) —
+plus the distributed half: server-side spans over CMD_TRACE, cross-host
+clock alignment over timestamped CMD_PING, the merged Perfetto export, the
+critical-path analyzer, and the tracing-off byte-identity contract."""
 
 import json
-import threading
+import struct
+import time
 
 import numpy as np
 import pytest
 
+from byteps_tpu.common import trace_analysis
 from byteps_tpu.core.native import get_core
-from byteps_tpu.server.client import PSSession
+from byteps_tpu.server.client import (PSSession, _REQ, CMD_HELLO,
+                                      CMD_INIT, CMD_PUSH, CMD_PULL,
+                                      CMD_PING, FLAG_TRACED,
+                                      estimate_clock_offset)
 
 from test_ps_server import ps_server  # noqa: F401  (fixture reuse)
+from testutil import StubPSServer, cpu_env
 
 
 @pytest.fixture
@@ -156,3 +165,385 @@ bps.shutdown()
     # 5000 f32 at 4096B partitions -> 5 partitions per traced push_pull
     pushes = [e for e in events if e["tid"] == "PUSH"]
     assert len(pushes) >= 5 and all("g.part" in e["name"] for e in pushes)
+
+
+# ---------------------------------------------------------------------------
+# Distributed tracing: server spans, clock alignment, merged export,
+# critical path (ISSUE 5)
+# ---------------------------------------------------------------------------
+def test_clock_offset_math():
+    """NTP midpoint: offset = server_ts - (t0+t1)/2 from the MINIMUM-RTT
+    sample — noisy high-RTT samples must not pollute the estimate."""
+    # Server clock runs 5000us ahead; tight sample rtt=200.
+    tight = (1000, 6100, 1200)          # midpoint 1100 -> offset 5000
+    # Noisy samples: same true offset but asymmetric delays that would
+    # estimate wrong — and larger RTTs, so they must lose.
+    noisy = [(2000, 7010, 12000), (3000, 10000, 9000)]
+    off, rtt = estimate_clock_offset([noisy[0], tight, noisy[1]])
+    assert off == 5000.0
+    assert rtt == 200.0
+    # Correction maps a server timestamp back onto the worker timeline.
+    assert 6100 - off == 1100
+    with pytest.raises(ValueError):
+        estimate_clock_offset([])
+
+
+def _recording_server():
+    """StubPSServer speaking just enough protocol for one worker's
+    push_pull (HELLO mode bytes, INIT completed_round, PUSH stores, PULL
+    echoes the stored payload), recording every raw request frame so the
+    test can assert on the exact bytes a client emits."""
+    store = {}
+
+    def handler(cmd, dt, fl, req_id, wid, key, payload):
+        if cmd == CMD_HELLO:
+            return 0, b"\x00\x00"
+        if cmd == CMD_INIT:
+            return 0, struct.pack("<Q", 0)
+        if cmd == CMD_PUSH:
+            store[key] = payload
+            return 0, b""
+        if cmd == CMD_PULL:
+            return 0, store.get(key, b"")
+        return 1, b""
+
+    return StubPSServer(handler, record=True)
+
+
+def test_wire_byte_identical_when_tracing_off(tmp_path):
+    """The tracing-off wire is byte-identical to the pre-trace protocol:
+    every header is exactly _REQ.pack with the round in the low 15 bits
+    of flags and the marker bit NEVER set (bit 15 belongs exclusively to
+    the tracer, so an untraced long run can't bleed a round counter into
+    it), and no PING/TRACE frames ride along.  With tracing ON the same
+    traffic carries FLAG_TRACED + the round mod 2^15."""
+    core = get_core()
+    core.trace_enable(False)
+    srv = _recording_server()
+    sess = None
+    try:
+        sess = PSSession(["127.0.0.1"], [srv.port], worker_id=0,
+                         num_servers=1, partition_bytes=4096, wire_conns=1)
+        x = np.arange(2048, dtype=np.float32)        # 8KB -> 2 partitions
+        np.testing.assert_array_equal(sess.push_pull(9, x), x)
+        with srv.lock:
+            frames = list(srv.frames)
+        cmds = {f[1] for f in frames}
+        assert cmds == {CMD_HELLO, CMD_INIT, CMD_PUSH, CMD_PULL}
+        for hdr, cmd, fl in frames:
+            c2, d2, f2, r2, w2, k2, l2 = _REQ.unpack(hdr)
+            # Byte-identity: re-packing the parsed fields reproduces the
+            # frame, and round flags are the raw 16-bit round (round 0
+            # here) with no trace bit.
+            assert hdr == _REQ.pack(c2, d2, f2, r2, w2, k2, l2)
+            assert not (fl & FLAG_TRACED)
+            if cmd in (CMD_PUSH, CMD_PULL):
+                assert fl == 0
+
+        core.trace_enable(True)
+        with srv.lock:
+            srv.frames.clear()
+        np.testing.assert_array_equal(sess.push_pull(9, x), x)  # round 1
+        with srv.lock:
+            frames = list(srv.frames)
+        pp = [(c, f) for _, c, f in frames if c in (CMD_PUSH, CMD_PULL)]
+        assert pp and all(f == (1 & 0x7FFF) | FLAG_TRACED for _, f in pp)
+    finally:
+        core.trace_enable(False)
+        if sess is not None:
+            sess.close()
+        srv.close()
+        if core.trace_count():    # don't leak spans into later tests
+            core.trace_dump(str(tmp_path / "flush.json"), 0)
+
+
+def test_server_spans_gated_by_trace_window(ps_server, tmp_path):  # noqa: F811
+    """The server records spans ONLY for pushes carrying the traced flag
+    (the worker's window): untraced rounds leave the ring empty, traced
+    rounds produce RECV/SUM/MERGE_WAIT/PUBLISH/PULL_SEND per (key, round),
+    and CMD_TRACE is fetch-and-clear."""
+    port = ps_server(num_workers=1)
+    core = get_core()
+    sess = PSSession(["127.0.0.1"], [port], worker_id=0, num_servers=1,
+                     partition_bytes=4096)
+    try:
+        x = np.arange(2048, dtype=np.float32)        # 2 partitions
+        core.trace_enable(False)
+        sess.push_pull(11, x)                        # untraced round
+        assert sess.fetch_server_trace() == []
+
+        core.trace_enable(True)
+        t0 = core.trace_now_us()
+        sess.push_pull(11, x)                        # traced round
+        t1 = core.trace_now_us()
+        spans = sess.fetch_server_trace()
+        by_stage = {}
+        for s in spans:
+            by_stage.setdefault(s["stage"], []).append(s)
+        for stage in ("RECV", "SUM", "MERGE_WAIT", "PUBLISH", "PULL_SEND"):
+            rows = by_stage.get(stage, [])
+            assert len(rows) == 2, (stage, sorted(by_stage))
+            for r in rows:
+                assert r["key"] >> 16 == 11
+                assert r["worker"] == 0
+                assert r["dur_us"] >= 0
+                # Aligned clock: the offset-corrected server timestamps
+                # land inside the worker-side bracket of the operation.
+                assert t0 - 10_000 <= r["ts_us"] <= t1 + 10_000
+        # Drain semantics: a second fetch starts empty again.
+        assert sess.fetch_server_trace() == []
+    finally:
+        core.trace_enable(False)
+        sess.close()
+        if core.trace_count():
+            core.trace_dump(str(tmp_path / "flush.json"), 0)
+
+
+def test_old_server_cmd_trace_graceful():
+    """Against a pre-CMD_TRACE server the fetch raises a clean 'server
+    too old' RuntimeError promptly — never a hang.  (The offset-
+    estimation leg hits it first: old PING answers 0 bytes.)"""
+    def old_handler(cmd, dt, fl, req_id, wid, key, payload):
+        if cmd == CMD_HELLO:
+            return 0, b"\x00\x00"
+        if cmd == CMD_PING:
+            return 0, b""        # the OLD ping: empty, flags ignored
+        return 1, b""            # pre-CMD_TRACE engine default arm
+
+    srv = StubPSServer(old_handler)
+    try:
+        s = PSSession(["127.0.0.1"], [srv.port], worker_id=0,
+                      num_servers=1, wire_conns=1)
+        t0 = time.time()
+        with pytest.raises(RuntimeError, match="too old"):
+            s.fetch_server_trace(timeout=20.0)
+        assert time.time() - t0 < 10, "error path took too long"
+        s.close()
+    finally:
+        srv.close()
+
+
+def test_trace_analyze_breakdown_sums_to_step():
+    """Analyzer unit test on synthetic events: the per-step breakdown
+    components take their measured values, partition the step exactly
+    (sum == step duration), and the MERGE_WAIT group attributes the
+    stragglers' cost to the last-merging worker."""
+    SP = trace_analysis.SERVER_PID_BASE
+    key = 7 << 16
+
+    def w(tid, ts, dur, **args):
+        return {"name": "g.part0", "ph": "X", "tid": tid, "pid": 0,
+                "ts": ts, "dur": dur,
+                "args": dict({"key": key, "bytes": 100, "priority": 0},
+                             **args)}
+
+    def s(tid, ts, dur, worker):
+        return {"name": "g.part0", "ph": "X", "tid": tid, "pid": SP,
+                "ts": ts, "dur": dur,
+                "args": {"key": key, "round": 0, "worker": worker,
+                         "bytes": 100}}
+
+    events = [
+        {"name": "process_name", "ph": "M", "pid": 0,
+         "args": {"name": "worker0"}},
+        {"name": "step_1", "ph": "X", "tid": "STEP", "pid": 0,
+         "ts": 0, "dur": 1000},
+        w("QUEUE", 10, 50),
+        w("PUSH", 60, 200),
+        w("PULL", 260, 400),
+        s("RECV", 70, 20, 0),
+        s("SUM", 90, 30, 0),
+        s("MERGE_WAIT", 120, 300, 0),    # we waited 300us on worker 1
+        s("MERGE_WAIT", 420, 0, 1),      # worker 1 merged last: straggler
+        s("PUBLISH", 420, 5, 1),
+    ]
+    result = trace_analysis.analyze(events, worker=0)
+    (row,) = result["steps"]
+    bd = row["breakdown_us"]
+    assert bd["queue"] == 50
+    assert bd["server_recv"] == 20
+    assert bd["server_sum"] == 30
+    assert bd["merge_wait"] == 300
+    assert bd["push_wire"] == 200 - 20 - 30
+    assert bd["pull_wire"] == 400 - 300
+    assert sum(bd.values()) == row["dur_us"] == 1000
+    assert not row["normalized"]
+    assert row["critical"] == "g.part0"
+    # Straggler attribution: worker 1 (min wait in the group) caused
+    # worker 0's 300us of merge wait.
+    assert result["straggler_wait_us"] == {1: 300}
+    assert result["top_blocking"][0]["name"] == "g"
+    # The gauges feed a registry without touching the process-global one.
+    from byteps_tpu.common.telemetry import MetricsRegistry
+    reg = MetricsRegistry()
+    trace_analysis.update_critical_path_gauges(result, registry=reg)
+    g = reg.gauge("bps_step_critical_path_seconds",
+                  labels={"component": "merge_wait"})
+    assert g.value() == pytest.approx(300 / 1e6)
+    sw = reg.gauge("bps_step_straggler_wait_seconds",
+                   labels={"worker": "1"})
+    assert sw.value() == pytest.approx(300 / 1e6)
+    # A later window where nobody straggles must ZERO the stale label —
+    # "the last analyzed trace window" means exactly that.
+    clean = dict(result, straggler_wait_us={})
+    trace_analysis.update_critical_path_gauges(clean, registry=reg)
+    assert sw.value() == 0
+
+
+def test_trace_analyze_members_and_normalization():
+    """Fused-bucket spans carry args.members into the blocking report,
+    and a chain longer than its step envelope normalizes so the
+    breakdown still sums exactly to the step time."""
+    key = 3 << 16
+    events = [
+        {"name": "step_2", "ph": "X", "tid": "STEP", "pid": 0,
+         "ts": 0, "dur": 100},
+        {"name": "t.fb0.f32x100.abc.part0", "ph": "X", "tid": "QUEUE",
+         "pid": 0, "ts": 0, "dur": 80,
+         "args": {"key": key, "bytes": 400, "priority": 9,
+                  "members": ["t['a']", "t['b']"]}},
+        {"name": "t.fb0.f32x100.abc.part0", "ph": "X", "tid": "PUSH",
+         "pid": 0, "ts": 80, "dur": 80,
+         "args": {"key": key, "bytes": 400, "priority": 9}},
+    ]
+    result = trace_analysis.analyze(events, worker=0)
+    (row,) = result["steps"]
+    assert row["normalized"]
+    assert sum(row["breakdown_us"].values()) == row["dur_us"] == 100
+    top = result["top_blocking"][0]
+    assert top["name"] == "t.fb0.f32x100.abc"
+    assert top["members"] == ["t['a']", "t['b']"]
+
+
+def test_merged_trace_two_worker_acceptance(ps_server, tmp_path):  # noqa: F811
+    """ISSUE-5 acceptance: a 2-worker PS run with BYTEPS_TRACE_ON=1
+    produces ONE merged Chrome/Perfetto file holding worker AND server
+    spans on an aligned clock; trace_analyze's per-step breakdown sums
+    to the measured step time; the straggler worker is attributed."""
+    import subprocess
+    import sys
+    port = ps_server(num_workers=2)
+    code = """
+import time
+import numpy as np, jax.numpy as jnp
+import byteps_tpu as bps
+bps.init()
+for step in range(4):
+    if bps.rank() == 1 and step >= 1:
+        time.sleep(0.12)      # worker 1 straggles inside the window
+    bps.push_pull(jnp.ones(5000), name="g", average=False)
+    bps.mark_step()
+bps.shutdown()
+"""
+    procs = []
+    for wid in (0, 1):
+        env = cpu_env({
+            "BYTEPS_TPU_PS_MODE": "1",
+            "DMLC_NUM_WORKER": "2",
+            "DMLC_WORKER_ID": str(wid),
+            "DMLC_NUM_SERVER": "1",
+            "DMLC_PS_ROOT_PORT": str(port - 1),
+            "BYTEPS_TRACE_ON": "1",
+            "BYTEPS_TRACE_DIR": str(tmp_path / f"w{wid}"),
+            "BYTEPS_TRACE_START_STEP": "1",
+            # Worker 0 closes its window (and drains the server ring)
+            # strictly before worker 1's shutdown-time dump: w0 dumps at
+            # its step-3 mark_step, which precedes its step-4 push, which
+            # gates w1's step-4 round.
+            "BYTEPS_TRACE_END_STEP": "2" if wid == 0 else "3",
+            "BYTEPS_PARTITION_BYTES": "4096",
+            "BYTEPS_LOG_LEVEL": "ERROR",
+        })
+        procs.append(subprocess.Popen(
+            [sys.executable, "-c", code], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True))
+    for p in procs:
+        out, err = p.communicate(timeout=240)
+        assert p.returncode == 0, err[-3000:]
+
+    with open(tmp_path / "w0" / "0" / "comm.json") as f:
+        doc = json.load(f)
+    events = doc["traceEvents"]
+    # Chrome/Perfetto schema: every event well-formed.
+    for e in events:
+        assert e.get("ph") in ("X", "M"), e
+        assert "name" in e and "pid" in e
+        if e["ph"] == "X":
+            assert isinstance(e["ts"], (int, float))
+            assert e.get("dur", 0) >= 0
+            assert "tid" in e
+    names = {e["name"] for e in events if e["ph"] == "M"}
+    assert "process_name" in names
+    SP = trace_analysis.SERVER_PID_BASE
+    worker_spans = [e for e in events if e["ph"] == "X" and e["pid"] < SP]
+    server_spans = [e for e in events if e["ph"] == "X" and e["pid"] >= SP]
+    assert {e["tid"] for e in worker_spans} >= {"STEP", "QUEUE", "PUSH",
+                                               "PULL"}
+    sstages = {e["tid"] for e in server_spans}
+    assert {"RECV", "SUM", "MERGE_WAIT", "PUBLISH", "PULL_SEND"} <= sstages
+    # MERGE_WAIT attributes both workers — the server saw the fleet.
+    mw_workers = {e["args"]["worker"] for e in server_spans
+                  if e["tid"] == "MERGE_WAIT"}
+    assert mw_workers == {0, 1}
+    # Aligned clock: server spans sit inside the worker timeline (with
+    # slack for the straggler sleep).
+    wlo = min(e["ts"] for e in worker_spans)
+    whi = max(e["ts"] + e.get("dur", 0) for e in worker_spans)
+    for e in server_spans:
+        assert wlo - 1_000_000 <= e["ts"] <= whi + 1_000_000
+
+    # Critical-path analysis: breakdown partitions each step exactly,
+    # and worker 1's 120ms sleep shows up as merge wait charged to it.
+    result = trace_analysis.analyze(events, worker=0)
+    assert result["steps"], "no STEP envelopes analyzed"
+    for row in result["steps"]:
+        assert sum(row["breakdown_us"].values()) == row["dur_us"]
+    assert max(r["breakdown_us"]["merge_wait"]
+               for r in result["steps"]) > 50_000
+    sw = result["straggler_wait_us"]
+    assert sw.get(1, 0) > sw.get(0, 0)
+    # The CLI renders the same result.
+    report = trace_analysis.format_report(result)
+    assert "merge_wait" in report and "worker 1" in report
+
+
+def test_fusion_bucket_members_in_merged_trace(ps_server, tmp_path):  # noqa: F811
+    """Satellite: fused-bucket spans in the merged file carry their
+    member-leaf names in args.members, so a slow bucket is attributable
+    to real parameters."""
+    import subprocess
+    import sys
+    port = ps_server(num_workers=1)
+    code = """
+import numpy as np, jax.numpy as jnp
+import byteps_tpu as bps
+bps.init()
+tree = {"a": jnp.ones(100), "b": jnp.ones(200), "c": jnp.ones(300)}
+for step in range(3):
+    bps.push_pull_tree(tree, name="t7", average=False)
+    bps.mark_step()
+bps.shutdown()
+"""
+    env = cpu_env({
+        "BYTEPS_TPU_PS_MODE": "1",
+        "DMLC_NUM_WORKER": "1",
+        "DMLC_NUM_SERVER": "1",
+        "DMLC_PS_ROOT_PORT": str(port - 1),
+        "BYTEPS_TRACE_ON": "1",
+        "BYTEPS_TRACE_DIR": str(tmp_path),
+        "BYTEPS_TRACE_START_STEP": "0",
+        "BYTEPS_TRACE_END_STEP": "1",
+        "BYTEPS_LOG_LEVEL": "ERROR",
+    })
+    r = subprocess.run([sys.executable, "-c", code], env=env,
+                       capture_output=True, text=True, timeout=240)
+    assert r.returncode == 0, r.stderr[-3000:]
+    with open(tmp_path / "0" / "comm.json") as f:
+        events = json.load(f)["traceEvents"]
+    bucket = [e for e in events if e.get("ph") == "X"
+              and ".fb0." in e.get("name", "")
+              and (e.get("args") or {}).get("members")]
+    assert bucket, "no fused-bucket span carries args.members"
+    members = bucket[0]["args"]["members"]
+    assert len(members) == 3
+    assert all("t7" in m for m in members)

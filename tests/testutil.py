@@ -16,3 +16,84 @@ def cpu_env(extra=None):
     (see byteps_tpu.utils.hermetic for why JAX_PLATFORMS alone fails)."""
     from byteps_tpu.utils.hermetic import cpu_subprocess_env
     return cpu_subprocess_env(extra)
+
+
+class StubPSServer:
+    """Minimal in-thread PS-protocol stub for wire tests.
+
+    Parses request frames (client.py ``_REQ``) off every accepted
+    connection and answers each with ``handler(cmd, dtype, flags, req_id,
+    worker_id, key, payload) -> (status, resp_bytes)`` wrapped in a
+    ``_RESP`` header.  One implementation for every hand-rolled stub the
+    wire tests need (old-server compatibility shims, frame recorders) —
+    a future header change lands here once.
+
+    With ``record=True`` every raw request header is kept in
+    ``self.frames`` as ``(raw_header_bytes, cmd, flags)`` under
+    ``self.lock``.
+    """
+
+    def __init__(self, handler, record: bool = False):
+        import socket as _socket
+        import threading as _threading
+        self.handler = handler
+        self.record = record
+        self.frames = []
+        self.lock = _threading.Lock()
+        self._srv = _socket.socket()
+        self._srv.setsockopt(_socket.SOL_SOCKET, _socket.SO_REUSEADDR, 1)
+        self._srv.bind(("127.0.0.1", 0))
+        self._srv.listen(8)
+        self.port = self._srv.getsockname()[1]
+        self._stop = _threading.Event()
+        self._accept = _threading.Thread(target=self._accept_loop,
+                                         daemon=True)
+        self._accept.start()
+
+    def _accept_loop(self):
+        import socket as _socket
+        import threading as _threading
+        self._srv.settimeout(0.2)
+        conns = []
+        while not self._stop.is_set():
+            try:
+                c, _ = self._srv.accept()
+            except _socket.timeout:
+                continue
+            conns.append(c)
+            _threading.Thread(target=self._serve, args=(c,),
+                              daemon=True).start()
+        for c in conns:
+            c.close()
+        self._srv.close()
+
+    @staticmethod
+    def _recv_exact(c, n):
+        buf = b""
+        while len(buf) < n:
+            got = c.recv(n - len(buf))
+            if not got:
+                raise OSError("closed")
+            buf += got
+        return buf
+
+    def _serve(self, c):
+        from byteps_tpu.server.client import _REQ, _RESP
+        try:
+            while True:
+                hdr = self._recv_exact(c, _REQ.size)
+                cmd, dt, fl, req_id, wid, key, ln = _REQ.unpack(hdr)
+                payload = self._recv_exact(c, ln) if ln else b""
+                if self.record:
+                    with self.lock:
+                        self.frames.append((hdr, cmd, fl))
+                status, resp = self.handler(cmd, dt, fl, req_id, wid, key,
+                                            payload)
+                c.sendall(_RESP.pack(status, req_id, key, len(resp))
+                          + resp)
+        except OSError:
+            pass
+
+    def close(self):
+        self._stop.set()
+        self._accept.join(timeout=5)

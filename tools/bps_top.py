@@ -4,8 +4,10 @@
 Polls the Prometheus text endpoint a worker serves when launched with
 ``BYTEPS_TPU_METRICS_PORT`` (see docs/monitoring.md) and renders the
 interesting slices: push-pull throughput, push RTT / dispatcher-queue
-latency percentiles, codec latency, per-worker round lag (straggler
-view), and the codec/transport/fusion counter panels.
+latency percentiles, codec latency, the step critical-path breakdown
+from the last analyzed trace window (``bps_step_critical_path_*``, see
+docs/timeline.md), per-worker round lag (straggler view), and the
+codec/transport/fusion counter panels.
 
 Usage:
     python tools/bps_top.py --url http://host:9100/metrics
@@ -132,6 +134,21 @@ def render(metrics: dict, prev: dict, dt: float) -> list:
     depth = _get(metrics, "bps_dispatch_queue_depth")
     lines.append(f"  dispatcher queue depth: {int(depth)}")
     lines.append("")
+
+    cp = metrics.get("bps_step_critical_path_seconds") or {}
+    if cp:
+        lines.append("step critical path (per-step mean, last trace window)")
+        total = sum(cp.values()) or 1.0
+        for key, v in sorted(cp.items(), key=lambda kv: -kv[1]):
+            comp = dict(key).get("component", "?")
+            bar = "#" * int(30 * v / total)
+            lines.append(f"  {comp:<12}{_fmt_s(v)}  {bar}")
+        sw = metrics.get("bps_step_straggler_wait_seconds") or {}
+        for key, v in sorted(sw.items(), key=lambda kv: -kv[1]):
+            if v > 0:
+                wid = dict(key).get("worker", "?")
+                lines.append(f"  peers waited {_fmt_s(v)} on worker {wid}")
+        lines.append("")
 
     lag = metrics.get("bps_worker_round_lag") or {}
     if lag:
