@@ -21,6 +21,9 @@ Modes:
                      naive tree_all_reduce vs bucketed vs hierarchical
                      (reference analog: example/pytorch/benchmark_byteps.py
                      measuring the framework's own data path)
+  BENCH_WIRE=1       raw-speed acceptance: PS goodput as pct_of_floor of
+                     the same-host raw socket echo floor (wire_bench.py
+                     --echo-floor; BENCH_WIRE_UDS=1 for the AF_UNIX path)
   BENCH_PS=1         PS wire goodput through the real C++ server over
                      loopback TCP (reference analog: the ps-lite transport
                      benchmark in .travis.yml:29-34)
@@ -89,10 +92,54 @@ def _param_count(params) -> int:
     return sum(int(l.size) for l in jax.tree.leaves(params))
 
 
+def _device_stamp() -> dict:
+    """Platform-honesty stamp for every BENCH record (ROADMAP: BENCH_r05
+    silently recorded CPU-fallback numbers that read like on-chip ones).
+
+    `device_platform` is what the jax backend actually initialized as by
+    record time — or "none(host-only)" for the wire/fault/telemetry
+    benches, which never touch a device backend (detected WITHOUT
+    initializing one: probing jax.devices() here could wedge on a dead
+    device tunnel, the exact failure mode the benches guard against).
+    `device_fallback` is True when an accelerator bench ended up on the
+    CPU host platform without the run being an explicit local CPU one
+    (BENCH_FORCE_CPU)."""
+    import sys
+    try:
+        xb = sys.modules.get("jax._src.xla_bridge")
+        if xb is None:
+            # jax never imported: host-only bench by construction.
+            return {"device_platform": "none(host-only)",
+                    "device_fallback": False}
+        backends = getattr(xb, "_backends", None)
+        if backends is None:
+            # jax IS imported but the private probe point moved (jax
+            # internals churn): fail LOUD rather than mislabel a real
+            # accelerator run as host-only — the stamp exists to prevent
+            # exactly that silent misread.
+            return {"device_platform": "unknown(jax xla_bridge internals "
+                                       "changed; update _device_stamp)",
+                    "device_fallback": True}
+        if not backends:
+            # jax imported, no backend initialized: host-only bench.
+            return {"device_platform": "none(host-only)",
+                    "device_fallback": False}
+        import jax
+        platform = jax.devices()[0].platform
+    except Exception as e:  # noqa: BLE001 — a stamp must never kill a record
+        return {"device_platform": f"unknown({e!r:.60})",
+                "device_fallback": True}
+    explicit_cpu = os.environ.get("BENCH_FORCE_CPU", "0") == "1" \
+        and os.environ.get("BENCH_CPU_FALLBACK_CHILD", "0") != "1"
+    return {"device_platform": platform,
+            "device_fallback": platform == "cpu" and not explicit_cpu}
+
+
 def _note() -> dict:
-    """Provenance note for the detail payload (set by the CPU fallback)."""
+    """Provenance for the detail payload: the CPU-fallback note plus the
+    device-platform honesty stamp (every BENCH record carries both)."""
     n = os.environ.get("BENCH_NOTE")
-    return {"note": n} if n else {}
+    return {**({"note": n} if n else {}), **_device_stamp()}
 
 
 def _headline(unit: str, vs_baseline: float) -> dict:
@@ -597,6 +644,49 @@ def _boot_ps_server(engine_threads: int):
     raise RuntimeError("PS server lost the port race 4 times")
 
 
+def bench_wire():
+    """Raw-speed transport benchmark (BENCH_WIRE=1): the ≥85%-of-wire-
+    floor acceptance number, measured by tools/wire_bench.py
+    --echo-floor and recorded in the BENCH json rather than
+    hand-calculated.
+
+    value = `wire_pct_of_floor`: PS raw push_pull goodput (4 MiB
+    partitions, interleaved best-of batches) as a percentage of the
+    same host's raw socket echo floor on the same transport;
+    vs_baseline = pct / 85 (the ROADMAP target).  BENCH_WIRE_UDS=1
+    measures the AF_UNIX colocated fast path instead of loopback TCP.
+    Host-only, like BENCH_PS.
+    """
+    import subprocess
+    import sys
+
+    from byteps_tpu.utils.hermetic import cpu_subprocess_env
+
+    args = [sys.executable,
+            os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         "tools", "wire_bench.py"),
+            "--echo-floor", "--json"]
+    if os.environ.get("BENCH_WIRE_UDS", "0") == "1":
+        args.append("--uds")
+    if os.environ.get("BENCH_SMALL", "0") == "1":
+        args.append("--quick")
+    r = subprocess.run(args, env=cpu_subprocess_env({}),
+                       capture_output=True, text=True, timeout=900)
+    if r.returncode != 0:
+        _error_record(f"wire bench failed rc={r.returncode}: "
+                      f"{r.stderr[-400:]}")
+        raise SystemExit(3)
+    ef = json.loads(r.stdout)["echo_floor"]
+    print(json.dumps({
+        "metric": "wire_pct_of_floor",
+        "value": ef["pct_of_floor"],
+        "unit": "pct_of_echo_floor",
+        "vs_baseline": round(ef["pct_of_floor"]
+                             / ef["target_pct_of_floor"], 3),
+        "detail": {**ef, **_note()},
+    }))
+
+
 def bench_fault():
     """Fault-tolerance benchmark: wall-clock cost of a mid-round
     connection reset through the chaos proxy (tools/chaos_proxy.py).
@@ -1025,6 +1115,7 @@ def bench_ps():
                         + ("; goodput counts LOGICAL f32 bytes — the wire "
                            "carries the compressed stream" if comp_kw
                            else ""),
+                **_device_stamp(),
             },
         }))
     finally:
@@ -1070,7 +1161,7 @@ def _error_record(err: str) -> None:
         "value": 0.0,
         "unit": "error",
         "vs_baseline": 0.0,
-        "detail": {"error": err},
+        "detail": {"error": err, **_device_stamp()},
     }), flush=True)
 
 
@@ -1214,6 +1305,8 @@ def main():
         bench_machinery()
     elif os.environ.get("BENCH_PS", "0") == "1":
         bench_ps()           # host-only: no device backend involved
+    elif os.environ.get("BENCH_WIRE", "0") == "1":
+        bench_wire()         # host-only: no device backend involved
     elif os.environ.get("BENCH_FUSION", "0") == "1":
         bench_fusion()       # host-only: no device backend involved
     elif os.environ.get("BENCH_FAULT", "0") == "1":

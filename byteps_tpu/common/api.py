@@ -815,17 +815,25 @@ def get_codec_stats() -> Dict[str, int]:
 
 
 def get_transport_stats() -> Dict[str, int]:
-    """Counters from the fault-tolerant PS transport
+    """Counters from the PS transport layer.  Fault tolerance
     (BYTEPS_TPU_RECONNECT_ATTEMPTS / _STALL_TIMEOUT_S): successful
     reconnects, exhausted backoff budgets, partitions replayed (push leg /
     pull leg), partitions parked (currently / ever), and stall-watchdog
-    trips.  The get_codec_stats() analog for the transport layer; all-zero
-    outside PS mode.  Used by the chaos tests and BENCH_FAULT=1 bench.py
-    to prove recovery actually exercised the replay path."""
+    trips.  Raw speed: receive-pool `pool_hits`/`pool_misses`/
+    `pool_buffers_held`, aggregate `lane_bytes_total`/
+    `lane_outstanding_bytes`, and a per-lane `lanes` row list ({server,
+    lane, transport(tcp|uds), bytes_total, outstanding_bytes, sends} —
+    the byte-credit scheduler's working signal).  The get_codec_stats()
+    analog for the transport layer; all-zero outside PS mode.  Numeric
+    keys export through the metrics registry's transport collector
+    (`bps_transport_*`); the `lanes` list is accessor-only.  Used by the
+    chaos/transport tests and BENCH_FAULT=1 / BENCH_WIRE=1 bench.py."""
     if _state.ps_session is not None:
         return _state.ps_session.transport_stats()
     from ..server.client import PSSession
-    return dict(PSSession.TRANSPORT_ZERO_STATS)
+    # Fresh `lanes` list per call: a shallow dict() would hand every
+    # caller (and the class template itself) the same mutable [].
+    return {**PSSession.TRANSPORT_ZERO_STATS, "lanes": []}
 
 
 def get_fusion_stats() -> Dict[str, int]:

@@ -62,7 +62,17 @@ class Config:
     # ---- communication tuning (reference: global.cc:42-43,134-144) ----
     partition_bytes: int = 4 * 1024 * 1024   # BYTEPS_PARTITION_BYTES
     min_compress_bytes: int = 65536          # BYTEPS_MIN_COMPRESS_BYTES
-    wire_conns: int = 2                      # BYTEPS_TPU_WIRE_CONNS
+    # Data lanes per worker<->server pair, picked per dispatch by byte
+    # credit (least-outstanding-bytes wins) so a large fused bucket can't
+    # head-of-line-block small high-priority partitions.
+    wire_conns: int = 4                      # BYTEPS_TPU_WIRE_CONNS
+    # Colocated-server UDS fast path: when set, a server at port P also
+    # listens on AF_UNIX at "<path>.P" and loopback workers dial it first
+    # (bit-identical framing, TCP fallback).  Empty = TCP only.
+    server_uds: str = ""                     # BYTEPS_TPU_SERVER_UDS
+    # SO_SNDBUF/SO_RCVBUF on worker conns and the server accept path, in
+    # KiB; 0 = kernel default (auto-tuning), the historical behavior.
+    sock_buf_kb: int = 0                     # BYTEPS_TPU_SOCK_BUF_KB
     # Worker-side codec pipeline threads (the reference's COMPRESS/
     # DECOMPRESS loop threads, core_loops.cc); 0 = inline encode/decode on
     # the caller/receiver threads.
@@ -159,7 +169,9 @@ class Config:
             force_distributed=_env_bool("BYTEPS_FORCE_DISTRIBUTED"),
             partition_bytes=_env_int("BYTEPS_PARTITION_BYTES", 4 * 1024 * 1024),
             min_compress_bytes=_env_int("BYTEPS_MIN_COMPRESS_BYTES", 65536),
-            wire_conns=_env_int("BYTEPS_TPU_WIRE_CONNS", 2),
+            wire_conns=_env_int("BYTEPS_TPU_WIRE_CONNS", 4),
+            server_uds=_env_str("BYTEPS_TPU_SERVER_UDS", ""),
+            sock_buf_kb=_env_int("BYTEPS_TPU_SOCK_BUF_KB", 0),
             compress_threads=_env_int("BYTEPS_TPU_COMPRESS_THREADS", 2),
             scheduling_credit=_env_int("BYTEPS_SCHEDULING_CREDIT", 0),
             fusion_bytes=_env_int("BYTEPS_TPU_FUSION_BYTES", 1024 * 1024),

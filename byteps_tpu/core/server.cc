@@ -45,6 +45,7 @@
 #include <netinet/tcp.h>
 #include <sys/socket.h>
 #include <sys/uio.h>
+#include <sys/un.h>
 #include <unistd.h>
 
 #include <atomic>
@@ -823,6 +824,14 @@ struct Conn {
   // exhaustion was still reachable).
   std::atomic<int> refs{0};
   std::atomic<bool> reader_done{false};
+  // Per-connection receive-buffer freelist: payload buffers cycle
+  // reader -> engine -> back here instead of a fresh (value-initialized!)
+  // vector per frame — `std::vector<char> payload(h.len)` was a hidden
+  // 4MB memset per partition per round on top of the malloc churn.
+  // Bounded small: steady-state one worker conn has ~engine-queue-depth
+  // buffers in flight.
+  std::mutex pool_mu;
+  std::vector<std::vector<char>> bufpool;
 };
 
 struct PendingPull {
@@ -869,6 +878,20 @@ struct KeyState {
   std::atomic<uint64_t> push_count{0};  // total pushes (schedule priority);
                                         // atomic: written by engine, read
                                         // by reader threads
+  // --- scatter-receive state (reader-visible) ---------------------------
+  // declared_len mirrors the store size the engine last established
+  // (INIT / size-change reset) so a READER thread can decide — without
+  // touching engine-owned state — whether an incoming raw-f32 push can
+  // be received straight into this key's scatter buffer.
+  std::atomic<uint64_t> declared_len{0};
+  // One frame at a time may hold the scatter lease (acquire via
+  // exchange); the holder's reader fills scatter_buf off the socket, the
+  // engine consumes it when the task runs (adopting it into the store by
+  // swap on the round's first push, summing from it otherwise) and
+  // releases the lease.  Losers of the CAS take the buffered path — the
+  // scatter is an allocation/copy optimization, never a semantic change.
+  std::atomic<bool> scatter_leased{false};
+  std::vector<char> scatter_buf;
 };
 
 struct Task {
@@ -885,6 +908,9 @@ struct Task {
   int64_t recv_us = 0;  // frame-read timestamp, set only for traced
                         // frames: engine-start minus this is the RECV
                         // span (server-side queue wait)
+  bool scattered = false;  // payload was scatter-received into the key's
+                           // scatter_buf (payload itself is empty); the
+                           // engine owns releasing the scatter lease
 };
 
 struct TaskCmp {
@@ -973,6 +999,27 @@ class Server {
                      mx, static_cast<unsigned long long>(max_msg_));
       }
     }
+    // Colocated-server UDS fast path (BYTEPS_TPU_SERVER_UDS): also listen
+    // on AF_UNIX at "<base>.<port>" — same framing, bit-identical
+    // protocol, lower per-frame cost than loopback TCP.  The ".<port>"
+    // suffix keys the path per server so one env var covers a multi-
+    // server host (client.py _dial derives the same name).
+    const char* uds = std::getenv("BYTEPS_TPU_SERVER_UDS");
+    if (uds && uds[0]) uds_base_ = uds;
+    // Socket buffer tuning (BYTEPS_TPU_SOCK_BUF_KB): SO_SNDBUF/SO_RCVBUF
+    // on every accepted connection; 0 = kernel default (auto-tuning).
+    // Strict-parse like max_msg_.
+    const char* sb = std::getenv("BYTEPS_TPU_SOCK_BUF_KB");
+    if (sb && sb[0]) {
+      char* end = nullptr;
+      uint64_t v = std::strtoull(sb, &end, 10);
+      if (end && *end == '\0')
+        sock_buf_bytes_ = static_cast<int>(v * 1024);
+      else
+        std::fprintf(stderr,
+                     "[byteps server] ignoring invalid "
+                     "BYTEPS_TPU_SOCK_BUF_KB=%s (want a KiB count)\n", sb);
+    }
   }
 
   int Run() {
@@ -992,36 +1039,46 @@ class Server {
     for (int i = 0; i < engine_threads_; ++i)
       engines_.emplace_back(&Server::EngineLoop, this, i);
 
-    while (!shutdown_.load()) {
-      int fd = accept(listen_fd_, nullptr, nullptr);
-      if (fd < 0) {
-        // Transient accept failures (fd pressure, aborted handshakes,
-        // signals) must not tear down the tier — existing sessions keep
-        // training and new connections retry.  Anything else (EBADF from
-        // the shutdown path closing the listener) ends the loop.
-        if (errno == EINTR || errno == ECONNABORTED || errno == EMFILE ||
-            errno == ENFILE || errno == ENOBUFS || errno == ENOMEM) {
-          std::this_thread::sleep_for(std::chrono::milliseconds(20));
-          continue;
+    // Optional AF_UNIX listener for colocated workers (see ctor): its
+    // acceptor runs on a side thread feeding the same ReaderLoop — a UDS
+    // conn is indistinguishable from a TCP one past accept().
+    std::thread uds_acceptor;
+    if (!uds_base_.empty()) {
+      uds_path_ = uds_base_ + "." + std::to_string(port_);
+      sockaddr_un ua{};
+      if (uds_path_.size() < sizeof(ua.sun_path)) {
+        uds_listen_fd_ = socket(AF_UNIX, SOCK_STREAM, 0);
+        if (uds_listen_fd_ >= 0) {
+          ua.sun_family = AF_UNIX;
+          std::strncpy(ua.sun_path, uds_path_.c_str(),
+                       sizeof(ua.sun_path) - 1);
+          ::unlink(uds_path_.c_str());   // stale file from a dead server
+          if (bind(uds_listen_fd_, reinterpret_cast<sockaddr*>(&ua),
+                   sizeof(ua)) == 0 &&
+              listen(uds_listen_fd_, 64) == 0) {
+            uds_acceptor = std::thread(
+                &Server::AcceptLoop, this, uds_listen_fd_, false);
+          } else {
+            std::fprintf(stderr,
+                         "[byteps server] UDS listen at %s failed "
+                         "(errno=%d); serving TCP only\n",
+                         uds_path_.c_str(), errno);
+            close(uds_listen_fd_);
+            uds_listen_fd_ = -1;
+          }
         }
-        break;
+      } else {
+        std::fprintf(stderr,
+                     "[byteps server] BYTEPS_TPU_SERVER_UDS path too long "
+                     "(%zu chars); serving TCP only\n", uds_path_.size());
       }
-      setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
-      auto* conn = new Conn();
-      conn->fd = fd;
-      {
-        std::lock_guard<std::mutex> lk(conns_mu_);
-        conns_.push_back(conn);
-      }
-      // Detached, counted: a joinable-but-terminated thread retains its
-      // stack until join, so tracking readers in a vector let a rogue
-      // connect loop accumulate a zombie stack per attempt (advisor r4).
-      // Shutdown synchronizes on the active count instead of join().
-      {
-        std::lock_guard<std::mutex> lk(readers_mu_);
-        ++active_readers_;
-      }
-      std::thread(&Server::ReaderLoop, this, conn).detach();
+    }
+
+    AcceptLoop(listen_fd_, true);
+    if (uds_acceptor.joinable()) uds_acceptor.join();
+    if (uds_listen_fd_ >= 0) {
+      close(uds_listen_fd_);
+      ::unlink(uds_path_.c_str());
     }
     for (auto& q : queues_) q.Stop();
     for (auto& t : engines_) t.join();
@@ -1049,6 +1106,52 @@ class Server {
   }
 
  private:
+  // Accept loop shared by the TCP and UDS listeners: accept, tune, hand
+  // the conn to a detached counted reader.  `is_tcp` gates TCP_NODELAY
+  // (meaningless on AF_UNIX).
+  void AcceptLoop(int lfd, bool is_tcp) {
+    int one = 1;
+    while (!shutdown_.load()) {
+      int fd = accept(lfd, nullptr, nullptr);
+      if (fd < 0) {
+        // Transient accept failures (fd pressure, aborted handshakes,
+        // signals) must not tear down the tier — existing sessions keep
+        // training and new connections retry.  Anything else (EBADF from
+        // the shutdown path closing the listener) ends the loop.
+        if (errno == EINTR || errno == ECONNABORTED || errno == EMFILE ||
+            errno == ENFILE || errno == ENOBUFS || errno == ENOMEM) {
+          std::this_thread::sleep_for(std::chrono::milliseconds(20));
+          continue;
+        }
+        break;
+      }
+      if (is_tcp)
+        setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+      if (sock_buf_bytes_ > 0) {
+        // Best-effort: the kernel clamps (and doubles) as it pleases.
+        setsockopt(fd, SOL_SOCKET, SO_SNDBUF, &sock_buf_bytes_,
+                   sizeof(sock_buf_bytes_));
+        setsockopt(fd, SOL_SOCKET, SO_RCVBUF, &sock_buf_bytes_,
+                   sizeof(sock_buf_bytes_));
+      }
+      auto* conn = new Conn();
+      conn->fd = fd;
+      {
+        std::lock_guard<std::mutex> lk(conns_mu_);
+        conns_.push_back(conn);
+      }
+      // Detached, counted: a joinable-but-terminated thread retains its
+      // stack until join, so tracking readers in a vector let a rogue
+      // connect loop accumulate a zombie stack per attempt (advisor r4).
+      // Shutdown synchronizes on the active count instead of join().
+      {
+        std::lock_guard<std::mutex> lk(readers_mu_);
+        ++active_readers_;
+      }
+      std::thread(&Server::ReaderLoop, this, conn).detach();
+    }
+  }
+
   static bool ReadFull(int fd, void* buf, size_t n) {
     char* p = static_cast<char*>(buf);
     while (n > 0) {
@@ -1209,12 +1312,14 @@ class Server {
     js.reserve(4096);
     std::snprintf(buf, sizeof(buf),
                   "{\"bytes_in\":%llu,\"bytes_out\":%llu,\"async\":%d,"
-                  "\"num_workers\":%d,\"keys\":{",
+                  "\"num_workers\":%d,\"scatter_frames\":%llu,\"keys\":{",
                   static_cast<unsigned long long>(
                       bytes_in_.load(std::memory_order_relaxed)),
                   static_cast<unsigned long long>(
                       bytes_out_.load(std::memory_order_relaxed)),
-                  async_ ? 1 : 0, num_workers_);
+                  async_ ? 1 : 0, num_workers_,
+                  static_cast<unsigned long long>(
+                      scatter_frames_.load(std::memory_order_relaxed)));
     js += buf;
     std::lock_guard<std::mutex> lk(stats_mu_);
     bool first = true;
@@ -1266,6 +1371,15 @@ class Server {
       if (conn->fd >= 0) ::shutdown(conn->fd, SHUT_RDWR);
     }
     conn->reader_done.store(true, std::memory_order_release);
+    {
+      // Drop the conn's recycled receive buffers: the Conn object itself
+      // lives until server shutdown (conns_ is never pruned), so a
+      // reconnect-churning fleet would otherwise pin ~4 payload-sized
+      // buffers per dead connection forever.
+      std::lock_guard<std::mutex> lk(conn->pool_mu);
+      conn->bufpool.clear();
+      conn->bufpool.shrink_to_fit();
+    }
     MaybeCloseFd(conn);
     {
       // notify while HOLDING the mutex: with a notify after release,
@@ -1278,13 +1392,81 @@ class Server {
     }
   }
 
+  // Pop a recycled receive buffer off the conn's freelist (resize only
+  // value-initializes GROWTH, and partition payloads are uniform, so the
+  // steady state is a no-op resize) / return one after the engine is done
+  // with it.  The conn outlives every holder (deleted only at server
+  // shutdown), so the engine-side return can't use-after-free.
+  static std::vector<char> PopBuf(Conn* c, size_t n) {
+    std::vector<char> b;
+    if (n >= 4096) {   // PushBuf's retention floor: a control frame must
+      //                  not evict (and then destroy) a pooled 4MB data
+      //                  buffer it will never refill
+      std::lock_guard<std::mutex> lk(c->pool_mu);
+      if (!c->bufpool.empty()) {
+        b = std::move(c->bufpool.back());
+        c->bufpool.pop_back();
+      }
+    }
+    b.resize(n);
+    return b;
+  }
+  static void PushBuf(Conn* c, std::vector<char>&& b) {
+    if (b.capacity() < 4096) return;   // tiny frames: not worth pooling
+    // A dead reader never pops again — returning a buffer after its
+    // exit-time pool purge would re-pin payload memory on a Conn that
+    // lives (unpooled) until server shutdown.
+    if (c->reader_done.load(std::memory_order_acquire)) return;
+    std::lock_guard<std::mutex> lk(c->pool_mu);
+    if (c->bufpool.size() < 4) c->bufpool.push_back(std::move(b));
+  }
+
+  KeyState* FindState(uint64_t key) {
+    std::lock_guard<std::mutex> lk(store_mu_);
+    auto it = store_.find(key);
+    return it == store_.end() ? nullptr : &it->second;
+  }
+
   void ReaderBody(Conn* conn) {
     ReqHeader h;
     while (!shutdown_.load()) {
       if (!ReadFull(conn->fd, &h, sizeof(h))) break;
       if (h.len > max_msg_) break;  // corrupt/hostile frame: drop the conn
-      std::vector<char> payload(h.len);
-      if (h.len && !ReadFull(conn->fd, payload.data(), h.len)) break;
+      // Scatter receive: a sync raw-f32 push for an already-declared key
+      // (reader-visible via the declared_len mirror) whose scatter lease
+      // is free reads its payload straight off the socket into the key's
+      // persistent scatter buffer — no per-push allocation, no memset,
+      // and on the round's first push the engine ADOPTS the buffer into
+      // the merge store by swap (HandlePush), so the payload's bytes are
+      // written exactly once end to end.  Lease losers / undeclared keys
+      // / compressed frames take the pooled buffered path below, with
+      // identical merge semantics (regression-tested).
+      bool scattered = false;
+      const uint64_t key = h.key;   // aligned copy (h is packed)
+      std::vector<char> payload;
+      if (h.cmd == kPush && h.dtype == kF32 && !async_ && h.len > 0) {
+        KeyState* ks = FindState(key);
+        if (ks &&
+            ks->declared_len.load(std::memory_order_acquire) == h.len &&
+            !ks->scatter_leased.exchange(true,
+                                         std::memory_order_acquire)) {
+          if (ks->scatter_buf.size() != h.len)
+            ks->scatter_buf.resize(h.len);
+          if (!ReadFull(conn->fd, ks->scatter_buf.data(), h.len)) {
+            // Conn died mid-payload: the lease must not leak.  The
+            // half-filled scatter_buf is harmless — the next holder
+            // overwrites it entirely before the engine ever reads it.
+            ks->scatter_leased.store(false, std::memory_order_release);
+            break;
+          }
+          scattered = true;
+          scatter_frames_.fetch_add(1, std::memory_order_relaxed);
+        }
+      }
+      if (!scattered) {
+        payload = PopBuf(conn, h.len);
+        if (h.len && !ReadFull(conn->fd, payload.data(), h.len)) break;
+      }
       bytes_in_.fetch_add(sizeof(h) + h.len, std::memory_order_relaxed);
       switch (h.cmd) {
         case kHello: {
@@ -1355,7 +1537,7 @@ class Server {
         case kShutdown:
           Respond(conn, kOk, h.req_id, h.key, nullptr, 0);
           shutdown_.store(true);
-          // Unblock accept().
+          // Unblock accept() on both listeners.
           { int s = socket(AF_INET, SOCK_STREAM, 0);
             sockaddr_in a{};
             a.sin_family = AF_INET;
@@ -1363,6 +1545,15 @@ class Server {
             a.sin_port = htons(static_cast<uint16_t>(port_));
             connect(s, reinterpret_cast<sockaddr*>(&a), sizeof(a));
             close(s); }
+          if (uds_listen_fd_ >= 0) {
+            int s = socket(AF_UNIX, SOCK_STREAM, 0);
+            sockaddr_un a{};
+            a.sun_family = AF_UNIX;
+            std::strncpy(a.sun_path, uds_path_.c_str(),
+                         sizeof(a.sun_path) - 1);
+            connect(s, reinterpret_cast<sockaddr*>(&a), sizeof(a));
+            close(s);
+          }
           return;
         default: {
           Task t;
@@ -1374,16 +1565,16 @@ class Server {
           t.key = h.key;
           t.payload = std::move(payload);
           t.conn = conn;
+          t.scattered = scattered;
           t.seq = seq_.fetch_add(1);
           t.priority = 0;
           // Clock read only for traced frames: the untraced hot path
           // stays exactly as cheap as before.
           t.recv_us = (h.flags & kFlagTraced) ? NowUs() : 0;
-          // h is #pragma pack(1): h.key sits at offset 12, so binding
-          // unordered_map::operator[]'s `const key_type&` directly to it
-          // is UB (misaligned 8-byte reference — UBSan catches it under
-          // the 4x2 soak).  Copy to an aligned local first.
-          const uint64_t key = h.key;
+          // `key` is the loop's aligned copy of h.key: h is
+          // #pragma pack(1), so binding unordered_map::operator[]'s
+          // `const key_type&` directly to h.key is UB (misaligned 8-byte
+          // reference — UBSan catches it under the 4x2 soak).
           int idx = EngineFor(key, h.len);
           if (schedule_) {
             std::lock_guard<std::mutex> lk(store_mu_);
@@ -1429,8 +1620,14 @@ class Server {
       }
       // The task's hold ends here (a deferred pull took its OWN ref in
       // HandlePull before this release, so the count can't dip to zero
-      // in between).  kLrScale tasks carry no conn.
-      if (t.conn) ReleaseRef(t.conn);
+      // in between).  kLrScale tasks carry no conn.  The payload buffer
+      // recycles back to the conn's freelist — for a COPY_FIRST push
+      // this is the PREVIOUS round's store (HandlePush swaps rather than
+      // moves), so the same few buffers cycle socket -> store -> socket.
+      if (t.conn) {
+        PushBuf(t.conn, std::move(t.payload));
+        ReleaseRef(t.conn);
+      }
       t.conn = nullptr;
     }
   }
@@ -1487,6 +1684,9 @@ class Server {
       ks.seen.clear();
       ks.merge_ts.clear();
     }
+    // Publish the declared size for the reader threads' scatter check
+    // (release pairs with the reader's acquire load).
+    ks.declared_len.store(n, std::memory_order_release);
     ks.dtype = t.dtype;
     uint64_t round = ks.completed_round;
     Respond(t.conn, kOk, t.req_id, t.key,
@@ -1495,8 +1695,19 @@ class Server {
 
   void HandlePush(Task& t) {
     KeyState& ks = StateFor(t.key);
-    // Captured before the COPY_FIRST move below can gut t.payload.
-    const uint64_t wire_len = t.payload.size();
+    // A scattered frame's payload lives in ks.scatter_buf (reader-filled
+    // under the scatter lease); this engine task owns releasing the
+    // lease — RAII, so every validation early-return below releases it.
+    struct LeaseGuard {
+      std::atomic<bool>* lease;
+      ~LeaseGuard() {
+        if (lease) lease->store(false, std::memory_order_release);
+      }
+    } lease_guard{t.scattered ? &ks.scatter_leased : nullptr};
+    const std::vector<char>* data =
+        t.scattered ? &ks.scatter_buf : &t.payload;
+    // Captured before the COPY_FIRST swap below can gut the source.
+    const uint64_t wire_len = data->size();
     if (t.dtype == kSeed) {
       // Store seeding for async weight-delta training: applied only if the
       // key has never been pushed, so a late-joining/rejoining worker
@@ -1529,9 +1740,8 @@ class Server {
     // as it found it (already-acked workers never re-push, so a wiped
     // `seen` could otherwise never refill and every pull would hang).
     std::vector<char> scratch;
-    const std::vector<char>* data = &t.payload;
     uint32_t comp_n = 0;
-    uint64_t want = t.payload.size();   // merged (f32) size this push implies
+    uint64_t want = wire_len;           // merged (f32) size this push implies
     if (t.dtype == kCompressed) {
       if (t.payload.size() < 5) {
         Respond(t.conn, kError, t.req_id, t.key, nullptr, 0);
@@ -1632,6 +1842,8 @@ class Server {
       ks.store.assign(want, 0);
       ks.seen.clear();
       ks.merge_ts.clear();   // the discarded merges' waits died with it
+      // Keep the readers' scatter check in step with the new store size.
+      ks.declared_len.store(want, std::memory_order_release);
     }
     ks.dtype = t.dtype == kCompressed ? kF32 : t.dtype;
     ks.push_count.fetch_add(1, std::memory_order_relaxed);
@@ -1653,16 +1865,22 @@ class Server {
       return;
     }
     if (first) {
-      // COPY_FIRST (reference: server.cc:299-379) — by MOVE when the
+      // COPY_FIRST (reference: server.cc:299-379) — by SWAP when the
       // payload arrived uncompressed: adopting the reader's buffer
-      // saves a full per-partition memory pass on the serve path (the
-      // buffer it replaces recycles through the heap, mallopt above).
+      // saves a full per-partition memory pass on the serve path, and
+      // the stale same-size ex-store buffer rides back for reuse (to
+      // the conn's freelist via t.payload, or as the key's next scatter
+      // target) instead of freeing — steady state, the same few buffers
+      // cycle socket -> store -> socket with zero allocation.
       // A compressed first push normally landed in the store above;
       // the exception is a size-change reset that PROMOTED a
       // scratch-validated push to first — copy it over.
-      if (data == &t.payload) {
-        ks.store = std::move(t.payload);
-        data = &ks.store;   // t.payload is dead from here
+      if (t.scattered) {
+        std::swap(ks.store, ks.scatter_buf);
+        data = &ks.store;
+      } else if (data == &t.payload) {
+        std::swap(ks.store, t.payload);
+        data = &ks.store;   // t.payload now holds the stale ex-store
       } else if (data == &scratch) {
         std::memcpy(ks.store.data(), scratch.data(), scratch.size());
         data = &ks.store;
@@ -1841,6 +2059,14 @@ class Server {
   uint64_t debug_key_ = ~0ULL;   // ~0 = all keys
   uint64_t max_msg_ = 1ULL << 30;  // wire frame cap (see ctor)
   int listen_fd_ = -1;
+  // UDS fast path + socket tuning (see ctor).
+  std::string uds_base_;
+  std::string uds_path_;
+  int uds_listen_fd_ = -1;
+  int sock_buf_bytes_ = 0;
+  // Scatter-receive telemetry: frames that took the zero-intermediate
+  // reader->store path (CMD_STATS "scatter_frames").
+  std::atomic<uint64_t> scatter_frames_{0};
 
   std::vector<EngineQueue> queues_;
   std::vector<std::thread> engines_;

@@ -37,7 +37,21 @@ TPU-host redesign of that data path:
     round-stall watchdog (BYTEPS_TPU_STALL_TIMEOUT_S) dumps a diagnostic
     snapshot and fails stuck handles loudly — the worker-side analog of
     server.cc's ORDERING INVARIANT guard.  bps.get_transport_stats()
-    exposes the counters.
+    exposes the counters,
+  - the receive path is pooled and zero-copy: raw pull payloads land
+    directly in the handle's output buffer (the per-request sink),
+    everything else rides a size-classed pooled-buffer ring
+    (_RecvBufPool) instead of a fresh allocation per frame, and
+    compressed pulls decode straight from the pooled view into the
+    output buffer,
+  - partitions spread over BYTEPS_TPU_WIRE_CONNS data lanes per server
+    by BYTE CREDIT at dispatch time (least-outstanding-bytes wins, ties
+    to least-used) — the multi-lane analog of ps-lite's per-connection
+    threads, minus the head-of-line blocking a fixed stripe invites,
+  - a colocated server is reached over AF_UNIX when
+    BYTEPS_TPU_SERVER_UDS is set ("<path>.<port>", bit-identical
+    protocol, transparent TCP fallback), and BYTEPS_TPU_SOCK_BUF_KB
+    sizes both directions' socket buffers.
 """
 
 from __future__ import annotations
@@ -128,15 +142,109 @@ class _ConnLost(ConnectionError):
         self.will_reconnect = will_reconnect
 
 
+class _PooledBuf:
+    """One checked-out receive buffer: an exact-length view of a pooled
+    bytearray plus the ticket to return it.
+
+    The receiver fills ``mv`` straight off the socket and hands the whole
+    object down the pull-completion path; exactly ONE consumer calls
+    ``release()`` after the payload's bytes have been consumed (copied
+    into the handle's output buffer or decoded out of it).  release() is
+    idempotent so error paths can call it defensively.
+    """
+
+    __slots__ = ("mv", "_pool", "_cls", "_buf")
+
+    def __init__(self, pool: "_RecvBufPool", cls: int, buf: bytearray,
+                 n: int):
+        self._pool, self._cls, self._buf = pool, cls, buf
+        self.mv = memoryview(buf)[:n]
+
+    def __len__(self) -> int:
+        return len(self.mv)
+
+    def release(self) -> None:
+        buf, self._buf = self._buf, None
+        if buf is not None:
+            self.mv.release()
+            self._pool._put(self._cls, buf)
+
+
+class _RecvBufPool:
+    """Size-classed pooled receive buffers for the payload hot path.
+
+    The pre-pool receiver allocated (and the allocator zero-filled) a
+    fresh bytearray per frame — a 4MB partition pull paid a 4MB
+    allocation + page-touch every round.  Here buffers recycle through
+    power-of-two size classes (4 KiB .. 16 MiB; larger payloads fall back
+    to a one-shot allocation): steady-state training traffic re-uses the
+    same few buffers round after round, so the per-frame cost drops to a
+    freelist pop.  Shared by every connection of a session — the classes
+    are locked, but acquire/release is two list ops per frame.
+
+    No-aliasing invariant: a buffer is EITHER on a freelist OR owned by
+    exactly one _PooledBuf (the receiver thread hands each checkout to a
+    single consumer, and release() nulls the ticket), so two concurrent
+    pulls can never scribble on the same backing storage — asserted by
+    tests/test_transport_speed.py.
+    """
+
+    MIN_CLASS = 12                       # 4 KiB — below this, pooling is
+    #                                      churn for no measurable win
+    MAX_CLASS = 24                       # 16 MiB
+    PER_CLASS = 8                        # buffers retained per class
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._free: Dict[int, list] = {}
+        self.hits = 0
+        self.misses = 0
+
+    def _class_for(self, n: int) -> Optional[int]:
+        if n <= 0 or n > (1 << self.MAX_CLASS):
+            return None
+        return max(self.MIN_CLASS, (n - 1).bit_length())
+
+    def acquire(self, n: int) -> _PooledBuf:
+        cls = self._class_for(n)
+        buf = None
+        if cls is not None:
+            with self._lock:
+                lst = self._free.get(cls)
+                if lst:
+                    buf = lst.pop()
+                    self.hits += 1
+                else:
+                    self.misses += 1
+        if buf is None:
+            buf = bytearray(1 << cls) if cls is not None else bytearray(n)
+        return _PooledBuf(self, cls, buf, n)
+
+    def _put(self, cls: Optional[int], buf: bytearray) -> None:
+        if cls is None:
+            return
+        with self._lock:
+            lst = self._free.setdefault(cls, [])
+            if len(lst) < self.PER_CLASS:
+                lst.append(buf)
+
+    def stats(self) -> Tuple[int, int, int]:
+        """(hits, misses, buffers currently held on freelists)."""
+        with self._lock:
+            held = sum(len(v) for v in self._free.values())
+            return self.hits, self.misses, held
+
+
 class _Future:
     """Completion slot for one outstanding request."""
 
     __slots__ = ("event", "data", "error", "callback", "sink", "sink_live",
-                 "cmd", "key", "req_id", "t0")
+                 "pool_ok", "cmd", "key", "req_id", "t0")
 
     def __init__(self, callback: Optional[Callable] = None,
                  sink: Optional[memoryview] = None,
-                 sink_live: Optional[Callable[[], bool]] = None):
+                 sink_live: Optional[Callable[[], bool]] = None,
+                 pool_ok: bool = False):
         self.event = None if callback else threading.Event()
         self.data: bytes = b""
         self.error: Optional[Exception] = None
@@ -149,6 +257,11 @@ class _Future:
         # False return (e.g. the owning handle timed out and the caller may
         # be reusing the buffer) diverts the payload to a scratch buffer.
         self.sink_live = sink_live
+        # True when the response payload may land in a pooled buffer (the
+        # pull data leg, whose completion path has a single well-defined
+        # consumer that releases it); control responses keep the private
+        # allocation so wait() callers can hold the bytes indefinitely.
+        self.pool_ok = pool_ok
         # Request context for diagnosable timeouts (filled in by send()).
         self.cmd = -1
         self.key = 0
@@ -195,7 +308,10 @@ class _ServerConn:
                  reconnect_attempts: int = 0,
                  reconnect_backoff_ms: float = 100.0,
                  on_reconnect: Optional[Callable] = None,
-                 on_give_up: Optional[Callable] = None):
+                 on_give_up: Optional[Callable] = None,
+                 uds_path: str = "",
+                 sock_buf_kb: int = 0,
+                 recv_pool: Optional[_RecvBufPool] = None):
         self.host, self.port = host, port
         self.timeout = timeout
         self.reconnect_attempts = max(0, int(reconnect_attempts))
@@ -203,6 +319,23 @@ class _ServerConn:
         self.on_reconnect = on_reconnect
         self.on_give_up = on_give_up
         self.reconnects = 0          # successful re-dials, for stats
+        # UDS fast path (BYTEPS_TPU_SERVER_UDS): dial AF_UNIX at
+        # "<uds_path>.<port>" first — same framing, bit-identical
+        # protocol, measurably lower per-frame cost for a colocated
+        # server — with transparent TCP fallback (including on re-dials,
+        # so a replacement server without the socket file still recovers).
+        self.uds_path = uds_path
+        self.sock_buf_kb = max(0, int(sock_buf_kb))
+        self.transport = "tcp"       # what _dial actually connected over
+        self._recv_pool = recv_pool
+        # Byte-credit lane accounting (the per-lane scheduling signal):
+        # outstanding_bytes is the wire payload in flight on this conn
+        # (charged at push dispatch / pull issue, returned on completion);
+        # lane_bytes_total / lane_sends are lifetime counters for stats.
+        self._lane_lock = threading.Lock()
+        self.outstanding_bytes = 0
+        self.lane_bytes_total = 0
+        self.lane_sends = 0
         self.sock = self._dial()
         self.lock = threading.Lock()          # send serialization
         self.replay_lock = threading.Lock()   # serializes on_reconnect runs
@@ -216,11 +349,61 @@ class _ServerConn:
         self._recv_thread.start()
 
     def _dial(self) -> socket.socket:
+        if self.uds_path:
+            # AF_UNIX first: "<base>.<port>" is the server's convention
+            # (core/server.cc UDS listener), so one env var covers a
+            # multi-server host.  Any failure (no socket file, refused,
+            # AF_UNSUPPORTED) falls back to TCP — the UDS path is an
+            # optimization, never a new failure mode.
+            sock = None
+            try:
+                sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+                sock.settimeout(self.timeout)
+                sock.connect(f"{self.uds_path}.{self.port}")
+                sock.settimeout(None)
+                self.transport = "uds"
+                self._tune(sock)
+                return sock
+            except OSError as e:
+                if sock is not None:
+                    try:
+                        sock.close()
+                    except OSError:
+                        pass
+                get_logger().debug(
+                    "UDS dial to %s.%d failed (%s); falling back to TCP",
+                    self.uds_path, self.port, e)
         sock = socket.create_connection((self.host, self.port),
                                         timeout=self.timeout)
         sock.settimeout(None)  # receiver blocks until data or close
         sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self.transport = "tcp"
+        self._tune(sock)
         return sock
+
+    def _tune(self, sock: socket.socket) -> None:
+        """Apply BYTEPS_TPU_SOCK_BUF_KB (0 = kernel default) to both
+        directions; best-effort — the kernel clamps/doubles as it sees
+        fit, and an EPERM on an exotic transport must not kill a dial."""
+        if self.sock_buf_kb <= 0:
+            return
+        nbytes = self.sock_buf_kb * 1024
+        for opt in (socket.SO_SNDBUF, socket.SO_RCVBUF):
+            try:
+                sock.setsockopt(socket.SOL_SOCKET, opt, nbytes)
+            except OSError:
+                pass
+
+    # -- byte-credit lane accounting ------------------------------------
+    def lane_charge(self, nbytes: int) -> None:
+        with self._lane_lock:
+            self.outstanding_bytes += nbytes
+            self.lane_bytes_total += nbytes
+            self.lane_sends += 1
+
+    def lane_return(self, nbytes: int) -> None:
+        with self._lane_lock:
+            self.outstanding_bytes = max(0, self.outstanding_bytes - nbytes)
 
     def state(self) -> str:
         """'up' | 'reconnecting' | 'closed' — for watchdog dumps/stats."""
@@ -239,8 +422,9 @@ class _ServerConn:
              worker_id: int = 0, dtype: int = 0, flags: int = 0,
              callback: Optional[Callable] = None,
              sink: Optional[memoryview] = None,
-             sink_live: Optional[Callable[[], bool]] = None) -> _Future:
-        fut = _Future(callback, sink, sink_live)
+             sink_live: Optional[Callable[[], bool]] = None,
+             pool_ok: bool = False) -> _Future:
+        fut = _Future(callback, sink, sink_live, pool_ok)
         with self._pending_lock:
             if self._closed:
                 raise ConnectionError("PS connection closed")
@@ -359,9 +543,14 @@ class _ServerConn:
                     return
 
     def _recv_pump(self) -> None:
+        # One persistent header buffer per pump: 21-byte RESP headers
+        # arrive once per response, so a fresh bytearray each time was
+        # pure allocator churn on the hot path.
+        hdr = bytearray(_RESP.size)
+        hdr_mv = memoryview(hdr)
         while True:
-            buf = self._recv_exact(_RESP.size)
-            status, req_id, rkey, length = _RESP.unpack(buf)
+            self._recv_into(hdr_mv)
+            status, req_id, rkey, length = _RESP.unpack(hdr)
             # Pop BEFORE the payload read: this thread owns the future
             # (and its sink buffer) exclusively, so a concurrent
             # _fail_pending can neither resolve it mid-write nor race a
@@ -369,6 +558,7 @@ class _ServerConn:
             # it if the connection dies mid-payload — no orphaning.
             with self._pending_lock:
                 fut = self._pending.pop(req_id, None)
+            pooled = None
             try:
                 if (fut is not None and fut.sink is not None
                         and status == 0 and length == len(fut.sink)
@@ -376,9 +566,20 @@ class _ServerConn:
                     # Matched sink: payload lands in the caller's buffer.
                     self._recv_into(fut.sink)
                     data = fut.sink
+                elif (fut is not None and fut.pool_ok and status == 0
+                        and length and self._recv_pool is not None):
+                    # Pull data leg with no sink match (compressed pull,
+                    # or a failed handle's diverted payload): land it in
+                    # a pooled buffer — the completion path consumes the
+                    # bytes and releases it (see _complete_pull).
+                    pooled = self._recv_pool.acquire(length)
+                    self._recv_into(pooled.mv)
+                    data = pooled
                 else:
                     data = self._recv_exact(length) if length else b""
             except (ConnectionError, OSError) as e:
+                if pooled is not None:
+                    pooled.release()
                 if fut is not None:
                     try:
                         fut.resolve(
@@ -611,13 +812,13 @@ class _PartTask:
     """One in-flight partition (the reference's TensorTableEntry partition,
     common.h:221-264)."""
 
-    __slots__ = ("pkey", "payload", "off", "ln", "round", "conn", "handle",
-                 "dtype", "done_evt", "wire_ln", "bidirectional",
+    __slots__ = ("pkey", "payload", "off", "ln", "round", "srv", "conn",
+                 "handle", "dtype", "done_evt", "wire_ln", "bidirectional",
                  "label", "priority", "enq_ts", "push_ts", "pull_ts",
                  "ready", "enc_err", "credit_ln", "phase", "parked",
-                 "enq_mono", "send_mono")
+                 "enq_mono", "send_mono", "lane_debt")
 
-    def __init__(self, pkey, payload, off, ln, rnd, conn, handle,
+    def __init__(self, pkey, payload, off, ln, rnd, srv, handle,
                  dtype=DT_F32, bidirectional=False, label=""):
         self.pkey = pkey
         self.payload = payload        # wire bytes (raw f32 or compressed);
@@ -626,7 +827,12 @@ class _PartTask:
         self.ln = ln                  # raw byte length of the partition
         self.wire_ln = len(payload) if payload is not None else ln
         self.round = rnd
-        self.conn = conn
+        # Server placement is fixed by the plan; the LANE (self.conn) is
+        # picked per dispatch by byte credit (_pick_lane) and charged
+        # lane_debt bytes until the round trip settles.
+        self.srv = srv
+        self.conn = None
+        self.lane_debt = 0
         self.handle = handle
         self.dtype = dtype
         self.bidirectional = bidirectional  # pull leg may arrive compressed
@@ -681,6 +887,14 @@ class PSSession:
         "parked_parts": 0,        # partitions currently parked for replay
         "parked_total": 0,        # partitions ever parked
         "watchdog_trips": 0,      # stall-watchdog dumps fired
+        "pool_hits": 0,           # recv buffers served from the pool
+        "pool_misses": 0,         # recv buffers freshly allocated
+        "pool_buffers_held": 0,   # buffers currently on pool freelists
+        "lane_bytes_total": 0,    # lifetime payload bytes across lanes
+        "lane_outstanding_bytes": 0,  # payload bytes in flight right now
+        "lanes": [],              # per-lane rows: {server, lane,
+        #                           transport, bytes_total,
+        #                           outstanding_bytes, sends}
     }
 
     def __init__(self, hosts: List[str], ports: List[int], worker_id: int,
@@ -688,13 +902,15 @@ class PSSession:
                  partition_bytes: int = 4 * 1024 * 1024,
                  scheduling_credit: int = 0,
                  min_compress_bytes: int = 65536,
-                 wire_conns: int = 2,
+                 wire_conns: int = 4,
                  compress_threads: int = 2,
                  reconnect_attempts: int = 0,
                  reconnect_backoff_ms: float = 100.0,
                  stall_timeout_s: float = 0.0,
                  barrier_timeout_s: float = 0.0,
-                 clock_sync_s: float = 30.0):
+                 clock_sync_s: float = 30.0,
+                 uds_path: str = "",
+                 sock_buf_kb: int = 0):
         self.worker_id = worker_id
         self.num_servers = max(1, num_servers)
         self.hash_fn = hash_fn
@@ -717,6 +933,12 @@ class PSSession:
         # often the background thread re-estimates server clock offsets
         # while tracing is on, bounding drift across a long trace window.
         self.clock_sync_s = max(1.0, float(clock_sync_s))
+        # UDS fast path + socket buffer tuning (BYTEPS_TPU_SERVER_UDS /
+        # BYTEPS_TPU_SOCK_BUF_KB).  The UDS dial only applies to servers
+        # this worker is actually colocated with (loopback hosts) — a
+        # remote server's conns keep dialing TCP.
+        self.uds_path = str(uds_path or "")
+        self.sock_buf_kb = max(0, int(sock_buf_kb))
         # Any failure before __init__ returns (a connect, the dispatcher,
         # the HELLO mode check) must tear down every socket and receiver
         # thread already created — the caller gets an exception, not a
@@ -733,20 +955,30 @@ class PSSession:
             raise
         self._session_ready = True
 
-    def _init_connections(self, hosts, ports, wire_conns: int) -> None:
-        """Primary conn per server + optional extra data connections.
+    _LOOPBACK_HOSTS = ("127.0.0.1", "localhost", "::1")
 
-        Partitions stripe across a server's pool, splitting the send-lock
-        and receive-thread work over more sockets (the reference gets the
-        same effect from ps-lite's per-connection threads).  Control
-        traffic (barrier/hello/shutdown) stays on the primary."""
+    def _init_connections(self, hosts, ports, wire_conns: int) -> None:
+        """Primary conn per server + optional extra data lanes.
+
+        Partitions spread across a server's lane pool by byte credit
+        (least-outstanding-bytes wins, picked at DISPATCH time — see
+        _pick_lane), splitting the send-lock and receive-thread work over
+        more sockets (the reference gets the same effect from ps-lite's
+        per-connection threads).  Control traffic (barrier/hello/
+        shutdown) stays on the primary."""
+        self._recv_pool = _RecvBufPool()
+
         def conn(h, p):
             return _ServerConn(
                 h, p,
                 reconnect_attempts=self.reconnect_attempts,
                 reconnect_backoff_ms=self.reconnect_backoff_ms,
                 on_reconnect=self._on_conn_reconnected,
-                on_give_up=self._on_conn_gave_up)
+                on_give_up=self._on_conn_gave_up,
+                uds_path=(self.uds_path
+                          if h in self._LOOPBACK_HOSTS else ""),
+                sock_buf_kb=self.sock_buf_kb,
+                recv_pool=self._recv_pool)
 
         for h, p in zip(hosts, ports):
             c = conn(h, p)
@@ -755,10 +987,11 @@ class PSSession:
         for pool, (h, p) in zip(self._data_conns, zip(hosts, ports)):
             for _ in range(wire_conns - 1):
                 pool.append(conn(h, p))
-        # Per-server round-robin cursor, persistent across plans: a
-        # per-plan counter would pin every single-partition tensor (the
-        # common case for DL gradients) to the primary socket.
-        self._conn_rr = [0] * len(self.conns)
+        for i, c in enumerate(self.conns):
+            if c.transport != "tcp":
+                get_logger().info(
+                    "PS server %d (%s:%d) connected over %s fast path",
+                    i, c.host, c.port, c.transport)
 
     def _abort_init(self) -> None:
         if getattr(self, "_watchdog_stop", None) is not None:
@@ -783,9 +1016,9 @@ class PSSession:
         self._compressors: Dict[int, object] = {}  # declared_key -> codec
         self._server_load = [0] * len(self.conns)
         self._plans: Dict[Tuple[int, int], list] = {}
-        # _plan's read-modify-write of _plans/_conn_rr/_server_load must be
-        # atomic: two threads planning concurrently would double-count
-        # server load and cache divergent stripe assignments.
+        # _plan's read-modify-write of _plans/_server_load must be atomic:
+        # two threads planning concurrently would double-count server
+        # load and cache divergent plans.
         self._plan_lock = threading.Lock()
         self._trace_labels: Dict[int, str] = {}
 
@@ -814,12 +1047,18 @@ class PSSession:
         # and only priority-order tests/tracing read it.
         self.record_push_order = False
         self.push_order: List[int] = []
-        # Fault-tolerance bookkeeping: wire-key -> conn (for re-declare
-        # invalidation after a reconnect) and the transport counter surface
-        # (bps.get_transport_stats, the codec/fusion-stats analog).
-        self._pkey_conn: Dict[int, _ServerConn] = {}
+        # Fault-tolerance bookkeeping: wire-key -> server index (for
+        # re-declare invalidation after a reconnect — a key's lane is
+        # picked per dispatch, but its SERVER is fixed by the hash) and
+        # the transport counter surface (bps.get_transport_stats, the
+        # codec/fusion-stats analog).
+        self._pkey_srv: Dict[int, int] = {}
         self._transport_lock = threading.Lock()
-        self._tstats = dict(self.TRANSPORT_ZERO_STATS)
+        # Int counters only: the template's "lanes" list is mutable and
+        # must never be shared (transport_stats() builds lanes fresh from
+        # the live conns anyway).
+        self._tstats = {k: v for k, v in self.TRANSPORT_ZERO_STATS.items()
+                        if isinstance(v, int)}
         # Round-stall watchdog (BYTEPS_TPU_STALL_TIMEOUT_S > 0): the
         # worker-side analog of server.cc's ORDERING INVARIANT guard — no
         # partition completing for the window with work outstanding dumps
@@ -908,7 +1147,9 @@ class PSSession:
                    reconnect_backoff_ms=cfg.reconnect_backoff_ms,
                    stall_timeout_s=cfg.stall_timeout_s,
                    barrier_timeout_s=cfg.barrier_timeout_s,
-                   clock_sync_s=cfg.clock_sync_s)
+                   clock_sync_s=cfg.clock_sync_s,
+                   uds_path=cfg.server_uds,
+                   sock_buf_kb=cfg.sock_buf_kb)
 
     def set_lr_scale(self, scale: float) -> None:
         """One-shot EF-error rescale after a learning-rate change;
@@ -943,12 +1184,17 @@ class PSSession:
 
     # -- partition planning -------------------------------------------------
     def _plan(self, declared_key: int, nbytes: int) -> list:
-        """[(pkey, offset, length, conn)] for a tensor of `nbytes` bytes.
+        """[(pkey, offset, length, server_idx)] for a tensor of `nbytes`
+        bytes.
 
         Partition bounds and key encoding come from the native core; server
         placement uses the configured hash over the partition key, with
         accumulated per-server load logged like the reference's placement
-        summary (reference: global.cc:643-692, 675-682).
+        summary (reference: global.cc:643-692, 675-682).  The LANE within
+        a server's pool is deliberately NOT planned here: it is picked at
+        dispatch time by byte credit (_pick_lane), so a large fused bucket
+        in flight can never head-of-line-block small high-priority
+        partitions onto the same socket.
         """
         with self._plan_lock:
             cached = self._plans.get((declared_key, nbytes))
@@ -957,21 +1203,12 @@ class PSSession:
             core = get_core()
             bounds = core.partition_bounds(nbytes, self.partition_bytes)
             plan = []
-            # Stripe by a per-server cursor that persists across plans (in
-            # self._conn_rr): a global-index stripe degenerates when
-            # placement correlates with index (hash_fn=naive), and a
-            # per-plan counter pins every single-partition tensor to the
-            # primary socket.  Plans are cached, so each partition's conn
-            # assignment is stable.
             for idx, (off, ln) in enumerate(bounds):
                 pkey = core.encode_key(declared_key, idx)
                 srv = core.key_to_server(pkey, len(self.conns), self.hash_fn)
                 self._server_load[srv] += ln
-                pool = self._data_conns[srv]
-                conn = pool[self._conn_rr[srv] % len(pool)]
-                plan.append((pkey, off, ln, conn))
-                self._pkey_conn[pkey] = conn
-                self._conn_rr[srv] += 1
+                plan.append((pkey, off, ln, srv))
+                self._pkey_srv[pkey] = srv
             self._plans[(declared_key, nbytes)] = plan
             total = sum(self._server_load) or 1
         get_logger().debug(
@@ -979,6 +1216,35 @@ class PSSession:
             declared_key, len(plan),
             ["%.1f%%" % (100.0 * l / total) for l in self._server_load])
         return plan
+
+    def _pick_lane(self, srv: int, nbytes: int) -> _ServerConn:
+        """Byte-credit lane pick: the lane of server `srv` with the least
+        outstanding payload bytes wins (ties broken by fewest lifetime
+        sends, so idle lanes still rotate), charged with this partition's
+        push + expected pull bytes until the round trip settles
+        (_lane_settle).  Replaces the plan-time round-robin stripe, which
+        let a 4MB fused bucket head-of-line-block a late high-priority
+        partition assigned to the same socket."""
+        conn = self._pick_lane_from(self._data_conns[srv])
+        conn.lane_charge(nbytes)
+        return conn
+
+    @staticmethod
+    def _pick_lane_from(pool) -> _ServerConn:
+        """Least-loaded pick among the "up" lanes of one server's pool
+        (static so the scheduler policy is unit-testable on stub conns)."""
+        if len(pool) == 1:
+            return pool[0]
+        up = [c for c in pool if c.state() == "up"] or pool
+        return min(up, key=lambda c: (c.outstanding_bytes, c.lane_sends))
+
+    def _lane_settle(self, part: "_PartTask") -> None:
+        """Return a partition's outstanding-byte charge to its lane —
+        idempotent, called wherever the partition leaves the wire (pull
+        completed, parked for replay, or failed)."""
+        debt, part.lane_debt = part.lane_debt, 0
+        if debt and part.conn is not None:
+            part.conn.lane_return(debt)
 
     # -- dispatcher ---------------------------------------------------------
     def _dispatch_loop(self) -> None:
@@ -1028,6 +1294,11 @@ class PSSession:
             part.send_mono = time.monotonic()
             if part.enq_mono:
                 self._m_queue_wait.observe(part.send_mono - part.enq_mono)
+            # Byte-credit lane pick, charged with the push payload plus
+            # the expected pull reply (both legs ride this conn).
+            self._lane_settle(part)     # replays drop any stale charge
+            part.conn = self._pick_lane(part.srv, part.wire_ln + part.ln)
+            part.lane_debt = part.wire_ln + part.ln
             try:
                 part.conn.send(
                     CMD_PUSH, pkey, part.payload, worker_id=self.worker_id,
@@ -1093,6 +1364,7 @@ class PSSession:
             flags=_round_flags(part.round, get_core().trace_on),
             sink=sink,
             sink_live=lambda h=part.handle: not h.failed(),
+            pool_ok=True,
             callback=lambda data, err, pkey=part.pkey:
                 self._on_pull(pkey, data, err))
 
@@ -1113,7 +1385,10 @@ class PSSession:
                 # the new round the moment the key leaves _inflight.
                 self._round[pkey] = part.round + 1
         if part is None:
+            if isinstance(data, _PooledBuf):
+                data.release()
             return
+        self._lane_settle(part)     # round trip done: return lane credit
         core = get_core()
         if core.trace_on and part.pull_ts:
             core.trace_record_part(part.label, "PULL", part.pull_ts,
@@ -1154,43 +1429,60 @@ class PSSession:
                 # part.handle.out (length-matched) — nothing to copy.
                 pass
             else:
-                if part.bidirectional and len(data) != part.ln:
+                raw = data.mv if isinstance(data, _PooledBuf) else data
+                if part.bidirectional and len(raw) != part.ln:
                     # Bidirectional compressor: the merged buffer came back
                     # re-compressed; decode it (reference: worker DECOMPRESS
-                    # stage, core_loops.cc:618-646).
+                    # stage, core_loops.cc:618-646) — straight from the
+                    # (pooled) receive view INTO the handle's output slice:
+                    # no bytes() snapshot, no scratch f32 array, no copy
+                    # pass.  Writing into `out` directly mirrors the raw
+                    # sink path's contract (out is session-allocated and
+                    # wait() never returns it after a failure), so the
+                    # failed() check only skips dead work.
                     from .wire import decode as wire_decode
                     t0 = (core.trace_now_us()
                           if core.trace_on or self._codec_pool is not None
                           else 0)
-                    got = wire_decode(bytes(data), n)
+                    if part.handle.failed():
+                        get_logger().debug(
+                            "discarding late pull for key %d: handle "
+                            "already timed out", part.pkey)
+                    else:
+                        off = part.off // 4
+                        wire_decode(raw, n,
+                                    out=part.handle.out[off:off + n])
                     if t0:
                         dur = core.trace_now_us() - t0
                         if core.trace_on:
                             core.trace_record_part(
                                 part.label, "DECODE", t0, dur, part.pkey,
-                                len(data), part.priority)
+                                len(raw), part.priority)
                         if self._codec_pool is not None:
                             self._codec_pool.record("DECODE", dur)
                 else:
-                    got = np.frombuffer(data, np.float32)
-                if got.size != n:
-                    raise ValueError(
-                        f"PS pull size mismatch for key {part.pkey}: "
-                        f"got {got.size} f32, want {n}")
-                if not part.handle._store_result(part.off // 4, got):
-                    get_logger().debug(
-                        "discarding late pull for key %d: handle already "
-                        "timed out", part.pkey)
+                    got = np.frombuffer(raw, np.float32)
+                    if got.size != n:
+                        raise ValueError(
+                            f"PS pull size mismatch for key {part.pkey}: "
+                            f"got {got.size} f32, want {n}")
+                    if not part.handle._store_result(part.off // 4, got):
+                        get_logger().debug(
+                            "discarding late pull for key %d: handle "
+                            "already timed out", part.pkey)
             part.handle._part_done(pkey=part.pkey)
         except Exception as e:
             part.handle._part_done(e, pkey=part.pkey)
         finally:
+            if isinstance(data, _PooledBuf):
+                data.release()
             part.done_evt.set()
 
     def _finish_part(self, pkey: int, error: Exception) -> None:
         with self._inflight_lock:
             part = self._inflight.pop(pkey, None)
         if part is not None:
+            self._lane_settle(part)
             part.handle._part_done(error, pkey=pkey)
             part.done_evt.set()
 
@@ -1224,6 +1516,7 @@ class PSSession:
                 return True     # the other path got here first
             part.parked = True
             part.phase = phase
+        self._lane_settle(part)    # parked work holds no lane credit
         with self._transport_lock:
             self._tstats["parked_parts"] += 1
             self._tstats["parked_total"] += 1
@@ -1286,11 +1579,11 @@ class PSSession:
         # epoch: its pre-restart offset history would place post-restart
         # trace spans wildly off the worker timeline.  Drop it; the next
         # sync/fetch re-estimates against the live process.
-        for srv, pool in enumerate(self._data_conns):
-            if conn in pool:
-                with self._clock_lock:
-                    self._clock_offsets.pop(srv, None)
-                break
+        conn_srv = next((i for i, pool in enumerate(self._data_conns)
+                         if conn in pool), None)
+        if conn_srv is not None:
+            with self._clock_lock:
+                self._clock_offsets.pop(conn_srv, None)
         try:
             mode = conn.request(CMD_HELLO, worker_id=self.worker_id)
             modes = ((bool(mode[0]), bool(mode[1]))
@@ -1312,11 +1605,11 @@ class PSSession:
             self._fail_parked_on(conn, e)
             return
         # Invalidate the re-declare cache for every key planned on this
-        # conn: a server restart lost its store sizes and round counters,
-        # and the next _init_parts must re-seed from live state.  (Keys
-        # whose state survived just get a cheap idempotent re-INIT.)
-        stale = [pkey for pkey, c in list(self._pkey_conn.items())
-                 if c is conn]
+        # conn's SERVER: a server restart lost its store sizes and round
+        # counters, and the next _init_parts must re-seed from live state.
+        # (Keys whose state survived just get a cheap idempotent re-INIT.)
+        stale = [pkey for pkey, s in list(self._pkey_srv.items())
+                 if s == conn_srv]
         for pkey in stale:
             self._inited.pop(pkey, None)
         with self._inflight_lock:
@@ -1409,6 +1702,10 @@ class PSSession:
         else:
             with self._transport_lock:
                 self._tstats["replayed_pulls"] += 1
+            # Pull-only replay: re-charge the lane for the reply leg (the
+            # original charge was returned when the partition parked).
+            part.conn.lane_charge(part.ln)
+            part.lane_debt = part.ln
             self._issue_pull(part)
 
     def _watchdog_loop(self) -> None:
@@ -1444,11 +1741,12 @@ class PSSession:
             f"queue pending={self._queue.pending()}",
         ]
         for p in sorted(outstanding, key=lambda p: p.pkey):
+            conn = (f"{p.conn.host}:{p.conn.port}[{p.conn.state()}]"
+                    if p.conn is not None else "<undispatched>")
             lines.append(
                 f"  key={p.pkey} round={p.round} phase={p.phase}"
                 f" parked={p.parked} priority={p.priority}"
-                f" bytes={p.wire_ln} conn={p.conn.host}:{p.conn.port}"
-                f"[{p.conn.state()}]")
+                f" bytes={p.wire_ln} conn={conn}")
         for i, pool in enumerate(self._data_conns):
             states = ",".join(c.state() for c in pool)
             lines.append(f"  server[{i}] conns: {states}")
@@ -1457,12 +1755,35 @@ class PSSession:
         get_logger().error("%s", "\n".join(lines))
 
     def transport_stats(self) -> dict:
-        """Fault-tolerance counters (reconnects, replayed/parked parts,
-        watchdog trips) — the get_codec_stats() analog for the transport."""
+        """Fault-tolerance + raw-speed transport counters: reconnects,
+        replayed/parked parts, watchdog trips, receive-pool hit/miss, and
+        per-lane bytes/outstanding (the byte-credit scheduler's working
+        signal) — the get_codec_stats() analog for the transport.  The
+        numeric keys export through the telemetry registry's transport
+        collector; `lanes` is the per-lane detail list (skipped by the
+        exporter, which only takes numbers)."""
         with self._transport_lock:
             s = dict(self._tstats)
         s["reconnects"] = sum(c.reconnects for pool in self._data_conns
                               for c in pool)
+        hits, misses, held = self._recv_pool.stats()
+        s["pool_hits"], s["pool_misses"] = hits, misses
+        s["pool_buffers_held"] = held
+        lanes = []
+        total_bytes = outstanding = 0
+        for srv, pool in enumerate(self._data_conns):
+            for li, c in enumerate(pool):
+                lanes.append({
+                    "server": srv, "lane": li, "transport": c.transport,
+                    "bytes_total": c.lane_bytes_total,
+                    "outstanding_bytes": c.outstanding_bytes,
+                    "sends": c.lane_sends,
+                })
+                total_bytes += c.lane_bytes_total
+                outstanding += c.outstanding_bytes
+        s["lane_bytes_total"] = total_bytes
+        s["lane_outstanding_bytes"] = outstanding
+        s["lanes"] = lanes
         return s
 
     def server_stats(self, timeout: float = 10.0) -> dict:
@@ -1485,7 +1806,8 @@ class PSSession:
         here as a clean "server too old" RuntimeError — never a hang.
         """
         merged = {"bytes_in": 0, "bytes_out": 0, "async": False,
-                  "num_workers": 0, "keys": {}, "workers": {}}
+                  "num_workers": 0, "scatter_frames": 0, "keys": {},
+                  "workers": {}}
         import json as _json
         for c in self.conns:
             try:
@@ -1499,6 +1821,7 @@ class PSSession:
             st = _json.loads(bytes(raw).decode())
             merged["bytes_in"] += int(st.get("bytes_in", 0))
             merged["bytes_out"] += int(st.get("bytes_out", 0))
+            merged["scatter_frames"] += int(st.get("scatter_frames", 0))
             merged["async"] = merged["async"] or bool(st.get("async"))
             merged["num_workers"] = max(merged["num_workers"],
                                         int(st.get("num_workers", 0)))
@@ -1771,8 +2094,12 @@ class PSSession:
         # completes.  The sequential-use guard in _stage_parts already
         # serializes re-pushes of the same key.
         plan = self._plan(declared_key, payload.nbytes)
+        # np.empty, not np.zeros: every partition's pull fills its slice
+        # before wait() can return the buffer (and a failed handle never
+        # returns it at all), so pre-zeroing a 64MB result buffer every
+        # round was a pure memset tax on the pull path.
         handle = PSHandle(arr.shape, arr.dtype, len(plan),
-                          np.zeros(payload.nbytes // 4, np.float32))
+                          np.empty(payload.nbytes // 4, np.float32))
         mv = memoryview(payload).cast("B")
         comp = self._compressors.get(declared_key)
         kw_bytes = comp.kwargs_string().encode() if comp else b""
@@ -1834,8 +2161,9 @@ class PSSession:
         never beat its INIT to the server."""
         deadline = time.monotonic() + 60.0
         inits = []
-        for pkey, off, ln, conn in plan:
+        for pkey, off, ln, srv in plan:
             if self._inited.get(pkey) != (ln, kw_bytes):
+                conn = self.conns[srv]    # control traffic: primary lane
                 init_payload = struct.pack(
                     "<QI", ln, len(kw_bytes)) + kw_bytes
                 inits.append((pkey, ln, conn, init_payload,
@@ -1902,7 +2230,7 @@ class PSSession:
         self._init_parts(plan, kw_bytes)
         pool = self._codec_pool
         core = get_core()
-        for pkey, off, ln, conn in plan:
+        for pkey, off, ln, srv in plan:
             # BYTEPS_MIN_COMPRESS_BYTES floor: small partitions go raw
             # (reference: operations.cc:362-364).
             use_comp = (comp is not None and not raw and not seed
@@ -1936,7 +2264,7 @@ class PSSession:
                     if prev is None:
                         part = _PartTask(
                             pkey, wire_payload, off, ln,
-                            self._round.get(pkey, 0), conn, handle,
+                            self._round.get(pkey, 0), srv, handle,
                             dtype=dtype,
                             bidirectional=use_comp and comp.bidirectional,
                             label=f"{label}.part{pkey & 0xFFFF}")

@@ -552,9 +552,15 @@ class WireCompressor:
         return np.concatenate([np.zeros(1, np.float32), pts])
 
 
-def decode(data: bytes, n: int) -> np.ndarray:
+def decode(data, n: int, out: Optional[np.ndarray] = None) -> np.ndarray:
     """Decode any compressed wire payload to an n-element f32 vector
     (the worker pull-leg decompress for bidirectional compressors).
+
+    ``data`` may be bytes OR any buffer-protocol object (bytearray /
+    memoryview) — the receive path hands pooled buffer views straight in,
+    with no bytes() snapshot.  ``out``, when given, is a contiguous
+    n-element float32 array the decode lands in directly (the handle's
+    output sink on the pull path); it is also returned.
 
     Rides the C decoder from libbyteps_core.so when available (the
     exact routine the server engine runs — the numpy paths below are
@@ -563,13 +569,35 @@ def decode(data: bytes, n: int) -> np.ndarray:
     comp, wn = struct.unpack_from("<BI", data, 0)
     if wn != n:
         raise ValueError(f"wire n={wn} != expected {n}")
+    if out is not None and (out.size != n or out.dtype != np.float32
+                            or not out.flags.c_contiguous):
+        raise ValueError("decode out= must be a contiguous f32[n] array")
     lib = _c_wire()
     if lib is not None:
-        out = np.empty(n, np.float32)
-        if lib.bps_wire_decode(data, len(data), out.ctypes.data, n) == 0:
-            return out
+        dst = out if out is not None else np.empty(n, np.float32)
+        if lib.bps_wire_decode(_c_buf(data), len(data),
+                               dst.ctypes.data, n) == 0:
+            return dst
         raise ValueError("malformed compressed wire payload (C decoder)")
-    return _decode_py(data, n)
+    res = _decode_py(data, n)
+    if out is not None:
+        out[:] = res
+        return out
+    return res
+
+
+def _c_buf(data):
+    """`data` as a ctypes-compatible char buffer WITHOUT copying: bytes
+    pass through (c_char_p converts natively); writable buffers
+    (bytearray, pooled memoryviews) wrap via from_buffer; anything
+    read-only falls back to one snapshot."""
+    if isinstance(data, bytes):
+        return data
+    import ctypes
+    try:
+        return (ctypes.c_char * len(data)).from_buffer(data)
+    except (TypeError, BufferError):
+        return bytes(data)
 
 
 def _decode_py(data: bytes, n: int) -> np.ndarray:

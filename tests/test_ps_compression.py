@@ -464,18 +464,18 @@ def test_slow_decode_does_not_stall_other_partitions(ps_server, monkeypatch):
     calls = []            # (thread_name, finish_time) per decode
     slowed = []
 
-    def traced_decode(data, n):
+    def traced_decode(data, n, out=None):
         with lock:
             slow = not slowed
             if slow:
                 slowed.append(True)
         if slow:
             time_mod.sleep(0.75)   # one slow partition (elias-like cost)
-        out = real_decode(data, n)
+        res = real_decode(data, n, out=out)
         with lock:
             calls.append((threading.current_thread().name,
                           time_mod.monotonic()))
-        return out
+        return res
 
     monkeypatch.setattr(wire, "decode", traced_decode)
     got = s.push_pull(8, g)

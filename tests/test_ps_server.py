@@ -325,7 +325,7 @@ def test_large_tensor_partitioned_across_servers(ps_server):
         plan = s._plan(11, data.nbytes)
         # >=5 partitions at the default 4MB bound, on >=2 distinct servers
         assert len(plan) >= 5
-        servers_used = {id(conn) for (_, _, _, conn) in plan}
+        servers_used = {srv for (_, _, _, srv) in plan}
         assert len(servers_used) >= 2, "partitions all landed on one server"
         keys = [pkey for (pkey, _, _, _) in plan]
         assert len(set(keys)) == len(keys)
@@ -342,20 +342,28 @@ def test_large_tensor_partitioned_across_servers(ps_server):
     np.testing.assert_array_equal(out[1], expect)
 
 
-def test_wire_conns_stripe_partitions(ps_server):
-    """With wire_conns=2, a multi-partition tensor's data must stripe over
-    both sockets of each server — for EVERY placement hash (a global-index
-    stripe degenerates under hash_fn=naive, whose server assignment has a
-    fixed index residue)."""
+def test_wire_conns_spread_partitions_over_lanes(ps_server):
+    """With wire_conns=2, a multi-partition tensor's data must spread over
+    both lanes of each server — lanes are picked at DISPATCH time by byte
+    credit (least-outstanding-bytes, ties to fewest sends), so after a few
+    rounds every lane must have carried traffic, for EVERY placement hash
+    (plan-time assignment no longer exists to degenerate)."""
     port = ps_server(num_workers=1)
     for hash_fn in ("naive", "djb2"):
         s = PSSession(["127.0.0.1"], [port], worker_id=0, num_servers=1,
                       hash_fn=hash_fn, partition_bytes=65536, wire_conns=2)
         data = np.arange(8 * 65536 // 4, dtype=np.float32)
         plan = s._plan(3, data.nbytes)
-        conns_used = {id(c) for (_, _, _, c) in plan}
-        assert len(conns_used) == 2, f"no striping under hash_fn={hash_fn}"
-        np.testing.assert_array_equal(s.push_pull(3, data), data)
+        assert len(plan) == 8
+        assert all(srv == 0 for (_, _, _, srv) in plan)
+        for _ in range(3):
+            np.testing.assert_array_equal(s.push_pull(3, data), data)
+        lanes = s.transport_stats()["lanes"]
+        assert len(lanes) == 2
+        assert all(l["sends"] > 0 for l in lanes), \
+            f"idle lane under hash_fn={hash_fn}: {lanes}"
+        assert all(l["outstanding_bytes"] == 0 for l in lanes), \
+            f"leaked lane credit: {lanes}"
         s.close()
 
 

@@ -292,6 +292,11 @@ def test_metrics_endpoint_during_two_worker_run(ps_server):
                            ("bps_transport_", bps.get_transport_stats()),
                            ("bps_fusion_", bps.get_fusion_stats())):
         for k, v in legacy.items():
+            if not isinstance(v, (int, float)):
+                # Non-numeric detail (e.g. the per-lane row list) is for
+                # get_*_stats() readers; the collector exports numbers only.
+                assert prefix + k not in exported, (prefix, k)
+                continue
             assert exported[prefix + k] == v, (prefix, k)
 
 

@@ -471,6 +471,34 @@ def test_close_warns_on_wedged_dispatcher(ps_server):
     real.join(timeout=10)    # the real dispatcher saw _closed and exited
 
 
+def test_reconnect_and_replay_over_uds(ps_server):
+    """Kill-and-restart recovery over the AF_UNIX fast path: a push
+    staged while the server is down parks, the conn re-dials the NEW
+    socket file (the restarted server re-binds the same path), the
+    replay rebases onto the fresh server, and the session stays on UDS
+    throughout — PR 3 reconnect/replay semantics, new transport."""
+    uds = f"/tmp/bps_uds_fault_{os.getpid()}"
+    port = ps_server(extra_env={"BYTEPS_TPU_SERVER_UDS": uds})
+    s = _session(port, attempts=20, backoff_ms=60.0, uds_path=uds)
+    try:
+        assert {c.transport for pool in s._data_conns
+                for c in pool} == {"uds"}
+        x = np.arange(5000, dtype=np.float32)
+        np.testing.assert_array_equal(s.push_pull(2, x), x)
+        victim = ps_server.procs[-1]
+        victim.kill()
+        victim.wait()
+        h = s.push_pull_async(2, x * 3)          # parks during the outage
+        ps_server(port=port, extra_env={"BYTEPS_TPU_SERVER_UDS": uds})
+        np.testing.assert_array_equal(h.wait(timeout=60), x * 3)
+        st = s.transport_stats()
+        assert st["reconnects"] >= 1, st
+        assert {c.transport for pool in s._data_conns
+                for c in pool} == {"uds"}
+    finally:
+        s.close()
+
+
 def test_transport_stats_shapes():
     import byteps_tpu as bps
     zero = bps.get_transport_stats()     # outside PS mode: all-zero shape

@@ -65,6 +65,31 @@ def test_wire_bench_quick_smoke():
 
 
 @pytest.mark.slow
+@pytest.mark.parametrize("uds", [False, True], ids=["tcp", "uds"])
+def test_wire_bench_echo_floor_smoke(uds):
+    """--echo-floor structural smoke on both transports: the bench emits
+    the pct_of_floor acceptance number itself (floor and PS goodput
+    measured in interleaved batches on the SAME transport), the server's
+    scatter path actually engaged, and the UDS run really rode AF_UNIX.
+    No threshold on pct here — shared CI hosts swing the floor ~2x; the
+    number's home is BENCH_WIRE=1 / docs/performance.md."""
+    r = subprocess.run([sys.executable, _TOOL, "--quick", "--json",
+                        "--echo-floor"] + (["--uds"] if uds else []),
+                       env=cpu_env(), capture_output=True, text=True,
+                       timeout=600)
+    assert r.returncode == 0, r.stderr[-3000:]
+    ef = json.loads(r.stdout)["echo_floor"]
+    assert ef["transport"] == ("uds" if uds else "tcp")
+    assert ef["floor_gbps"] > 0 and ef["goodput_gbps"] > 0
+    assert ef["pct_of_floor"] == pytest.approx(
+        100.0 * ef["goodput_gbps"] / ef["floor_gbps"], abs=0.1)
+    assert ef["target_pct_of_floor"] == 85.0
+    assert ef["partitions"] == 4          # 16 MB quick tensor, 4 MiB parts
+    assert ef["scatter_frames"] > 0       # raw-f32 pushes scatter-received
+    assert len(ef["floor_batches_gbps"]) == len(ef["goodput_batches_gbps"])
+
+
+@pytest.mark.slow
 def test_wire_bench_fusion_smoke():
     """Many-small-tensors scenario (--fusion-only): fusion must cut wire
     messages >= 4x (the headline structural claim — each bucket replaces

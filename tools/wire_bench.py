@@ -103,7 +103,7 @@ def codec_throughput(n: int, reps: int) -> list:
     return out
 
 
-def boot_server():
+def boot_server(extra_env=None):
     """Native PS server subprocess on a freshly-probed port (the bind
     race retry pattern of bench.py bench_ps)."""
     import tempfile
@@ -115,6 +115,7 @@ def boot_server():
             "DMLC_PS_ROOT_PORT": str(port - 1),
             "DMLC_NUM_WORKER": "1",
             "BYTEPS_SERVER_ENGINE_THREAD": str(min(4, os.cpu_count() or 4)),
+            **(extra_env or {}),
         })
         errf = tempfile.TemporaryFile(mode="w+")
         proc = subprocess.Popen(
@@ -141,6 +142,145 @@ def boot_server():
                     raise RuntimeError("PS server did not come up")
                 time.sleep(0.1)
     raise RuntimeError("PS server lost the port race 4 times")
+
+
+def measure_echo_floor(nbytes: int, reps: int,
+                       uds_path: str = "") -> float:
+    """Raw synchronous send+recv echo — the transport ceiling for a
+    Python client on this host, measured over the SAME transport the PS
+    session uses (loopback TCP, or AF_UNIX when ``uds_path`` is set):
+    no protocol, no framing, no summing, no store.  Returns GB/s of
+    2 * nbytes * reps (the echo moves each byte both ways)."""
+    import threading
+
+    if uds_path:
+        srv = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        path = f"{uds_path}.echo.{os.getpid()}"
+        try:
+            os.unlink(path)
+        except OSError:
+            pass
+        srv.bind(path)
+        addr = path
+    else:
+        srv = socket.socket()
+        srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        srv.bind(("127.0.0.1", 0))
+        addr = ("127.0.0.1", srv.getsockname()[1])
+    srv.listen(1)
+
+    def serve():
+        c, _ = srv.accept()
+        buf = bytearray(nbytes)
+        view = memoryview(buf)
+        for _ in range(reps + 1):
+            got = 0
+            while got < nbytes:
+                r = c.recv_into(view[got:], nbytes - got)
+                if r == 0:
+                    return
+                got += r
+            c.sendall(buf)
+
+    th = threading.Thread(target=serve, daemon=True)
+    th.start()
+    if uds_path:
+        c = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        c.connect(addr)
+    else:
+        c = socket.create_connection(addr)
+    data = bytes(nbytes)
+    out = bytearray(nbytes)
+    oview = memoryview(out)
+
+    def rt():
+        c.sendall(data)
+        got = 0
+        while got < nbytes:
+            got += c.recv_into(oview[got:], nbytes - got)
+
+    rt()  # warm
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        rt()
+    dt = time.perf_counter() - t0
+    c.close()
+    srv.close()
+    if uds_path:
+        try:
+            os.unlink(path)
+        except OSError:
+            pass
+    return 2 * nbytes * reps / dt / 1e9
+
+
+def echo_floor_section(nbytes: int, part_bytes: int, reps: int,
+                       uds: bool = False, wire_conns: int = 0) -> dict:
+    """The ≥85%-of-wire-floor acceptance number, emitted by the bench
+    instead of hand-calculated: raw-socket echo floor and full-PS raw
+    push_pull goodput on the SAME host and transport, as a percentage.
+
+    The PS goodput counts logical push+pull bytes (2 * tensor bytes per
+    round) against wall time — the same accounting as the floor's
+    send+recv — so pct_of_floor is exactly "how much of the achievable
+    wire rate the full KV semantics (partitioned, summed, round-tracked)
+    sustain"."""
+    uds_path = f"/tmp/bps_wire_bench_{os.getpid()}" if uds else ""
+    batches = 4
+    batch_reps = max(2, reps // batches)
+    _log(f"  echo floor ({nbytes / 1e6:.0f} MB, {batches} interleaved "
+         f"batches x {batch_reps} reps, {'uds' if uds else 'tcp'}) ...")
+    proc, port = boot_server(
+        {"BYTEPS_TPU_SERVER_UDS": uds_path} if uds else None)
+    try:
+        kw = {"wire_conns": wire_conns} if wire_conns else {}
+        sess = PSSession(["127.0.0.1"], [port], worker_id=0, num_servers=1,
+                         partition_bytes=part_bytes,
+                         uds_path=uds_path, **kw)
+        transports = sorted({c.transport
+                             for pool in sess._data_conns for c in pool})
+        x = np.random.default_rng(0).standard_normal(
+            nbytes // 4).astype(np.float32)
+        sess.push_pull(1, x)               # init + warm
+        # INTERLEAVED best-of batches: on shared/small hosts the floor
+        # itself swings ~2x with CPU-frequency and neighbor noise, so a
+        # single floor-then-PS sequence reports whatever the host was
+        # doing that second.  Alternating short batches and taking each
+        # side's best compares like with like.
+        floors, goods = [], []
+        for _ in range(batches):
+            floors.append(measure_echo_floor(nbytes, batch_reps,
+                                             uds_path=uds_path))
+            t0 = time.perf_counter()
+            for _ in range(batch_reps):
+                sess.push_pull(1, x)
+            goods.append(2 * x.nbytes * batch_reps
+                         / (time.perf_counter() - t0) / 1e9)
+        floor, goodput = max(floors), max(goods)
+        stats = sess.server_stats()
+        tstats = sess.transport_stats()
+        sess.close()
+    finally:
+        proc.kill()
+        proc.wait()
+    row = {
+        "transport": "+".join(transports),
+        "tensor_mb": round(nbytes / 1e6, 1),
+        "partitions": (nbytes + part_bytes - 1) // part_bytes,
+        "reps": batches * batch_reps,
+        "floor_gbps": round(floor, 3),
+        "floor_batches_gbps": [round(f, 3) for f in floors],
+        "goodput_gbps": round(goodput, 3),
+        "goodput_batches_gbps": [round(g, 3) for g in goods],
+        "pct_of_floor": round(100.0 * goodput / floor, 1),
+        "target_pct_of_floor": 85.0,
+        "scatter_frames": stats.get("scatter_frames", 0),
+        "pool_hits": tstats["pool_hits"],
+    }
+    _log(f"  {row['transport']:8s} floor {row['floor_gbps']:6.2f} GB/s   "
+         f"PS {row['goodput_gbps']:6.2f} GB/s   "
+         f"pct_of_floor {row['pct_of_floor']:5.1f}%")
+    return row
 
 
 def _timed_rounds(sess, key, data, rounds: int):
@@ -375,6 +515,17 @@ def main(argv=None) -> int:
                     help="timed push_pull rounds per mode")
     ap.add_argument("--fusion-only", action="store_true",
                     help="run only the many-small-tensors fusion A/B")
+    ap.add_argument("--echo-floor", action="store_true",
+                    help="run only the raw-speed section: raw socket echo "
+                         "floor vs full-PS raw push_pull goodput on the "
+                         "same transport, reported as pct_of_floor "
+                         "(target >= 85)")
+    ap.add_argument("--uds", action="store_true",
+                    help="with --echo-floor: measure the AF_UNIX fast "
+                         "path (floor AND PS session both ride UDS)")
+    ap.add_argument("--wire-conns", type=int, default=0,
+                    help="with --echo-floor: lane count override "
+                         "(default: session default)")
     ap.add_argument("--no-fusion", action="store_true",
                     help="skip the fusion A/B (codec/pipeline sections "
                          "only, the pre-fusion bench surface)")
@@ -389,6 +540,22 @@ def main(argv=None) -> int:
     mb = args.mb if args.mb is not None else (8.0 if quick else 32.0)
     part_kb = args.part_kb or (512 if quick else 1024)
     rounds = args.rounds or (9 if quick else 15)
+
+    if args.echo_floor:
+        # The acceptance workload: 4 MiB partitions, raw f32, same-host
+        # echo floor on the same transport.  16 MB tensor under --quick
+        # keeps the CI smoke short; 64 MB otherwise (the bench_ps shape).
+        ef_bytes = (16 << 20) if quick else (64 << 20)
+        ef_reps = args.rounds or (5 if quick else 15)
+        _log(f"wire_bench: echo floor vs PS goodput "
+             f"({ef_bytes >> 20} MB, 4 MiB partitions, {ef_reps} reps)")
+        ef = echo_floor_section(ef_bytes, 4 << 20, ef_reps, uds=args.uds,
+                                wire_conns=args.wire_conns)
+        doc = {"echo_floor": ef,
+               "config": {"quick": quick, "cpus": os.cpu_count()}}
+        if args.json:
+            print(json.dumps(doc, indent=1))
+        return 0
 
     # Many-small-tensors fusion A/B (the transformer layernorm/bias tail):
     # 512 leaves of 4-64 KiB, fused at the 1 MiB default threshold.
