@@ -29,6 +29,9 @@ Modes:
                      benchmark in .travis.yml:29-34)
   BENCH_FAULT=1      fault-tolerance bench: mid-round connection reset via
                      tools/chaos_proxy.py; emits fault_reconnect_recovery_ms
+  BENCH_ELASTIC=1    elastic-membership bench: permanent worker kill +
+                     replacement join; emits evict_detect_ms and
+                     join_catchup_ms (BENCH_ELASTIC_EVICT_S tunes the lease)
   BENCH_FUSION=1     fusion-layer wire bench: many small tensors, per-leaf
                      vs fused-bucket dispatch through the real PS server
                      (emits fusion_small_tensor_caller_block)
@@ -589,11 +592,12 @@ def bench_fusion():
     }))
 
 
-def _boot_ps_server(engine_threads: int):
+def _boot_ps_server(engine_threads: int, num_workers: int = 1,
+                    extra_env: dict = None):
     """Start the native PS server on a freshly-probed free port, retrying
     on a new port if another process snatches it (bind/close-then-launch
     is inherently TOCTOU on a busy host).  Returns (proc, port); shared by
-    the PS-tier benches (BENCH_PS / BENCH_FAULT)."""
+    the PS-tier benches (BENCH_PS / BENCH_FAULT / BENCH_ELASTIC)."""
     import socket
     import subprocess
     import sys
@@ -610,8 +614,9 @@ def _boot_ps_server(engine_threads: int):
             port = sk.getsockname()[1]      # the server's data port
         env = cpu_subprocess_env({
             "DMLC_PS_ROOT_PORT": str(port - 1),
-            "DMLC_NUM_WORKER": "1",
+            "DMLC_NUM_WORKER": str(num_workers),
             "BYTEPS_SERVER_ENGINE_THREAD": str(engine_threads),
+            **(extra_env or {}),
         })
         errf = tempfile.TemporaryFile(mode="w+")
         proc = subprocess.Popen(
@@ -764,6 +769,109 @@ def bench_fault():
         }))
     finally:
         proxy.stop()
+        proc.kill()
+        proc.wait()
+
+
+def bench_elastic():
+    """Elastic-membership benchmark (BENCH_ELASTIC=1): wall-clock cost of
+    the two transitions an autoscaled/preempted fleet pays.
+
+    `evict_detect_ms`: 2 workers mid-training with lease eviction armed
+    (BYTEPS_TPU_EVICT_TIMEOUT_S = BENCH_ELASTIC_EVICT_S, default 0.5);
+    worker 1 dies without notice, and the value is how long worker 0's
+    next round blocks until the server evicts the corpse and re-finalizes
+    the open round (minus a healthy round) — the unavailability window a
+    permanent worker loss costs the survivors.
+
+    `join_catchup_ms`: a replacement worker then HELLOs in while the
+    survivor keeps stepping; the value is session construction -> its
+    first completed push_pull (epoch admission + INIT round rebase +
+    first post-join round).  Host-only, like BENCH_FAULT.
+    """
+    import threading
+
+    import numpy as np
+
+    from byteps_tpu.server.client import PSSession
+
+    evict_s = float(os.environ.get("BENCH_ELASTIC_EVICT_S", "0.5"))
+    proc, port = _boot_ps_server(
+        engine_threads=2, num_workers=2,
+        extra_env={"BYTEPS_TPU_EVICT_TIMEOUT_S": str(evict_s)})
+
+    def mk(wid):
+        return PSSession(["127.0.0.1"], [port], worker_id=wid,
+                         num_servers=1, wire_conns=1,
+                         evict_timeout_s=evict_s)
+
+    try:
+        s0, s1 = mk(0), mk(1)
+        x = np.random.default_rng(0).standard_normal(
+            1 << 18, dtype=np.float32)          # 1 MB, one partition
+        for _ in range(3):                       # init + warm
+            h0 = s0.push_pull_async(1, x)
+            h1 = s1.push_pull_async(1, x)
+            h0.wait(30); h1.wait(30)
+        t0 = time.perf_counter()
+        h0 = s0.push_pull_async(1, x)
+        h1 = s1.push_pull_async(1, x)
+        h0.wait(30); h1.wait(30)
+        healthy_ms = (time.perf_counter() - t0) * 1e3
+
+        # Permanent kill: worker 1 vanishes (no leave, no FIN courtesy).
+        s1.close()
+        t0 = time.perf_counter()
+        s0.push_pull_async(1, x).wait(60)
+        evict_detect_ms = (time.perf_counter() - t0) * 1e3 - healthy_ms
+
+        # Replacement joins while the survivor keeps stepping.
+        stop = threading.Event()
+
+        def survivor():
+            while not stop.is_set():
+                try:
+                    s0.push_pull_async(1, x).wait(60)
+                except Exception:
+                    return
+
+        th = threading.Thread(target=survivor, daemon=True)
+        th.start()
+        t0 = time.perf_counter()
+        s1b = mk(1)
+        s1b.push_pull_async(1, x).wait(60)
+        join_catchup_ms = (time.perf_counter() - t0) * 1e3
+        stop.set()
+        th.join(timeout=60)
+        epoch = s0.membership()["epoch"]
+        s0.close()
+        s1b.close()
+        detail = {
+            "healthy_round_ms": round(healthy_ms, 1),
+            "evict_timeout_s": evict_s,
+            "final_epoch": epoch,
+            "note": "evict_detect_ms = survivor's blocked round minus a "
+                    "healthy round (lease expiry + re-finalize); "
+                    "join_catchup_ms = session construction -> first "
+                    "completed post-join push_pull",
+            **_note(),
+        }
+        print(json.dumps({
+            "metric": "evict_detect_ms",
+            "value": round(evict_detect_ms, 1),
+            "unit": "ms",
+            "vs_baseline": round(evict_detect_ms / (evict_s * 1e3), 2),
+            "detail": detail,
+        }))
+        print(json.dumps({
+            "metric": "join_catchup_ms",
+            "value": round(join_catchup_ms, 1),
+            "unit": "ms",
+            "vs_baseline": round(join_catchup_ms / max(healthy_ms, 1e-3),
+                                 2),
+            "detail": detail,
+        }))
+    finally:
         proc.kill()
         proc.wait()
 
@@ -1311,6 +1419,8 @@ def main():
         bench_fusion()       # host-only: no device backend involved
     elif os.environ.get("BENCH_FAULT", "0") == "1":
         bench_fault()        # host-only: no device backend involved
+    elif os.environ.get("BENCH_ELASTIC", "0") == "1":
+        bench_elastic()      # host-only: no device backend involved
     elif os.environ.get("BENCH_TELEMETRY", "0") == "1":
         bench_telemetry()    # host-only: no device backend involved
     elif os.environ.get("BENCH_TRACE", "0") == "1":

@@ -15,6 +15,7 @@ from .version import __version__
 from .common.api import (
     init, shutdown, suspend, resume,
     rank, size, local_rank, local_size,
+    leave, get_membership, on_membership_change,
     declare, declared_key, register_compressor, get_ps_session,
     push_pull, push_pull_async, push_pull_tree, synchronize, poll,
     broadcast_parameters, broadcast_optimizer_state,
@@ -56,6 +57,7 @@ __all__ = [
     "__version__",
     "init", "shutdown", "suspend", "resume",
     "rank", "size", "local_rank", "local_size",
+    "leave", "get_membership", "on_membership_change",
     "declare", "declared_key", "register_compressor", "get_ps_session",
     "push_pull", "push_pull_async", "push_pull_tree", "synchronize",
     "poll", "AsyncPSTrainer",

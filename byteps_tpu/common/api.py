@@ -52,6 +52,15 @@ class _State:
     ps_session: Optional[Any] = None  # PS-mode client session, when enabled
     exporter: Optional[Any] = None    # TelemetryExporter, when enabled
     trace_atexit: bool = False        # crash-flush guard registered
+    # Elastic membership: the last fetched view (get_membership /
+    # the on_membership_change poller), the registered callback, and the
+    # poller plumbing.  size() reads the cached view, so a resize is
+    # visible to the training loop without a wire fetch per step.
+    membership: Optional[dict] = None
+    membership_cb: Optional[Any] = None
+    membership_poll_stop: Optional[Any] = None
+    membership_poll_thread: Optional[Any] = None
+    membership_poll_interval: float = 2.0
 
 
 _state = _State()
@@ -146,6 +155,13 @@ def init(lazy: bool = True) -> None:
                 "build") from e
         _state.ps_session = PSSession.from_config(cfg)
         _state.ps_session.barrier()
+        if cfg.evict_timeout_s > 0:
+            # Elasticity armed: size()/averages must follow an eviction
+            # even when the app never registers a callback or calls
+            # get_membership() — dividing by a stale launch count would
+            # silently corrupt every post-eviction gradient.  Fixed jobs
+            # (timeout 0) start no poller and send no extra traffic.
+            _start_membership_poller(cfg.membership_poll_s)
         if cfg.trace_on:
             # Clock alignment at trace-enable (NTP midpoint over
             # timestamped CMD_PINGs) + the periodic re-sync thread, so
@@ -188,6 +204,12 @@ def init(lazy: bool = True) -> None:
 def shutdown() -> None:
     if not _state.initialized:
         return
+    if _state.membership_poll_stop is not None:
+        _state.membership_poll_stop.set()
+        _state.membership_poll_stop = None
+        _state.membership_poll_thread = None
+        _state.membership_cb = None
+    _state.membership = None
     if _state.exporter is not None:
         # Before the session teardown: the exporter's refresh hook polls
         # the live session for CMD_STATS.
@@ -268,6 +290,16 @@ def rank() -> int:
 def size() -> int:
     cfg = _state.config or get_config()
     if _state.ps_session is not None or _env_cluster(cfg):
+        # Elastic membership: once the epoch has ever advanced, the world
+        # is the LIVE worker set, not the launch-time DMLC_NUM_WORKER —
+        # averages and per-rank sharding must rescale with it.  The view
+        # is the cached one (refreshed by get_membership() and the
+        # on_membership_change poller), so this stays a dict read on the
+        # hot path; a fixed-membership job (epoch 0 / nothing cached)
+        # keeps the launch count exactly.
+        m = _state.membership
+        if m is not None and int(m.get("epoch", 0)) > 0:
+            return max(1, len(m.get("alive", ())))
         return cfg.num_worker
     return jax.process_count()
 
@@ -314,6 +346,143 @@ def get_ps_session():
     """The live PS-mode session, or None (collective mode).  Used by
     AsyncPSTrainer and power users driving the KV tier directly."""
     return _state.ps_session
+
+
+# ---------------------------------------------------------------------------
+# Elastic membership (docs/elasticity.md): the worker set is an
+# epoch-versioned, server-negotiated table.  Joins happen implicitly (a new
+# worker's init() HELLO admits it at the next epoch boundary); leaves are
+# explicit (bps.leave()); evictions are lease expiries when
+# BYTEPS_TPU_EVICT_TIMEOUT_S > 0.  size() follows the live set once the
+# epoch has ever advanced.
+# ---------------------------------------------------------------------------
+def leave(drain_timeout_s: float = 60.0) -> None:
+    """Gracefully exit the worker membership (PS mode).
+
+    Drains this worker's in-flight rounds, then removes it from every
+    server's membership at the next epoch boundary — survivors' open
+    rounds re-finalize without it and their size() shrinks at their next
+    membership refresh.  Call it before shutdown() when the departure is
+    planned (autoscaler scale-down, preemption notice); an unplanned death
+    is covered by lease eviction instead.  No-op outside PS mode (the
+    collective plane resizes through suspend()/resume())."""
+    _require_init()
+    if _state.ps_session is None:
+        get_logger().warning(
+            "bps.leave() outside PS mode is a no-op: collective-plane "
+            "resizes go through suspend()/resume()")
+        return
+    _state.ps_session.leave(drain_timeout_s)
+
+
+def get_membership(refresh: bool = True) -> dict:
+    """The current worker membership: ``{"epoch", "workers": {id:
+    {"alive", "age_ms"}}, "alive": [ids], "barrier": {...}}``.
+
+    In PS mode this is the server-negotiated epoch-versioned table
+    (merged across servers); ``refresh=False`` returns the cached view
+    without touching the wire.  Outside PS mode (or before the first
+    fetch with refresh off) it synthesizes the fixed launch world —
+    epoch 0, every rank alive.  Fetches also feed the
+    ``bps_membership_epoch`` / ``bps_workers_alive`` /
+    ``bps_worker_alive`` gauges."""
+    _require_init()
+    if _state.ps_session is not None and refresh:
+        m = _state.ps_session.membership()
+        _state.membership = m
+        telemetry.update_membership(m)
+        return m
+    if _state.membership is not None:
+        return _state.membership
+    n = size()
+    return {"epoch": 0,
+            "workers": {i: {"alive": True, "age_ms": 0.0}
+                        for i in range(n)},
+            "alive": list(range(n)), "barrier": {}}
+
+
+def _start_membership_poller(interval: float) -> None:
+    """Idempotently start the CMD_MEMBERS poller: refresh the cached
+    membership view (what size() reads) and the liveness gauges every
+    ``interval`` seconds, and fire the registered callback on each epoch
+    change.  Started by init() whenever elasticity is armed
+    (BYTEPS_TPU_EVICT_TIMEOUT_S > 0) — so size() tracks an eviction even
+    when no callback was registered and nothing else polls — and by
+    on_membership_change() for callback users."""
+    # The interval lives in _state so a later caller (e.g.
+    # on_membership_change(cb, poll_s=0.2) after init() auto-started the
+    # poller at the config default) retunes the LIVE poller instead of
+    # being silently ignored; the loop re-reads it every cycle, so the
+    # new cadence takes effect after at most one old interval.
+    _state.membership_poll_interval = max(0.05, float(interval))
+    if _state.membership_poll_thread is not None:
+        return
+    stop = threading.Event()
+    _state.membership_poll_stop = stop
+
+    def _poll():
+        last_epoch = (int(_state.membership.get("epoch", 0))
+                      if _state.membership else 0)
+        while not stop.wait(_state.membership_poll_interval):
+            sess = _state.ps_session
+            if sess is None:
+                return
+            try:
+                m = sess.membership(timeout=5.0)
+            except Exception as e:
+                get_logger().debug("membership poll failed: %s", e)
+                continue
+            _state.membership = m       # size() follows before the cb runs
+            telemetry.update_membership(m)
+            if int(m.get("epoch", 0)) != last_epoch:
+                last_epoch = int(m.get("epoch", 0))
+                cb = _state.membership_cb
+                if cb is not None:
+                    try:
+                        cb(m)
+                    except Exception:
+                        get_logger().exception(
+                            "membership-change callback failed")
+
+    t = threading.Thread(target=_poll, daemon=True,
+                         name="bps-membership-poll")
+    _state.membership_poll_thread = t
+    t.start()
+
+
+def on_membership_change(callback, poll_s: Optional[float] = None) -> None:
+    """Register ``callback(membership)`` to fire when the membership
+    epoch changes (join, leave, or eviction), so the training loop can
+    rescale — re-derive per-rank sharding, LR scaling, data splits —
+    without polling by hand.  size()/rank() already follow the new epoch
+    by the time the callback runs.
+
+    A background poller (every ``poll_s`` seconds, default
+    ``BYTEPS_TPU_MEMBERSHIP_POLL_S``) re-fetches CMD_MEMBERS while a
+    callback is registered — or, regardless of callbacks, while
+    elasticity is armed (``BYTEPS_TPU_EVICT_TIMEOUT_S > 0``), so size()
+    follows evictions either way.  ``on_membership_change(None)``
+    unregisters the callback (the poller keeps running if elasticity
+    armed it; otherwise it stops) — an unregistered fixed-membership job
+    sends no extra wire traffic.  PS mode only."""
+    _require_init()
+    cfg = _state.config or get_config()
+    if callback is None:
+        _state.membership_cb = None
+        if cfg.evict_timeout_s <= 0 and _state.membership_poll_stop \
+                is not None:
+            _state.membership_poll_stop.set()
+            _state.membership_poll_stop = None
+            _state.membership_poll_thread = None
+        return
+    if _state.ps_session is None:
+        raise RuntimeError(
+            "bps.on_membership_change() requires PS mode "
+            "(BYTEPS_TPU_PS_MODE=1); the collective plane resizes "
+            "through suspend()/resume()")
+    _state.membership_cb = callback
+    _start_membership_poller(poll_s if poll_s is not None
+                             else cfg.membership_poll_s)
 
 
 # ---------------------------------------------------------------------------
@@ -783,6 +952,12 @@ def get_server_stats() -> dict:
     stats = _state.ps_session.server_stats()
     stats["round_lag"] = telemetry.update_round_lag(
         stats, cfg.straggler_rounds)
+    if "members" in stats:
+        # CMD_STATS carries the membership view too (epoch + per-worker
+        # lease age): feed the liveness gauges so every scrape can tell
+        # an evicted worker from a slow one.  Old servers omit it.
+        telemetry.update_membership(
+            {"epoch": stats.get("epoch", 0), "workers": stats["members"]})
     return stats
 
 

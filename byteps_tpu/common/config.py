@@ -102,6 +102,18 @@ class Config:
     # bps.barrier() deadline; 0 = wait forever (the historical default,
     # with a periodic "still waiting" warning either way).
     barrier_timeout_s: float = 0.0           # BYTEPS_TPU_BARRIER_TIMEOUT_S
+    # Elastic membership (docs/elasticity.md).  evict_timeout_s > 0 arms
+    # the server-side lease scanner — a worker silent that long is
+    # evicted at an epoch boundary and open rounds re-finalize against
+    # the survivors — and the worker-side lease heartbeat that keeps an
+    # idle-but-alive worker's lease warm.  0 (default) keeps today's
+    # fail-fast/stall-watchdog semantics: a dead worker wedges rounds
+    # until the watchdog or barrier timeout fails them loudly.
+    evict_timeout_s: float = 0.0             # BYTEPS_TPU_EVICT_TIMEOUT_S
+    # How often bps.on_membership_change()'s poller re-fetches the
+    # membership view (CMD_MEMBERS).  Only runs while a callback is
+    # registered — an unregistered fixed job sends no extra traffic.
+    membership_poll_s: float = 2.0           # BYTEPS_TPU_MEMBERSHIP_POLL_S
     server_engine_threads: int = 4           # BYTEPS_SERVER_ENGINE_THREAD
     server_enable_schedule: bool = False     # BYTEPS_SERVER_ENABLE_SCHEDULE
     enable_async: bool = False               # BYTEPS_ENABLE_ASYNC
@@ -184,6 +196,10 @@ class Config:
                 os.environ.get("BYTEPS_TPU_STALL_TIMEOUT_S") or 0.0),
             barrier_timeout_s=float(
                 os.environ.get("BYTEPS_TPU_BARRIER_TIMEOUT_S") or 0.0),
+            evict_timeout_s=float(
+                os.environ.get("BYTEPS_TPU_EVICT_TIMEOUT_S") or 0.0),
+            membership_poll_s=float(
+                os.environ.get("BYTEPS_TPU_MEMBERSHIP_POLL_S") or 2.0),
             server_engine_threads=_env_int("BYTEPS_SERVER_ENGINE_THREAD", 4),
             server_enable_schedule=_env_bool("BYTEPS_SERVER_ENABLE_SCHEDULE"),
             enable_async=_env_bool("BYTEPS_ENABLE_ASYNC"),

@@ -387,6 +387,36 @@ def pushpull_speed_mbps() -> float:
 # ---------------------------------------------------------------------------
 # Straggler detection (per-worker round lag from CMD_STATS)
 # ---------------------------------------------------------------------------
+def update_membership(membership: dict, registry: Optional[MetricsRegistry]
+                      = None) -> None:
+    """Fold an elastic-membership view into the registry gauges.
+
+    ``membership`` is the merged CMD_MEMBERS shape ({"epoch", "workers":
+    {id: {"alive", ...}}, ...}).  Exports ``bps_membership_epoch`` (the
+    current epoch id), ``bps_workers_alive`` (live member count) and a
+    per-worker ``bps_worker_alive`` 0/1 gauge — the signal bps_top and
+    alerting use to tell an evicted/left worker from a merely slow one.
+    A fixed-membership job exports epoch 0 and all-alive, matching its
+    launch world.
+    """
+    reg = registry or get_registry()
+    workers = membership.get("workers") or {}
+    alive = membership.get("alive")
+    if alive is None:
+        alive = [w for w, r in workers.items() if r.get("alive")]
+    reg.gauge("bps_membership_epoch",
+              help="elastic membership epoch id (0 = launch set, never "
+                   "resized)").set(int(membership.get("epoch", 0)))
+    reg.gauge("bps_workers_alive",
+              help="live workers in the current membership epoch"
+              ).set(len(alive))
+    for w, rec in workers.items():
+        reg.gauge("bps_worker_alive",
+                  help="1 = member of the current epoch, 0 = left/evicted",
+                  labels={"worker": str(w)}
+                  ).set(1 if rec.get("alive") else 0)
+
+
 def update_round_lag(server_stats: dict, straggler_rounds: int,
                      registry: Optional[MetricsRegistry] = None
                      ) -> Dict[int, int]:

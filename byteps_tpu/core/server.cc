@@ -48,6 +48,7 @@
 #include <sys/un.h>
 #include <unistd.h>
 
+#include <algorithm>
 #include <atomic>
 #include <cerrno>
 #include <chrono>
@@ -92,7 +93,24 @@ enum Cmd : uint8_t {
                  // as kStats.  Spans are recorded ONLY for frames whose
                  // header flags carry kFlagTraced — the worker's trace
                  // window — so an untraced run records (and pays) nothing.
+  kLeave = 10,   // graceful worker departure (CMD_LEAVE): the sender is
+                 // removed from the membership at the next epoch boundary
+                 // and open rounds re-finalize against the survivor set.
+                 // Reader thread (a leave must land even past a wedged
+                 // engine); old servers answer kError via the engine's
+                 // default arm — clients surface "server too old".
+  kMembers = 11, // membership snapshot (CMD_MEMBERS): epoch id, per-worker
+                 // alive flag + last-seen age, and the worker ids arrived
+                 // at each pending barrier generation, as JSON.  Reader
+                 // thread, same old-server error path as kStats.
 };
+
+// Engine-internal task (never on the wire, far above any Cmd value): a
+// membership transition fanned out to every engine so per-key round state
+// — which is engine-owned — is mutated only on its owning thread.  The
+// payload snapshots the transition (see MembershipTransition), so the
+// handler never reads the live membership table.
+enum : uint8_t { kMembershipTask = 200 };
 enum Status : uint8_t { kOk = 0, kError = 1 };
 
 // Header `flags` bit 15: this frame is inside the sending worker's trace
@@ -856,6 +874,16 @@ struct KeyState {
                                // round r+1 is already merging
   std::set<uint32_t> seen;     // worker ids seen this round (dedup,
                                // reference: server.cc:150-177 seen_sender)
+  // The OPEN round's contributor set under elastic membership.  EMPTY in
+  // a fixed-membership run (epoch 0): round completion then falls back to
+  // the historical seen.size() >= num_workers_ count, so a job that never
+  // resizes behaves (and talks) exactly as before.  Once the epoch has
+  // ever advanced, every round's first push snapshots the live worker set
+  // here, and the round publishes only when ALL of them have contributed
+  // — membership changes land between rounds, never inside one.  A
+  // transition's fan-out task pins still-open epoch-0 rounds to the
+  // pre-transition set and erases departed workers (the re-finalize leg).
+  std::set<uint32_t> round_members;
   uint64_t completed_round = 0;
   uint8_t dtype = 0;
   std::string kwargs;          // compressor registration (INIT payload)
@@ -1020,6 +1048,28 @@ class Server {
                      "[byteps server] ignoring invalid "
                      "BYTEPS_TPU_SOCK_BUF_KB=%s (want a KiB count)\n", sb);
     }
+    // Elastic membership: the launch-time worker set is epoch 0 — dense
+    // ids 0..num_workers-1, the DMLC_WORKER_ID convention — each with a
+    // lease refreshed by any frame it sends (traffic or CMD_PING).
+    // BYTEPS_TPU_EVICT_TIMEOUT_S > 0 arms the lease scanner: a worker
+    // silent for that long is evicted at an epoch boundary and open
+    // rounds re-finalize against the survivors.  0 (default) keeps the
+    // historical semantics — a dead worker wedges rounds until the
+    // worker-side stall watchdog/barrier timeout fails them loudly.
+    const char* ev = std::getenv("BYTEPS_TPU_EVICT_TIMEOUT_S");
+    if (ev && ev[0]) {
+      char* end = nullptr;
+      double v = std::strtod(ev, &end);
+      if (end && *end == '\0' && v >= 0.0)
+        evict_timeout_s_ = v;
+      else
+        std::fprintf(stderr,
+                     "[byteps server] ignoring invalid "
+                     "BYTEPS_TPU_EVICT_TIMEOUT_S=%s (want seconds)\n", ev);
+    }
+    const int64_t now = NowUs();
+    for (int i = 0; i < num_workers_; ++i)
+      members_[static_cast<uint32_t>(i)] = MemberRec{now, true};
   }
 
   int Run() {
@@ -1038,6 +1088,12 @@ class Server {
 
     for (int i = 0; i < engine_threads_; ++i)
       engines_.emplace_back(&Server::EngineLoop, this, i);
+
+    // Lease scanner (elastic eviction), armed only by the env knob — a
+    // fixed-membership server runs zero extra threads.
+    std::thread lease_thread;
+    if (evict_timeout_s_ > 0.0)
+      lease_thread = std::thread(&Server::LeaseLoop, this);
 
     // Optional AF_UNIX listener for colocated workers (see ctor): its
     // acceptor runs on a side thread feeding the same ReaderLoop — a UDS
@@ -1075,6 +1131,7 @@ class Server {
     }
 
     AcceptLoop(listen_fd_, true);
+    if (lease_thread.joinable()) lease_thread.join();
     if (uds_acceptor.joinable()) uds_acceptor.join();
     if (uds_listen_fd_ >= 0) {
       close(uds_listen_fd_);
@@ -1312,14 +1369,19 @@ class Server {
     js.reserve(4096);
     std::snprintf(buf, sizeof(buf),
                   "{\"bytes_in\":%llu,\"bytes_out\":%llu,\"async\":%d,"
-                  "\"num_workers\":%d,\"scatter_frames\":%llu,\"keys\":{",
+                  "\"num_workers\":%d,\"scatter_frames\":%llu,"
+                  "\"epoch\":%llu,\"deferred_joins\":%llu,\"keys\":{",
                   static_cast<unsigned long long>(
                       bytes_in_.load(std::memory_order_relaxed)),
                   static_cast<unsigned long long>(
                       bytes_out_.load(std::memory_order_relaxed)),
                   async_ ? 1 : 0, num_workers_,
                   static_cast<unsigned long long>(
-                      scatter_frames_.load(std::memory_order_relaxed)));
+                      scatter_frames_.load(std::memory_order_relaxed)),
+                  static_cast<unsigned long long>(
+                      epoch_atomic_.load(std::memory_order_acquire)),
+                  static_cast<unsigned long long>(
+                      deferred_joins_.load(std::memory_order_relaxed)));
     js += buf;
     std::lock_guard<std::mutex> lk(stats_mu_);
     bool first = true;
@@ -1353,8 +1415,297 @@ class Server {
       js += buf;
       first = false;
     }
+    // Membership view (epoch-versioned worker set + lease ages) so one
+    // CMD_STATS poll carries the whole liveness story.  member_mu_ nests
+    // inside stats_mu_ here and nowhere takes them in the other order.
+    js += "},\"members\":{";
+    {
+      const int64_t now = NowUs();
+      std::lock_guard<std::mutex> mlk(member_mu_);
+      first = true;
+      for (auto& kv : members_) {
+        std::snprintf(buf, sizeof(buf),
+                      "%s\"%u\":{\"alive\":%d,\"age_ms\":%lld}",
+                      first ? "" : ",", kv.first,
+                      kv.second.alive ? 1 : 0,
+                      static_cast<long long>(
+                          (now - kv.second.last_seen_us) / 1000));
+        js += buf;
+        first = false;
+      }
+    }
     js += "}}";
     return js;
+  }
+
+  // --- elastic membership --------------------------------------------
+  // The worker set is epoch-versioned: every join (HELLO from a non-live
+  // id), graceful leave (CMD_LEAVE) and lease eviction bumps `epoch_` and
+  // fans a snapshot task out to every engine (per-key round state is
+  // engine-owned).  Fixed-membership runs never transition, epoch stays
+  // 0, and every data-path check short-circuits on the atomic mirror —
+  // the wire and the merge math are untouched.
+  struct MemberRec {
+    int64_t last_seen_us = 0;
+    bool alive = false;
+  };
+
+  // Lease refresh: any frame from a live member renews it.  Non-members
+  // are ignored — only HELLO admits (a stray frame from a rogue id must
+  // not silently grow the world).
+  void TouchWorker(uint32_t worker) {
+    // Fixed-mode fast path: with eviction unarmed and the epoch never
+    // advanced, nothing consumes leases — skip the clock read and the
+    // lock so the per-frame hot path is exactly as cheap as before this
+    // feature (CMD_STATS ages then read as time-since-launch, which is
+    // documented and has no liveness consumer at epoch 0).
+    if (evict_timeout_s_ <= 0.0 &&
+        epoch_atomic_.load(std::memory_order_relaxed) == 0)
+      return;
+    std::lock_guard<std::mutex> lk(member_mu_);
+    auto it = members_.find(worker);
+    if (it != members_.end() && it->second.alive)
+      it->second.last_seen_us = NowUs();
+  }
+
+  // HELLO admission: a non-live id joins the membership at the next
+  // epoch boundary (each key's next round snapshots the new set).  A
+  // live member's HELLO — every fixed-mode session start, and every
+  // reconnect handshake — is a lease touch, nothing more.
+  void AdmitWorker(uint32_t worker) {
+    std::vector<uint32_t> old_live, removed;
+    {
+      std::lock_guard<std::mutex> lk(member_mu_);
+      MemberRec& m = members_[worker];
+      m.last_seen_us = NowUs();
+      if (m.alive) return;
+      for (auto& kv : members_)
+        if (kv.second.alive) old_live.push_back(kv.first);
+      m.alive = true;
+      ++epoch_;
+      epoch_atomic_.store(epoch_, std::memory_order_release);
+      std::fprintf(stderr,
+                   "[byteps server] worker %u joined; membership epoch %llu"
+                   " (%zu live)\n", worker,
+                   static_cast<unsigned long long>(epoch_),
+                   old_live.size() + 1);
+    }
+    FanOutMembership(old_live, removed, /*refinalize=*/false);
+    RecheckBarriers();
+  }
+
+  // Leave/evict: remove a live member at an epoch boundary and
+  // re-finalize open rounds against the survivors.  The last live worker
+  // is never removed — evicting the whole world helps no one, and a
+  // paused single-worker job must stay resumable.
+  void RemoveWorker(uint32_t worker, const char* why) {
+    std::vector<uint32_t> old_live, removed;
+    {
+      std::lock_guard<std::mutex> lk(member_mu_);
+      auto it = members_.find(worker);
+      if (it == members_.end() || !it->second.alive) return;
+      int live = 0;
+      for (auto& kv : members_)
+        if (kv.second.alive) {
+          ++live;
+          old_live.push_back(kv.first);
+        }
+      if (live <= 1) {
+        std::fprintf(stderr,
+                     "[byteps server] not removing worker %u (%s): it is "
+                     "the last live member\n", worker, why);
+        return;
+      }
+      it->second.alive = false;
+      removed.push_back(worker);
+      ++epoch_;
+      epoch_atomic_.store(epoch_, std::memory_order_release);
+      std::fprintf(stderr,
+                   "[byteps server] worker %u removed (%s); membership "
+                   "epoch %llu (%d live)\n", worker, why,
+                   static_cast<unsigned long long>(epoch_), live - 1);
+    }
+    FanOutMembership(old_live, removed, /*refinalize=*/true);
+    RecheckBarriers();
+  }
+
+  int LiveCount() {
+    std::lock_guard<std::mutex> lk(member_mu_);
+    int n = 0;
+    for (auto& kv : members_)
+      if (kv.second.alive) ++n;
+    return n;
+  }
+
+  std::vector<uint32_t> LiveWorkers() {
+    std::lock_guard<std::mutex> lk(member_mu_);
+    std::vector<uint32_t> out;
+    for (auto& kv : members_)
+      if (kv.second.alive) out.push_back(kv.first);
+    return out;
+  }
+
+  // Identity-based barrier completion: a generation releases when every
+  // LIVE worker has arrived.  Arrival COUNT is not enough under
+  // elasticity — an evicted worker's stale arrival would otherwise fill
+  // the shrunken bar and release the group while a live worker is still
+  // on its way, stranding it in a fresh group forever.
+  static bool BarrierGroupComplete(const std::vector<PendingPull>& group,
+                                   const std::vector<uint32_t>& live) {
+    std::set<uint32_t> arrived;
+    for (const auto& w : group) arrived.insert(w.worker);
+    for (uint32_t w : live)
+      if (!arrived.count(w)) return false;
+    return true;
+  }
+
+  // Snapshot the live set into a key's round_members — the per-round
+  // epoch boundary.  Called at each round's first push once the epoch
+  // has ever advanced (epoch 0 keeps the legacy count-based completion).
+  void AdoptRoundMembers(KeyState& ks) {
+    std::lock_guard<std::mutex> lk(member_mu_);
+    ks.round_members.clear();
+    for (auto& kv : members_)
+      if (kv.second.alive) ks.round_members.insert(kv.first);
+  }
+
+  // One transition task per engine, payload self-contained:
+  //   u8 refinalize | u32 n_old | u32 old_ids[] | u32 n_rm | u32 rm_ids[]
+  // old_ids = the live set BEFORE the transition (pins still-open
+  // epoch-0 rounds to the set they opened under); rm_ids = departures to
+  // erase from every open round's contributor set.
+  void FanOutMembership(const std::vector<uint32_t>& old_live,
+                        const std::vector<uint32_t>& removed,
+                        bool refinalize) {
+    std::vector<char> payload(1 + 4 + old_live.size() * 4 +
+                              4 + removed.size() * 4);
+    char* p = payload.data();
+    p[0] = refinalize ? 1 : 0;
+    uint32_t n = static_cast<uint32_t>(old_live.size());
+    std::memcpy(p + 1, &n, 4);
+    std::memcpy(p + 5, old_live.data(), old_live.size() * 4);
+    uint32_t m = static_cast<uint32_t>(removed.size());
+    std::memcpy(p + 5 + old_live.size() * 4, &m, 4);
+    std::memcpy(p + 9 + old_live.size() * 4, removed.data(),
+                removed.size() * 4);
+    for (int i = 0; i < engine_threads_; ++i) {
+      Task t;
+      t.cmd = kMembershipTask;
+      t.dtype = 0;
+      t.flags = 0;
+      t.req_id = 0;
+      t.worker_id = 0;
+      t.key = 0;
+      t.payload = payload;   // copy per engine
+      t.conn = nullptr;
+      t.seq = seq_.fetch_add(1);
+      t.priority = UINT64_MAX;   // jump queued pushes, like kLrScale
+      queues_[i].Push(std::move(t));
+    }
+  }
+
+  // A shrink can complete a barrier the departed worker would never
+  // reach; a grow raises the bar for groups still filling.  Like
+  // HandleBarrier, the live set is read inside barrier_mu_ so the check
+  // and the release are atomic against further transitions.
+  void RecheckBarriers() {
+    std::vector<PendingPull> to_release;
+    {
+      std::lock_guard<std::mutex> lk(barrier_mu_);
+      const std::vector<uint32_t> live = LiveWorkers();
+      for (auto it = barrier_waiters_.begin();
+           it != barrier_waiters_.end();) {
+        if (BarrierGroupComplete(it->second, live)) {
+          for (auto& w : it->second) to_release.push_back(w);
+          released_gens_.insert(it->first);
+          it = barrier_waiters_.erase(it);
+        } else {
+          ++it;
+        }
+      }
+    }
+    for (auto& w : to_release) {
+      Respond(w.conn, kOk, w.req_id, w.key, nullptr, 0);
+      ReleaseRef(w.conn);
+    }
+  }
+
+  // CMD_MEMBERS JSON: epoch, per-worker alive + last-seen age, and which
+  // ids have arrived at each pending barrier generation (the "who is the
+  // barrier waiting on" half of the diagnostic).
+  std::string MembersJson() {
+    char buf[160];
+    std::string js;
+    js.reserve(512);
+    const int64_t now = NowUs();
+    {
+      std::lock_guard<std::mutex> lk(member_mu_);
+      std::snprintf(buf, sizeof(buf),
+                    "{\"epoch\":%llu,\"members\":{",
+                    static_cast<unsigned long long>(epoch_));
+      js += buf;
+      bool first = true;
+      for (auto& kv : members_) {
+        std::snprintf(buf, sizeof(buf),
+                      "%s\"%u\":{\"alive\":%d,\"age_ms\":%lld}",
+                      first ? "" : ",", kv.first,
+                      kv.second.alive ? 1 : 0,
+                      static_cast<long long>(
+                          (now - kv.second.last_seen_us) / 1000));
+        js += buf;
+        first = false;
+      }
+    }
+    js += "},\"barrier\":{";
+    {
+      std::lock_guard<std::mutex> lk(barrier_mu_);
+      bool first = true;
+      for (auto& kv : barrier_waiters_) {
+        std::snprintf(buf, sizeof(buf), "%s\"%llu\":[",
+                      first ? "" : ",",
+                      static_cast<unsigned long long>(kv.first));
+        js += buf;
+        for (size_t i = 0; i < kv.second.size(); ++i) {
+          std::snprintf(buf, sizeof(buf), "%s%u", i ? "," : "",
+                        kv.second[i].worker);
+          js += buf;
+        }
+        js += "]";
+        first = false;
+      }
+    }
+    js += "}}";
+    return js;
+  }
+
+  // Lease scanner (armed only when BYTEPS_TPU_EVICT_TIMEOUT_S > 0): a
+  // live member silent past the timeout is evicted.  Workers keep the
+  // lease warm with data traffic, or — when idle — the client-side
+  // heartbeat PING the same knob arms (client.py _lease_loop).
+  void LeaseLoop() {
+    const int64_t timeout_us =
+        static_cast<int64_t>(evict_timeout_s_ * 1e6);
+    const int64_t scan_us =
+        std::max<int64_t>(20000, std::min<int64_t>(timeout_us / 4,
+                                                   1000000));
+    while (!shutdown_.load()) {
+      std::this_thread::sleep_for(std::chrono::microseconds(scan_us));
+      const int64_t now = NowUs();
+      std::vector<std::pair<int64_t, uint32_t>> expired;  // (last_seen, id)
+      {
+        std::lock_guard<std::mutex> lk(member_mu_);
+        for (auto& kv : members_)
+          if (kv.second.alive &&
+              now - kv.second.last_seen_us > timeout_us)
+            expired.emplace_back(kv.second.last_seen_us, kv.first);
+      }
+      // Most-stale first: when several leases lapse in one scan (e.g. a
+      // heartbeat hiccup), the worker silent the LONGEST is the dead one
+      // — and the last-live guard then protects the rest.
+      std::sort(expired.begin(), expired.end());
+      for (auto& e : expired)
+        RemoveWorker(e.second, "lease expired");  // last-live guard inside
+    }
   }
 
   void ReaderLoop(Conn* conn) {
@@ -1468,14 +1819,35 @@ class Server {
         if (h.len && !ReadFull(conn->fd, payload.data(), h.len)) break;
       }
       bytes_in_.fetch_add(sizeof(h) + h.len, std::memory_order_relaxed);
+      // Lease refresh: any frame from a live member renews its lease
+      // (the "refreshed by traffic/CMD_PING" contract) — one uncontended
+      // lock per frame, noise next to the per-frame EngineFor lookup.
+      TouchWorker(h.worker_id);
       switch (h.cmd) {
         case kHello: {
           // HELLO advertises server mode: u8 async | u8 schedule.  Lets
           // clients fail fast on mode mismatches (e.g. weight-delta async
           // training against a sync server would silently train on deltas).
+          // It is also the elastic join/rejoin door: a HELLO from an id
+          // that is not currently live admits it at the next epoch
+          // boundary (a live member's HELLO — every fixed-mode session
+          // start — changes nothing, keeping the fixed wire identical).
+          AdmitWorker(h.worker_id);
           char mode[2] = {static_cast<char>(async_ ? 1 : 0),
                           static_cast<char>(schedule_ ? 1 : 0)};
           Respond(conn, kOk, h.req_id, h.key, mode, 2);
+          break;
+        }
+        case kLeave:
+          // Graceful departure: the client drained its in-flight rounds
+          // first (client.py leave()), so open rounds either already
+          // carry its push or re-finalize without it.
+          RemoveWorker(h.worker_id, "graceful leave");
+          Respond(conn, kOk, h.req_id, h.key, nullptr, 0);
+          break;
+        case kMembers: {
+          std::string js = MembersJson();
+          Respond(conn, kOk, h.req_id, h.key, js.data(), js.size());
           break;
         }
         case kPing:
@@ -1532,7 +1904,7 @@ class Server {
         }
         case kBarrier:
           AddRef(conn);   // barrier waiters outlive the reader
-          HandleBarrier(conn, h.req_id, h.key);
+          HandleBarrier(conn, h.req_id, h.key, h.worker_id);
           break;
         case kShutdown:
           Respond(conn, kOk, h.req_id, h.key, nullptr, 0);
@@ -1588,19 +1960,46 @@ class Server {
     }
   }
 
-  void HandleBarrier(Conn* conn, uint32_t req_id, uint64_t gen) {
+  void HandleBarrier(Conn* conn, uint32_t req_id, uint64_t gen,
+                     uint32_t worker) {
     // Waiters are grouped by generation so overlapping barriers (or a late
     // worker from generation g arriving amid generation g+1 waiters) can
-    // never release a mixed group early.
+    // never release a mixed group early.  Release is IDENTITY-based:
+    // every LIVE member must have arrived (== the historical
+    // distinct-count bar for a fixed dense world, but immune to a dead
+    // worker's stale arrival under-filling or over-filling the group).
+    // The live set is read INSIDE barrier_mu_ (member_mu_ nests inside
+    // it; nothing takes them in the other order while holding
+    // member_mu_), so an admit/evict between the read and the insert
+    // cannot release against a stale world.
+    //
+    // A RELEASED generation stays an open door: a worker arriving at a
+    // generation that already released — the elastic-join case, a
+    // replacement worker's init() hitting the gen-0 startup rendezvous
+    // the incumbents passed long ago — is answered immediately instead
+    // of waiting for arrivals that will never come.  Generations are
+    // therefore one-shot (monotonically increasing per job), which is
+    // how every caller already uses them.
     std::vector<PendingPull> to_release;
+    bool already_released = false;
     {
       std::lock_guard<std::mutex> lk(barrier_mu_);
-      auto& group = barrier_waiters_[gen];
-      group.push_back({conn, req_id, gen});
-      if (static_cast<int>(group.size()) >= num_workers_) {
-        to_release.swap(group);
-        barrier_waiters_.erase(gen);
+      if (released_gens_.count(gen)) {
+        already_released = true;
+      } else {
+        auto& group = barrier_waiters_[gen];
+        group.push_back({conn, req_id, gen, 0, worker});
+        if (BarrierGroupComplete(group, LiveWorkers())) {
+          to_release.swap(group);
+          barrier_waiters_.erase(gen);
+          released_gens_.insert(gen);
+        }
       }
+    }
+    if (already_released) {
+      Respond(conn, kOk, req_id, gen, nullptr, 0);
+      ReleaseRef(conn);
+      return;
     }
     for (auto& w : to_release) {
       Respond(w.conn, kOk, w.req_id, w.key, nullptr, 0);
@@ -1616,6 +2015,13 @@ class Server {
         case kPush: HandlePush(t); break;
         case kPull: HandlePull(t); break;
         case kLrScale: HandleLrScale(t, idx); break;
+        case kMembershipTask:
+          // Internal fan-outs carry no conn; a WIRE frame claiming this
+          // cmd is a protocol violator (or a probing client) and gets
+          // the unknown-command error — never a membership mutation.
+          if (t.conn == nullptr) HandleMembership(t, idx);
+          else Respond(t.conn, kError, t.req_id, t.key, nullptr, 0);
+          break;
         default: Respond(t.conn, kError, t.req_id, t.key, nullptr, 0);
       }
       // The task's hold ends here (a deferred pull took its OWN ref in
@@ -1651,6 +2057,74 @@ class Server {
   KeyState& StateFor(uint64_t key) {
     std::lock_guard<std::mutex> lk(store_mu_);
     return store_[key];
+  }
+
+  // The one round-completion predicate.  Empty round_members = fixed
+  // membership (epoch never advanced): the historical distinct-sender
+  // count.  Otherwise the round publishes exactly when every member of
+  // ITS contributor set has merged — departed workers were erased from
+  // the set by the transition fan-out, so a survivor-complete round
+  // re-finalizes instead of waiting on the dead.
+  bool RoundComplete(const KeyState& ks) const {
+    if (ks.round_members.empty())
+      return static_cast<int>(ks.seen.size()) >= num_workers_;
+    for (uint32_t w : ks.round_members)
+      if (!ks.seen.count(w)) return false;
+    return true;
+  }
+
+  // Membership transition, engine side (see FanOutMembership for the
+  // payload).  Runs on the thread that owns each key, so no lock beyond
+  // the assignment map is needed.
+  void HandleMembership(Task& t, int idx) {
+    const char* p = t.payload.data();
+    size_t left = t.payload.size();
+    if (left < 5) return;
+    const bool refinalize = p[0] != 0;
+    uint32_t n_old = 0;
+    std::memcpy(&n_old, p + 1, 4);
+    if (left < 9 + static_cast<size_t>(n_old) * 4) return;
+    std::set<uint32_t> old_live;
+    for (uint32_t i = 0; i < n_old; ++i) {
+      uint32_t w = 0;
+      std::memcpy(&w, p + 5 + i * 4, 4);
+      old_live.insert(w);
+    }
+    uint32_t n_rm = 0;
+    std::memcpy(&n_rm, p + 5 + static_cast<size_t>(n_old) * 4, 4);
+    if (left < 9 + (static_cast<size_t>(n_old) + n_rm) * 4) return;
+    std::set<uint32_t> removed;
+    for (uint32_t i = 0; i < n_rm; ++i) {
+      uint32_t w = 0;
+      std::memcpy(&w, p + 9 + (static_cast<size_t>(n_old) + i) * 4, 4);
+      removed.insert(w);
+    }
+    if (async_) return;   // no rounds to pin or re-finalize
+    std::vector<uint64_t> keys;
+    {
+      std::lock_guard<std::mutex> lk(assign_mu_);
+      for (auto& kv : key_engine_)
+        if (kv.second == idx) keys.push_back(kv.first);
+    }
+    for (uint64_t key : keys) {
+      KeyState& ks = StateFor(key);
+      // Pin a still-open epoch-0 round to the set it opened under: from
+      // this transition on, a joiner must never be able to complete (or
+      // pollute) a round that predates its admission.
+      if (!ks.seen.empty() && ks.round_members.empty())
+        ks.round_members = old_live;
+      // Erase departures — the surviving members become the round's
+      // whole requirement (the re-finalize contract).
+      if (!ks.round_members.empty())
+        for (uint32_t w : removed) ks.round_members.erase(w);
+      if (!refinalize || ks.seen.empty()) continue;
+      // Publish if the survivors are all in.  A round whose pinned set
+      // emptied entirely (every contributor departed) publishes what was
+      // merged: the departed workers DID contribute, and holding the
+      // round open would wedge every joiner's first pull.
+      if (ks.round_members.empty() || RoundComplete(ks))
+        PublishRound(ks, key, t.worker_id);
+    }
   }
 
   void HandleInit(Task& t) {
@@ -1792,6 +2266,27 @@ class Server {
       Respond(t.conn, kOk, t.req_id, t.key, nullptr, 0);
       return;
     }
+    if (!async_ && epoch_atomic_.load(std::memory_order_acquire) != 0) {
+      // Elastic membership engaged (the epoch has advanced at least
+      // once).  A round's FIRST push is its epoch boundary: snapshot the
+      // live set as this round's contributor requirement.  Fixed-mode
+      // runs never reach here — zero overhead, identical behavior.
+      if (ks.seen.empty())
+        AdoptRoundMembers(ks);
+      if (!ks.round_members.empty() &&
+          !ks.round_members.count(t.worker_id)) {
+        // A worker that joined AFTER this round opened (its set was
+        // pinned by the transition fan-out): admitted at the next round
+        // boundary.  Ack-and-drop, exactly like a stale replay — its
+        // pull still serves this round's published sum, so its weights
+        // stay in lockstep with the incumbents, and its next push lands
+        // in a round whose set includes it.
+        deferred_joins_.fetch_add(1, std::memory_order_relaxed);
+        StatPush(t.key, t.worker_id, wire_len, false, 0);
+        Respond(t.conn, kOk, t.req_id, t.key, nullptr, 0);
+        return;
+      }
+    }
     // SUM span start: everything from here to the merge landing
     // (decompress + validate + sum/copy-first) is this push's share of
     // engine work.
@@ -1842,6 +2337,11 @@ class Server {
       ks.store.assign(want, 0);
       ks.seen.clear();
       ks.merge_ts.clear();   // the discarded merges' waits died with it
+      // The restarted merge is a fresh round boundary: re-snapshot its
+      // contributor set under elastic membership (empty = legacy count).
+      ks.round_members.clear();
+      if (epoch_atomic_.load(std::memory_order_acquire) != 0)
+        AdoptRoundMembers(ks);
       // Keep the readers' scatter check in step with the new store size.
       ks.declared_len.store(want, std::memory_order_release);
     }
@@ -1904,64 +2404,68 @@ class Server {
     StatPush(t.key, t.worker_id, wire_len, true, ks.completed_round + 1,
              ks.seen.size());
     Respond(t.conn, kOk, t.req_id, t.key, nullptr, 0);
-    if (static_cast<int>(ks.seen.size()) >= num_workers_) {
-      // ALL_RECV: publish the completed round and start a fresh merge.
-      // Bidirectional compressors re-compress the merged buffer for the
-      // pull leg (reference: impl/onebit bidirectional, server engine).
-      const uint64_t pub_round = ks.completed_round;
-      const int64_t pub_t0 = ks.merge_ts.empty() ? 0 : NowUs();
-      if (ks.round_compressed && ks.bidirectional) {
-        size_t ne = ks.store.size() / 4;
-        float* s = reinterpret_cast<float*>(ks.store.data());
-        if (ks.server_ef) {
-          // Vanilla EF on the requantization: fold last round's error into
-          // the merged gradient before compressing (the store is a fresh
-          // COPY_FIRST merge every round, so the in-place add is safe).
-          if (ks.ef_err.size() != ne) ks.ef_err.assign(ne, 0.0f);
-          for (size_t i = 0; i < ne; ++i) s[i] += ks.ef_err[i];
-        }
-        codec::CompressOnebit(ks.store, ks.onebit_scaled, &ks.out);
-        if (ks.server_ef) {
-          // The decoded onebit value is just +-scale with the sign bit
-          // taken from the corrected gradient — compute the error inline
-          // instead of a full decompress round-trip + allocation.
-          float scale = 1.0f;
-          std::memcpy(&scale, ks.out.data() + 5, 4);
-          for (size_t i = 0; i < ne; ++i)
-            ks.ef_err[i] = s[i] - (s[i] < 0.0f ? -scale : scale);
-        }
-        // Log BEFORE the increment so all_recv and its contributing
-        // push_recv lines carry the same round number (the compressed
-        // branch logs after the EF fold — the store it publishes).
-        DebugLog("all_recv", t.key, t.worker_id, ks.completed_round,
-                 ks.store);
-      } else {
-        DebugLog("all_recv", t.key, t.worker_id, ks.completed_round,
-                 ks.store);
-        // Publish by swap, not copy: `out` takes the merged round (what
-        // pulls serve) and `store` inherits a stale same-size buffer that
-        // the next round's COPY_FIRST fully overwrites — saving a
-        // full-buffer memcpy per partition per round on the serve path.
-        std::swap(ks.out, ks.store);
+    if (RoundComplete(ks))
+      PublishRound(ks, t.key, t.worker_id);
+  }
+
+  // ALL_RECV: publish the completed round and start a fresh merge.
+  // Bidirectional compressors re-compress the merged buffer for the
+  // pull leg (reference: impl/onebit bidirectional, server engine).
+  // Extracted from HandlePush's tail so the membership re-finalize path
+  // (HandleMembership) publishes through the identical code — EF fold,
+  // trace spans, pending-pull flush and all.
+  void PublishRound(KeyState& ks, uint64_t key, uint32_t worker_id) {
+    const uint64_t pub_round = ks.completed_round;
+    const int64_t pub_t0 = ks.merge_ts.empty() ? 0 : NowUs();
+    if (ks.round_compressed && ks.bidirectional) {
+      size_t ne = ks.store.size() / 4;
+      float* s = reinterpret_cast<float*>(ks.store.data());
+      if (ks.server_ef) {
+        // Vanilla EF on the requantization: fold last round's error into
+        // the merged gradient before compressing (the store is a fresh
+        // COPY_FIRST merge every round, so the in-place add is safe).
+        if (ks.ef_err.size() != ne) ks.ef_err.assign(ne, 0.0f);
+        for (size_t i = 0; i < ne; ++i) s[i] += ks.ef_err[i];
       }
-      ks.completed_round++;
-      ks.seen.clear();
-      ks.round_compressed = false;
-      if (pub_t0) {
-        // One MERGE_WAIT span per traced contributor: merge-complete ->
-        // publish.  The LAST arriver's wait is ~0; every other worker's
-        // wait is exactly how long the straggler(s) held the round open
-        // — the signal the critical-path analyzer attributes.
-        for (const auto& wt : ks.merge_ts)
-          tracer_.Record("MERGE_WAIT", t.key, pub_round, wt.first,
-                         wt.second, pub_t0 - wt.second, 0);
-        tracer_.Record("PUBLISH", t.key, pub_round, t.worker_id, pub_t0,
-                       NowUs() - pub_t0, ks.out.size());
+      codec::CompressOnebit(ks.store, ks.onebit_scaled, &ks.out);
+      if (ks.server_ef) {
+        // The decoded onebit value is just +-scale with the sign bit
+        // taken from the corrected gradient — compute the error inline
+        // instead of a full decompress round-trip + allocation.
+        float scale = 1.0f;
+        std::memcpy(&scale, ks.out.data() + 5, 4);
+        for (size_t i = 0; i < ne; ++i)
+          ks.ef_err[i] = s[i] - (s[i] < 0.0f ? -scale : scale);
       }
-      ks.merge_ts.clear();
-      StatPublish(t.key, ks.completed_round);
-      FlushPulls(ks, t.key);
+      // Log BEFORE the increment so all_recv and its contributing
+      // push_recv lines carry the same round number (the compressed
+      // branch logs after the EF fold — the store it publishes).
+      DebugLog("all_recv", key, worker_id, ks.completed_round, ks.store);
+    } else {
+      DebugLog("all_recv", key, worker_id, ks.completed_round, ks.store);
+      // Publish by swap, not copy: `out` takes the merged round (what
+      // pulls serve) and `store` inherits a stale same-size buffer that
+      // the next round's COPY_FIRST fully overwrites — saving a
+      // full-buffer memcpy per partition per round on the serve path.
+      std::swap(ks.out, ks.store);
     }
+    ks.completed_round++;
+    ks.seen.clear();
+    ks.round_compressed = false;
+    if (pub_t0) {
+      // One MERGE_WAIT span per traced contributor: merge-complete ->
+      // publish.  The LAST arriver's wait is ~0; every other worker's
+      // wait is exactly how long the straggler(s) held the round open
+      // — the signal the critical-path analyzer attributes.
+      for (const auto& wt : ks.merge_ts)
+        tracer_.Record("MERGE_WAIT", key, pub_round, wt.first,
+                       wt.second, pub_t0 - wt.second, 0);
+      tracer_.Record("PUBLISH", key, pub_round, worker_id, pub_t0,
+                     NowUs() - pub_t0, ks.out.size());
+    }
+    ks.merge_ts.clear();
+    StatPublish(key, ks.completed_round);
+    FlushPulls(ks, key);
   }
 
   void DebugLog(const char* stage, uint64_t key, uint32_t worker,
@@ -2085,6 +2589,21 @@ class Server {
 
   std::mutex barrier_mu_;
   std::map<uint64_t, std::vector<PendingPull>> barrier_waiters_;
+  // Generations that already released: late arrivals (elastic joiners
+  // catching up to the startup rendezvous) pass straight through.
+  // Generations are one-shot by contract, so this only ever holds as
+  // many entries as distinct barrier calls the job makes.
+  std::set<uint64_t> released_gens_;
+
+  // Elastic membership (see the "elastic membership" section above).
+  // epoch_atomic_ mirrors epoch_ for the lock-free fixed-mode
+  // short-circuit on the push hot path.
+  std::mutex member_mu_;
+  uint64_t epoch_ = 0;
+  std::map<uint32_t, MemberRec> members_;
+  std::atomic<uint64_t> epoch_atomic_{0};
+  double evict_timeout_s_ = 0.0;
+  std::atomic<uint64_t> deferred_joins_{0};
 
   // CMD_TRACE span ring (see ServerTracer).
   ServerTracer tracer_;

@@ -74,7 +74,8 @@ _REQ = struct.Struct("<BBHIIQQ")   # cmd dtype flags req_id worker_id key len
 _RESP = struct.Struct("<BIQQ")     # status req_id key len
 
 CMD_HELLO, CMD_INIT, CMD_PUSH, CMD_PULL, CMD_BARRIER, CMD_SHUTDOWN, \
-    CMD_PING, CMD_LR_SCALE, CMD_STATS, CMD_TRACE = range(10)
+    CMD_PING, CMD_LR_SCALE, CMD_STATS, CMD_TRACE, CMD_LEAVE, \
+    CMD_MEMBERS = range(12)
 
 # dtype byte on the wire (server.cc WireDtype)
 DT_F32, DT_RAW, DT_COMPRESSED, DT_SEED = 0, 1, 2, 3
@@ -94,7 +95,7 @@ ROUND_MASK = 0x7FFF
 
 _CMD_NAMES = {0: "HELLO", 1: "INIT", 2: "PUSH", 3: "PULL", 4: "BARRIER",
               5: "SHUTDOWN", 6: "PING", 7: "LR_SCALE", 8: "STATS",
-              9: "TRACE"}
+              9: "TRACE", 10: "LEAVE", 11: "MEMBERS"}
 
 
 def _round_flags(rnd: int, traced: bool) -> int:
@@ -121,6 +122,52 @@ def estimate_clock_offset(samples) -> Tuple[float, float]:
         raise ValueError("estimate_clock_offset: no samples")
     t0, ts, t1 = min(samples, key=lambda s: s[2] - s[0])
     return ts - (t0 + t1) / 2.0, float(t1 - t0)
+
+def _merge_member_rec(workers: dict, worker: int, rec: dict) -> None:
+    """Fold one server's view of one worker into a merged workers map:
+    alive only if EVERY server agrees (one server evicting it means its
+    rounds there re-finalize without it — the operative fact), lease age
+    takes the max (staleness anywhere is the honest signal).  The ONE
+    merge law, shared by merge_membership (CMD_MEMBERS) and
+    server_stats (CMD_STATS) so the two surfaces can never disagree."""
+    alive = bool(rec.get("alive"))
+    age = float(rec.get("age_ms", 0.0))
+    prev = workers.get(worker)
+    if prev is None:
+        workers[worker] = {"alive": alive, "age_ms": age}
+    else:
+        prev["alive"] = prev["alive"] and alive
+        prev["age_ms"] = max(prev["age_ms"], age)
+
+
+def merge_membership(views: list) -> dict:
+    """Merge per-server CMD_MEMBERS snapshots into one worker-set view.
+
+    Epoch takes the max across servers (each server versions its own
+    table; transitions reach every server through the same worker
+    actions, so the max is the freshest view).  A worker counts as alive
+    only if EVERY server that knows it says so — one server evicting it
+    means its rounds there will re-finalize without it, which is the
+    operative fact for the training loop.  Lease ages take the max
+    (staleness anywhere is the honest signal) and barrier arrivals
+    union (in practice barriers live on server 0 only).
+
+    Returns ``{"epoch", "workers": {id: {"alive", "age_ms"}}, "alive":
+    [ids], "barrier": {gen: [ids]}}``.
+    """
+    merged: dict = {"epoch": 0, "workers": {}, "barrier": {}}
+    for st in views:
+        merged["epoch"] = max(merged["epoch"], int(st.get("epoch", 0)))
+        for w, rec in (st.get("members") or {}).items():
+            _merge_member_rec(merged["workers"], int(w), rec)
+        for g, ids in (st.get("barrier") or {}).items():
+            g = int(g)
+            merged["barrier"][g] = sorted(
+                set(merged["barrier"].get(g, ())) | {int(i) for i in ids})
+    merged["alive"] = sorted(w for w, r in merged["workers"].items()
+                             if r["alive"])
+    return merged
+
 
 # How often the barrier wait logs a "still waiting" warning; module-level so
 # tests can shrink it (bps.barrier legitimately blocks on peers for a long
@@ -489,7 +536,8 @@ class _ServerConn:
 
     def request(self, cmd: int, key: int = 0, payload: bytes = b"",
                 worker_id: int = 0, dtype: int = 0, flags: int = 0,
-                timeout: Optional[float] = 60.0) -> bytes:
+                timeout: Optional[float] = 60.0,
+                barrier_diag: Optional[Callable[[], str]] = None) -> bytes:
         """Blocking request/response (INIT, BARRIER, control commands).
 
         BARRIER legitimately blocks on peers, so its default deadline is
@@ -497,20 +545,39 @@ class _ServerConn:
         finite one through PSSession.barrier) — but it logs a periodic
         "still waiting" warning so a dead peer is never silent.  Everything
         else fails loudly after `timeout` instead of hanging a training job
-        on a wedged server.
+        on a wedged server.  ``barrier_diag``, when given, is called on
+        each warning/timeout to append the live membership picture (which
+        ranks the barrier is actually waiting on).
         """
         fut = self.send(cmd, key, payload, worker_id, dtype, flags)
         if cmd == CMD_BARRIER:
-            return self._wait_barrier(fut, key, timeout)
+            return self._wait_barrier(fut, key, timeout, barrier_diag)
         return fut.wait(timeout)
 
     def _wait_barrier(self, fut: _Future, gen: int,
-                      timeout: Optional[float]) -> bytes:
+                      timeout: Optional[float],
+                      diag: Optional[Callable[[], str]] = None) -> bytes:
         """Barrier wait with periodic progress warnings and an optional
-        overall deadline (0/None = wait forever, the historical default)."""
+        overall deadline (0/None = wait forever, the historical default).
+
+        The warning/timeout text reports the live epoch membership and the
+        ranks the barrier is actually waiting on (via ``diag``, wired by
+        PSSession.barrier to a CMD_MEMBERS fetch) — a dead-or-evicted peer
+        is named, instead of the old blanket "DMLC_NUM_WORKER over-counts
+        the world" guess."""
         if not timeout or timeout <= 0:
             timeout = None
         deadline = None if timeout is None else time.monotonic() + timeout
+
+        def diag_text() -> str:
+            if diag is None:
+                return "a peer is down, slow, or not yet started"
+            try:
+                return diag()
+            except Exception as e:   # old server / mid-outage: degrade
+                return (f"a peer is down, slow, or not yet started "
+                        f"(membership unavailable: {e})")
+
         t0 = time.monotonic()
         while True:
             chunk = BARRIER_WARN_INTERVAL_S
@@ -522,12 +589,11 @@ class _ServerConn:
             if deadline is not None and time.monotonic() >= deadline:
                 raise TimeoutError(
                     f"PS barrier timed out: gen={gen} elapsed={elapsed:.1f}s"
-                    f" (BYTEPS_TPU_BARRIER_TIMEOUT_S={timeout}); a peer is"
-                    f" down or DMLC_NUM_WORKER over-counts the world")
+                    f" (BYTEPS_TPU_BARRIER_TIMEOUT_S={timeout});"
+                    f" {diag_text()}")
             get_logger().warning(
                 "still waiting on barrier gen=%d after %.1fs (server %s:%d;"
-                " a peer may be down or slow)", gen, elapsed, self.host,
-                self.port)
+                " %s)", gen, elapsed, self.host, self.port, diag_text())
         if fut.error is not None:
             raise fut.error
         return fut.data
@@ -910,7 +976,8 @@ class PSSession:
                  barrier_timeout_s: float = 0.0,
                  clock_sync_s: float = 30.0,
                  uds_path: str = "",
-                 sock_buf_kb: int = 0):
+                 sock_buf_kb: int = 0,
+                 evict_timeout_s: float = 0.0):
         self.worker_id = worker_id
         self.num_servers = max(1, num_servers)
         self.hash_fn = hash_fn
@@ -939,6 +1006,13 @@ class PSSession:
         # remote server's conns keep dialing TCP.
         self.uds_path = str(uds_path or "")
         self.sock_buf_kb = max(0, int(sock_buf_kb))
+        # Elastic membership (BYTEPS_TPU_EVICT_TIMEOUT_S): when eviction
+        # is armed, this worker must keep its server-side lease warm even
+        # while idle (blocked on a pull, between steps) — a lease is
+        # refreshed by any traffic, and the heartbeat PING below is the
+        # idle-time traffic.  0 (default) = no heartbeat thread, no extra
+        # wire bytes: a fixed-membership job's traffic is untouched.
+        self.evict_timeout_s = max(0.0, float(evict_timeout_s))
         # Any failure before __init__ returns (a connect, the dispatcher,
         # the HELLO mode check) must tear down every socket and receiver
         # thread already created — the caller gets an exception, not a
@@ -996,6 +1070,8 @@ class PSSession:
     def _abort_init(self) -> None:
         if getattr(self, "_watchdog_stop", None) is not None:
             self._watchdog_stop.set()
+        if getattr(self, "_lease_stop", None) is not None:
+            self._lease_stop.set()
         if getattr(self, "_clock_sync_stop", None) is not None:
             self._clock_sync_stop.set()
         if getattr(self, "_dispatcher", None) is not None:
@@ -1098,6 +1174,14 @@ class PSSession:
             help="partitions waiting in the priority scheduler",
             fn=self._queue_depth_fn)
         self._join_timeout_s = 10.0   # close()'s thread-join budget
+        # Lease heartbeat (elastic eviction armed): periodic untraced
+        # CMD_PINGs keep this worker's lease warm while it is idle, so
+        # only a worker that is actually GONE ever expires.  `_left` stops
+        # the heartbeat after a graceful leave — a departed worker must
+        # not keep renewing the lease it just gave up.
+        self._left = False
+        self._lease_stop = threading.Event()
+        self._lease_thread: Optional[threading.Thread] = None
         self._dispatcher = threading.Thread(
             target=self._dispatch_loop, daemon=True, name="bps-ps-dispatch")
         self._dispatcher.start()
@@ -1106,6 +1190,10 @@ class PSSession:
                 target=self._watchdog_loop, daemon=True,
                 name="bps-ps-watchdog")
             self._watchdog.start()
+        if self.evict_timeout_s > 0:
+            self._lease_thread = threading.Thread(
+                target=self._lease_loop, daemon=True, name="bps-ps-lease")
+            self._lease_thread.start()
 
     def _hello_mode_check(self, worker_id: int) -> None:
         # HELLO returns the server's mode flags (u8 async | u8 schedule).
@@ -1149,7 +1237,8 @@ class PSSession:
                    barrier_timeout_s=cfg.barrier_timeout_s,
                    clock_sync_s=cfg.clock_sync_s,
                    uds_path=cfg.server_uds,
-                   sock_buf_kb=cfg.sock_buf_kb)
+                   sock_buf_kb=cfg.sock_buf_kb,
+                   evict_timeout_s=cfg.evict_timeout_s)
 
     def set_lr_scale(self, scale: float) -> None:
         """One-shot EF-error rescale after a learning-rate change;
@@ -1575,6 +1664,15 @@ class PSSession:
         """
         if not getattr(self, "_session_ready", False):
             return      # drop during __init__: nothing staged to replay yet
+        if self._left:
+            # A departed worker must NOT re-run the handshake: HELLO is
+            # the join door, and re-sending it after leave() would
+            # re-admit this worker into the membership — every future
+            # round would then wait on pushes that are never coming.
+            # (A deliberate rejoin is a NEW session, which HELLOs fresh.)
+            self._fail_parked_on(conn, ConnectionError(
+                "worker left the membership; not replaying"))
+            return
         # The peer may be a RESTARTED process with a fresh steady_clock
         # epoch: its pre-restart offset history would place post-restart
         # trace spans wildly off the worker timeline.  Drop it; the next
@@ -1752,7 +1850,154 @@ class PSSession:
             lines.append(f"  server[{i}] conns: {states}")
         with self._transport_lock:
             lines.append(f"  transport stats: {dict(self._tstats)}")
+        # A stuck partition's round may be waiting on a peer that is GONE
+        # (evicted/left), not merely slow — name it, so the operator (and
+        # the log reader) stops hunting for a straggler that no longer
+        # exists.  Best-effort: a dead server tier degrades to a note.
+        try:
+            m = self.membership(timeout=2.0)
+            gone = sorted(w for w, r in m["workers"].items()
+                          if not r["alive"])
+            lines.append(
+                f"  membership: epoch={m['epoch']} alive={m['alive']}"
+                f" gone={gone}"
+                + (" — stuck rounds re-finalize at the next epoch"
+                   " transition; a gone peer is not coming back"
+                   if gone else ""))
+        except Exception as e:
+            lines.append(f"  membership: unavailable ({e})")
         get_logger().error("%s", "\n".join(lines))
+
+    # -- elastic membership: heartbeat, leave, membership view --------------
+    def _lease_loop(self) -> None:
+        """Keep this worker's server-side lease warm while idle: an
+        untraced CMD_PING per server every third of the evict timeout.
+        Fire-and-forget — a mid-reconnect conn just skips a beat (the
+        re-dial's HELLO touches the lease anyway).
+
+        Every few beats it also SELF-CHECKS the membership: a worker
+        falsely evicted while its sockets stayed up (GC pause or stall
+        just past the timeout) would otherwise become a silent zombie —
+        every push acked-and-dropped as a non-member, its pulls still
+        served, training "successfully" while contributing nothing.  On
+        detecting its own eviction it logs loudly and re-HELLOs, which
+        re-admits it at the next epoch boundary."""
+        interval = max(0.05, self.evict_timeout_s / 3.0)
+        beat = 0
+        while not self._lease_stop.wait(interval):
+            if self._left:
+                return
+            for c in self.conns:
+                try:
+                    c.send(CMD_PING, worker_id=self.worker_id,
+                           callback=lambda data, err: None)
+                except (ConnectionError, OSError):
+                    pass
+            beat += 1
+            if beat % 3 == 0:       # ~once per evict timeout
+                try:
+                    self._readmit_if_evicted()
+                except Exception as e:
+                    get_logger().debug("membership self-check failed: %s",
+                                       e)
+
+    def _readmit_if_evicted(self) -> None:
+        """Detect this worker's own (false) eviction and re-admit it via
+        HELLO — see _lease_loop.  Safe to call any time; no-op while the
+        membership agrees this worker is alive, or after leave()."""
+        if self._left:
+            return
+        m = self.membership(timeout=5.0)
+        rec = m["workers"].get(self.worker_id)
+        if rec is None or rec["alive"]:
+            return
+        get_logger().error(
+            "worker %d was evicted while still alive (lease lapsed — a "
+            "stall longer than BYTEPS_TPU_EVICT_TIMEOUT_S=%.1fs?); "
+            "re-admitting via HELLO.  Rounds merged while evicted did "
+            "not include this worker's pushes.", self.worker_id,
+            self.evict_timeout_s)
+        for c in self.conns:
+            try:
+                c.request(CMD_HELLO, worker_id=self.worker_id,
+                          timeout=10.0)
+            except (ConnectionError, OSError, RuntimeError) as e:
+                get_logger().warning("re-admission HELLO to %s:%d "
+                                     "failed: %s", c.host, c.port, e)
+
+    def leave(self, drain_timeout_s: float = 60.0) -> None:
+        """Graceful departure: drain in-flight rounds, then tell every
+        server to drop this worker from the membership at the next epoch
+        boundary (CMD_LEAVE).  The session stays usable for pulls/close;
+        pushes after leave() would be deferred-dropped by the servers, so
+        the training loop should stop stepping first.
+
+        Raises TimeoutError if in-flight partitions do not drain in
+        ``drain_timeout_s`` — leaving with rounds half-pushed would strand
+        peers waiting on contributions that already happened."""
+        deadline = time.monotonic() + max(0.0, drain_timeout_s)
+        while True:
+            with self._inflight_lock:
+                n = len(self._inflight)
+            if n == 0:
+                break
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"bps.leave(): {n} partition(s) still in flight after "
+                    f"{drain_timeout_s}s; wait on outstanding handles "
+                    f"before leaving")
+            time.sleep(0.02)
+        self._left = True
+        self._lease_stop.set()
+        for c in self.conns:
+            try:
+                c.request(CMD_LEAVE, worker_id=self.worker_id, timeout=10.0)
+            except RuntimeError as e:
+                raise RuntimeError(
+                    f"PS server at {c.host}:{c.port} does not support "
+                    f"CMD_LEAVE (server too old — rebuild/redeploy the "
+                    f"server tier to match this client): {e}") from e
+            except (ConnectionError, OSError) as e:
+                # A server that is itself gone cannot hold our lease open
+                # anyway (it lost all state); best-effort is correct here.
+                get_logger().warning(
+                    "leave notification to %s:%d failed: %s",
+                    c.host, c.port, e)
+        get_logger().info("worker %d left the membership", self.worker_id)
+
+    def membership(self, timeout: float = 10.0) -> dict:
+        """Live membership view merged across servers (CMD_MEMBERS):
+        ``{"epoch", "workers": {id: {"alive", "age_ms"}}, "alive": [ids],
+        "barrier": {gen: [arrived ids]}}`` — see merge_membership for the
+        merge law.  A pre-CMD_MEMBERS server surfaces as a clean "server
+        too old" RuntimeError, never a hang."""
+        import json as _json
+        views = []
+        for c in self.conns:
+            try:
+                raw = c.request(CMD_MEMBERS, worker_id=self.worker_id,
+                                timeout=timeout)
+            except RuntimeError as e:
+                raise RuntimeError(
+                    f"PS server at {c.host}:{c.port} does not support "
+                    f"CMD_MEMBERS (server too old — rebuild/redeploy the "
+                    f"server tier to match this client): {e}") from e
+            views.append(_json.loads(bytes(raw).decode()))
+        return merge_membership(views)
+
+    def _barrier_diag_text(self, generation: int) -> str:
+        """One line naming who the barrier is waiting on: live epoch
+        membership + arrived ranks from server 0 (where barriers live)."""
+        m = self.membership(timeout=5.0)
+        arrived = m.get("barrier", {}).get(generation, [])
+        waiting_on = sorted(set(m["alive"]) - set(arrived))
+        gone = sorted(w for w, r in m["workers"].items() if not r["alive"])
+        txt = (f"membership epoch={m['epoch']} alive={m['alive']}, "
+               f"arrived={sorted(arrived)}, waiting on rank(s) "
+               f"{waiting_on}")
+        if gone:
+            txt += f"; gone (left/evicted): {gone}"
+        return txt
 
     def transport_stats(self) -> dict:
         """Fault-tolerance + raw-speed transport counters: reconnects,
@@ -1807,7 +2052,8 @@ class PSSession:
         """
         merged = {"bytes_in": 0, "bytes_out": 0, "async": False,
                   "num_workers": 0, "scatter_frames": 0, "keys": {},
-                  "workers": {}}
+                  "workers": {}, "epoch": 0, "deferred_joins": 0,
+                  "members": {}}
         import json as _json
         for c in self.conns:
             try:
@@ -1825,6 +2071,13 @@ class PSSession:
             merged["async"] = merged["async"] or bool(st.get("async"))
             merged["num_workers"] = max(merged["num_workers"],
                                         int(st.get("num_workers", 0)))
+            # Elastic membership — the one merge law (_merge_member_rec):
+            # freshest epoch wins, alive = AND across servers, age = max.
+            # Old servers omit these keys entirely.
+            merged["epoch"] = max(merged["epoch"], int(st.get("epoch", 0)))
+            merged["deferred_joins"] += int(st.get("deferred_joins", 0))
+            for w, rec in (st.get("members") or {}).items():
+                _merge_member_rec(merged["members"], int(w), rec)
             for k, v in (st.get("keys") or {}).items():
                 merged["keys"][int(k)] = v
             for w, v in (st.get("workers") or {}).items():
@@ -2305,10 +2558,20 @@ class PSSession:
         Waits forever by default (peers are allowed to be slow), logging a
         periodic "still waiting" warning; BYTEPS_TPU_BARRIER_TIMEOUT_S > 0
         turns a dead peer into a loud TimeoutError instead of a silent
-        hang."""
-        self.conns[0].request(CMD_BARRIER, generation,
-                              worker_id=self.worker_id,
-                              timeout=self.barrier_timeout_s or None)
+        hang.  Warnings and the timeout report the live epoch membership
+        and which ranks the barrier is actually waiting on (CMD_MEMBERS),
+        so a dead/evicted peer is named rather than guessed at.
+
+        Generations are ONE-SHOT (use a fresh, monotonically increasing
+        number per rendezvous): once a generation releases, any later
+        arrival at it — an elastic joiner catching up to the startup
+        rendezvous the incumbents passed long ago — returns immediately
+        instead of waiting for arrivals that will never come."""
+        self.conns[0].request(
+            CMD_BARRIER, generation, worker_id=self.worker_id,
+            timeout=self.barrier_timeout_s or None,
+            barrier_diag=lambda gen=generation:
+                self._barrier_diag_text(gen))
 
     def shutdown_servers(self) -> None:
         for c in self.conns:
@@ -2331,6 +2594,7 @@ class PSSession:
             self._cv.notify_all()
         self._watchdog_stop.set()
         self._clock_sync_stop.set()
+        self._lease_stop.set()
         # Detach the queue-depth gauge's sampler: the registry outlives the
         # session, and a lazy gauge holding `self` would both leak the
         # session and report a dead scheduler's depth.  Only if the gauge

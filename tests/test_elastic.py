@@ -1,0 +1,632 @@
+"""Elastic worker membership tests (docs/elasticity.md).
+
+Drives the REAL client/server wire code through the three membership
+transitions — graceful leave (CMD_LEAVE), crash eviction (lease expiry
+under BYTEPS_TPU_EVICT_TIMEOUT_S), and join (HELLO admission at the next
+epoch boundary) — and asserts the invariants the epoch model promises:
+rounds never mix contributor sets, open rounds re-finalize against the
+survivors so pulls stop hanging, a joiner rebases onto the live round and
+contributes from the next boundary, and a fixed-membership job (the
+default, eviction off) sends byte-for-byte the same wire traffic as
+before this feature existed.
+"""
+
+import json
+import os
+import socket
+import struct
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from byteps_tpu.server.client import (
+    PSSession, merge_membership,
+    CMD_HELLO, CMD_INIT, CMD_PUSH, CMD_PULL, CMD_PING, CMD_STATS,
+    CMD_LEAVE, CMD_MEMBERS,
+)
+
+from testutil import cpu_env, free_port, StubPSServer
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "tools"))
+from chaos_proxy import ChaosProxy  # noqa: E402
+
+
+# ---------------------------------------------------------------------------
+# harness
+# ---------------------------------------------------------------------------
+@pytest.fixture
+def ps_server():
+    """Yields a `start(...) -> port` callable with a live C++ server;
+    kills every started server afterwards (same contract as
+    tests/test_transport_fault.py)."""
+    made = []
+
+    def start(num_workers=2, evict_s=0.0, extra_env=None, port=None):
+        last = None
+        for _ in range(3):
+            try:
+                return _start_once(num_workers, evict_s, extra_env, port)
+            except RuntimeError as e:
+                last = e
+                if port is not None:
+                    raise
+        raise last
+
+    def _start_once(num_workers, evict_s, extra_env, port):
+        port = port or free_port()
+        env = cpu_env({
+            "DMLC_PS_ROOT_PORT": str(port - 1),
+            "DMLC_NUM_WORKER": str(num_workers),
+            "BYTEPS_SERVER_ENGINE_THREAD": "2",
+            "BYTEPS_TPU_EVICT_TIMEOUT_S": str(evict_s) if evict_s else "",
+            "JAX_PLATFORMS": "cpu",
+            **(extra_env or {}),
+        })
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "byteps_tpu.server"], env=env,
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+        made.append(proc)
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            try:
+                socket.create_connection(("127.0.0.1", port), 0.5).close()
+                return port
+            except OSError:
+                if proc.poll() is not None:
+                    raise RuntimeError(f"server died rc={proc.returncode}")
+                time.sleep(0.1)
+        raise TimeoutError("PS server did not come up")
+
+    yield start
+    for p in made:
+        p.kill()
+        p.wait()
+
+
+def _session(port, wid, evict_s=0.0, **kw):
+    kw.setdefault("wire_conns", 1)
+    return PSSession(["127.0.0.1"], [port], worker_id=wid, num_servers=1,
+                     evict_timeout_s=evict_s, **kw)
+
+
+# ---------------------------------------------------------------------------
+# fast: epoch math / membership plumbing
+# ---------------------------------------------------------------------------
+def test_merge_membership_math():
+    """Freshest epoch wins; alive = AND across servers; age = max;
+    barrier arrivals union."""
+    a = {"epoch": 3,
+         "members": {"0": {"alive": 1, "age_ms": 5},
+                     "1": {"alive": 1, "age_ms": 100}},
+         "barrier": {"7": [0]}}
+    b = {"epoch": 2,
+         "members": {"0": {"alive": 1, "age_ms": 50},
+                     "1": {"alive": 0, "age_ms": 900},
+                     "2": {"alive": 1, "age_ms": 1}},
+         "barrier": {"7": [1]}}
+    m = merge_membership([a, b])
+    assert m["epoch"] == 3
+    assert m["workers"][0] == {"alive": True, "age_ms": 50.0}
+    assert m["workers"][1]["alive"] is False      # evicted anywhere = gone
+    assert m["workers"][1]["age_ms"] == 900.0
+    assert m["alive"] == [0, 2]
+    assert m["barrier"] == {7: [0, 1]}
+
+
+def test_fixed_world_is_epoch_zero(ps_server):
+    """A job that never resizes reports epoch 0, every launch rank alive
+    — in CMD_MEMBERS and in the CMD_STATS membership section alike."""
+    port = ps_server(num_workers=2)
+    s = _session(port, 0)
+    try:
+        m = s.membership()
+        assert m["epoch"] == 0
+        assert m["alive"] == [0, 1]
+        st = s.server_stats()
+        assert st["epoch"] == 0
+        assert st["num_workers"] == 2
+        assert set(st["members"]) == {0, 1}
+        assert all(rec["alive"] for rec in st["members"].values())
+    finally:
+        s.close()
+
+
+def test_fixed_membership_sends_no_new_wire_traffic():
+    """Regression for the no-resize acceptance: with eviction off
+    (default) a session's traffic contains no LEAVE/MEMBERS/heartbeat
+    frames — the data plane is byte-for-byte the pre-elastic protocol."""
+    store = {}
+
+    def handler(cmd, dt, fl, req_id, wid, key, payload):
+        if cmd == CMD_HELLO:
+            return 0, b"\x00\x00"
+        if cmd == CMD_INIT:
+            return 0, struct.pack("<Q", 0)
+        if cmd == CMD_PUSH:
+            store[key] = bytes(payload)
+            return 0, b""
+        if cmd == CMD_PULL:
+            return 0, store[key]
+        return 1, b""
+
+    srv = StubPSServer(handler, record=True)
+    try:
+        s = PSSession(["127.0.0.1"], [srv.port], worker_id=0,
+                      num_servers=1, wire_conns=1)
+        x = np.arange(64, dtype=np.float32)
+        got = s.push_pull(3, x)
+        np.testing.assert_array_equal(got, x)
+        time.sleep(0.3)     # a heartbeat, if one existed, would fire late
+        s.close()
+        with srv.lock:
+            cmds = {c for _, c, _ in srv.frames}
+        assert cmds <= {CMD_HELLO, CMD_INIT, CMD_PUSH, CMD_PULL}, cmds
+    finally:
+        srv.close()
+
+
+def test_evict_disabled_keeps_fixed_semantics(ps_server):
+    """BYTEPS_TPU_EVICT_TIMEOUT_S=0 (default): a vanished worker is NOT
+    evicted — the membership stays at epoch 0 and the job keeps today's
+    fail-fast/stall-watchdog behavior."""
+    port = ps_server(num_workers=2, evict_s=0.0)
+    s0 = _session(port, 0)
+    s1 = _session(port, 1)
+    try:
+        a = np.arange(8, dtype=np.float32)
+        h0, h1 = s0.push_pull_async(1, a), s1.push_pull_async(1, a)
+        h0.wait(20), h1.wait(20)
+        s1.close()              # vanish without notice
+        time.sleep(1.2)         # far past any would-be lease timeout
+        m = s0.membership()
+        assert m["epoch"] == 0
+        assert m["alive"] == [0, 1]     # nobody evicted anyone
+    finally:
+        s0.close()
+
+
+def test_api_size_follows_membership_epoch(monkeypatch):
+    """bps.size() is epoch-dependent in PS mode: the launch count until
+    the epoch ever advances, the live member count afterwards; rank() is
+    the stable worker id throughout."""
+    from byteps_tpu.common import api
+    from byteps_tpu.common.config import Config
+
+    monkeypatch.setattr(api._state, "config",
+                        Config(num_worker=3, worker_id=1))
+    monkeypatch.setattr(api._state, "ps_session", object())
+    monkeypatch.setattr(api._state, "membership", None)
+    assert api.size() == 3                      # fixed world
+    assert api.rank() == 1
+    monkeypatch.setattr(api._state, "membership",
+                        {"epoch": 0, "alive": [0, 1, 2]})
+    assert api.size() == 3                      # epoch 0 = launch count
+    monkeypatch.setattr(api._state, "membership",
+                        {"epoch": 2, "alive": [0, 1]})
+    assert api.size() == 2                      # live set after a shrink
+    assert api.rank() == 1                      # ids never re-assign
+    monkeypatch.setattr(api._state, "membership",
+                        {"epoch": 3, "alive": [0, 1, 2, 7]})
+    assert api.size() == 4                      # and after a grow
+
+
+# ---------------------------------------------------------------------------
+# fast: the three transitions
+# ---------------------------------------------------------------------------
+def test_graceful_leave_refinalizes_next_round(ps_server):
+    """bps.leave(): the next round excludes the leaver — the survivor's
+    solo push publishes instead of hanging on the departed peer."""
+    port = ps_server(num_workers=2, evict_s=0.0)  # leave works without evict
+    s0 = _session(port, 0)
+    s1 = _session(port, 1)
+    try:
+        a = np.arange(16, dtype=np.float32)
+        h0 = s0.push_pull_async(1, a)
+        h1 = s1.push_pull_async(1, a * 10)
+        np.testing.assert_array_equal(h0.wait(20), a + a * 10)
+        np.testing.assert_array_equal(h1.wait(20), a + a * 10)
+
+        s1.leave()
+        m = s0.membership()
+        assert m["epoch"] == 1
+        assert m["alive"] == [0]
+
+        t0 = time.monotonic()
+        got = s0.push_pull_async(1, a).wait(20)     # solo round publishes
+        assert time.monotonic() - t0 < 10
+        np.testing.assert_array_equal(got, a)
+    finally:
+        s0.close()
+        s1.close()
+
+
+def test_leave_refuses_with_inflight_rounds(ps_server):
+    """leave() must drain first: leaving with partitions in flight would
+    strand peers on contributions that already happened."""
+    port = ps_server(num_workers=2)
+    s0 = _session(port, 0)
+    try:
+        a = np.arange(8, dtype=np.float32)
+        s0.push_pull_async(1, a)        # open round: peer 1 never pushes
+        with pytest.raises(TimeoutError, match="in flight"):
+            s0.leave(drain_timeout_s=0.3)
+    finally:
+        s0.close()
+
+
+def test_lease_eviction_refinalizes_open_round(ps_server):
+    """Crash eviction: a worker that vanishes mid-job is evicted after
+    the lease timeout and the survivor's open round re-finalizes — the
+    pull completes instead of hanging forever.  The survivor itself is
+    idle while it waits, so this also proves the heartbeat keeps an
+    idle-but-alive worker's lease warm."""
+    evict_s = 0.6
+    port = ps_server(num_workers=2, evict_s=evict_s)
+    s0 = _session(port, 0, evict_s=evict_s)
+    s1 = _session(port, 1, evict_s=evict_s)
+    try:
+        a = np.arange(16, dtype=np.float32)
+        h0 = s0.push_pull_async(1, a)
+        h1 = s1.push_pull_async(1, a * 10)
+        h0.wait(20), h1.wait(20)
+
+        s1.close()                      # crash, no goodbye
+        t0 = time.monotonic()
+        got = s0.push_pull_async(1, a).wait(30)
+        dt = time.monotonic() - t0
+        np.testing.assert_array_equal(got, a)
+        assert dt < 5 * evict_s, f"re-finalize took {dt:.2f}s"
+
+        m = s0.membership()
+        assert m["epoch"] >= 1
+        assert m["alive"] == [0]
+        assert m["workers"][1]["alive"] is False
+    finally:
+        s0.close()
+
+
+def test_join_two_to_three_with_correct_sums(ps_server):
+    """Join: a third worker HELLOs into a 2-worker job, rebases via the
+    INIT completed_round, and the first fully post-join round sums all
+    three contributions (the 2→3 acceptance)."""
+    port = ps_server(num_workers=2)
+    s0 = _session(port, 0)
+    s1 = _session(port, 1)
+    try:
+        a = np.arange(32, dtype=np.float32)
+        h0 = s0.push_pull_async(1, a)
+        h1 = s1.push_pull_async(1, a * 10)
+        np.testing.assert_array_equal(h0.wait(20), a + a * 10)
+        h1.wait(20)
+
+        s2 = _session(port, 2)          # HELLO admits at the next boundary
+        try:
+            m = s0.membership()
+            assert m["epoch"] == 1
+            assert m["alive"] == [0, 1, 2]
+
+            h0 = s0.push_pull_async(1, a)
+            h1 = s1.push_pull_async(1, a * 10)
+            h2 = s2.push_pull_async(1, a * 100)
+            want = a + a * 10 + a * 100
+            np.testing.assert_array_equal(h0.wait(20), want)
+            np.testing.assert_array_equal(h1.wait(20), want)
+            np.testing.assert_array_equal(h2.wait(20), want)
+        finally:
+            s2.close()
+    finally:
+        s0.close()
+        s1.close()
+
+
+def test_join_mid_open_round_is_deferred(ps_server):
+    """A worker joining while a round is OPEN must not pollute it: the
+    round was pinned to its pre-join set, the joiner's push for it is
+    ack-and-dropped (deferred_joins stat), its pull serves the old set's
+    published sum — so its weights stay in lockstep — and the NEXT round
+    includes it."""
+    port = ps_server(num_workers=2)
+    s0 = _session(port, 0)
+    s1 = _session(port, 1)
+    try:
+        a = np.arange(16, dtype=np.float32)
+        h0 = s0.push_pull_async(1, a)    # round 0 opens: seen={0}
+        time.sleep(0.3)                  # let the push land server-side
+
+        s2 = _session(port, 2)           # joins with round 0 still open
+        try:
+            h2 = s2.push_pull_async(1, a * 100)   # round 0 push: deferred
+            time.sleep(0.2)
+            h1 = s1.push_pull_async(1, a * 10)    # completes the old set
+            old_sum = a + a * 10                  # w2 NOT in round 0
+            np.testing.assert_array_equal(h0.wait(20), old_sum)
+            np.testing.assert_array_equal(h1.wait(20), old_sum)
+            np.testing.assert_array_equal(h2.wait(20), old_sum)
+            st = s0.server_stats()
+            assert st["deferred_joins"] >= 1
+
+            # Round 1 is the joiner's first contributing round.
+            h0 = s0.push_pull_async(1, a)
+            h1 = s1.push_pull_async(1, a * 10)
+            h2 = s2.push_pull_async(1, a * 100)
+            want = a + a * 10 + a * 100
+            np.testing.assert_array_equal(h0.wait(20), want)
+            np.testing.assert_array_equal(h1.wait(20), want)
+            np.testing.assert_array_equal(h2.wait(20), want)
+        finally:
+            s2.close()
+    finally:
+        s0.close()
+        s1.close()
+
+
+def test_barrier_timeout_names_waiting_ranks(ps_server):
+    """The barrier-timeout diagnostic reports the live epoch membership
+    and WHICH ranks the barrier is waiting on, not the old blanket
+    'DMLC_NUM_WORKER over-counts the world' guess."""
+    port = ps_server(num_workers=2)
+    s0 = _session(port, 0, barrier_timeout_s=1.5)
+    try:
+        with pytest.raises(TimeoutError) as ei:
+            s0.barrier()
+        msg = str(ei.value)
+        assert "waiting on rank(s) [1]" in msg, msg
+        assert "epoch=0" in msg
+        assert "over-counts" not in msg
+    finally:
+        s0.close()
+
+
+def test_barrier_releases_when_peer_is_evicted(ps_server):
+    """A barrier must not dangle on a corpse: evicting the missing peer
+    re-checks pending generations against the shrunken live count."""
+    evict_s = 0.6
+    port = ps_server(num_workers=2, evict_s=evict_s)
+    s0 = _session(port, 0, evict_s=evict_s)
+    s1 = _session(port, 1, evict_s=evict_s)
+    try:
+        a = np.arange(8, dtype=np.float32)
+        h0, h1 = s0.push_pull_async(1, a), s1.push_pull_async(1, a)
+        h0.wait(20), h1.wait(20)
+        s1.close()                      # peer dies before the barrier
+        t0 = time.monotonic()
+        s0.barrier(generation=5)        # releases once the corpse evicts
+        assert time.monotonic() - t0 < 5 * evict_s
+    finally:
+        s0.close()
+
+
+def test_late_joiner_passes_released_startup_barrier(ps_server):
+    """Barrier generations are one-shot open doors: a joiner arriving at
+    the gen-0 startup rendezvous AFTER the incumbents released it (the
+    documented bps.init() join path) passes immediately instead of
+    waiting forever for arrivals that will never come."""
+    port = ps_server(num_workers=2)
+    s0 = _session(port, 0)
+    s1 = _session(port, 1)
+    try:
+        t = threading.Thread(target=lambda: s1.barrier(generation=0))
+        t.start()
+        s0.barrier(generation=0)        # both arrive: gen 0 releases
+        t.join(timeout=10)
+        assert not t.is_alive()
+        s2 = _session(port, 2)          # the late joiner
+        try:
+            t0 = time.monotonic()
+            s2.barrier(generation=0)    # must pass straight through
+            assert time.monotonic() - t0 < 5
+        finally:
+            s2.close()
+    finally:
+        s0.close()
+        s1.close()
+
+
+def test_false_eviction_self_heals(ps_server):
+    """A worker evicted while its sockets stayed up (lease lapse from a
+    stall) must not become a silent zombie: the lease loop's self-check
+    detects the eviction and re-admits it via HELLO, after which its
+    pushes count again."""
+    evict_s = 0.6
+    port = ps_server(num_workers=2, evict_s=evict_s)
+    s0 = _session(port, 0, evict_s=evict_s)
+    s1 = _session(port, 1, evict_s=0.0)     # NO heartbeat: lease lapses
+    try:
+        a = np.arange(8, dtype=np.float32)
+        h0, h1 = s0.push_pull_async(1, a), s1.push_pull_async(1, a * 10)
+        h0.wait(20), h1.wait(20)
+        deadline = time.time() + 5 * evict_s
+        while time.time() < deadline:
+            if s0.membership()["alive"] == [0]:
+                break
+            time.sleep(0.05)
+        assert s0.membership()["alive"] == [0]   # w1 falsely evicted
+        # The self-check (run by the lease loop in a heartbeat-enabled
+        # session) re-admits; call it directly for determinism.
+        s1._readmit_if_evicted()
+        assert s0.membership()["alive"] == [0, 1]
+        h0 = s0.push_pull_async(1, a)
+        h1 = s1.push_pull_async(1, a * 10)
+        np.testing.assert_array_equal(h0.wait(20), a + a * 10)
+        np.testing.assert_array_equal(h1.wait(20), a + a * 10)
+    finally:
+        s0.close()
+        s1.close()
+
+
+def test_barrier_not_released_early_by_dead_arrival(ps_server):
+    """Identity-based barrier release: an evicted worker's stale arrival
+    must NOT fill the shrunken bar while a live worker is still on its
+    way — the group releases only once every live member has arrived."""
+    evict_s = 0.6
+    port = ps_server(num_workers=3, evict_s=evict_s)
+    s0 = _session(port, 0, evict_s=evict_s)
+    s1 = _session(port, 1, evict_s=evict_s)
+    s2 = _session(port, 2, evict_s=evict_s)
+    done = {}
+
+    def arrive(name, sess):
+        try:
+            sess.barrier(generation=9)
+            done[name] = "ok"
+        except Exception as e:
+            done[name] = e
+
+    try:
+        t1 = threading.Thread(target=arrive, args=("w1", s1))
+        t2 = threading.Thread(target=arrive, args=("w2", s2))
+        t1.start(), t2.start()
+        time.sleep(0.3)             # both arrivals registered server-side
+        s2.close()                  # worker 2 dies AFTER arriving
+        time.sleep(3 * evict_s)     # eviction has long since fired
+        # live={0,1}; arrivals={1,2}: 2 waiters >= 2 live, but worker 0
+        # has NOT arrived — the group must still be held open.
+        assert t1.is_alive(), "barrier released early on a dead arrival"
+        arrive("w0", s0)            # the missing live member arrives
+        t1.join(timeout=10)
+        assert not t1.is_alive() and done["w1"] == "ok" == done["w0"]
+        t2.join(timeout=10)
+    finally:
+        for s in (s0, s1):
+            s.close()
+
+
+def test_left_worker_reconnect_does_not_readmit(ps_server):
+    """A departed worker's transport reconnect (which re-sends HELLO, the
+    join door) must not re-admit it — only a NEW session is a rejoin."""
+    port = ps_server(num_workers=2)
+    s0 = _session(port, 0)
+    s1 = _session(port, 1, reconnect_attempts=3)
+    try:
+        a = np.arange(8, dtype=np.float32)
+        h0, h1 = s0.push_pull_async(1, a), s1.push_pull_async(1, a)
+        h0.wait(20), h1.wait(20)
+        s1.leave()
+        assert s0.membership()["alive"] == [0]
+        # Simulate the post-reconnect handshake the transport would run
+        # after a TCP blip: it must refuse to re-HELLO a left worker.
+        s1._on_conn_reconnected(s1.conns[0])
+        m = s0.membership()
+        assert m["alive"] == [0], m         # still gone
+        assert m["epoch"] == 1              # no re-admission epoch bump
+        # ...and the survivor's rounds still publish without worker 1.
+        np.testing.assert_array_equal(
+            s0.push_pull_async(1, a).wait(20), a)
+    finally:
+        s0.close()
+        s1.close()
+
+
+# ---------------------------------------------------------------------------
+# slow: chaos acceptance — permanent kill mid-training, then rejoin
+# ---------------------------------------------------------------------------
+@pytest.mark.slow
+def test_chaos_permanent_kill_survivors_bit_identical_then_rejoin(ps_server):
+    """The ISSUE's chaos acceptance: 3 workers mid-training, worker 2's
+    link is permanently killed (tools/chaos_proxy.py kill_permanently —
+    drop and never restore).  The job keeps running: open rounds
+    re-finalize within the evict timeout, no pull hangs, and the two
+    survivors' weight trajectories stay bit-identical to each other.  A
+    subsequent join brings the worker set back to 3 with correct sums in
+    the first post-join round."""
+    evict_s = 1.0
+    kill_after, total_rounds = 3, 9
+    port = ps_server(num_workers=3, evict_s=evict_s)
+    proxy = ChaosProxy("127.0.0.1", port).start()
+
+    dim = 64
+    rng = np.random.default_rng(7)
+    # Integer-valued f32 gradients: 3-way sums are then EXACT regardless
+    # of server merge (arrival) order, so the cross-worker equality and
+    # the post-join want-sum checks are order-independent — a
+    # standard_normal sum differs in the last ulp depending on which
+    # worker's push merged first.
+    grads = {(w, r): rng.integers(-8, 9, dim).astype(np.float32)
+             for w in range(3) for r in range(total_rounds + 2)}
+
+    trajectories = {0: [], 1: [], 2: []}
+    errors = []
+
+    def train(wid, sess, rounds):
+        w = np.zeros(dim, np.float32)
+        try:
+            for r in range(rounds):
+                if wid == 2 and r == kill_after:
+                    # The permanent kill, timed deterministically: the
+                    # victim's link dies right before its next push —
+                    # mid-training, with the other workers' round open.
+                    proxy.kill_permanently()
+                got = sess.push_pull_async(1, grads[(wid, r)]).wait(30)
+                w = w - np.float32(0.1) * got
+                trajectories[wid].append(w.copy())
+        except Exception as e:      # the killed worker dies here
+            errors.append((wid, e))
+
+    s0 = _session(port, 0, evict_s=evict_s)
+    s1 = _session(port, 1, evict_s=evict_s)
+    # Worker 2 rides the chaos proxy so its link can be killed for good.
+    s2 = PSSession(["127.0.0.1"], [proxy.port], worker_id=2, num_servers=1,
+                   wire_conns=1, evict_timeout_s=evict_s)
+    try:
+        threads = [
+            threading.Thread(target=train, args=(0, s0, total_rounds)),
+            threading.Thread(target=train, args=(1, s1, total_rounds)),
+            threading.Thread(target=train, args=(2, s2, total_rounds)),
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+            assert not t.is_alive()
+
+        # Survivors finished every round; the victim died en route.
+        assert len(trajectories[0]) == total_rounds
+        assert len(trajectories[1]) == total_rounds
+        assert len(trajectories[2]) < total_rounds
+        assert any(wid == 2 for wid, _ in errors)
+        # Bit-identical survivor trajectories, round by round.
+        for r, (w0, w1) in enumerate(zip(trajectories[0],
+                                         trajectories[1])):
+            assert np.array_equal(w0, w1), f"diverged at round {r}"
+
+        m = s0.membership()
+        assert m["workers"][2]["alive"] is False
+        assert m["alive"] == [0, 1]
+
+        # Rejoin: a replacement worker 2 (direct link) HELLOs back in;
+        # the first fully post-join round must sum all three.
+        s2b = _session(port, 2, evict_s=evict_s)
+        try:
+            assert s0.membership()["alive"] == [0, 1, 2]
+            r = total_rounds
+            h0 = s0.push_pull_async(1, grads[(0, r)])
+            h1 = s1.push_pull_async(1, grads[(1, r)])
+            h2 = s2b.push_pull_async(1, grads[(2, r)])
+            got0, got1, got2 = h0.wait(30), h1.wait(30), h2.wait(30)
+            assert np.array_equal(got0, got1)
+            assert np.array_equal(got0, got2)
+            # The joiner's very first push may be deferred to the next
+            # boundary if a round was still open; either way the NEXT
+            # round must be an exact 3-way sum.
+            r += 1
+            want = (grads[(0, r)] + grads[(1, r)] + grads[(2, r)])
+            h0 = s0.push_pull_async(1, grads[(0, r)])
+            h1 = s1.push_pull_async(1, grads[(1, r)])
+            h2 = s2b.push_pull_async(1, grads[(2, r)])
+            np.testing.assert_array_equal(h0.wait(30), want)
+            np.testing.assert_array_equal(h1.wait(30), want)
+            np.testing.assert_array_equal(h2.wait(30), want)
+        finally:
+            s2b.close()
+    finally:
+        for s in (s0, s1, s2):
+            try:
+                s.close()
+            except Exception:
+                pass
+        proxy.stop()

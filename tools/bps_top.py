@@ -152,12 +152,30 @@ def render(metrics: dict, prev: dict, dt: float) -> list:
 
     lag = metrics.get("bps_worker_round_lag") or {}
     if lag:
-        lines.append("workers (round lag — stragglers first)")
+        epoch = int(_get(metrics, "bps_membership_epoch"))
+        n_alive = int(_get(metrics, "bps_workers_alive"))
+        header = "workers (round lag — stragglers first)"
+        if epoch > 0:
+            header += f"   [membership epoch {epoch}, {n_alive} alive]"
+        lines.append(header)
+        # A lagging worker that is no longer a member is not slow — it is
+        # GONE (left/evicted); its rounds re-finalized and nothing waits
+        # on it.  Only a lagging LIVE worker deserves the straggler flag.
+        alive = {dict(k).get("worker"): v
+                 for k, v in (metrics.get("bps_worker_alive") or {}).items()}
         ranked = sorted(lag.items(), key=lambda kv: -kv[1])
+        worst_live = max((v for k, v in ranked
+                          if alive.get(dict(k).get("worker"), 1)),
+                         default=0)
         for key, v in ranked:
             wid = dict(key).get("worker", "?")
             bar = "#" * min(40, int(v))
-            flag = "  <-- straggler" if v > 0 and v == ranked[0][1] else ""
+            if not alive.get(wid, 1):
+                flag = "  <-- evicted/gone"
+            elif v > 0 and v == worst_live:
+                flag = "  <-- straggler"
+            else:
+                flag = ""
             lines.append(f"  worker {wid:>3}  lag {int(v):4d}  {bar}{flag}")
         lines.append("")
 

@@ -14,6 +14,8 @@ client/server wire code through a fault instead of mocking sockets.
     proxy.reset_after(4096)        # RST both sides after 4 KiB upstream
     proxy.blackhole(True)          # swallow everything, answer nothing
     proxy.kill_connections()       # drop every live conn right now
+    proxy.kill_permanently()       # drop AND refuse all future conns —
+                                   #   the peer is gone for good
     proxy.pass_through()           # clear all faults
 
 Faults are **one-shot** by default (fire once, then the link heals —
@@ -62,6 +64,7 @@ class ChaosProxy:
         self._fault: Optional[_Fault] = None
         self._delay_s = 0.0
         self._blackhole = False
+        self._refuse = False
         self._closing = False
         self._conns: list = []        # [(client_sock, server_sock)]
         self._accept_thread: Optional[threading.Thread] = None
@@ -70,6 +73,7 @@ class ChaosProxy:
         self._bytes_down = 0          # server -> client, forwarded
         self._bytes_eaten = 0         # swallowed by blackhole
         self._connections = 0
+        self._connections_refused = 0
         self._faults_fired = 0
 
     # -- lifecycle ----------------------------------------------------------
@@ -126,12 +130,31 @@ class ChaosProxy:
         with self._lock:
             self._blackhole = enabled
 
+    def refuse_new(self, enabled: bool = True) -> None:
+        """Refuse (RST) every NEW connection while enabled.  Live
+        connections keep flowing — combine with kill_connections() for a
+        full outage (see kill_permanently)."""
+        with self._lock:
+            self._refuse = enabled
+
+    def kill_permanently(self) -> None:
+        """Drop every live connection AND refuse all future ones: the
+        peer behind this proxy is gone for good (permanent worker loss /
+        decommissioned host), vs kill_connections()'s transient outage
+        where a reconnect succeeds.  What elastic-eviction tests use to
+        prove the job survives a worker that is never coming back.
+        pass_through() undoes it (the 'replacement hardware' scenario)."""
+        self.refuse_new(True)
+        self.kill_connections()
+
     def pass_through(self) -> None:
-        """Clear every armed fault (delay, blackhole, reset/drop)."""
+        """Clear every armed fault (delay, blackhole, reset/drop,
+        refuse-new)."""
         with self._lock:
             self._fault = None
             self._delay_s = 0.0
             self._blackhole = False
+            self._refuse = False
 
     def kill_connections(self, rst: bool = True) -> None:
         """Immediately drop every live proxied connection (RST by default);
@@ -146,6 +169,7 @@ class ChaosProxy:
         with self._lock:
             return {
                 "connections": self._connections,
+                "connections_refused": self._connections_refused,
                 "live_connections": len(self._conns),
                 "bytes_up": self._bytes_up,
                 "bytes_down": self._bytes_down,
@@ -164,8 +188,19 @@ class ChaosProxy:
                 if self._closing:
                     client.close()
                     return
-                self._connections += 1
-                hole = self._blackhole
+                if self._refuse:
+                    # Permanent-kill mode: the peer is gone — every dial
+                    # gets an immediate RST, so reconnect loops burn their
+                    # backoff budget instead of finding a healed link.
+                    self._connections_refused += 1
+                    refuse = True
+                else:
+                    refuse = False
+                    self._connections += 1
+                    hole = self._blackhole
+            if refuse:
+                self._hard_close(client, rst=True)
+                continue
             if hole:
                 # Accept but never dial upstream: the connection looks
                 # alive to the client while everything it sends vanishes.
@@ -302,6 +337,9 @@ def main() -> int:
                     help="FIN connections after N upstream bytes")
     ap.add_argument("--blackhole", action="store_true",
                     help="swallow all traffic silently")
+    ap.add_argument("--kill-permanent", action="store_true",
+                    help="drop every connection and refuse all new ones "
+                         "(the peer is gone for good)")
     ap.add_argument("--flap", action="store_true",
                     help="re-arm the reset/drop fault for every connection "
                          "(default: fire once, then heal)")
@@ -317,6 +355,8 @@ def main() -> int:
         proxy.drop_after(args.drop_after, once=not args.flap)
     if args.blackhole:
         proxy.blackhole(True)
+    if args.kill_permanent:
+        proxy.kill_permanently()
     print(f"chaos proxy: {args.listen_host}:{proxy.port} -> "
           f"{host}:{port}", flush=True)
     try:
