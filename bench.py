@@ -773,10 +773,67 @@ def bench_fault():
         proc.wait()
 
 
+def _boot_ring_servers(n: int, engine_threads: int = 2,
+                       extra_env: dict = None):
+    """Start `n` ring-armed PS servers on consecutive ports (the
+    root+1+id convention both the servers' peer book and the workers
+    derive).  Returns (procs, ports); retries the whole group on a port
+    collision."""
+    import socket
+    import subprocess
+    import sys
+
+    from byteps_tpu.utils.hermetic import cpu_subprocess_env
+
+    for _ in range(4):
+        with socket.socket() as sk:
+            sk.bind(("127.0.0.1", 0))
+            base = sk.getsockname()[1]
+        ports = [base + i for i in range(n)]
+        procs = []
+        ok = True
+        for i in range(n):
+            env = cpu_subprocess_env({
+                "DMLC_PS_ROOT_PORT": str(base - 1),
+                "DMLC_NUM_WORKER": "1",
+                "DMLC_NUM_SERVER": str(n),
+                "DMLC_SERVER_ID": str(i),
+                "BYTEPS_TPU_RING": "1",
+                "BYTEPS_SERVER_ENGINE_THREAD": str(engine_threads),
+                **(extra_env or {}),
+            })
+            procs.append(subprocess.Popen(
+                [sys.executable, "-m", "byteps_tpu.server"], env=env,
+                stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL))
+        deadline = time.time() + 30
+        up = set()
+        while time.time() < deadline and len(up) < n:
+            for i, p in enumerate(ports):
+                if i in up:
+                    continue
+                try:
+                    socket.create_connection(("127.0.0.1", p), 0.5).close()
+                    up.add(i)
+                except OSError:
+                    if procs[i].poll() is not None:
+                        ok = False
+                        break
+            if not ok:
+                break
+            time.sleep(0.1)
+        if ok and len(up) == n:
+            return procs, ports
+        for p in procs:
+            p.kill()
+            p.wait()
+    raise RuntimeError(f"could not boot {n} ring servers")
+
+
 def bench_elastic():
     """Elastic-membership benchmark (BENCH_ELASTIC=1): wall-clock cost of
-    the two transitions an autoscaled/preempted fleet pays.
+    the transitions an autoscaled/preempted fleet pays — both halves.
 
+    Worker half (PR 7):
     `evict_detect_ms`: 2 workers mid-training with lease eviction armed
     (BYTEPS_TPU_EVICT_TIMEOUT_S = BENCH_ELASTIC_EVICT_S, default 0.5);
     worker 1 dies without notice, and the value is how long worker 0's
@@ -787,7 +844,19 @@ def bench_elastic():
     `join_catchup_ms`: a replacement worker then HELLOs in while the
     survivor keeps stepping; the value is session construction -> its
     first completed push_pull (epoch admission + INIT round rebase +
-    first post-join round).  Host-only, like BENCH_FAULT.
+    first post-join round).
+
+    Server half (elastic PS ring):
+    `migration_ms`: 2 ring-armed servers; server 1 is gracefully drained
+    (bps-level drain_server: state handoff + redirect) and the value is
+    the drain call plus the first post-drain round, minus a healthy
+    round — the availability cost of scaling the PS tier down by one.
+
+    `server_failover_ms`: 2 ring-armed servers with the worker-side
+    server-lease scanner armed; server 1 is SIGKILLed mid-job and the
+    value is how long the next round blocks until the scanner declares
+    it dead, the survivors claim its key ranges, and the open round
+    re-pushes — minus a healthy round.  Host-only, like BENCH_FAULT.
     """
     import threading
 
@@ -874,6 +943,102 @@ def bench_elastic():
     finally:
         proc.kill()
         proc.wait()
+
+    # ---- server half: graceful drain (migration) ------------------------
+    import numpy as np
+    from byteps_tpu.server.client import PSSession
+
+    def ring_session(ports, srv_evict=0.0):
+        return PSSession(["127.0.0.1"] * len(ports), ports, worker_id=0,
+                         num_servers=len(ports), wire_conns=1, ring=True,
+                         server_evict_timeout_s=srv_evict,
+                         partition_bytes=1 << 18)
+
+    # Several 256 KiB keys so both servers own a share of the ring.
+    keys = list(range(1, 9))
+    x = np.random.default_rng(0).standard_normal(1 << 16,
+                                                 dtype=np.float32)
+
+    def round_all(sess, timeout=60):
+        hs = [sess.push_pull_async(k, x) for k in keys]
+        for h in hs:
+            h.wait(timeout)
+
+    procs, ports = _boot_ring_servers(2)
+    try:
+        sess = ring_session(ports)
+        for _ in range(3):                   # init + warm
+            round_all(sess)
+        t0 = time.perf_counter()
+        round_all(sess)
+        healthy_ms = (time.perf_counter() - t0) * 1e3
+
+        t0 = time.perf_counter()
+        drain_doc = sess.drain_server(1)
+        round_all(sess)                      # first fully re-homed round
+        migration_ms = (time.perf_counter() - t0) * 1e3 - healthy_ms
+        stats = sess.transport_stats()
+        sess.close()
+        print(json.dumps({
+            "metric": "migration_ms",
+            "value": round(migration_ms, 1),
+            "unit": "ms",
+            "vs_baseline": round(migration_ms / max(healthy_ms, 1e-3), 2),
+            "detail": {
+                "healthy_round_ms": round(healthy_ms, 1),
+                "keys": len(keys),
+                "ring_epoch": drain_doc.get("epoch"),
+                "ring_redirects": stats.get("ring_redirects", 0),
+                "note": "drain_server(1) (state handoff via CMD_MIGRATE "
+                        "+ kMoved redirects) plus the first post-drain "
+                        "round, minus a healthy round",
+                **_note(),
+            },
+        }))
+    finally:
+        for p in procs:
+            p.kill()
+            p.wait()
+
+    # ---- server half: failover (permanent server death) -----------------
+    procs, ports = _boot_ring_servers(2)
+    try:
+        sess = ring_session(ports, srv_evict=evict_s)
+        for _ in range(3):
+            round_all(sess)
+        t0 = time.perf_counter()
+        round_all(sess)
+        healthy_ms = (time.perf_counter() - t0) * 1e3
+
+        procs[1].kill()                      # the PS process is GONE
+        procs[1].wait()
+        t0 = time.perf_counter()
+        round_all(sess, timeout=120)         # blocks until failover lands
+        server_failover_ms = (time.perf_counter() - t0) * 1e3 - healthy_ms
+        stats = sess.transport_stats()
+        ring_epoch = sess.get_ring().get("epoch")
+        sess.close()
+        print(json.dumps({
+            "metric": "server_failover_ms",
+            "value": round(server_failover_ms, 1),
+            "unit": "ms",
+            "vs_baseline": round(server_failover_ms / (evict_s * 1e3), 2),
+            "detail": {
+                "healthy_round_ms": round(healthy_ms, 1),
+                "server_evict_timeout_s": evict_s,
+                "ring_epoch": ring_epoch,
+                "server_failovers": stats.get("server_failovers", 0),
+                "replayed_pushes": stats.get("replayed_pushes", 0),
+                "note": "SIGKILL of 1-of-2 ring servers; value = blocked "
+                        "round (down-detect + ring epoch + re-declare + "
+                        "open-round re-push) minus a healthy round",
+                **_note(),
+            },
+        }))
+    finally:
+        for p in procs:
+            p.kill()
+            p.wait()
 
 
 def bench_telemetry():

@@ -16,6 +16,7 @@ from .common.api import (
     init, shutdown, suspend, resume,
     rank, size, local_rank, local_size,
     leave, get_membership, on_membership_change,
+    get_ring, drain_ps_server,
     declare, declared_key, register_compressor, get_ps_session,
     push_pull, push_pull_async, push_pull_tree, synchronize, poll,
     broadcast_parameters, broadcast_optimizer_state,
@@ -58,6 +59,7 @@ __all__ = [
     "init", "shutdown", "suspend", "resume",
     "rank", "size", "local_rank", "local_size",
     "leave", "get_membership", "on_membership_change",
+    "get_ring", "drain_ps_server",
     "declare", "declared_key", "register_compressor", "get_ps_session",
     "push_pull", "push_pull_async", "push_pull_tree", "synchronize",
     "poll", "AsyncPSTrainer",

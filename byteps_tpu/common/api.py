@@ -375,6 +375,44 @@ def leave(drain_timeout_s: float = 60.0) -> None:
     _state.ps_session.leave(drain_timeout_s)
 
 
+def get_ring() -> dict:
+    """The elastic PS server ring (CMD_RING): epoch, vnodes, member
+    (id, host, port) rows, per-server keys_owned and draining flags.
+    Requires PS mode with the ring armed (``BYTEPS_TPU_RING=1``);
+    returns a fixed single-epoch synthetic view otherwise.  A pre-ring
+    server surfaces as a clean "server too old" error, never a hang."""
+    _require_init()
+    sess = _state.ps_session
+    if sess is None or not getattr(sess, "ring_armed", False):
+        cfg = _state.config or get_config()
+        n = max(1, cfg.num_server) if sess is not None else 0
+        return {"epoch": 0, "armed": 0, "vnodes": cfg.ring_vnodes,
+                "servers": [{"id": i} for i in range(n)]}
+    return sess.get_ring()
+
+
+def drain_ps_server(server_id: int, timeout_s: float = 120.0,
+                    shutdown: bool = False) -> dict:
+    """Gracefully scale the PS tier down by one server (CMD_DRAIN).
+
+    The target streams every owned key's state — declared meta, merge
+    store, published round, completed_round, the open round's
+    contributor set — to its new consistent-hash owner, then answers
+    every later frame with a redirect; sums are exact across the
+    migration boundary.  Blocks until the target owns zero keys;
+    ``shutdown=True`` also retires the process.  Requires PS mode with
+    the ring armed (``BYTEPS_TPU_RING=1`` on workers and servers).
+    Call it from ONE worker (the autoscaler's controller); the rest
+    discover the new epoch through redirects and re-plan on their own.
+    """
+    _require_init()
+    if _state.ps_session is None:
+        raise RuntimeError(
+            "bps.drain_ps_server() requires PS mode (BYTEPS_TPU_PS_MODE=1)")
+    return _state.ps_session.drain_server(server_id, timeout_s=timeout_s,
+                                          shutdown=shutdown)
+
+
 def get_membership(refresh: bool = True) -> dict:
     """The current worker membership: ``{"epoch", "workers": {id:
     {"alive", "age_ms"}}, "alive": [ids], "barrier": {...}}``.
@@ -958,6 +996,11 @@ def get_server_stats() -> dict:
         # an evicted worker from a slow one.  Old servers omit it.
         telemetry.update_membership(
             {"epoch": stats.get("epoch", 0), "workers": stats["members"]})
+    if stats.get("servers"):
+        # Elastic PS ring: feed bps_ring_epoch / bps_server_alive /
+        # bps_keys_owned so every scrape can tell a dead or draining
+        # server from a slow one.  Old servers omit these keys.
+        telemetry.update_ring(stats)
     return stats
 
 

@@ -114,6 +114,22 @@ class Config:
     # membership view (CMD_MEMBERS).  Only runs while a callback is
     # registered — an unregistered fixed job sends no extra traffic.
     membership_poll_s: float = 2.0           # BYTEPS_TPU_MEMBERSHIP_POLL_S
+    # Elastic PS server tier (docs/elasticity.md "The server half").
+    # ring=True arms consistent-hash key placement (common/ring.py) on
+    # workers AND servers — required for drain / scale-up / failover;
+    # off (default) keeps the legacy fixed hash and a wire byte-identical
+    # to pre-ring.  ring_vnodes is the virtual-node count per server
+    # (placement granularity; must agree across the fleet).
+    ring: bool = False                       # BYTEPS_TPU_RING
+    ring_vnodes: int = 64                    # BYTEPS_TPU_RING_VNODES
+    # Server failover: > 0 arms the worker-side server-lease scanner — a
+    # ring member whose every connection has been down this long is
+    # declared dead, the survivors adopt the next ring epoch and claim
+    # its key ranges, and the open round re-pushes from gradient state.
+    # Implies ring placement.  0 (default): a dead server wedges its
+    # keys until the stall watchdog fails them loudly (pre-ring
+    # semantics).
+    server_evict_timeout_s: float = 0.0      # BYTEPS_TPU_SERVER_EVICT_TIMEOUT_S
     server_engine_threads: int = 4           # BYTEPS_SERVER_ENGINE_THREAD
     server_enable_schedule: bool = False     # BYTEPS_SERVER_ENABLE_SCHEDULE
     enable_async: bool = False               # BYTEPS_ENABLE_ASYNC
@@ -200,6 +216,11 @@ class Config:
                 os.environ.get("BYTEPS_TPU_EVICT_TIMEOUT_S") or 0.0),
             membership_poll_s=float(
                 os.environ.get("BYTEPS_TPU_MEMBERSHIP_POLL_S") or 2.0),
+            ring=_env_bool("BYTEPS_TPU_RING"),
+            ring_vnodes=_env_int("BYTEPS_TPU_RING_VNODES", 64),
+            server_evict_timeout_s=float(
+                os.environ.get("BYTEPS_TPU_SERVER_EVICT_TIMEOUT_S")
+                or 0.0),
             server_engine_threads=_env_int("BYTEPS_SERVER_ENGINE_THREAD", 4),
             server_enable_schedule=_env_bool("BYTEPS_SERVER_ENABLE_SCHEDULE"),
             enable_async=_env_bool("BYTEPS_ENABLE_ASYNC"),

@@ -417,6 +417,40 @@ def update_membership(membership: dict, registry: Optional[MetricsRegistry]
                   ).set(1 if rec.get("alive") else 0)
 
 
+def update_ring(server_stats: dict, registry: Optional[MetricsRegistry]
+                = None) -> None:
+    """Fold the elastic PS-ring view from a merged CMD_STATS payload
+    into the registry gauges.
+
+    Exports ``bps_ring_epoch`` (the server-ring epoch; 0 = launch set,
+    never re-sharded), ``bps_server_alive{server=}`` (1 = reachable ring
+    member) and ``bps_keys_owned{server=}`` (keys whose live state the
+    server holds — during a drain this runs to zero on the leaver and
+    climbs on its inheritors, the migration-progress signal), plus
+    ``bps_server_migrations{server=,direction=}`` counters-as-gauges for
+    the in/out handoff totals.  A fixed-topology job exports epoch 0 and
+    whatever its launch servers report.
+    """
+    reg = registry or get_registry()
+    reg.gauge("bps_ring_epoch",
+              help="elastic PS ring epoch (0 = launch placement, never "
+                   "re-sharded)").set(int(server_stats.get("ring_epoch",
+                                                           0)))
+    for sid, rec in (server_stats.get("servers") or {}).items():
+        lbl = {"server": str(sid)}
+        reg.gauge("bps_server_alive",
+                  help="1 = reachable PS ring member, 0 = dead/retired",
+                  labels=lbl).set(1 if rec.get("alive") else 0)
+        reg.gauge("bps_keys_owned",
+                  help="keys whose live merge state this server holds",
+                  labels=lbl).set(int(rec.get("keys_owned", 0)))
+        for direction in ("in", "out"):
+            reg.gauge("bps_server_migrations",
+                      help="keys migrated across ring transitions",
+                      labels={"server": str(sid), "direction": direction}
+                      ).set(int(rec.get(f"migrations_{direction}", 0)))
+
+
 def update_round_lag(server_stats: dict, straggler_rounds: int,
                      registry: Optional[MetricsRegistry] = None
                      ) -> Dict[int, int]:

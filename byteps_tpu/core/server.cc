@@ -103,6 +103,37 @@ enum Cmd : uint8_t {
                  // alive flag + last-seen age, and the worker ids arrived
                  // at each pending barrier generation, as JSON.  Reader
                  // thread, same old-server error path as kStats.
+  kRing = 12,    // ring-table read (CMD_RING): the epoch-versioned
+                 // consistent-hash server ring — epoch, vnodes, member
+                 // (id, host, port) rows, draining flag, keys_owned — as
+                 // JSON (flags bit0 = binary instead, the joiner's
+                 // C++-side read).  Reader thread; an OLD server answers
+                 // kError via the engine default arm, which clients turn
+                 // into "server too old".
+  kRingSet = 13, // ring-table write (CMD_RING_SET): binary next-epoch
+                 // ring (common/ring.py RingTable.to_wire).  Applied only
+                 // when the proposed epoch is NEWER than the local one
+                 // (idempotent under racing proposers — every worker that
+                 // observed the same server death proposes the same
+                 // transition); the response is the resulting ring JSON
+                 // either way, so a stale proposer converges on the
+                 // authoritative table.  Applying fans a reshard task to
+                 // every engine: keys whose new owner is another live
+                 // server stream their state there (CMD_MIGRATE) and
+                 // retire locally.
+  kDrain = 14,   // graceful scale-down (CMD_DRAIN): CMD_RING_SET whose
+                 // member set excludes THIS server, plus the draining
+                 // mark.  From then on every owned key is migrated to its
+                 // new owner (synchronously, state-before-redirect) and
+                 // the frame that found it answered kMoved — "stop
+                 // accepting new rounds, hand the state over, retire".
+  kMigrate = 15, // server->server state handoff (CMD_MIGRATE): one key's
+                 // full merge state — declared meta, merge store, the
+                 // published `out` buffer, completed_round, seen /
+                 // round_members (the pending open round), EF error —
+                 // installed atomically on the receiving key's engine
+                 // thread.  Sent with worker_id 0xFFFFFFFF so a migration
+                 // can never touch worker leases.
 };
 
 // Engine-internal task (never on the wire, far above any Cmd value): a
@@ -111,7 +142,17 @@ enum Cmd : uint8_t {
 // payload snapshots the transition (see MembershipTransition), so the
 // handler never reads the live membership table.
 enum : uint8_t { kMembershipTask = 200 };
-enum Status : uint8_t { kOk = 0, kError = 1 };
+// Engine-internal ring-reshard task (never on the wire): fanned to every
+// engine when a new ring epoch lands, so each engine migrates the keys IT
+// owns whose new ring owner is another server — per-key state mutates
+// only on its owning thread, exactly like kMembershipTask.
+enum : uint8_t { kRingTask = 201 };
+// kMoved: this server is not (or no longer) the ring owner of the frame's
+// key.  The response payload is the CURRENT ring table as JSON, so the
+// client re-plans and re-routes without an extra round trip.  Emitted
+// only once the ring epoch has advanced past 0 — a fixed-topology job
+// (and any pre-ring client) never sees status 2.
+enum Status : uint8_t { kOk = 0, kError = 1, kMoved = 2 };
 
 // Header `flags` bit 15: this frame is inside the sending worker's trace
 // window.  PUSH/PULL frames carry their round in the LOW 15 BITS always;
@@ -719,6 +760,45 @@ inline int64_t NowUs() {
       .count();
 }
 
+// ---------------------------------------------------------------------------
+// Consistent-hash ring — the server half of the ONE placement law shared
+// with the workers (common/ring.py; parity asserted by
+// tests/test_server_elastic.py through bps_ring_owner).  A key is owned
+// by the server whose first virtual-node point is at-or-after the key's
+// point on a 64-bit ring (wrapping).  Removing a server moves only ITS
+// keys; adding one moves ~1/N of the keys, all TO the joiner — which is
+// what makes state handoff a one-directional stream.
+// ---------------------------------------------------------------------------
+namespace ring {
+
+inline uint64_t Mix64(uint64_t x) {
+  // splitmix64 — bit-identical to common/ring.py splitmix64().
+  x += 0x9E3779B97F4A7C15ULL;
+  x = (x ^ (x >> 30)) * 0xBF58476D1CE4E5B9ULL;
+  x = (x ^ (x >> 27)) * 0x94D049BB133111EBULL;
+  return x ^ (x >> 31);
+}
+
+inline uint64_t VnodePoint(uint32_t id, uint32_t v) {
+  return Mix64(((static_cast<uint64_t>(id) + 1) << 32) | v);
+}
+
+inline uint64_t KeyPoint(uint64_t key) { return Mix64(key); }
+
+// Owner of `key` among sorted (point, id) rows: first point >= the key's
+// point, wrapping to the smallest.
+inline uint32_t Owner(uint64_t key,
+                      const std::vector<std::pair<uint64_t, uint32_t>>&
+                          points) {
+  uint64_t kp = KeyPoint(key);
+  auto it = std::lower_bound(points.begin(), points.end(),
+                             std::make_pair(kp, uint32_t{0}));
+  if (it == points.end()) it = points.begin();
+  return it->second;
+}
+
+}  // namespace ring
+
 struct TraceSpan {
   const char* stage = "";  // static strings only ("RECV", "SUM", ...)
   uint64_t key = 0;
@@ -920,6 +1000,12 @@ struct KeyState {
   // scatter is an allocation/copy optimization, never a semantic change.
   std::atomic<bool> scatter_leased{false};
   std::vector<char> scatter_buf;
+  // Live state marker for the elastic ring: set by INIT/push/migrate-in,
+  // cleared by migrate-out.  Drives the keys_owned gauge and tells the
+  // kMoved path whether there is state to hand over before redirecting.
+  // Atomic because the reader-thread stats path counts it while engines
+  // flip it.
+  std::atomic<bool> active{false};
 };
 
 struct Task {
@@ -1070,6 +1156,90 @@ class Server {
     const int64_t now = NowUs();
     for (int i = 0; i < num_workers_; ++i)
       members_[static_cast<uint32_t>(i)] = MemberRec{now, true};
+    // Elastic PS tier (consistent-hash ring).  BYTEPS_TPU_RING=1 arms
+    // ring placement + ownership enforcement; BYTEPS_TPU_RING_JOIN=1
+    // additionally makes this a JOINING server (it announces itself to
+    // the launch peers at startup and the ring re-shards ~1/N of the
+    // keys onto it).  Unarmed (default), no ring state exists, status
+    // kMoved is never emitted, and the wire is byte-identical to the
+    // pre-ring server.
+    auto truthy = [](const char* v) {
+      return v && v[0] && !(v[0] == '0' && v[1] == '\0');
+    };
+    ring_join_ = truthy(std::getenv("BYTEPS_TPU_RING_JOIN"));
+    ring_armed_ = ring_join_ || truthy(std::getenv("BYTEPS_TPU_RING"));
+    const char* sid = std::getenv("DMLC_SERVER_ID");
+    if (sid && sid[0])
+      my_server_id_ = static_cast<uint32_t>(std::strtoul(sid, nullptr, 10));
+    const char* vn = std::getenv("BYTEPS_TPU_RING_VNODES");
+    if (vn && vn[0]) {
+      char* end = nullptr;
+      uint64_t v = std::strtoull(vn, &end, 10);
+      if (end && *end == '\0' && v > 0 && v <= 4096)
+        ring_vnodes_ = static_cast<int>(v);
+      else
+        std::fprintf(stderr,
+                     "[byteps server] ignoring invalid "
+                     "BYTEPS_TPU_RING_VNODES=%s (want 1..4096)\n", vn);
+    }
+    if (ring_armed_) {
+      // Peer address book: BYTEPS_TPU_RING_PEERS="host:port,host:port"
+      // (index = server id), else the single-host convention the workers
+      // use — 127.0.0.1:(DMLC_PS_ROOT_PORT + 1 + id) for the
+      // DMLC_NUM_SERVER launch servers.  First-seen addresses are
+      // sticky: a worker-proposed RING_SET can never redirect
+      // server-to-server migrations through a worker-side chaos proxy.
+      const char* root = std::getenv("DMLC_PS_ROOT_PORT");
+      int root_port = root && root[0] ? std::atoi(root) : 9000;
+      const char* ns = std::getenv("DMLC_NUM_SERVER");
+      int num_server = ns && ns[0] ? std::atoi(ns) : 1;
+      const char* peers = std::getenv("BYTEPS_TPU_RING_PEERS");
+      if (peers && peers[0]) {
+        std::string s(peers);
+        size_t pos = 0;
+        uint32_t id = 0;
+        while (pos <= s.size()) {
+          size_t comma = s.find(',', pos);
+          std::string one = s.substr(
+              pos, comma == std::string::npos ? std::string::npos
+                                              : comma - pos);
+          size_t colon = one.rfind(':');
+          if (colon != std::string::npos)
+            peer_book_[id++] = {one.substr(0, colon),
+                                std::atoi(one.c_str() + colon + 1)};
+          if (comma == std::string::npos) break;
+          pos = comma + 1;
+        }
+      } else {
+        for (int i = 0; i < num_server; ++i)
+          peer_book_[static_cast<uint32_t>(i)] =
+              {"127.0.0.1", root_port + 1 + i};
+      }
+      // Advertised address for migrations TO this server (the joiner
+      // announces it in its RING_SET).
+      advertise_host_ = "127.0.0.1";
+      advertise_port_ = port_;
+      const char* adv = std::getenv("BYTEPS_TPU_RING_ADVERTISE");
+      if (adv && adv[0]) {
+        std::string a(adv);
+        size_t colon = a.rfind(':');
+        if (colon != std::string::npos) {
+          advertise_host_ = a.substr(0, colon);
+          advertise_port_ = std::atoi(a.c_str() + colon + 1);
+        }
+      }
+      if (!ring_join_) {
+        // Launch ring, epoch 0: the DMLC_NUM_SERVER launch set.  The
+        // epoch mirror stays 0, so ownership is NOT enforced yet —
+        // workers armed with the same law already place by this ring,
+        // and enforcement only matters once a transition can strand a
+        // frame on a stale owner.
+        for (auto& kv : peer_book_)
+          ring_members_.push_back(
+              RingServer{kv.first, kv.second.first, kv.second.second});
+        RebuildRingPointsLocked();
+      }
+    }
   }
 
   int Run() {
@@ -1130,7 +1300,13 @@ class Server {
       }
     }
 
+    // Joining server: announce once the listeners are up, so migrations
+    // streaming back land on a live acceptor.
+    std::thread join_thread;
+    if (ring_join_) join_thread = std::thread(&Server::JoinLoop, this);
+
     AcceptLoop(listen_fd_, true);
+    if (join_thread.joinable()) join_thread.join();
     if (lease_thread.joinable()) lease_thread.join();
     if (uds_acceptor.joinable()) uds_acceptor.join();
     if (uds_listen_fd_ >= 0) {
@@ -1157,6 +1333,11 @@ class Server {
         delete c;
       }
       conns_.clear();
+    }
+    {
+      std::lock_guard<std::mutex> lk(peer_mu_);
+      for (auto& kv : peer_fds_) close(kv.second);
+      peer_fds_.clear();
     }
     close(listen_fd_);
     return 0;
@@ -1361,16 +1542,21 @@ class Server {
   }
 
   std::string StatsJson() {
-    // Worst-case keys row: 6 u64 fields at 20 digits + ~110 chars of
-    // labels — keep comfortable headroom (snprintf truncation would
-    // silently corrupt the JSON).
-    char buf[320];
+    // Worst-case row: the header now carries ~13 numeric fields at up
+    // to 20 digits + ~270 chars of labels — keep comfortable headroom
+    // (snprintf truncation would silently corrupt the JSON).
+    char buf[640];
     std::string js;
     js.reserve(4096);
+    const uint64_t keys_owned = ring_armed_ ? KeysOwned() : 0;
     std::snprintf(buf, sizeof(buf),
                   "{\"bytes_in\":%llu,\"bytes_out\":%llu,\"async\":%d,"
                   "\"num_workers\":%d,\"scatter_frames\":%llu,"
-                  "\"epoch\":%llu,\"deferred_joins\":%llu,\"keys\":{",
+                  "\"epoch\":%llu,\"deferred_joins\":%llu,"
+                  "\"server_id\":%u,\"ring_armed\":%d,\"ring_epoch\":%llu,"
+                  "\"draining\":%d,\"keys_owned\":%llu,"
+                  "\"migrations_in\":%llu,\"migrations_out\":%llu,"
+                  "\"moved_frames\":%llu,\"keys\":{",
                   static_cast<unsigned long long>(
                       bytes_in_.load(std::memory_order_relaxed)),
                   static_cast<unsigned long long>(
@@ -1381,7 +1567,18 @@ class Server {
                   static_cast<unsigned long long>(
                       epoch_atomic_.load(std::memory_order_acquire)),
                   static_cast<unsigned long long>(
-                      deferred_joins_.load(std::memory_order_relaxed)));
+                      deferred_joins_.load(std::memory_order_relaxed)),
+                  my_server_id_, ring_armed_ ? 1 : 0,
+                  static_cast<unsigned long long>(
+                      ring_epoch_atomic_.load(std::memory_order_acquire)),
+                  draining_ ? 1 : 0,
+                  static_cast<unsigned long long>(keys_owned),
+                  static_cast<unsigned long long>(
+                      migrations_in_.load(std::memory_order_relaxed)),
+                  static_cast<unsigned long long>(
+                      migrations_out_.load(std::memory_order_relaxed)),
+                  static_cast<unsigned long long>(
+                      moved_frames_.load(std::memory_order_relaxed)));
     js += buf;
     std::lock_guard<std::mutex> lk(stats_mu_);
     bool first = true;
@@ -1708,6 +1905,680 @@ class Server {
     }
   }
 
+  // --- elastic PS ring ------------------------------------------------
+  // The server tier's own membership: an epoch-versioned consistent-hash
+  // ring (see the `ring` namespace for the shared law).  Transitions are
+  // CMD_RING_SET/CMD_DRAIN writes carrying the full next-epoch table;
+  // applied tables fan a reshard task per engine so owned-but-no-longer-
+  // mine keys stream their state to the new owner (CMD_MIGRATE) before
+  // any redirect is issued — state-before-redirect is what makes drain
+  // and scale-up exact.  ring_epoch_atomic_ mirrors the epoch for the
+  // lock-free fixed-mode short-circuit on the data path.
+  struct RingServer {
+    uint32_t id;
+    std::string host;
+    int port;
+  };
+
+  void RebuildRingPointsLocked() {
+    auto pts = std::make_shared<
+        std::vector<std::pair<uint64_t, uint32_t>>>();
+    for (auto& m : ring_members_)
+      for (int v = 0; v < ring_vnodes_; ++v)
+        pts->emplace_back(
+            ring::VnodePoint(m.id, static_cast<uint32_t>(v)), m.id);
+    std::sort(pts->begin(), pts->end());
+    // Published via atomic shared_ptr so the PER-FRAME ownership check
+    // never takes ring_mu_: after the first transition every
+    // INIT/PUSH/PULL consults the table, and serializing all engines
+    // through one mutex for the rest of the run would undo the epoch-0
+    // fast path's whole point.
+    std::shared_ptr<const std::vector<std::pair<uint64_t, uint32_t>>>
+        cpts = std::move(pts);
+    std::atomic_store_explicit(&ring_points_, std::move(cpts),
+                               std::memory_order_release);
+  }
+
+  std::shared_ptr<const std::vector<std::pair<uint64_t, uint32_t>>>
+  RingPoints() {
+    return std::atomic_load_explicit(&ring_points_,
+                                     std::memory_order_acquire);
+  }
+
+  // True when this server must NOT process frames for `key` (the ring
+  // has advanced and another server owns it — or this server is
+  // draining, in which case it is no longer a member at all).  The data
+  // path pays one atomic load until the first transition, and a
+  // lock-free point-table read plus one binary search after it.
+  bool RingMisplaced(uint64_t key) {
+    if (!ring_armed_) return false;
+    if (ring_epoch_atomic_.load(std::memory_order_acquire) == 0)
+      return false;
+    auto pts = RingPoints();
+    if (!pts || pts->empty()) return false;
+    return ring::Owner(key, *pts) != my_server_id_;
+  }
+
+  uint64_t KeysOwned() {
+    std::lock_guard<std::mutex> lk(store_mu_);
+    uint64_t n = 0;
+    for (auto& kv : store_)
+      if (kv.second.active.load(std::memory_order_relaxed)) ++n;
+    return n;
+  }
+
+  // Ring table as JSON (CMD_RING response and every kMoved payload).
+  // `include_owned=false` skips the full-store KeysOwned() scan — the
+  // kMoved path emits this per redirected frame, and clients never read
+  // keys_owned from a MOVED payload (only CMD_RING polls do).
+  std::string RingJson(bool include_owned = true) {
+    const uint64_t owned = include_owned ? KeysOwned() : 0;
+    char buf[512];                        // store_mu_ released before
+    //                                       ring_mu_ — never nested.
+    // 512 covers the worst-case row (a 255-byte host + labels) and the
+    // worst-case header; snprintf truncation would silently corrupt the
+    // JSON every worker redirect depends on.
+    std::string js;
+    js.reserve(256);
+    std::lock_guard<std::mutex> lk(ring_mu_);
+    std::snprintf(buf, sizeof(buf),
+                  "{\"epoch\":%llu,\"vnodes\":%d,\"armed\":%d,"
+                  "\"draining\":%d,\"server_id\":%u,\"keys_owned\":%llu,"
+                  "\"migrations_in\":%llu,\"migrations_out\":%llu,"
+                  "\"servers\":[",
+                  static_cast<unsigned long long>(ring_epoch_),
+                  ring_vnodes_, ring_armed_ ? 1 : 0, draining_ ? 1 : 0,
+                  my_server_id_, static_cast<unsigned long long>(owned),
+                  static_cast<unsigned long long>(
+                      migrations_in_.load(std::memory_order_relaxed)),
+                  static_cast<unsigned long long>(
+                      migrations_out_.load(std::memory_order_relaxed)));
+    js += buf;
+    bool first = true;
+    for (auto& m : ring_members_) {
+      std::snprintf(buf, sizeof(buf),
+                    "%s{\"id\":%u,\"host\":\"%s\",\"port\":%d}",
+                    first ? "" : ",", m.id, m.host.c_str(), m.port);
+      js += buf;
+      first = false;
+    }
+    js += "]}";
+    return js;
+  }
+
+  // Binary ring table (the CMD_RING_SET payload format,
+  // common/ring.py RingTable.to_wire): u64 epoch | u32 vnodes | u32 n |
+  // n x (u32 id | u16 port | u8 host_len | host).  Shared by the
+  // joiner's peer read (CMD_RING flags bit0) and the write parse.
+  std::string RingWire() {
+    std::lock_guard<std::mutex> lk(ring_mu_);
+    std::string out;
+    char hdr[16];
+    uint64_t ep = ring_epoch_;
+    uint32_t vn = static_cast<uint32_t>(ring_vnodes_);
+    uint32_t n = static_cast<uint32_t>(ring_members_.size());
+    std::memcpy(hdr, &ep, 8);
+    std::memcpy(hdr + 8, &vn, 4);
+    std::memcpy(hdr + 12, &n, 4);
+    out.append(hdr, 16);
+    for (auto& m : ring_members_) {
+      char row[7];
+      uint16_t p16 = static_cast<uint16_t>(m.port);
+      uint8_t hl = static_cast<uint8_t>(
+          std::min<size_t>(m.host.size(), 255));
+      std::memcpy(row, &m.id, 4);
+      std::memcpy(row + 4, &p16, 2);
+      row[6] = static_cast<char>(hl);
+      out.append(row, 7);
+      out.append(m.host.data(), hl);
+    }
+    return out;
+  }
+
+  bool ParseRingWire(const std::vector<char>& p, uint64_t* epoch,
+                     uint32_t* vnodes, std::vector<RingServer>* out) {
+    if (p.size() < 16) return false;
+    uint32_t n = 0;
+    std::memcpy(epoch, p.data(), 8);
+    std::memcpy(vnodes, p.data() + 8, 4);
+    std::memcpy(&n, p.data() + 12, 4);
+    if (n == 0 || n > 4096 || *vnodes == 0 || *vnodes > 4096) return false;
+    size_t pos = 16;
+    for (uint32_t i = 0; i < n; ++i) {
+      if (pos + 7 > p.size()) return false;
+      RingServer s;
+      uint16_t p16 = 0;
+      std::memcpy(&s.id, p.data() + pos, 4);
+      std::memcpy(&p16, p.data() + pos + 4, 2);
+      uint8_t hl = static_cast<uint8_t>(p[pos + 6]);
+      pos += 7;
+      if (pos + hl > p.size()) return false;
+      s.host.assign(p.data() + pos, hl);
+      s.port = p16;
+      pos += hl;
+      out->push_back(std::move(s));
+    }
+    return true;
+  }
+
+  // Apply a proposed ring table.  Only a NEWER epoch lands (racing
+  // proposers of the same transition are idempotent; a stale proposer
+  // reads the authoritative table back from the response).  Known
+  // server ids keep their first-seen (peer-book) address — proposals
+  // travel through workers, whose dial addresses may be test proxies —
+  // and unknown ids (the joiner) are adopted into the book.  Applying
+  // fans a reshard task to every engine.
+  bool ApplyRing(uint64_t epoch, uint32_t vnodes,
+                 std::vector<RingServer> servers, bool make_draining) {
+    {
+      std::lock_guard<std::mutex> lk(ring_mu_);
+      if (epoch <= ring_epoch_) return false;
+      for (auto& s : servers) {
+        auto it = peer_book_.find(s.id);
+        if (it != peer_book_.end()) {
+          s.host = it->second.first;
+          s.port = it->second.second;
+        } else {
+          peer_book_[s.id] = {s.host, s.port};
+        }
+      }
+      ring_epoch_ = epoch;
+      ring_vnodes_ = static_cast<int>(vnodes);
+      ring_members_ = std::move(servers);
+      if (make_draining) draining_.store(true, std::memory_order_relaxed);
+      RebuildRingPointsLocked();
+      ring_epoch_atomic_.store(ring_epoch_, std::memory_order_release);
+      bool member = false;
+      for (auto& m : ring_members_)
+        if (m.id == my_server_id_) member = true;
+      std::fprintf(stderr,
+                   "[byteps server] ring epoch %llu applied: %zu member(s)"
+                   "%s%s\n",
+                   static_cast<unsigned long long>(ring_epoch_),
+                   ring_members_.size(),
+                   member ? "" : " (this server excluded)",
+                   draining_.load() ? " [draining]" : "");
+    }
+    // Reshard fan-out: each engine migrates ITS keys that now belong to
+    // another live server — max priority so the handoff jumps queued
+    // pushes (which would be kMoved-redirected anyway).
+    for (int i = 0; i < engine_threads_; ++i) {
+      Task t;
+      t.cmd = kRingTask;
+      t.dtype = 0;
+      t.flags = 0;
+      t.req_id = 0;
+      t.worker_id = 0;
+      t.key = 0;
+      t.conn = nullptr;
+      t.seq = seq_.fetch_add(1);
+      t.priority = UINT64_MAX;
+      queues_[i].Push(std::move(t));
+    }
+    return true;
+  }
+
+  // --- server->server peer transport (migrations) ---------------------
+  // One cached blocking connection per peer, serialized by peer_mu_ —
+  // migrations are rare (ring transitions only) and strictly ordered,
+  // so a single in-flight request at a time is plenty and keeps the
+  // path free of multiplexing machinery.
+  int DialPeer(const std::string& host, int port) {
+    int fd = socket(AF_INET, SOCK_STREAM, 0);
+    if (fd < 0) return -1;
+    timeval tv{30, 0};
+    setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+    setsockopt(fd, SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof(tv));
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_port = htons(static_cast<uint16_t>(port));
+    if (inet_pton(AF_INET, host.c_str(), &addr.sin_addr) != 1) {
+      close(fd);
+      return -1;
+    }
+    int one = 1;
+    setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    if (connect(fd, reinterpret_cast<sockaddr*>(&addr),
+                sizeof(addr)) != 0) {
+      close(fd);
+      return -1;
+    }
+    return fd;
+  }
+
+  // Blocking request/response to a peer server.  worker_id 0xFFFFFFFF:
+  // never a member id, so peer traffic cannot refresh worker leases.
+  // `resp` (optional) receives the response payload.  One retry on a
+  // stale cached fd (peer restarted between migrations).
+  bool PeerRequest(uint32_t id, const std::string& host, int port,
+                   uint8_t cmd, uint16_t flags, uint64_t key,
+                   const char* payload, uint64_t len,
+                   std::vector<char>* resp = nullptr) {
+    std::lock_guard<std::mutex> lk(peer_mu_);
+    // Negative cache: a peer that just failed (dead joiner, partition)
+    // is not re-dialed for 2s — without this, EVERY misplaced frame for
+    // its keys would block its engine thread in connect() for up to the
+    // socket timeout, head-of-line-stalling healthy keys on the same
+    // engine.  Callers treat the fast false as "migration failed" and
+    // answer kError (exact-or-loud).
+    {
+      auto it = peer_down_until_us_.find(id);
+      if (it != peer_down_until_us_.end()) {
+        if (NowUs() < it->second) return false;
+        peer_down_until_us_.erase(it);
+      }
+    }
+    for (int attempt = 0; attempt < 2; ++attempt) {
+      int fd = -1;
+      auto it = peer_fds_.find(id);
+      if (it != peer_fds_.end()) fd = it->second;
+      bool fresh = fd < 0;
+      if (fd < 0) {
+        fd = DialPeer(host, port);
+        if (fd < 0) {
+          peer_down_until_us_[id] = NowUs() + 2000000;
+          return false;
+        }
+        peer_fds_[id] = fd;
+      }
+      ReqHeader h{cmd, 0, flags, 0, 0xFFFFFFFFu, key, len};
+      bool ok = WriteFull(fd, &h, sizeof(h)) &&
+                (len == 0 || WriteFull(fd, payload, len));
+      RespHeader rh{};
+      ok = ok && ReadFull(fd, &rh, sizeof(rh));
+      if (ok && rh.len > 0) {
+        if (rh.len > max_msg_) ok = false;
+        else {
+          std::vector<char> body(rh.len);
+          ok = ReadFull(fd, body.data(), rh.len);
+          if (ok && resp) *resp = std::move(body);
+        }
+      }
+      if (ok) return rh.status == kOk;
+      close(fd);
+      peer_fds_.erase(id);
+      if (fresh) {               // a brand-new dial failing won't heal
+        peer_down_until_us_[id] = NowUs() + 2000000;
+        return false;
+      }
+    }
+    peer_down_until_us_[id] = NowUs() + 2000000;
+    return false;
+  }
+
+  // Serialize one key's full merge state for CMD_MIGRATE.  Runs on the
+  // key's engine thread, so every field is stable.
+  std::vector<char> SerializeKeyState(const KeyState& ks) {
+    std::vector<char> out;
+    auto put = [&](const void* p, size_t n) {
+      out.insert(out.end(), static_cast<const char*>(p),
+                 static_cast<const char*>(p) + n);
+    };
+    uint64_t completed = ks.completed_round;
+    uint64_t declared = ks.declared_len.load(std::memory_order_relaxed);
+    uint64_t pushes = ks.push_count.load(std::memory_order_relaxed);
+    uint8_t dtype = ks.dtype;
+    uint8_t flags = (ks.bidirectional ? 1 : 0) |
+                    (ks.onebit_scaled ? 2 : 0) | (ks.server_ef ? 4 : 0) |
+                    (ks.round_compressed ? 8 : 0);
+    put(&completed, 8);
+    put(&declared, 8);
+    put(&pushes, 8);
+    put(&dtype, 1);
+    put(&flags, 1);
+    uint32_t klen = static_cast<uint32_t>(ks.kwargs.size());
+    put(&klen, 4);
+    put(ks.kwargs.data(), klen);
+    uint64_t n = ks.store.size();
+    put(&n, 8);
+    put(ks.store.data(), n);
+    n = ks.out.size();
+    put(&n, 8);
+    put(ks.out.data(), n);
+    n = ks.ef_err.size();
+    put(&n, 8);
+    put(ks.ef_err.data(), n * 4);
+    uint32_t cnt = static_cast<uint32_t>(ks.seen.size());
+    put(&cnt, 4);
+    for (uint32_t w : ks.seen) put(&w, 4);
+    cnt = static_cast<uint32_t>(ks.round_members.size());
+    put(&cnt, 4);
+    for (uint32_t w : ks.round_members) put(&w, 4);
+    return out;
+  }
+
+  // Stream one key's state to its new ring owner and retire it locally.
+  // Engine thread (owns the key).  Returns false — state kept — when the
+  // new owner is unreachable; the caller then answers kError instead of
+  // kMoved, so a worker can never be redirected AHEAD of the state (the
+  // exactness contract: state-before-redirect).
+  bool MigrateKeyOut(uint64_t key, KeyState& ks) {
+    uint32_t owner = 0;
+    std::string host;
+    int port = 0;
+    {
+      std::lock_guard<std::mutex> lk(ring_mu_);
+      auto pts = RingPoints();
+      if (!pts || pts->empty()) return false;
+      owner = ring::Owner(key, *pts);
+      if (owner == my_server_id_) return true;   // raced a newer ring
+      for (auto& m : ring_members_)
+        if (m.id == owner) {
+          host = m.host;
+          port = m.port;
+        }
+    }
+    if (host.empty()) return false;
+    std::vector<char> blob = SerializeKeyState(ks);
+    if (!PeerRequest(owner, host, port, kMigrate, 0, key, blob.data(),
+                     blob.size())) {
+      std::fprintf(stderr,
+                   "[byteps server] migration of key %llu to server %u "
+                   "(%s:%d) failed; state kept\n",
+                   static_cast<unsigned long long>(key), owner,
+                   host.c_str(), port);
+      return false;
+    }
+    migrations_out_.fetch_add(1, std::memory_order_relaxed);
+    // Waiting pulls re-route to the new owner (which now holds `out`).
+    if (!ks.pending.empty()) {
+      std::string js = RingJson(/*include_owned=*/false);
+      int64_t flushed = 0;
+      for (auto& p : ks.pending) {
+        Respond(p.conn, kMoved, p.req_id, key, js.data(), js.size());
+        ReleaseRef(p.conn);
+        ++flushed;
+      }
+      ks.pending.clear();
+      StatPendingPulls(key, -flushed);
+    }
+    // Retire: the KeyState object stays (readers may hold pointers into
+    // the store_ map — entries are never erased, same as the rest of the
+    // server) but all payload memory is released and the scatter door
+    // closed.  declared_len 0 first, so no new scatter lease can start;
+    // an ALREADY-queued scattered task still holds the lease, in which
+    // case the buffer is left for its (kMoved-bound) task to release.
+    ks.declared_len.store(0, std::memory_order_release);
+    if (!ks.scatter_leased.exchange(true, std::memory_order_acquire)) {
+      ks.scatter_buf.clear();
+      ks.scatter_buf.shrink_to_fit();
+      ks.scatter_leased.store(false, std::memory_order_release);
+    }
+    ks.store.clear();
+    ks.store.shrink_to_fit();
+    ks.out.clear();
+    ks.out.shrink_to_fit();
+    ks.seen.clear();
+    ks.round_members.clear();
+    ks.merge_ts.clear();
+    ks.ef_err.clear();
+    ks.ef_err.shrink_to_fit();
+    ks.kwargs.clear();
+    ks.round_compressed = false;
+    ks.active.store(false, std::memory_order_relaxed);
+    return true;
+  }
+
+  // The one kMoved answer: hand state over first (if any), then redirect
+  // with the current ring so the client re-plans without another RTT.
+  void RespondMoved(Task& t, KeyState* ks) {
+    moved_frames_.fetch_add(1, std::memory_order_relaxed);
+    if (ks != nullptr && ks->active.load(std::memory_order_relaxed)) {
+      if (!MigrateKeyOut(t.key, *ks)) {
+        Respond(t.conn, kError, t.req_id, t.key, nullptr, 0);
+        return;
+      }
+    }
+    std::string js = RingJson(/*include_owned=*/false);
+    Respond(t.conn, kMoved, t.req_id, t.key, js.data(), js.size());
+  }
+
+  // Reshard (kRingTask, engine side): migrate every key this engine owns
+  // whose new ring owner is another server — proactively, so pull-side
+  // state (published rounds, EF errors) reaches the new owner without
+  // waiting for worker traffic to bounce off a kMoved.
+  void HandleReshard(int idx) {
+    if (!ring_armed_) return;
+    std::vector<uint64_t> keys;
+    {
+      std::lock_guard<std::mutex> lk(assign_mu_);
+      for (auto& kv : key_engine_)
+        if (kv.second == idx) keys.push_back(kv.first);
+    }
+    for (uint64_t key : keys) {
+      if (!RingMisplaced(key)) continue;
+      KeyState* ks = FindState(key);
+      if (ks != nullptr && ks->active.load(std::memory_order_relaxed))
+        MigrateKeyOut(key, *ks);   // failure logged inside; state kept —
+      //                              the next frame retries via kMoved
+    }
+  }
+
+  // Install a migrated key (CMD_MIGRATE, engine side).
+  void HandleMigrate(Task& t) {
+    const std::vector<char>& p = t.payload;
+    size_t pos = 0;
+    auto take = [&](void* dst, size_t n) {
+      if (pos + n > p.size()) return false;
+      std::memcpy(dst, p.data() + pos, n);
+      pos += n;
+      return true;
+    };
+    // Overflow-safe bounds: every length is compared against the bytes
+    // REMAINING (p.size() - pos), never via `pos + n` — the length
+    // fields come off the wire, and a crafted store_n near 2^64 (or an
+    // ef_n whose *4 wraps) would otherwise pass a wrapped addition and
+    // drive an out-of-bounds read or an uncaught engine bad_alloc.
+    auto remaining = [&]() -> uint64_t { return p.size() - pos; };
+    uint64_t completed = 0, declared = 0, pushes = 0;
+    uint8_t dtype = 0, flags = 0;
+    uint32_t klen = 0;
+    if (!take(&completed, 8) || !take(&declared, 8) ||
+        !take(&pushes, 8) || !take(&dtype, 1) || !take(&flags, 1) ||
+        !take(&klen, 4) || klen > remaining()) {
+      Respond(t.conn, kError, t.req_id, t.key, nullptr, 0);
+      return;
+    }
+    std::string kwargs(p.data() + pos, klen);
+    pos += klen;
+    uint64_t store_n = 0, out_n = 0, ef_n = 0;
+    if (!take(&store_n, 8) || store_n > remaining()) {
+      Respond(t.conn, kError, t.req_id, t.key, nullptr, 0);
+      return;
+    }
+    size_t store_at = pos;
+    pos += static_cast<size_t>(store_n);
+    if (!take(&out_n, 8) || out_n > remaining()) {
+      Respond(t.conn, kError, t.req_id, t.key, nullptr, 0);
+      return;
+    }
+    size_t out_at = pos;
+    pos += static_cast<size_t>(out_n);
+    if (!take(&ef_n, 8) || ef_n > remaining() / 4) {
+      Respond(t.conn, kError, t.req_id, t.key, nullptr, 0);
+      return;
+    }
+    size_t ef_at = pos;
+    pos += static_cast<size_t>(ef_n) * 4;
+    uint32_t n_seen = 0;
+    if (!take(&n_seen, 4) || n_seen > remaining() / 4) {
+      Respond(t.conn, kError, t.req_id, t.key, nullptr, 0);
+      return;
+    }
+    size_t seen_at = pos;
+    pos += static_cast<size_t>(n_seen) * 4;
+    uint32_t n_members = 0;
+    if (!take(&n_members, 4) || n_members > remaining() / 4) {
+      Respond(t.conn, kError, t.req_id, t.key, nullptr, 0);
+      return;
+    }
+    size_t members_at = pos;
+    KeyState& ks = StateFor(t.key);
+    if (ks.active.load(std::memory_order_relaxed) &&
+        ks.push_count.load(std::memory_order_relaxed) > 0) {
+      // The local key already carries LIVE pushes: either workers
+      // rebased onto this server before a straggling migration landed
+      // (local rounds are ahead), or a worker that adopted the new ring
+      // early fresh-INITed and pushed here while the old owner's
+      // reshard stream was still in flight (local round 0, migrated
+      // round r).  Installing over either would silently destroy
+      // merged gradients and desync round counters across the fleet —
+      // refuse loudly instead: the sender keeps its copy, its next
+      // frame answers kError, and the job fails EXACT-OR-LOUD rather
+      // than diverging.
+      std::fprintf(stderr,
+                   "[byteps server] refusing migration of key %llu: local "
+                   "state has live pushes at round %llu (migrated round "
+                   "%llu)\n",
+                   static_cast<unsigned long long>(t.key),
+                   static_cast<unsigned long long>(ks.completed_round),
+                   static_cast<unsigned long long>(completed));
+      Respond(t.conn, kError, t.req_id, t.key, nullptr, 0);
+      return;
+    }
+    ks.completed_round = completed;
+    ks.dtype = dtype;
+    ks.kwargs = std::move(kwargs);
+    ks.bidirectional = (flags & 1) != 0;
+    ks.onebit_scaled = (flags & 2) != 0;
+    ks.server_ef = (flags & 4) != 0;
+    ks.round_compressed = (flags & 8) != 0;
+    ks.store.assign(p.data() + store_at, p.data() + store_at + store_n);
+    ks.out.assign(p.data() + out_at, p.data() + out_at + out_n);
+    ks.ef_err.resize(ef_n);
+    if (ef_n)
+      std::memcpy(ks.ef_err.data(), p.data() + ef_at,
+                  static_cast<size_t>(ef_n) * 4);
+    ks.seen.clear();
+    for (uint32_t i = 0; i < n_seen; ++i) {
+      uint32_t w = 0;
+      std::memcpy(&w, p.data() + seen_at + i * 4ull, 4);
+      ks.seen.insert(w);
+    }
+    ks.round_members.clear();
+    for (uint32_t i = 0; i < n_members; ++i) {
+      uint32_t w = 0;
+      std::memcpy(&w, p.data() + members_at + i * 4ull, 4);
+      ks.round_members.insert(w);
+    }
+    ks.merge_ts.clear();
+    ks.push_count.store(pushes, std::memory_order_relaxed);
+    ks.declared_len.store(declared, std::memory_order_release);
+    ks.active.store(true, std::memory_order_relaxed);
+    migrations_in_.fetch_add(1, std::memory_order_relaxed);
+    StatPublish(t.key, ks.completed_round);
+    Respond(t.conn, kOk, t.req_id, t.key, nullptr, 0);
+    // A pull parked here BEFORE the migration landed (a worker that
+    // adopted the new ring early) may be satisfiable by the migrated
+    // published round — serve it now, not at some unrelated later
+    // publish.
+    FlushPulls(ks, t.key);
+  }
+
+  // Joining server: read the current ring from a launch peer (binary
+  // CMD_RING), compose next-epoch = current + self, apply locally (so
+  // migrations streaming in are accepted), then announce to every
+  // member.  Runs on its own thread once the listeners are up.
+  void JoinLoop() {
+    // Snapshot the launch peer book under ring_mu_: ApplyRing mutates
+    // peer_book_ from reader threads (a concurrent worker proposal),
+    // and an unlocked map iteration racing that insert is UB.
+    std::map<uint32_t, std::pair<std::string, int>> launch_peers;
+    {
+      std::lock_guard<std::mutex> lk(ring_mu_);
+      launch_peers = peer_book_;
+    }
+    std::vector<char> bin;
+    bool got = false;
+    for (int attempt = 0; attempt < 120 && !shutdown_.load(); ++attempt) {
+      for (auto& kv : launch_peers) {
+        if (kv.first == my_server_id_) continue;
+        if (PeerRequest(kv.first, kv.second.first, kv.second.second,
+                        kRing, /*flags=*/1, 0, nullptr, 0, &bin)) {
+          got = true;
+          break;
+        }
+      }
+      if (got) break;
+      std::this_thread::sleep_for(std::chrono::milliseconds(500));
+    }
+    if (!got) {
+      std::fprintf(stderr,
+                   "[byteps server] ring join failed: no peer answered "
+                   "CMD_RING; serving without joining\n");
+      return;
+    }
+    // Compose-announce-CONFIRM, retried: peers reject a RING_SET whose
+    // epoch collides with a concurrent transition (e.g. a worker
+    // failover proposal that claimed the same epoch+1) yet still answer
+    // kOk with their authoritative table — so membership must be
+    // verified by re-reading the ring, never assumed from the acks.
+    for (int round = 0; round < 5 && !shutdown_.load(); ++round) {
+      uint64_t epoch = 0;
+      uint32_t vnodes = static_cast<uint32_t>(ring_vnodes_);
+      std::vector<RingServer> servers;
+      if (!ParseRingWire(bin, &epoch, &vnodes, &servers)) {
+        std::fprintf(stderr,
+                     "[byteps server] ring join failed: unparseable peer "
+                     "ring; serving without joining\n");
+        return;
+      }
+      bool already_member = false;
+      for (auto& s : servers)
+        if (s.id == my_server_id_) already_member = true;
+      if (already_member) {
+        ApplyRing(epoch, vnodes, servers, /*make_draining=*/false);
+        std::fprintf(stderr,
+                     "[byteps server] joined the ring as server %u "
+                     "(epoch %llu)\n", my_server_id_,
+                     static_cast<unsigned long long>(epoch));
+        return;
+      }
+      std::vector<RingServer> next;
+      for (auto& s : servers) next.push_back(s);
+      next.push_back(
+          RingServer{my_server_id_, advertise_host_, advertise_port_});
+      ApplyRing(epoch + 1, vnodes, next, /*make_draining=*/false);
+      std::string wire = RingWire();
+      for (auto& s : next) {
+        if (s.id == my_server_id_) continue;
+        auto it = launch_peers.find(s.id);
+        auto addr = it != launch_peers.end()
+                        ? it->second : std::make_pair(s.host, s.port);
+        if (!PeerRequest(s.id, addr.first, addr.second, kRingSet, 0, 0,
+                         wire.data(), wire.size()))
+          std::fprintf(stderr,
+                       "[byteps server] ring join announce to server %u "
+                       "failed (it will learn via a worker proposal)\n",
+                       s.id);
+      }
+      // Confirm against a peer's view; on a collision, re-compose from
+      // the fresher table next round.
+      std::this_thread::sleep_for(std::chrono::milliseconds(200));
+      got = false;
+      for (auto& kv : launch_peers) {
+        if (kv.first == my_server_id_) continue;
+        if (PeerRequest(kv.first, kv.second.first, kv.second.second,
+                        kRing, /*flags=*/1, 0, nullptr, 0, &bin)) {
+          got = true;
+          break;
+        }
+      }
+      if (!got) {
+        std::fprintf(stderr,
+                     "[byteps server] ring join: peers unreachable after "
+                     "announce; assuming epoch %llu stands\n",
+                     static_cast<unsigned long long>(
+                         ring_epoch_atomic_.load(
+                             std::memory_order_acquire)));
+        return;
+      }
+    }
+    std::fprintf(stderr,
+                 "[byteps server] ring join did not converge after 5 "
+                 "rounds; serving with the last announced table\n");
+  }
+
   void ReaderLoop(Conn* conn) {
     ReaderBody(conn);
     // Reader exit (peer hung up, we rejected an oversize frame, or a
@@ -1847,6 +2718,39 @@ class Server {
           break;
         case kMembers: {
           std::string js = MembersJson();
+          Respond(conn, kOk, h.req_id, h.key, js.data(), js.size());
+          break;
+        }
+        case kRing: {
+          // Ring read: JSON for workers, binary (flags bit0) for a
+          // joining server's C++-side parse.  Reader thread so the ring
+          // can still be read past a wedged engine — the failover path
+          // depends on it.
+          if (h.flags & 1) {
+            std::string b = RingWire();
+            Respond(conn, kOk, h.req_id, h.key, b.data(), b.size());
+          } else {
+            std::string js = RingJson();
+            Respond(conn, kOk, h.req_id, h.key, js.data(), js.size());
+          }
+          break;
+        }
+        case kRingSet:
+        case kDrain: {
+          // Ring write / graceful drain.  Both carry a full binary
+          // next-epoch table; drain additionally marks this server
+          // draining (its member set excludes it, so every owned key
+          // migrates out and subsequent frames are kMoved-redirected).
+          uint64_t ep = 0;
+          uint32_t vn = 0;
+          std::vector<RingServer> srvs;
+          if (!ring_armed_ ||
+              !ParseRingWire(payload, &ep, &vn, &srvs)) {
+            Respond(conn, kError, h.req_id, h.key, nullptr, 0);
+            break;
+          }
+          ApplyRing(ep, vn, std::move(srvs), h.cmd == kDrain);
+          std::string js = RingJson();
           Respond(conn, kOk, h.req_id, h.key, js.data(), js.size());
           break;
         }
@@ -2022,6 +2926,12 @@ class Server {
           if (t.conn == nullptr) HandleMembership(t, idx);
           else Respond(t.conn, kError, t.req_id, t.key, nullptr, 0);
           break;
+        case kRingTask:
+          // Same wire-rejection rule as kMembershipTask.
+          if (t.conn == nullptr) HandleReshard(idx);
+          else Respond(t.conn, kError, t.req_id, t.key, nullptr, 0);
+          break;
+        case kMigrate: HandleMigrate(t); break;
         default: Respond(t.conn, kError, t.req_id, t.key, nullptr, 0);
       }
       // The task's hold ends here (a deferred pull took its OWN ref in
@@ -2134,7 +3044,18 @@ class Server {
     // kwargs (compressor registration, reference: server.cc:232-261).
     // Responds with u64 completed_round so reconnecting workers re-seed
     // their round counters from server state.
+    //
+    // Ring ownership gate: once the ring epoch has advanced, an INIT
+    // for a key this server no longer owns must NOT recreate state here
+    // — hand over any remaining state, then redirect (kMoved carries
+    // the ring table).  Checked before StateFor so a redirected key
+    // never even allocates.
+    if (RingMisplaced(t.key)) {
+      RespondMoved(t, FindState(t.key));
+      return;
+    }
     KeyState& ks = StateFor(t.key);
+    ks.active.store(true, std::memory_order_relaxed);
     uint64_t n = 0;
     if (t.payload.size() >= 8)
       std::memcpy(&n, t.payload.data(), 8);
@@ -2182,6 +3103,16 @@ class Server {
         t.scattered ? &ks.scatter_buf : &t.payload;
     // Captured before the COPY_FIRST swap below can gut the source.
     const uint64_t wire_len = data->size();
+    // Ring ownership gate (after the lease guard is armed, so a
+    // scattered frame's lease always releases): a push for a key this
+    // server no longer owns hands its state over, then redirects — the
+    // worker replays the SAME gradient to the new owner, so no round is
+    // lost and nothing merges twice (state-before-redirect).
+    if (RingMisplaced(t.key)) {
+      RespondMoved(t, &ks);
+      return;
+    }
+    ks.active.store(true, std::memory_order_relaxed);
     if (t.dtype == kSeed) {
       // Store seeding for async weight-delta training: applied only if the
       // key has never been pushed, so a late-joining/rejoining worker
@@ -2502,6 +3433,13 @@ class Server {
   }
 
   void HandlePull(Task& t) {
+    // Ring ownership gate: a pull for a moved key redirects like a push
+    // — the published `out` buffer migrated with the state, so the new
+    // owner serves the identical bytes.
+    if (RingMisplaced(t.key)) {
+      RespondMoved(t, FindState(t.key));
+      return;
+    }
     KeyState& ks = StateFor(t.key);
     // t.flags = the round (mod 2^15, low bits of the u16; bit 15 is the
     // trace marker) the worker just pushed; its result is ready once that
@@ -2605,6 +3543,32 @@ class Server {
   double evict_timeout_s_ = 0.0;
   std::atomic<uint64_t> deferred_joins_{0};
 
+  // Elastic PS ring (see the "elastic PS ring" section above).
+  // ring_epoch_atomic_ mirrors ring_epoch_ for the lock-free data-path
+  // short-circuit; everything else under ring_mu_.
+  bool ring_armed_ = false;
+  bool ring_join_ = false;
+  std::atomic<bool> draining_{false};
+  uint32_t my_server_id_ = 0;
+  int ring_vnodes_ = 64;
+  std::string advertise_host_;
+  int advertise_port_ = 0;
+  std::mutex ring_mu_;
+  uint64_t ring_epoch_ = 0;
+  std::vector<RingServer> ring_members_;
+  // Atomically-swapped sorted point table (see RebuildRingPointsLocked):
+  // readers are lock-free; the pointer is rebuilt whole per transition.
+  std::shared_ptr<const std::vector<std::pair<uint64_t, uint32_t>>>
+      ring_points_;
+  std::map<uint32_t, std::pair<std::string, int>> peer_book_;
+  std::atomic<uint64_t> ring_epoch_atomic_{0};
+  std::atomic<uint64_t> migrations_in_{0};
+  std::atomic<uint64_t> migrations_out_{0};
+  std::atomic<uint64_t> moved_frames_{0};
+  std::mutex peer_mu_;
+  std::map<uint32_t, int> peer_fds_;
+  std::map<uint32_t, int64_t> peer_down_until_us_;  // negative cache
+
   // CMD_TRACE span ring (see ServerTracer).
   ServerTracer tracer_;
 
@@ -2634,6 +3598,26 @@ int bps_ps_server_run(int port, int num_workers, int engine_threads,
   bps_server::Server s(port, num_workers, engine_threads,
                        enable_schedule != 0, enable_async != 0);
   return s.Run();
+}
+
+// Ring-placement parity hook (ctypes from tests and common/ring.py
+// consumers): the owner of `key` among `ids[n]` with `vnodes` virtual
+// nodes per server, computed by the SAME code the server's ownership
+// gate runs.  Returns the owning server id, or -1 on bad args.  Test
+// surface only — the worker's hot path uses the pure-Python mirror.
+__attribute__((visibility("default")))
+int64_t bps_ring_owner(uint64_t key, const uint32_t* ids, int32_t n,
+                       int32_t vnodes) {
+  if (ids == nullptr || n <= 0 || vnodes <= 0 || vnodes > 4096) return -1;
+  std::vector<std::pair<uint64_t, uint32_t>> points;
+  points.reserve(static_cast<size_t>(n) * vnodes);
+  for (int32_t i = 0; i < n; ++i)
+    for (int32_t v = 0; v < vnodes; ++v)
+      points.emplace_back(
+          bps_server::ring::VnodePoint(ids[i], static_cast<uint32_t>(v)),
+          ids[i]);
+  std::sort(points.begin(), points.end());
+  return static_cast<int64_t>(bps_server::ring::Owner(key, points));
 }
 
 // Worker-side codec acceleration (ctypes from server/wire.py).  Same

@@ -67,6 +67,7 @@ import numpy as np
 
 from ..common.config import Config
 from ..common.logging import get_logger
+from ..common.ring import DEFAULT_VNODES, RingTable
 from ..core.native import get_core
 from .codec_pool import CompressionPool
 
@@ -75,7 +76,14 @@ _RESP = struct.Struct("<BIQQ")     # status req_id key len
 
 CMD_HELLO, CMD_INIT, CMD_PUSH, CMD_PULL, CMD_BARRIER, CMD_SHUTDOWN, \
     CMD_PING, CMD_LR_SCALE, CMD_STATS, CMD_TRACE, CMD_LEAVE, \
-    CMD_MEMBERS = range(12)
+    CMD_MEMBERS, CMD_RING, CMD_RING_SET, CMD_DRAIN, CMD_MIGRATE = range(16)
+
+# Response status bytes (server.cc Status).  MOVED carries the server's
+# current ring table as JSON: the addressed server is not (or no longer)
+# the consistent-hash owner of the frame's key — re-plan and re-route.
+# Emitted only once the ring epoch has advanced, so a fixed-topology job
+# never sees it.
+STATUS_OK, STATUS_ERROR, STATUS_MOVED = 0, 1, 2
 
 # dtype byte on the wire (server.cc WireDtype)
 DT_F32, DT_RAW, DT_COMPRESSED, DT_SEED = 0, 1, 2, 3
@@ -95,7 +103,8 @@ ROUND_MASK = 0x7FFF
 
 _CMD_NAMES = {0: "HELLO", 1: "INIT", 2: "PUSH", 3: "PULL", 4: "BARRIER",
               5: "SHUTDOWN", 6: "PING", 7: "LR_SCALE", 8: "STATS",
-              9: "TRACE", 10: "LEAVE", 11: "MEMBERS"}
+              9: "TRACE", 10: "LEAVE", 11: "MEMBERS", 12: "RING",
+              13: "RING_SET", 14: "DRAIN", 15: "MIGRATE"}
 
 
 def _round_flags(rnd: int, traced: bool) -> int:
@@ -173,6 +182,20 @@ def merge_membership(views: list) -> dict:
 # tests can shrink it (bps.barrier legitimately blocks on peers for a long
 # time — silence is the failure mode being fixed, not the waiting itself).
 BARRIER_WARN_INTERVAL_S = 10.0
+
+
+class _KeyMoved(Exception):
+    """A request drew status MOVED: the addressed server is not the ring
+    owner of the key.  ``doc`` is the server's current ring table (the
+    MOVED payload) — the session adopts it, re-plans, and replays the
+    partition against the new owner (state already migrated there:
+    the server's contract is state-before-redirect)."""
+
+    def __init__(self, key: int, doc: dict):
+        super().__init__(f"key {key} moved (ring epoch "
+                         f"{doc.get('epoch', '?')})")
+        self.key = key
+        self.doc = doc
 
 
 class _ConnLost(ConnectionError):
@@ -391,6 +414,9 @@ class _ServerConn:
         self._req_counter = 0
         self._closed = False
         self._down = False           # dropped, re-dial in progress
+        self.down_since = 0.0        # monotonic ts of the current outage
+        #                              (0 = up) — the server-failover
+        #                              scanner's lease signal
         self._recv_thread = threading.Thread(
             target=self._recv_loop, daemon=True, name="bps-ps-recv")
         self._recv_thread.start()
@@ -657,8 +683,19 @@ class _ServerConn:
                 raise
             if fut is None:
                 continue  # response for a cancelled request
-            err = (RuntimeError(f"PS server error for key {rkey}")
-                   if status != 0 else None)
+            err = None
+            if status == STATUS_MOVED:
+                # The key's ring owner changed: the payload is the
+                # server's current ring table.  Parsed here (it is tiny)
+                # so every completion path gets a structured error.
+                import json as _json
+                try:
+                    doc = _json.loads(bytes(data).decode())
+                except Exception:
+                    doc = {}
+                err = _KeyMoved(rkey, doc)
+            elif status != 0:
+                err = RuntimeError(f"PS server error for key {rkey}")
             try:
                 fut.resolve(data, err)
             except Exception:
@@ -675,6 +712,8 @@ class _ServerConn:
             if self._closed:
                 return False
             self._down = True
+            if not self.down_since:
+                self.down_since = time.monotonic()
             dropped, self._pending = self._pending, {}
         # Park-don't-fail: pending futures resolve with a reconnect-tagged
         # loss so the session can stash their partitions for replay.
@@ -721,6 +760,7 @@ class _ServerConn:
                         pass
                     return False
                 self._down = False
+                self.down_since = 0.0
             self.reconnects += 1
             get_logger().warning(
                 "PS connection to %s:%d re-established (attempt %d/%d)",
@@ -953,6 +993,8 @@ class PSSession:
         "parked_parts": 0,        # partitions currently parked for replay
         "parked_total": 0,        # partitions ever parked
         "watchdog_trips": 0,      # stall-watchdog dumps fired
+        "ring_redirects": 0,      # partitions re-routed by status MOVED
+        "server_failovers": 0,    # dead servers this worker failed over
         "pool_hits": 0,           # recv buffers served from the pool
         "pool_misses": 0,         # recv buffers freshly allocated
         "pool_buffers_held": 0,   # buffers currently on pool freelists
@@ -977,7 +1019,10 @@ class PSSession:
                  clock_sync_s: float = 30.0,
                  uds_path: str = "",
                  sock_buf_kb: int = 0,
-                 evict_timeout_s: float = 0.0):
+                 evict_timeout_s: float = 0.0,
+                 ring: bool = False,
+                 ring_vnodes: int = DEFAULT_VNODES,
+                 server_evict_timeout_s: float = 0.0):
         self.worker_id = worker_id
         self.num_servers = max(1, num_servers)
         self.hash_fn = hash_fn
@@ -1013,6 +1058,19 @@ class PSSession:
         # idle-time traffic.  0 (default) = no heartbeat thread, no extra
         # wire bytes: a fixed-membership job's traffic is untouched.
         self.evict_timeout_s = max(0.0, float(evict_timeout_s))
+        # Elastic PS tier (docs/elasticity.md "The server half").
+        # `ring` arms consistent-hash placement (the shared law in
+        # common/ring.py) — required for drain/scale-up/failover;
+        # `server_evict_timeout_s` > 0 additionally arms the worker-side
+        # server-lease scanner: a server whose every lane has been down
+        # that long is declared dead, the survivors adopt the next ring
+        # epoch, and this worker re-declares + re-pushes the open round
+        # from gradient state.  Both default off: placement is then the
+        # legacy fixed hash and the wire is byte-identical to pre-ring.
+        self.server_evict_timeout_s = max(0.0,
+                                          float(server_evict_timeout_s))
+        self.ring_armed = bool(ring) or self.server_evict_timeout_s > 0
+        self.ring_vnodes = max(1, int(ring_vnodes))
         # Any failure before __init__ returns (a connect, the dispatcher,
         # the HELLO mode check) must tear down every socket and receiver
         # thread already created — the caller gets an exception, not a
@@ -1024,6 +1082,8 @@ class PSSession:
             self._init_connections(hosts, ports, max(1, wire_conns))
             self._init_state(scheduling_credit)
             self._hello_mode_check(worker_id)
+            if self.ring_armed:
+                self._ring_bootstrap()
         except Exception:
             self._abort_init()
             raise
@@ -1041,35 +1101,49 @@ class PSSession:
         per-connection threads).  Control traffic (barrier/hello/
         shutdown) stays on the primary."""
         self._recv_pool = _RecvBufPool()
-
-        def conn(h, p):
-            return _ServerConn(
-                h, p,
-                reconnect_attempts=self.reconnect_attempts,
-                reconnect_backoff_ms=self.reconnect_backoff_ms,
-                on_reconnect=self._on_conn_reconnected,
-                on_give_up=self._on_conn_gave_up,
-                uds_path=(self.uds_path
-                          if h in self._LOOPBACK_HOSTS else ""),
-                sock_buf_kb=self.sock_buf_kb,
-                recv_pool=self._recv_pool)
+        self._wire_conns = wire_conns
+        self._hosts, self._ports = list(hosts), list(ports)
 
         for h, p in zip(hosts, ports):
-            c = conn(h, p)
+            c = self._make_conn(h, p)
             self.conns.append(c)
             self._data_conns.append([c])
         for pool, (h, p) in zip(self._data_conns, zip(hosts, ports)):
             for _ in range(wire_conns - 1):
-                pool.append(conn(h, p))
+                pool.append(self._make_conn(h, p))
         for i, c in enumerate(self.conns):
             if c.transport != "tcp":
                 get_logger().info(
                     "PS server %d (%s:%d) connected over %s fast path",
                     i, c.host, c.port, c.transport)
 
+    def _make_conn(self, h: str, p: int) -> "_ServerConn":
+        # With server failover armed, a drop must PARK partitions (and
+        # keep re-dialing under backoff) rather than fail-fast: the
+        # scanner decides whether the server is dead — at which point the
+        # ring transitions and the parked parts replay on the new owner —
+        # or merely rebooting, in which case the re-dial heals it.  The
+        # effectively-unbounded budget is cut short by conn.close() when
+        # the dead server is retired from the ring.
+        attempts = self.reconnect_attempts
+        if self.server_evict_timeout_s > 0:
+            attempts = max(attempts, 1 << 30)
+        return _ServerConn(
+            h, p,
+            reconnect_attempts=attempts,
+            reconnect_backoff_ms=self.reconnect_backoff_ms,
+            on_reconnect=self._on_conn_reconnected,
+            on_give_up=self._on_conn_gave_up,
+            uds_path=(self.uds_path
+                      if h in self._LOOPBACK_HOSTS else ""),
+            sock_buf_kb=self.sock_buf_kb,
+            recv_pool=self._recv_pool)
+
     def _abort_init(self) -> None:
         if getattr(self, "_watchdog_stop", None) is not None:
             self._watchdog_stop.set()
+        if getattr(self, "_srvdown_stop", None) is not None:
+            self._srvdown_stop.set()
         if getattr(self, "_lease_stop", None) is not None:
             self._lease_stop.set()
         if getattr(self, "_clock_sync_stop", None) is not None:
@@ -1182,6 +1256,31 @@ class PSSession:
         self._left = False
         self._lease_stop = threading.Event()
         self._lease_thread: Optional[threading.Thread] = None
+        # Elastic PS ring (ring_armed): the worker's copy of the
+        # epoch-versioned server ring (common/ring.py — same law the
+        # server enforces), the server-id -> conn-slot map (slots are
+        # stable for the session; a joiner appends one, a dead/drained
+        # server's slot is retired but never reused), and the remap
+        # queue: partitions whose key moved (status MOVED or a failover
+        # transition) wait here for the remap worker to re-declare and
+        # replay them against the new owner.
+        self._ring_lock = threading.Lock()
+        self._ring: Optional[RingTable] = None
+        self._srv_slot: Dict[int, int] = {}
+        self._slot_srv: Dict[int, int] = {}
+        self._dead_slots: set = set()
+        if self.ring_armed:
+            self._ring = RingTable(
+                [(i, self._hosts[i], self._ports[i])
+                 for i in range(len(self.conns))],
+                self.ring_vnodes, epoch=0)
+            self._srv_slot = {i: i for i in range(len(self.conns))}
+            self._slot_srv = {i: i for i in range(len(self.conns))}
+        self._remap_lock = threading.Lock()
+        self._remap_queue: List[int] = []
+        self._remap_thread: Optional[threading.Thread] = None
+        self._srvdown_stop = threading.Event()
+        self._srvdown_thread: Optional[threading.Thread] = None
         self._dispatcher = threading.Thread(
             target=self._dispatch_loop, daemon=True, name="bps-ps-dispatch")
         self._dispatcher.start()
@@ -1194,6 +1293,11 @@ class PSSession:
             self._lease_thread = threading.Thread(
                 target=self._lease_loop, daemon=True, name="bps-ps-lease")
             self._lease_thread.start()
+        if self.server_evict_timeout_s > 0:
+            self._srvdown_thread = threading.Thread(
+                target=self._server_lease_loop, daemon=True,
+                name="bps-ps-srvlease")
+            self._srvdown_thread.start()
 
     def _hello_mode_check(self, worker_id: int) -> None:
         # HELLO returns the server's mode flags (u8 async | u8 schedule).
@@ -1238,7 +1342,10 @@ class PSSession:
                    clock_sync_s=cfg.clock_sync_s,
                    uds_path=cfg.server_uds,
                    sock_buf_kb=cfg.sock_buf_kb,
-                   evict_timeout_s=cfg.evict_timeout_s)
+                   evict_timeout_s=cfg.evict_timeout_s,
+                   ring=cfg.ring,
+                   ring_vnodes=cfg.ring_vnodes,
+                   server_evict_timeout_s=cfg.server_evict_timeout_s)
 
     def set_lr_scale(self, scale: float) -> None:
         """One-shot EF-error rescale after a learning-rate change;
@@ -1294,7 +1401,16 @@ class PSSession:
             plan = []
             for idx, (off, ln) in enumerate(bounds):
                 pkey = core.encode_key(declared_key, idx)
-                srv = core.key_to_server(pkey, len(self.conns), self.hash_fn)
+                if self._ring is not None:
+                    # Ring placement (the elastic law, common/ring.py):
+                    # owner id -> this session's conn slot.  The server
+                    # enforces the same law once the epoch advances, so a
+                    # stale plan self-corrects via status MOVED.
+                    with self._ring_lock:
+                        srv = self._srv_slot[self._ring.owner(pkey)]
+                else:
+                    srv = core.key_to_server(pkey, len(self.conns),
+                                             self.hash_fn)
                 self._server_load[srv] += ln
                 plan.append((pkey, off, ln, srv))
                 self._pkey_srv[pkey] = srv
@@ -1355,6 +1471,14 @@ class PSSession:
             if part is None:  # cancelled (session closing)
                 self._queue.report_finish(nbytes)
                 continue
+            if part.parked:
+                # Parked mid-queue (ring remap / server failover claimed
+                # it before this entry popped): return the credit and let
+                # the replay path re-enqueue it against the new owner.
+                self._queue.report_finish(nbytes)
+                with self._cv:
+                    self._cv.notify_all()
+                continue
             if self.record_push_order:
                 self.push_order.append(pkey)
             if part.ready is not None and not part.ready.is_set():
@@ -1408,6 +1532,13 @@ class PSSession:
         with self._cv:
             self._cv.notify_all()
         if error is not None:
+            # Ring redirect: the server handed the key's state to its new
+            # owner and told us so — park the partition and replay it
+            # there (same gradient, so no round is lost and the server's
+            # seen-dedup keeps it single-counted).
+            if isinstance(error, _KeyMoved):
+                self._on_key_moved(pkey, "push", error)
+                return
             # A reconnect-tagged loss parks the partition for replay (the
             # ack never arrived, so the push phase must be re-run — the
             # server's seen-dedup and the stale-round push guard make the
@@ -1460,6 +1591,11 @@ class PSSession:
     def _on_pull(self, pkey: int, data: bytes,
                  error: Optional[Exception]) -> None:
         if error is not None:
+            # Ring redirect on the pull leg: the published round migrated
+            # with the key — re-pull from the new owner.
+            if isinstance(error, _KeyMoved):
+                self._on_key_moved(pkey, "pull", error)
+                return
             # Pull leg lost to a recoverable drop: the push WAS acked, so
             # replay re-issues only the pull (round flags unchanged — the
             # server serves completed_round or pends until it publishes).
@@ -1585,8 +1721,14 @@ class PSSession:
         of failing its handle.  Only recoverable drops park (`_ConnLost`
         with an active reconnect policy); returns False when the caller
         should fail the partition as before.  Idempotent: the send-raise
-        and drop-resolution paths can both observe one loss."""
-        if not (self.reconnect_attempts > 0
+        and drop-resolution paths can both observe one loss.  Server
+        failover (server_evict_timeout_s > 0) arms parking too: a drop
+        must hold partitions until the lease scanner rules the server
+        dead (ring transition + remap to the new owner) or merely
+        rebooting (re-dial + replay)."""
+        recovery_armed = (self.reconnect_attempts > 0
+                          or self.server_evict_timeout_s > 0)
+        if not (recovery_armed
                 and isinstance(error, _ConnLost) and error.will_reconnect):
             return False
         if getattr(self, "server_async", False) and phase == "push":
@@ -1721,6 +1863,11 @@ class PSSession:
         for part in mine:
             try:
                 self._replay_part(conn, part)
+            except _KeyMoved as e:
+                # The reconnected server no longer owns this key (a ring
+                # transition landed during the outage): hand the part to
+                # the remap path instead of failing it.
+                self._on_key_moved(part.pkey, part.phase, e)
             except ConnectionError as e:
                 # Dropped mid-replay: re-park; the next reconnect cycle
                 # picks the remainder up.  (The part was already claimed
@@ -1754,6 +1901,34 @@ class PSSession:
         pushes whose round flag is stale."""
         if not self._unpark(part):
             return      # another replay pass or a failure beat us to it
+        replay_push = self._reconcile_part(conn, part)
+        if replay_push:
+            # Back through the scheduler: replays dispatch in the same
+            # (priority desc, key asc) order as first sends, and re-charge
+            # the same credit (returned when the original send failed).
+            with self._transport_lock:
+                self._tstats["replayed_pushes"] += 1
+            with self._cv:
+                self._queue.add(part.pkey, part.priority, part.credit_ln)
+                self._cv.notify_all()
+        else:
+            with self._transport_lock:
+                self._tstats["replayed_pulls"] += 1
+            # Pull-only replay: re-pick a live lane on the partition's
+            # (possibly re-ringed) server and re-charge it for the reply
+            # leg (the original charge was returned at park time).
+            part.conn = self._pick_lane(part.srv, part.ln)
+            part.lane_debt = part.ln
+            self._issue_pull(part)
+
+    def _reconcile_part(self, conn: "_ServerConn",
+                        part: "_PartTask") -> bool:
+        """Idempotent CMD_INIT against ``conn``'s server + round
+        reconciliation for one partition; returns True when the push leg
+        must (re)run.  Shared by the reconnect replay and the ring-remap
+        path (where ``conn`` is the key's NEW owner — a fresh owner after
+        failover answers completed_round 0 and the partition rebases,
+        re-pushing the open round from gradient state)."""
         comp = self._compressors.get(part.pkey >> 16)
         kw_bytes = comp.kwargs_string().encode() if comp else b""
         init_payload = struct.pack("<QI", part.ln, len(kw_bytes)) + kw_bytes
@@ -1788,23 +1963,9 @@ class PSSession:
                     f"of this worker by {completed - part.round} rounds "
                     f"(completed={completed}, staged round={part.round}) — "
                     f"another worker is reusing this worker_id?")
-        if replay_push:
-            # Back through the scheduler: replays dispatch in the same
-            # (priority desc, key asc) order as first sends, and re-charge
-            # the same credit (returned when the original send failed).
-            with self._transport_lock:
-                self._tstats["replayed_pushes"] += 1
-            with self._cv:
-                self._queue.add(part.pkey, part.priority, part.credit_ln)
-                self._cv.notify_all()
-        else:
-            with self._transport_lock:
-                self._tstats["replayed_pulls"] += 1
-            # Pull-only replay: re-charge the lane for the reply leg (the
-            # original charge was returned when the partition parked).
-            part.conn.lane_charge(part.ln)
-            part.lane_debt = part.ln
-            self._issue_pull(part)
+        if not replay_push:
+            part.phase = "pull"
+        return replay_push
 
     def _watchdog_loop(self) -> None:
         interval = max(0.2, min(self.stall_timeout_s / 4.0, 5.0))
@@ -1847,7 +2008,22 @@ class PSSession:
                 f" bytes={p.wire_ln} conn={conn}")
         for i, pool in enumerate(self._data_conns):
             states = ",".join(c.state() for c in pool)
-            lines.append(f"  server[{i}] conns: {states}")
+            dead = " [retired from ring]" if i in self._dead_slots else ""
+            lines.append(f"  server[{i}] conns: {states}{dead}")
+        # A dead SERVER reads as "slow keys" without this: name every
+        # server whose entire lane pool is down, with the keys planned on
+        # it — those keys are not slow, their store is unreachable (and,
+        # with failover armed, about to be claimed by the survivors).
+        for slot, host, port, owned in self._down_servers():
+            shown = ", ".join(str(k) for k in owned[:16])
+            if len(owned) > 16:
+                shown += f", ... ({len(owned)} total)"
+            lines.append(
+                f"  server[{slot}] {host}:{port} is DOWN (every lane) — "
+                f"owns {len(owned)} planned key(s): [{shown}]"
+                + ("; failover armed: the surviving ring will claim them"
+                   if self.server_evict_timeout_s > 0 else
+                   "; these keys are unreachable, not slow"))
         with self._transport_lock:
             lines.append(f"  transport stats: {dict(self._tstats)}")
         # A stuck partition's round may be waiting on a peer that is GONE
@@ -1997,7 +2173,517 @@ class PSSession:
                f"{waiting_on}")
         if gone:
             txt += f"; gone (left/evicted): {gone}"
+        down = self._down_servers()
+        if down:
+            txt += ("; PS server(s) unreachable: "
+                    + ", ".join(f"{slot} ({host}:{port})"
+                                for slot, host, port, _ in down))
         return txt
+
+    # -- elastic PS ring: placement, redirects, drain, failover -------------
+    def _ring_bootstrap(self) -> None:
+        """Adopt the server tier's ring at session start (CMD_RING from
+        server 0).  A late-starting or restarted worker joining a fleet
+        whose ring already transitioned must learn the live epoch —
+        including any joiner's address — before planning a single key.
+        A pre-ring server answers the unknown command with an error
+        status, surfaced as a clean "server too old" (never a hang); a
+        server with the ring unarmed (or a different vnode count) is a
+        configuration mismatch and fails loudly too — a silent placement
+        disagreement would redirect-livelock every push."""
+        import json as _json
+        try:
+            raw = self.conns[0].request(CMD_RING, worker_id=self.worker_id,
+                                        timeout=30.0)
+        except RuntimeError as e:
+            raise RuntimeError(
+                f"PS server at {self.conns[0].host}:{self.conns[0].port} "
+                f"does not support CMD_RING (server too old — "
+                f"rebuild/redeploy the server tier to match this client, "
+                f"or unset BYTEPS_TPU_RING): {e}") from e
+        doc = _json.loads(bytes(raw).decode())
+        if not doc.get("armed"):
+            raise RuntimeError(
+                "BYTEPS_TPU_RING is armed on this worker but not on the "
+                "server tier — set BYTEPS_TPU_RING=1 (plus DMLC_SERVER_ID/"
+                "DMLC_NUM_SERVER) on every server, or unset it here")
+        if int(doc.get("vnodes", self.ring_vnodes)) != self.ring_vnodes:
+            raise RuntimeError(
+                f"BYTEPS_TPU_RING_VNODES mismatch: worker={self.ring_vnodes}"
+                f" server={doc.get('vnodes')} — placement laws must agree")
+        if int(doc.get("epoch", 0)) > 0:
+            self._adopt_ring_doc(doc)
+
+    def get_ring(self, timeout: float = 10.0) -> dict:
+        """The server tier's current ring table (CMD_RING JSON) from the
+        first reachable server: epoch, vnodes, member (id, host, port)
+        rows, keys_owned, draining.  "Server too old" on a pre-ring
+        server, never a hang."""
+        import json as _json
+        last: Optional[Exception] = None
+        for slot, c in enumerate(self.conns):
+            if slot in self._dead_slots:
+                continue
+            try:
+                raw = c.request(CMD_RING, worker_id=self.worker_id,
+                                timeout=timeout)
+                return _json.loads(bytes(raw).decode())
+            except RuntimeError as e:
+                raise RuntimeError(
+                    f"PS server at {c.host}:{c.port} does not support "
+                    f"CMD_RING (server too old — rebuild/redeploy the "
+                    f"server tier to match this client): {e}") from e
+            except (ConnectionError, OSError, TimeoutError) as e:
+                last = e
+        raise ConnectionError(f"no PS server reachable for CMD_RING: {last}")
+
+    def drain_server(self, server_id: int, timeout_s: float = 120.0,
+                     shutdown: bool = False) -> dict:
+        """Gracefully scale the PS tier down: drain ``server_id`` out of
+        the ring (CMD_DRAIN).  The survivors adopt the next ring epoch
+        first (so migrations land under the new law), then the target
+        streams every owned key's state — declared meta, merge store,
+        published round, completed_round, the open round's contributor
+        set — to its new owner and answers every later frame with a
+        redirect.  Blocks until the target reports zero owned keys (its
+        drain is complete); ``shutdown=True`` then also retires the
+        process.  Returns the target's final CMD_RING document."""
+        if not self.ring_armed:
+            raise RuntimeError(
+                "drain_server requires the elastic ring "
+                "(BYTEPS_TPU_RING=1 on workers and servers)")
+        import json as _json
+        # Compose from the server tier's FRESH table, not this session's
+        # cached one: servers silently ignore (and idempotently ack) a
+        # STALE-epoch proposal, which would otherwise surface only as a
+        # misleading poll timeout below.
+        self._safe_adopt_ring(self.get_ring())
+        with self._ring_lock:
+            ring = self._ring
+            if ring is None or server_id not in ring.ids():
+                raise ValueError(
+                    f"server {server_id} is not in the current ring "
+                    f"{ring.ids() if ring else []}")
+            proposal = ring.without(server_id)   # raises on last member
+            target_slot = self._srv_slot[server_id]
+            survivors = [(sid, slot) for sid, slot in self._srv_slot.items()
+                         if sid != server_id
+                         and slot not in self._dead_slots]
+        wire = proposal.to_wire()
+        # Survivors first: every migration the drain streams must land on
+        # a server that already accepts the new epoch — otherwise a push
+        # racing the handoff could bounce between two stale owners.
+        for sid, slot in survivors:
+            self.conns[slot].request(CMD_RING_SET, payload=wire,
+                                     worker_id=self.worker_id, timeout=30.0)
+        raw = self.conns[target_slot].request(
+            CMD_DRAIN, payload=wire, worker_id=self.worker_id, timeout=30.0)
+        doc = _json.loads(bytes(raw).decode())
+        if not doc.get("draining"):
+            # The target rejected the epoch (a transition raced this
+            # drain): fail loudly NOW with the real cause instead of
+            # burning the poll deadline on a server that never drained.
+            raise RuntimeError(
+                f"PS server {server_id} did not enter draining (a ring "
+                f"transition raced this drain: server epoch "
+                f"{doc.get('epoch')} vs proposed {proposal.epoch}); "
+                f"re-run drain_server")
+        # NOTE: the new table is adopted only AFTER the target reports
+        # zero owned keys (below).  Until then this worker keeps
+        # planning by the OLD ring, so its pushes land on the draining
+        # target and follow the migrate-then-redirect path — adopting
+        # early would let a concurrent push fresh-INIT a key on the new
+        # owner while that key's migration is still streaming (the
+        # install-race HandleMigrate refuses loudly).
+        deadline = time.monotonic() + max(1.0, timeout_s)
+        while True:
+            raw = self.conns[target_slot].request(
+                CMD_RING, worker_id=self.worker_id, timeout=10.0)
+            doc = _json.loads(bytes(raw).decode())
+            if int(doc.get("keys_owned", 0)) == 0:
+                break
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"drain of PS server {server_id} still reports "
+                    f"{doc.get('keys_owned')} owned key(s) after "
+                    f"{timeout_s}s")
+            time.sleep(0.05)
+        self._safe_adopt_ring(doc)   # every key's state has landed
+        get_logger().info("PS server %d drained (ring epoch %s)",
+                          server_id, doc.get("epoch"))
+        if shutdown:
+            try:
+                self.conns[target_slot].request(
+                    CMD_SHUTDOWN, worker_id=self.worker_id, timeout=10.0)
+            except (ConnectionError, OSError) as e:
+                get_logger().debug("drained-server shutdown race: %s", e)
+            # The process is going away: retire the slot and close its
+            # lanes NOW, or (with failover armed) their effectively-
+            # unbounded re-dial loops would spin against a dead address
+            # for the life of the session.  Without shutdown the server
+            # stays up answering redirects/stats, so its conns stay.
+            self._dead_slots.add(target_slot)
+            for c in self._data_conns[target_slot]:
+                try:
+                    c.close()
+                except Exception:
+                    pass
+        return doc
+
+    def _adopt_ring_doc(self, doc: dict) -> bool:
+        """Adopt a server-sent ring table (CMD_RING / RING_SET response /
+        MOVED payload) if its epoch is newer than ours."""
+        try:
+            table = RingTable.from_json(doc)
+        except Exception as e:
+            get_logger().warning("unparseable ring table ignored: %s", e)
+            return False
+        if not table.servers:
+            return False
+        return self._apply_ring(table)
+
+    def _apply_ring(self, table: RingTable) -> bool:
+        """Install a newer ring table: merge addresses (this session's
+        dial address wins for servers it already knows — it may be a
+        test proxy), dial any joiner, rebuild the id->slot map, then
+        invalidate the placement caches so the next plan (and every
+        remap) follows the new law.  Returns True when the epoch
+        advanced, False when the table is stale OR a joiner could not be
+        dialed — adoption is all-or-nothing (a half-applied table whose
+        owner has no conn slot would crash every plan), and a False here
+        is always retryable: the next MOVED redirect or scanner pass
+        re-presents the table."""
+        with self._ring_lock:
+            if self._ring is None or table.epoch <= self._ring.epoch:
+                return False
+            merged = []
+            joiners = []
+            for sid, h, p in table.servers:
+                slot = self._srv_slot.get(sid)
+                if slot is not None and slot not in self._dead_slots:
+                    c = self.conns[slot]
+                    merged.append((sid, c.host, c.port))
+                else:
+                    merged.append((sid, h, p))
+                    if slot is None:
+                        joiners.append((sid, h, p))
+        # Dial every joiner's lane pool OUTSIDE the ring lock (connects
+        # can block for seconds against a still-booting pod, and _plan
+        # needs the lock on every staging thread), and BEFORE committing
+        # anything — adoption is all-or-nothing: a half-applied table
+        # whose owner has no conn slot would crash every plan.
+        dialed = []
+        try:
+            for sid, h, p in joiners:
+                pool = [self._make_conn(h, p)]
+                for _ in range(self._wire_conns - 1):
+                    pool.append(self._make_conn(h, p))
+                dialed.append((sid, h, p, pool))
+        except OSError as e:
+            for _sid, _h, _p, pool in dialed:
+                for c in pool:
+                    try:
+                        c.close()
+                    except Exception:
+                        pass
+            get_logger().warning(
+                "not adopting ring epoch %d yet: cannot dial joining "
+                "PS server (%s) — will retry on the next redirect",
+                table.epoch, e)
+            return False
+        with self._ring_lock:
+            if self._ring is None or table.epoch <= self._ring.epoch:
+                # Another adoption won while we were dialing.
+                for _sid, _h, _p, pool in dialed:
+                    for c in pool:
+                        try:
+                            c.close()
+                        except Exception:
+                            pass
+                return False
+            for sid, h, p, pool in dialed:
+                live = self._srv_slot.get(sid)
+                if live is not None and live not in self._dead_slots:
+                    # A concurrent lower-epoch adoption already slotted
+                    # this joiner while we were dialing — keep its pool.
+                    for c in pool:
+                        try:
+                            c.close()
+                        except Exception:
+                            pass
+                    continue
+                slot = len(self.conns)
+                self.conns.append(pool[0])
+                self._data_conns.append(pool)
+                self._server_load.append(0)
+                self._hosts.append(h)
+                self._ports.append(p)
+                self._srv_slot[sid] = slot
+                self._slot_srv[slot] = sid
+                get_logger().info(
+                    "PS server %d (%s:%d) joined the ring; dialed as "
+                    "slot %d", sid, h, p, slot)
+            self._ring = RingTable(merged, table.vnodes, table.epoch)
+            live_ids = set(self._ring.ids())
+            self._srv_slot = {sid: slot for sid, slot
+                              in self._srv_slot.items() if sid in live_ids}
+            epoch = table.epoch
+        # Placement-cache invalidation OUTSIDE ring_mu_ (the _plan path
+        # takes _plan_lock THEN _ring_lock; same order here).
+        with self._plan_lock:
+            self._plans.clear()
+            with self._ring_lock:
+                ring, slots = self._ring, dict(self._srv_slot)
+            for pkey, old_slot in list(self._pkey_srv.items()):
+                new_slot = slots.get(ring.owner(pkey))
+                if new_slot is not None and new_slot != old_slot:
+                    # Moved key: the next stage must re-INIT on the new
+                    # owner (re-seeding its round from migrated — or,
+                    # after failover, fresh — server state).
+                    self._pkey_srv[pkey] = new_slot
+                    self._inited.pop(pkey, None)
+        get_logger().warning(
+            "adopted PS ring epoch %d: servers %s", epoch,
+            sorted(slots))
+        return True
+
+    def _park_for_remap(self, pkey: int,
+                        phase: Optional[str] = None) -> bool:
+        """Claim one in-flight partition for the ring-remap path: mark it
+        parked (so the dispatcher skips any queued entry), settle its
+        lane credit, and count it — the ONE bookkeeping block shared by
+        every redirect/failover site, mirroring what _park_part does for
+        reconnect parking.  Returns False when the part is gone or
+        already claimed."""
+        with self._inflight_lock:
+            part = self._inflight.get(pkey)
+            if part is None or part.parked:
+                return False
+            part.parked = True
+            if phase is not None:
+                part.phase = phase
+        self._lane_settle(part)
+        with self._transport_lock:
+            self._tstats["parked_parts"] += 1
+            self._tstats["parked_total"] += 1
+        return True
+
+    def _safe_adopt_ring(self, doc: dict) -> bool:
+        """_adopt_ring_doc that can never take down its calling thread:
+        both callers (the receiver-callback redirect path and the remap
+        worker) must survive a transiently undialable joiner — adoption
+        is retryable by construction (the next redirect re-presents the
+        table)."""
+        try:
+            return self._adopt_ring_doc(doc)
+        except Exception:
+            get_logger().exception("ring adoption failed (will retry on "
+                                   "the next redirect)")
+            return False
+
+    def _on_key_moved(self, pkey: int, phase: str,
+                      err: _KeyMoved) -> None:
+        """A push/pull drew status MOVED: park the partition and hand it
+        — with the attached ring table — to the remap worker, which
+        adopts the table and replays the partition against the new owner
+        (whose state the old owner already streamed over:
+        state-before-redirect is the server's contract).  Runs on a
+        receiver-callback thread, so it must never block: adoption (which
+        may dial a joiner) belongs to the remap worker."""
+        claimed = self._park_for_remap(pkey, phase)
+        if claimed:
+            with self._transport_lock:
+                self._tstats["ring_redirects"] += 1
+            self._queue_remap(pkey, err.doc)
+        else:
+            self._queue_remap(None, err.doc)   # still adopt the table
+
+    def _queue_remap(self, pkey: Optional[int],
+                     doc: Optional[dict] = None) -> None:
+        # The worker nulls _remap_thread UNDER _remap_lock just before
+        # exiting (see _remap_loop), so this check can never observe a
+        # thread that has already decided to stop — the
+        # append-then-strand TOCTOU a bare is_alive() test would allow.
+        with self._remap_lock:
+            self._remap_queue.append((pkey, doc))
+            if self._remap_thread is None:
+                self._remap_thread = threading.Thread(
+                    target=self._remap_loop, daemon=True,
+                    name="bps-ps-remap-ring")
+                self._remap_thread.start()
+
+    def _remap_loop(self) -> None:
+        """Drain the remap queue: route each parked partition to its
+        current ring owner and replay it (re-INIT + round reconcile +
+        push/pull replay — the same idempotent machinery reconnects
+        use).  Runs on a transient daemon thread so no receiver thread
+        ever blocks on a cross-server round trip."""
+        while True:
+            with self._remap_lock:
+                if not self._remap_queue:
+                    self._remap_thread = None   # hand-off point: a later
+                    return                      # _queue_remap starts fresh
+                pkey, doc = self._remap_queue.pop(0)
+            if doc is not None:
+                self._safe_adopt_ring(doc)
+            if pkey is None:
+                continue        # adoption-only entry
+            with self._inflight_lock:
+                part = self._inflight.get(pkey)
+            if part is None:
+                continue        # finished/failed while queued
+            with self._ring_lock:
+                ring = self._ring
+                slot = (None if ring is None
+                        else self._srv_slot.get(ring.owner(pkey)))
+            if slot is None or slot in self._dead_slots:
+                self._finish_part(pkey, ConnectionError(
+                    f"no live ring owner for moved key {pkey}"))
+                continue
+            part.srv = slot
+            self._pkey_srv[pkey] = slot
+            conn = self.conns[slot]
+            try:
+                self._replay_part(conn, part)
+            except _KeyMoved as e:
+                # Moved again mid-remap (back-to-back transitions, or a
+                # joiner not yet dialable): adopt the newer table and
+                # requeue.  The tiny sleep stops a hot redirect loop
+                # while an undialable joiner keeps adoption at bay —
+                # each retry is otherwise only RTT-throttled.
+                requeue = self._park_for_remap(pkey)
+                if not self._safe_adopt_ring(e.doc):
+                    time.sleep(0.1)
+                if requeue:
+                    self._queue_remap(pkey)
+            except ConnectionError as e:
+                err = (e if isinstance(e, _ConnLost)
+                       else conn._lost_exc(str(e)))
+                if not self._park_part(pkey, part.phase, err):
+                    self._finish_part(pkey, err)
+            except Exception as e:
+                self._finish_part(pkey, e)
+
+    def _down_servers(self) -> list:
+        """[(slot, host, port, planned_pkeys)] for servers whose EVERY
+        lane is down — the "dead server, not slow keys" diagnostic."""
+        rows = []
+        # list() snapshots: _plan/_remap mutate _pkey_srv concurrently,
+        # and a python-level iteration racing an insert raises
+        # "dictionary changed size" — which would kill the watchdog
+        # thread exactly when it is needed.
+        placed = list(self._pkey_srv.items())
+        for slot, pool in enumerate(list(self._data_conns)):
+            if slot in self._dead_slots or not pool:
+                continue
+            if all(c.state() != "up" for c in pool):
+                owned = sorted(k for k, s in placed if s == slot)
+                rows.append((slot, pool[0].host, pool[0].port, owned))
+        return rows
+
+    def _server_lease_loop(self) -> None:
+        """Worker-side server-lease scanner (armed by
+        BYTEPS_TPU_SERVER_EVICT_TIMEOUT_S > 0 — the server-tier mirror
+        of PR 7's worker eviction): a ring member whose every lane has
+        been down longer than the timeout is declared dead.  The
+        survivors adopt the next ring epoch (CMD_RING_SET; idempotent
+        under racing workers — all observed the same death, so all
+        propose the same transition), this worker re-routes everything
+        parked on the corpse, and the open round's gradients re-push to
+        the claimed ranges — no round is lost."""
+        interval = max(0.05, min(self.server_evict_timeout_s / 4.0, 1.0))
+        while not self._srvdown_stop.wait(interval):
+            if not self.ring_armed or self._ring is None:
+                continue
+            now = time.monotonic()
+            with self._ring_lock:
+                members = list(self._srv_slot.items())
+            live = [(sid, slot) for sid, slot in members
+                    if slot not in self._dead_slots]
+            for sid, slot in live:
+                pool = self._data_conns[slot]
+                dead = all(
+                    c.state() != "up" and c.down_since
+                    and now - c.down_since > self.server_evict_timeout_s
+                    for c in pool)
+                if not dead:
+                    continue
+                if len(live) <= 1:
+                    get_logger().error(
+                        "PS server %d is down past the evict timeout but "
+                        "is the LAST ring member — nothing to fail over "
+                        "to", sid)
+                    continue
+                try:
+                    self._declare_server_dead(sid, slot)
+                except Exception:
+                    get_logger().exception("server failover failed")
+
+    def _declare_server_dead(self, sid: int, slot: int) -> None:
+        age = max((time.monotonic() - c.down_since)
+                  for c in self._data_conns[slot] if c.down_since)
+        get_logger().error(
+            "PS server %d (%s:%d) declared DEAD: every lane down for "
+            "%.1fs (> BYTEPS_TPU_SERVER_EVICT_TIMEOUT_S=%.1fs); the "
+            "surviving ring claims its key ranges and the open round "
+            "re-pushes from gradient state",
+            sid, self.conns[slot].host, self.conns[slot].port, age,
+            self.server_evict_timeout_s)
+        import json as _json
+        with self._ring_lock:
+            ring = self._ring
+            if ring is None or sid not in ring.ids():
+                return          # another thread/worker beat us to it
+            proposal = ring.without(sid)
+            survivors = [(osid, oslot) for osid, oslot
+                         in self._srv_slot.items()
+                         if osid != sid and oslot not in self._dead_slots]
+        wire = proposal.to_wire()
+        adopted = None
+        for osid, oslot in survivors:
+            try:
+                raw = self.conns[oslot].request(
+                    CMD_RING_SET, payload=wire, worker_id=self.worker_id,
+                    timeout=15.0)
+                doc = _json.loads(bytes(raw).decode())
+                if adopted is None or (int(doc.get("epoch", 0))
+                                       > int(adopted.get("epoch", 0))):
+                    adopted = doc
+            except Exception as e:
+                get_logger().warning(
+                    "failover RING_SET to server %d failed: %s", osid, e)
+        if adopted is None:
+            # NO survivor accepted the proposal: this worker may be the
+            # partitioned one, not the server.  Transitioning locally
+            # anyway would split the fleet across two rings (this worker
+            # pushing a key's fresh lineage to a survivor while everyone
+            # else still pushes it to the "dead" server).  Hold the
+            # line and retry next scan — parked parts stay parked.
+            get_logger().error(
+                "failover of PS server %d aborted: no survivor accepted "
+                "the ring proposal (is THIS worker partitioned?); "
+                "retrying", sid)
+            return
+        self._adopt_ring_doc(adopted)
+        with self._transport_lock:
+            self._tstats["server_failovers"] += 1
+        # Park-and-remap everything routed at the corpse, THEN close its
+        # conns (ending the background re-dial loops).  Parked parts in
+        # the scheduler queue are skipped by the dispatcher until the
+        # remap re-enqueues them against the new owner.
+        with self._inflight_lock:
+            stuck = [p.pkey for p in self._inflight.values()
+                     if p.srv == slot]
+        for pkey in stuck:
+            self._park_for_remap(pkey)   # no-op if already parked — the
+            #                              remap claims each exactly once
+            self._queue_remap(pkey)
+        self._dead_slots.add(slot)
+        for c in self._data_conns[slot]:
+            try:
+                c.close()
+            except Exception:
+                pass
 
     def transport_stats(self) -> dict:
         """Fault-tolerance + raw-speed transport counters: reconnects,
@@ -2053,9 +2739,14 @@ class PSSession:
         merged = {"bytes_in": 0, "bytes_out": 0, "async": False,
                   "num_workers": 0, "scatter_frames": 0, "keys": {},
                   "workers": {}, "epoch": 0, "deferred_joins": 0,
-                  "members": {}}
+                  "members": {}, "ring_epoch": 0, "servers": {}}
         import json as _json
-        for c in self.conns:
+        for slot, c in enumerate(self.conns):
+            sid = self._slot_srv.get(slot, slot)
+            if slot in self._dead_slots:
+                merged["servers"][sid] = {"alive": False, "keys_owned": 0,
+                                          "draining": False}
+                continue
             try:
                 raw = c.request(CMD_STATS, worker_id=self.worker_id,
                                 timeout=timeout)
@@ -2064,7 +2755,33 @@ class PSSession:
                     f"PS server at {c.host}:{c.port} does not support "
                     f"CMD_STATS (server too old — rebuild/redeploy the "
                     f"server tier to match this client): {e}") from e
+            except (ConnectionError, OSError, TimeoutError):
+                # A dead/unreachable server must not break the whole
+                # stats plane — that is exactly when an operator reads
+                # it.  Its row reports alive=False; the survivors' rows
+                # still merge.
+                merged["servers"][sid] = {"alive": False, "keys_owned": 0,
+                                          "draining": False}
+                continue
             st = _json.loads(bytes(raw).decode())
+            merged["ring_epoch"] = max(merged["ring_epoch"],
+                                       int(st.get("ring_epoch", 0)))
+            # Row key: the server-reported id only when the ring is
+            # armed (ids are then meaningful and unique).  Unarmed
+            # deployments all report server_id 0 (DMLC_SERVER_ID is not
+            # required there) — keying by it would collapse N servers
+            # into one row and hide a dead one from the exact panel
+            # built to expose it.
+            row_id = (int(st.get("server_id", sid))
+                      if st.get("ring_armed") else sid)
+            merged["servers"][row_id] = {
+                "alive": True,
+                "keys_owned": int(st.get("keys_owned", 0)),
+                "draining": bool(st.get("draining", 0)),
+                "migrations_in": int(st.get("migrations_in", 0)),
+                "migrations_out": int(st.get("migrations_out", 0)),
+                "moved_frames": int(st.get("moved_frames", 0)),
+            }
             merged["bytes_in"] += int(st.get("bytes_in", 0))
             merged["bytes_out"] += int(st.get("bytes_out", 0))
             merged["scatter_frames"] += int(st.get("scatter_frames", 0))
@@ -2357,21 +3074,41 @@ class PSSession:
         comp = self._compressors.get(declared_key)
         kw_bytes = comp.kwargs_string().encode() if comp else b""
         label = self._label(declared_key)
-        parts = []
-        try:
-            self._stage_parts(plan, payload, mv, comp, kw_bytes, handle,
-                              parts, raw, seed, label, priority)
-        except Exception:
-            # Roll back partitions already staged in _inflight: leaving them
-            # would wedge the key forever (the sequential-use guard waits on
-            # done_evt, which nothing would ever set).
-            with self._inflight_lock:
-                for p in parts:
-                    if self._inflight.get(p.pkey) is p:
-                        del self._inflight[p.pkey]
-                    p.done_evt.set()
-            raise
+        parts: list = []
+        for attempt in range(4):
+            try:
+                self._stage_parts(plan, payload, mv, comp, kw_bytes,
+                                  handle, parts, raw, seed, label,
+                                  priority)
+                return handle, parts
+            except _KeyMoved as e:
+                # A staging INIT hit a ring transition: roll back, adopt
+                # the attached table, re-plan against it, retry (partition
+                # BOUNDS are placement-independent, so the handle stays
+                # valid).  Bounded — a healthy ring settles in one hop.
+                self._rollback_stage(parts)
+                parts = []
+                self._adopt_ring_doc(e.doc)
+                if attempt == 3:
+                    raise RuntimeError(
+                        f"ring kept moving while staging key "
+                        f"{declared_key}") from e
+                plan = self._plan(declared_key, payload.nbytes)
+            except Exception:
+                # Roll back partitions already staged in _inflight:
+                # leaving them would wedge the key forever (the
+                # sequential-use guard waits on done_evt, which nothing
+                # would ever set).
+                self._rollback_stage(parts)
+                raise
         return handle, parts
+
+    def _rollback_stage(self, parts: list) -> None:
+        with self._inflight_lock:
+            for p in parts:
+                if self._inflight.get(p.pkey) is p:
+                    del self._inflight[p.pkey]
+                p.done_evt.set()
 
     def _enqueue(self, staged) -> None:
         """Enqueue staged partitions ([(parts, priority), ...]) into the
@@ -2593,6 +3330,7 @@ class PSSession:
             self._closed = True
             self._cv.notify_all()
         self._watchdog_stop.set()
+        self._srvdown_stop.set()
         self._clock_sync_stop.set()
         self._lease_stop.set()
         # Detach the queue-depth gauge's sampler: the registry outlives the

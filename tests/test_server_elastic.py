@@ -1,0 +1,549 @@
+"""Elastic PS server tier tests (docs/elasticity.md, "The server half").
+
+Drives the REAL client/server wire code through the ring transitions —
+graceful drain (CMD_DRAIN state handoff), scale-up (a joining server's
+CMD_RING_SET announce + re-shard), and failover (worker-side server
+lease scanner claiming a dead server's key ranges) — and asserts the
+invariants the ring model promises: the Python and C++ placement laws
+are bit-identical, adding a server moves only ~1/N of the keys (all of
+them TO the joiner), sums are exact across every migration boundary,
+and a fixed-topology job (ring unarmed, the default) sends byte-for-
+byte the same wire traffic as before the ring existed.
+"""
+
+import ctypes
+import json
+import os
+import socket
+import struct
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from byteps_tpu.common.ring import (
+    RingTable, build_points, moved_fraction, owner_of, splitmix64,
+)
+from byteps_tpu.core import build as core_build
+from byteps_tpu.server.client import (
+    PSSession,
+    CMD_HELLO, CMD_INIT, CMD_PUSH, CMD_PULL, CMD_RING,
+)
+
+from testutil import cpu_env, free_port, StubPSServer
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "tools"))
+from chaos_proxy import MultiChaosProxy  # noqa: E402
+
+
+# ---------------------------------------------------------------------------
+# harness: N ring-armed servers on consecutive ports
+# ---------------------------------------------------------------------------
+@pytest.fixture
+def ring_servers():
+    """Yields ``start(n, ...) -> (ports, base)``; every started server is
+    killed afterwards.  Servers follow the root+1+id port convention so
+    their peer books and the workers' launch rings agree."""
+    made = []
+
+    def start(n, evict_s=0.0, extra_env=None, num_workers=1):
+        last = None
+        for _ in range(4):
+            try:
+                return _start_group(n, evict_s, extra_env, num_workers)
+            except (RuntimeError, TimeoutError) as e:
+                last = e
+        raise last
+
+    def _start_group(n, evict_s, extra_env, num_workers):
+        with socket.socket() as sk:
+            sk.bind(("127.0.0.1", 0))
+            base = sk.getsockname()[1]
+        ports = [base + i for i in range(n)]
+        procs = []
+        for i in range(n):
+            procs.append(_boot_server(i, n, base, num_workers, evict_s,
+                                      extra_env))
+        made.extend(procs)
+        deadline = time.time() + 30
+        up = set()
+        while time.time() < deadline and len(up) < n:
+            for i, p in enumerate(ports):
+                if i in up:
+                    continue
+                try:
+                    socket.create_connection(("127.0.0.1", p), 0.5).close()
+                    up.add(i)
+                except OSError:
+                    if procs[i].poll() is not None:
+                        raise RuntimeError(
+                            f"server {i} died rc={procs[i].returncode}")
+            time.sleep(0.1)
+        if len(up) < n:
+            raise TimeoutError("ring servers did not come up")
+        return ports, base
+
+    def _boot_server(i, n, base, num_workers, evict_s, extra_env,
+                     join=False):
+        env = cpu_env({
+            "DMLC_PS_ROOT_PORT": str(base - 1),
+            "DMLC_NUM_WORKER": str(num_workers),
+            "DMLC_NUM_SERVER": str(n),
+            "DMLC_SERVER_ID": str(i),
+            "BYTEPS_TPU_RING": "1",
+            "BYTEPS_TPU_RING_JOIN": "1" if join else "",
+            "BYTEPS_TPU_EVICT_TIMEOUT_S": str(evict_s) if evict_s else "",
+            "BYTEPS_SERVER_ENGINE_THREAD": "2",
+            "JAX_PLATFORMS": "cpu",
+            **(extra_env or {}),
+        })
+        return subprocess.Popen(
+            [sys.executable, "-m", "byteps_tpu.server"], env=env,
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+
+    start.boot_joiner = lambda i, n, base: _track(
+        _boot_server(i, n, base, 1, 0.0, None, join=True))
+
+    def _track(p):
+        made.append(p)
+        return p
+
+    yield start
+    for p in made:
+        p.kill()
+        p.wait()
+
+
+def _ring_session(ports, wid=0, srv_evict=0.0, **kw):
+    kw.setdefault("wire_conns", 1)
+    kw.setdefault("partition_bytes", 1 << 16)
+    return PSSession(["127.0.0.1"] * len(ports), list(ports),
+                     worker_id=wid, num_servers=len(ports), ring=True,
+                     server_evict_timeout_s=srv_evict, **kw)
+
+
+# ---------------------------------------------------------------------------
+# fast: ring math — the one placement law
+# ---------------------------------------------------------------------------
+def test_ring_owner_matches_cpp():
+    """The Python ring law and the C++ ring law (server.cc ownership
+    gate, via bps_ring_owner) are bit-identical — a disagreement would
+    redirect-livelock every push."""
+    lib = ctypes.CDLL(core_build.build())
+    lib.bps_ring_owner.restype = ctypes.c_int64
+    lib.bps_ring_owner.argtypes = [
+        ctypes.c_uint64, ctypes.POINTER(ctypes.c_uint32), ctypes.c_int32,
+        ctypes.c_int32]
+    for ids in ([0, 1], [0, 1, 2], [0, 2, 7], [3]):
+        arr = (ctypes.c_uint32 * len(ids))(*ids)
+        pts = build_points(ids, 64)
+        for k in range(2000):
+            key = splitmix64(k) ^ (k << 16)
+            assert owner_of(key, pts) == lib.bps_ring_owner(
+                key, arr, len(ids), 64), (ids, key)
+    assert lib.bps_ring_owner(1, None, 0, 64) == -1
+
+
+def test_ring_stability_add_moves_about_one_nth():
+    """Adding one of N+1 servers moves ~1/(N+1) of the keys — and every
+    moved key moves TO the joiner (state handoff is one-directional)."""
+    old = RingTable([(0, "h", 1), (1, "h", 2), (2, "h", 3)])
+    new = old.with_server(3, "h", 4)
+    keys = [(k << 16) | (k % 4) for k in range(4000)]
+    frac = moved_fraction(old, new, keys)
+    assert 0.10 < frac < 0.45, frac          # ideal 0.25 with 64 vnodes
+    for k in keys:
+        if old.owner(k) != new.owner(k):
+            assert new.owner(k) == 3
+    # Removing a server moves ONLY its keys, all to survivors.
+    back = new.without(3)
+    for k in keys:
+        if new.owner(k) != back.owner(k):
+            assert new.owner(k) == 3
+        else:
+            assert back.owner(k) == new.owner(k)
+
+
+def test_ring_table_wire_and_json_roundtrip():
+    t = RingTable([(0, "10.0.0.1", 9001), (2, "10.0.0.3", 9003)],
+                  vnodes=32, epoch=5)
+    wire = t.to_wire()
+    epoch, vnodes, n = struct.unpack("<QII", wire[:16])
+    assert (epoch, vnodes, n) == (5, 32, 2)
+    t2 = RingTable.from_json(t.describe())
+    assert t2.epoch == 5 and t2.vnodes == 32
+    assert t2.ids() == t.ids()
+    assert t2.owner(12345) == t.owner(12345)
+    with pytest.raises(ValueError):
+        RingTable([(0, "h", 1)]).without(0)   # never empty the ring
+
+
+# ---------------------------------------------------------------------------
+# fast: fixed topology is untouched; old servers fail clean
+# ---------------------------------------------------------------------------
+def test_fixed_topology_wire_unchanged():
+    """Ring unarmed (default): placement is the legacy hash and the
+    traffic contains no RING/MIGRATE/redirect frame — byte-for-byte the
+    pre-ring protocol (the PR-7-style recording-stub regression)."""
+    store = {}
+
+    def handler(cmd, dt, fl, req_id, wid, key, payload):
+        if cmd == CMD_HELLO:
+            return 0, b"\x00\x00"
+        if cmd == CMD_INIT:
+            return 0, struct.pack("<Q", 0)
+        if cmd == CMD_PUSH:
+            store[key] = bytes(payload)
+            return 0, b""
+        if cmd == CMD_PULL:
+            return 0, store[key]
+        return 1, b""
+
+    srv = StubPSServer(handler, record=True)
+    try:
+        s = PSSession(["127.0.0.1"], [srv.port], worker_id=0,
+                      num_servers=1, wire_conns=1)
+        assert not s.ring_armed
+        x = np.arange(64, dtype=np.float32)
+        np.testing.assert_array_equal(s.push_pull(3, x), x)
+        # Placement still comes from the legacy fixed hash.
+        from byteps_tpu.core.native import get_core
+        core = get_core()
+        for pkey, srv_idx in s._pkey_srv.items():
+            assert srv_idx == core.key_to_server(pkey, 1, s.hash_fn)
+        s.close()
+        with srv.lock:
+            cmds = {c for _, c, _ in srv.frames}
+        assert cmds <= {CMD_HELLO, CMD_INIT, CMD_PUSH, CMD_PULL}, cmds
+    finally:
+        srv.close()
+
+
+def test_ring_armed_against_old_server_fails_clean():
+    """A ring-armed worker against a pre-ring server gets a clean
+    "server too old" error from its CMD_RING bootstrap — never a hang,
+    never silent legacy placement."""
+    def handler(cmd, dt, fl, req_id, wid, key, payload):
+        if cmd == CMD_HELLO:
+            return 0, b"\x00\x00"
+        return 1, b""        # old server: unknown command -> error status
+
+    srv = StubPSServer(handler)
+    try:
+        with pytest.raises(RuntimeError, match="server too old"):
+            PSSession(["127.0.0.1"], [srv.port], worker_id=0,
+                      num_servers=1, wire_conns=1, ring=True)
+    finally:
+        srv.close()
+
+
+def test_ring_armed_against_unarmed_server_fails_clean():
+    """Armed worker + unarmed (new) server is a configuration mismatch,
+    named as such."""
+    def handler(cmd, dt, fl, req_id, wid, key, payload):
+        if cmd == CMD_HELLO:
+            return 0, b"\x00\x00"
+        if cmd == CMD_RING:
+            return 0, json.dumps({"epoch": 0, "armed": 0,
+                                  "servers": []}).encode()
+        return 1, b""
+
+    srv = StubPSServer(handler)
+    try:
+        with pytest.raises(RuntimeError, match="not on the server tier"):
+            PSSession(["127.0.0.1"], [srv.port], worker_id=0,
+                      num_servers=1, wire_conns=1, ring=True)
+    finally:
+        srv.close()
+
+
+# ---------------------------------------------------------------------------
+# fast: drain — state handoff exactness
+# ---------------------------------------------------------------------------
+def test_drain_handoff_exactness(ring_servers):
+    """Graceful 3->2 drain (the acceptance's scale-down): every key's
+    state (completed rounds AND the open round's partial merge) streams
+    to its new owner; sums stay exact across the boundary, and the
+    drained server reports zero owned keys.  Two workers, with the drain
+    landing INSIDE an open round — worker 0's contribution migrates as
+    state, worker 1's push is redirected, and the round publishes on the
+    new owner with both."""
+    ports, _ = ring_servers(3, num_workers=2)
+    s0 = _ring_session(ports, wid=0)
+    s1 = _ring_session(ports, wid=1)
+    try:
+        keys = list(range(1, 13))
+        x = np.arange(1 << 12, dtype=np.float32)
+
+        def round_all(mult):
+            h0 = [s0.push_pull_async(k, x * mult) for k in keys]
+            h1 = [s1.push_pull_async(k, x * (10 * mult)) for k in keys]
+            want = x * mult + x * (10 * mult)
+            for h in h0 + h1:
+                np.testing.assert_array_equal(h.wait(30), want)
+
+        round_all(1.0)
+        round_all(2.0)
+        # Drain the server owning the MOST keys (never vacuous; slot ==
+        # server id at launch).
+        by_slot: dict = {}
+        for slot in s0._pkey_srv.values():
+            by_slot[slot] = by_slot.get(slot, 0) + 1
+        target = max(by_slot, key=by_slot.get)
+        assert by_slot[target] > 0
+
+        # Open a round: worker 0 pushes alone, lands server-side.
+        h0 = [s0.push_pull_async(k, x * 3) for k in keys]
+        time.sleep(0.4)
+        doc = s0.drain_server(target)
+        assert doc["keys_owned"] == 0
+        assert doc["draining"] == 1
+        # Worker 1 completes the round post-drain: redirected pushes
+        # must merge into the MIGRATED partial state.
+        h1 = [s1.push_pull_async(k, x * 30) for k in keys]
+        want = x * 3 + x * 30
+        for h in h0 + h1:
+            np.testing.assert_array_equal(h.wait(30), want)
+
+        # And the next full round runs entirely on the survivors.
+        round_all(4.0)
+        st = s0.server_stats()
+        assert st["ring_epoch"] >= 1
+        assert st["servers"][target]["keys_owned"] == 0
+        assert st["servers"][target]["draining"] is True
+        survivors = [sid for sid in st["servers"] if sid != target]
+        assert sum(st["servers"][sid]["migrations_in"]
+                   for sid in survivors) > 0
+        assert target not in set(s0._pkey_srv.values())
+    finally:
+        s0.close()
+        s1.close()
+
+
+# ---------------------------------------------------------------------------
+# fast: scale-up — joiner admission + ~1/N re-shard with state handoff
+# ---------------------------------------------------------------------------
+def test_scale_up_reshard(ring_servers):
+    """A third server joins a 2-server ring (BYTEPS_TPU_RING_JOIN):
+    ~1/3 of the keys re-home onto it WITH their state (no round
+    rebases), every moved key moves to the joiner, and sums stay exact
+    through the transition."""
+    ports, base = ring_servers(2)
+    s = _ring_session(ports)
+    try:
+        keys = list(range(1, 13))
+        x = np.arange(1 << 12, dtype=np.float32)
+
+        def round_all(mult, timeout=30):
+            hs = [s.push_pull_async(k, x * mult) for k in keys]
+            for h in hs:
+                np.testing.assert_array_equal(h.wait(timeout), x * mult)
+
+        round_all(1.0)
+        round_all(2.0)
+        pre = dict(s._pkey_srv)
+
+        ring_servers.boot_joiner(2, 2, base)
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            if s.get_ring().get("epoch", 0) >= 1:
+                break
+            time.sleep(0.1)
+        assert s.get_ring()["epoch"] >= 1, "joiner never announced"
+
+        round_all(3.0, timeout=60)      # redirects land here
+        round_all(4.0)
+        post = dict(s._pkey_srv)
+        moved = [k for k in pre if post[k] != pre[k]]
+        assert moved, "no keys re-homed to the joiner"
+        assert all(post[k] == 2 for k in moved), \
+            "keys moved somewhere other than the joiner"
+        frac = len(moved) / len(pre)
+        assert frac < 0.8, f"re-shard moved {frac:.0%} of keys"
+        st = s.server_stats()
+        assert st["servers"][2]["keys_owned"] > 0
+        assert st["servers"][2]["migrations_in"] > 0
+    finally:
+        s.close()
+
+
+# ---------------------------------------------------------------------------
+# fast: failover — dead server's ranges claimed, open round re-pushed
+# ---------------------------------------------------------------------------
+def test_server_failover_claims_ranges(ring_servers):
+    """1-of-2 servers SIGKILLed mid-job with the server lease scanner
+    armed: the survivor claims the dead ranges at the next ring epoch,
+    the open round re-pushes from gradient state, and no pull hangs."""
+    evict = 0.8
+    ports, _ = ring_servers(2)
+    s = _ring_session(ports, srv_evict=evict)
+    try:
+        keys = list(range(1, 9))
+        x = np.arange(1 << 12, dtype=np.float32)
+        for m in (1.0, 2.0):
+            hs = [s.push_pull_async(k, x * m) for k in keys]
+            for h in hs:
+                np.testing.assert_array_equal(h.wait(30), x * m)
+
+        # SIGKILL the process behind ports[1]: a crash, not a drain — no
+        # FIN courtesy, no CMD_LEAVE, its store dies with it.
+        _kill_listener(ports[1])
+
+        t0 = time.monotonic()
+        hs = [s.push_pull_async(k, x * 5) for k in keys]
+        for h in hs:
+            np.testing.assert_array_equal(h.wait(60), x * 5)
+        dt = time.monotonic() - t0
+        assert dt < 20, f"failover round took {dt:.1f}s"
+        st = s.transport_stats()
+        assert st["server_failovers"] >= 1
+        ring = s.get_ring()
+        assert ring["epoch"] >= 1
+        assert [sv["id"] for sv in ring["servers"]] == [0]
+        # Subsequent rounds run clean on the survivor.
+        hs = [s.push_pull_async(k, x * 6) for k in keys]
+        for h in hs:
+            np.testing.assert_array_equal(h.wait(30), x * 6)
+    finally:
+        s.close()
+
+
+def _kill_listener(port: int) -> None:
+    """SIGKILL the process listening on 127.0.0.1:`port` (the crash
+    fault — no FIN, no drain)."""
+    import signal
+    out = subprocess.run(
+        ["python", "-c", (
+            "import glob,os\n"
+            f"port={port}\n"
+            "import re\n"
+            "hexp = '%04X' % port\n"
+            "inode = None\n"
+            "for line in open('/proc/net/tcp'):\n"
+            "    f = line.split()\n"
+            "    if len(f) > 9 and f[1].endswith(':' + hexp) "
+            "and f[3] == '0A':\n"
+            "        inode = f[9]\n"
+            "if inode:\n"
+            "    for fd in glob.glob('/proc/[0-9]*/fd/*'):\n"
+            "        try:\n"
+            "            if os.readlink(fd) == 'socket:[' + inode + ']':\n"
+            "                print(fd.split('/')[2]); break\n"
+            "        except OSError: pass\n")],
+        capture_output=True, text=True)
+    pid = out.stdout.strip()
+    assert pid, f"no listener found on port {port}"
+    os.kill(int(pid), signal.SIGKILL)
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        try:
+            socket.create_connection(("127.0.0.1", port), 0.3).close()
+            time.sleep(0.1)
+        except OSError:
+            return
+
+
+# ---------------------------------------------------------------------------
+# slow: chaos acceptance — permanent kill of 1-of-3 servers mid-training
+# ---------------------------------------------------------------------------
+@pytest.mark.slow
+def test_chaos_server_kill_bit_identical_trajectories(ring_servers):
+    """The ISSUE's chaos acceptance: 2 workers train against 3 ring
+    servers fronted by ONE MultiChaosProxy process; server 1's link is
+    killed permanently mid-training.  The job completes every round, and
+    both workers' weight trajectories are BIT-IDENTICAL to the exact
+    expected trajectory (integer gradients => the unfaulted sums are
+    computable in closed form, so this is equality with an unfaulted
+    run, not merely cross-worker agreement)."""
+    evict = 1.0
+    kill_after, total_rounds = 3, 8
+    ports, _ = ring_servers(3, num_workers=2)
+    multi = MultiChaosProxy([("127.0.0.1", p) for p in ports]).start()
+
+    dim = 1 << 12
+    nkeys = 6
+    rng = np.random.default_rng(11)
+    grads = {(w, r, k): rng.integers(-8, 9, dim).astype(np.float32)
+             for w in range(2) for r in range(total_rounds)
+             for k in range(1, nkeys + 1)}
+
+    # The exact unfaulted trajectory: w_{r} = w_{r-1} - 0.1 * sum_w g.
+    expected = {}
+    for k in range(1, nkeys + 1):
+        w = np.zeros(dim, np.float32)
+        traj = []
+        for r in range(total_rounds):
+            s = grads[(0, r, k)] + grads[(1, r, k)]
+            w = w - np.float32(0.1) * s
+            traj.append(w.copy())
+        expected[k] = traj
+
+    sessions = [
+        PSSession(["127.0.0.1"] * 3, multi.ports, worker_id=w,
+                  num_servers=3, wire_conns=1, ring=True,
+                  server_evict_timeout_s=evict,
+                  partition_bytes=1 << 16)
+        for w in range(2)]
+    trajectories = {0: {}, 1: {}}
+    errors = []
+    barrier = threading.Barrier(2)
+
+    def train(wid, sess):
+        weights = {k: np.zeros(dim, np.float32)
+                   for k in range(1, nkeys + 1)}
+        try:
+            for r in range(total_rounds):
+                # Kill between rounds (both workers aligned): the open
+                # round's gradients then re-push to the claimed ranges —
+                # "no round is lost".
+                barrier.wait(timeout=120)
+                if wid == 0 and r == kill_after:
+                    multi.kill_permanently(1)
+                barrier.wait(timeout=120)
+                hs = {k: sess.push_pull_async(k, grads[(wid, r, k)])
+                      for k in weights}
+                for k, h in hs.items():
+                    got = h.wait(90)
+                    weights[k] = (weights[k]
+                                  - np.float32(0.1) * got)
+                    trajectories[wid].setdefault(k, []).append(
+                        weights[k].copy())
+        except Exception as e:
+            errors.append((wid, e))
+
+    try:
+        threads = [threading.Thread(target=train, args=(w, sessions[w]))
+                   for w in range(2)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=300)
+            assert not t.is_alive(), "training wedged"
+        assert not errors, errors
+
+        # Bit-identical to the UNFAULTED trajectory, every worker, every
+        # key, every round.
+        for wid in (0, 1):
+            for k in range(1, nkeys + 1):
+                assert len(trajectories[wid][k]) == total_rounds
+                for r in range(total_rounds):
+                    assert np.array_equal(trajectories[wid][k][r],
+                                          expected[k][r]), \
+                        f"worker {wid} key {k} diverged at round {r}"
+
+        ring = sessions[0].get_ring()
+        assert ring["epoch"] >= 1
+        assert 1 not in [sv["id"] for sv in ring["servers"]]
+        st = sessions[0].transport_stats()
+        assert st["server_failovers"] >= 1
+    finally:
+        for s in sessions:
+            try:
+                s.close()
+            except Exception:
+                pass
+        multi.stop()

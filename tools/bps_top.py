@@ -150,6 +150,31 @@ def render(metrics: dict, prev: dict, dt: float) -> list:
                 lines.append(f"  peers waited {_fmt_s(v)} on worker {wid}")
         lines.append("")
 
+    srv_alive = metrics.get("bps_server_alive") or {}
+    if srv_alive:
+        ring_epoch = int(_get(metrics, "bps_ring_epoch"))
+        owned = {dict(k).get("server"): v
+                 for k, v in (metrics.get("bps_keys_owned") or {}).items()}
+        mig = {}
+        for k, v in (metrics.get("bps_server_migrations") or {}).items():
+            d = dict(k)
+            mig.setdefault(d.get("server"), {})[d.get("direction")] = int(v)
+        total_owned = sum(owned.values()) or 1
+        lines.append(f"PS servers (ring epoch {ring_epoch})")
+        for key, alive in sorted(srv_alive.items(),
+                                 key=lambda kv: dict(kv[0]).get("server",
+                                                                "")):
+            sid = dict(key).get("server", "?")
+            n = int(owned.get(sid, 0))
+            bar = "#" * int(30 * n / total_owned)
+            m = mig.get(sid, {})
+            migtxt = (f"  mig in/out {m.get('in', 0)}/{m.get('out', 0)}"
+                      if m.get("in") or m.get("out") else "")
+            flag = "" if alive else "  <-- dead/retired"
+            lines.append(f"  server {sid:>3}  keys {n:5d}  {bar}"
+                         f"{migtxt}{flag}")
+        lines.append("")
+
     lag = metrics.get("bps_worker_round_lag") or {}
     if lag:
         epoch = int(_get(metrics, "bps_membership_epoch"))

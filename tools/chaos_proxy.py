@@ -322,12 +322,78 @@ class ChaosProxy:
             pass
 
 
+class MultiChaosProxy:
+    """N independent chaos proxies in ONE process — the server-tier
+    counterpart of ChaosProxy.
+
+    A server-failover chaos test fronts every PS server with a proxy so
+    any one of them can be killed permanently; spawning a process per
+    server made that O(N) interpreters for a 3-line need.  Each target
+    keeps its own fault schedule:
+
+        multi = MultiChaosProxy([("127.0.0.1", p) for p in ports]).start()
+        sess  = PSSession(["127.0.0.1"] * 3, multi.ports, ...)
+        multi.kill_permanently(1)      # server 1 is gone for good
+        multi.restore(1)               # ...or comes back (new hardware)
+        multi.stats()                  # per-target counter dicts
+
+    Any per-target fault the single proxy offers is reachable through
+    ``multi.proxy(i)``.
+    """
+
+    def __init__(self, upstreams, listen_host: str = "127.0.0.1"):
+        self.proxies = [ChaosProxy(h, p, listen_host=listen_host)
+                        for h, p in upstreams]
+
+    @property
+    def ports(self):
+        return [p.port for p in self.proxies]
+
+    def start(self) -> "MultiChaosProxy":
+        for p in self.proxies:
+            p.start()
+        return self
+
+    def stop(self) -> None:
+        for p in self.proxies:
+            p.stop()
+
+    def __enter__(self) -> "MultiChaosProxy":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    def proxy(self, i: int) -> ChaosProxy:
+        return self.proxies[i]
+
+    def kill(self, i: int) -> None:
+        """Transient outage of target i (reconnects succeed)."""
+        self.proxies[i].kill_connections()
+
+    def kill_permanently(self, i: int) -> None:
+        """Target i is gone for good: drop and refuse forever."""
+        self.proxies[i].kill_permanently()
+
+    def restore(self, i: int) -> None:
+        """Heal target i (clear every armed fault)."""
+        self.proxies[i].pass_through()
+
+    def stats(self) -> list:
+        return [p.stats() for p in self.proxies]
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--upstream", required=True, metavar="HOST:PORT",
-                    help="real server address to forward to")
-    ap.add_argument("--listen-port", type=int, default=0,
-                    help="local port to listen on (0 = ephemeral)")
+                    action="append",
+                    help="real server address to forward to; repeat for "
+                         "multi-target mode (one proxy per upstream, one "
+                         "process total)")
+    ap.add_argument("--listen-port", type=int, default=None,
+                    action="append",
+                    help="local port to listen on (0/omitted = ephemeral);"
+                         " repeat to pair with repeated --upstream")
     ap.add_argument("--listen-host", default="127.0.0.1")
     ap.add_argument("--delay-ms", type=float, default=0.0,
                     help="per-chunk latency, both directions")
@@ -344,27 +410,37 @@ def main() -> int:
                     help="re-arm the reset/drop fault for every connection "
                          "(default: fire once, then heal)")
     args = ap.parse_args()
-    host, port = args.upstream.rsplit(":", 1)
-    proxy = ChaosProxy(host, int(port), args.listen_host, args.listen_port)
-    proxy.start()
-    if args.delay_ms:
-        proxy.delay(args.delay_ms)
-    if args.reset_after is not None:
-        proxy.reset_after(args.reset_after, once=not args.flap)
-    if args.drop_after is not None:
-        proxy.drop_after(args.drop_after, once=not args.flap)
-    if args.blackhole:
-        proxy.blackhole(True)
-    if args.kill_permanent:
-        proxy.kill_permanently()
-    print(f"chaos proxy: {args.listen_host}:{proxy.port} -> "
-          f"{host}:{port}", flush=True)
+    upstreams = [u.rsplit(":", 1) for u in args.upstream]
+    lports = args.listen_port or []
+    proxies = []
+    for i, (host, port) in enumerate(upstreams):
+        lp = lports[i] if i < len(lports) else 0
+        proxy = ChaosProxy(host, int(port), args.listen_host, lp)
+        proxy.start()
+        # The CLI fault schedule applies to EVERY target; per-target
+        # schedules are an in-process (MultiChaosProxy) feature.
+        if args.delay_ms:
+            proxy.delay(args.delay_ms)
+        if args.reset_after is not None:
+            proxy.reset_after(args.reset_after, once=not args.flap)
+        if args.drop_after is not None:
+            proxy.drop_after(args.drop_after, once=not args.flap)
+        if args.blackhole:
+            proxy.blackhole(True)
+        if args.kill_permanent:
+            proxy.kill_permanently()
+        print(f"chaos proxy[{i}]: {args.listen_host}:{proxy.port} -> "
+              f"{host}:{port}", flush=True)
+        proxies.append(proxy)
     try:
         while True:
             time.sleep(5)
-            print(f"chaos proxy stats: {proxy.stats()}", flush=True)
+            for i, proxy in enumerate(proxies):
+                print(f"chaos proxy[{i}] stats: {proxy.stats()}",
+                      flush=True)
     except KeyboardInterrupt:
-        proxy.stop()
+        for proxy in proxies:
+            proxy.stop()
         return 0
 
 
