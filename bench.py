@@ -38,6 +38,10 @@ Modes:
   BENCH_TRACE=1      tracing-overhead bench: sync-round time with the
                      distributed tracer hot (worker+server spans, traced
                      wire flags) vs off (emits trace_overhead_ms)
+  BENCH_AUDIT=1      auditor-overhead bench: sync-round time with the
+                     consistency auditor hot (publish digests, pull
+                     trailers, re-digest, health sampling) vs off —
+                     audit_overhead_ms, expected within noise
   BENCH_TELEMETRY=1  telemetry-overhead bench: sync-round time with the
                      metrics endpoint scraped at 20Hz vs export plane off
                      (emits telemetry_overhead_ms; expected within noise)
@@ -143,6 +147,31 @@ def _note() -> dict:
     device-platform honesty stamp (every BENCH record carries both)."""
     n = os.environ.get("BENCH_NOTE")
     return {**({"note": n} if n else {}), **_device_stamp()}
+
+
+def _headline_note() -> dict:
+    """`_note()` for HEADLINE records (flagship / MULTICHIP / CNN): a
+    run that silently fell back to the CPU host REFUSES to write the
+    record at all — BENCH_r05's fallback number sat in the history
+    reading like an on-chip result for a whole round, and the unit
+    prefix alone did not stop it.  `BENCH_ALLOW_FALLBACK=1` is the
+    explicit override: the record is then written stamped
+    `"fallback": true` so no downstream reader can mistake it.
+    Host-only benches (wire/fault/telemetry/audit/...) keep plain
+    `_note()` — they never involve a device, so there is nothing to
+    fall back from."""
+    n = _note()
+    if n.get("device_fallback"):
+        if os.environ.get("BENCH_ALLOW_FALLBACK", "0") != "1":
+            _error_record(
+                "device_fallback detected — REFUSING to write a headline "
+                "BENCH record from a CPU-fallback run (the r05 silent-CPU "
+                "failure mode).  Fix the device tunnel, or set "
+                "BENCH_ALLOW_FALLBACK=1 to record it stamped "
+                "\"fallback\": true")
+            raise SystemExit(3)
+        n["fallback"] = True
+    return n
 
 
 def _headline(unit: str, vs_baseline: float) -> dict:
@@ -371,7 +400,7 @@ def bench_flagship():
             "remat_policy": cfg.remat_policy,
             "scan_unroll": cfg.scan_unroll,
             **cost,
-            **_note(),
+            **_headline_note(),
         },
     }))
 
@@ -452,7 +481,7 @@ def bench_cnn():
             "devices": n_dev,
             "batch": batch, "image_size": hw,
             "model": name, "dtype": "float32",
-            **_note(),
+            **_headline_note(),
         },
     }))
 
@@ -533,7 +562,7 @@ def bench_machinery():
             "mixed": mixed,
             "devices": n_dev,
             "ici_size": ici,
-            **_note(),
+            **_headline_note(),
         },
     }))
 
@@ -1147,6 +1176,77 @@ def bench_telemetry():
         proc.wait()
 
 
+def bench_audit():
+    """Auditor-overhead benchmark (BENCH_AUDIT=1): sync-round time with
+    the value-domain consistency auditor HOT (server publish digests +
+    pull trailers + worker re-digest + health sampling every round) vs
+    OFF (BYTEPS_TPU_AUDIT unset: the wire is byte-identical to
+    pre-audit, asserted by tests/test_audit.py).
+
+    `audit_overhead_ms` is the median per-round delta for a 4 MB
+    partition; expected within round-to-round noise — the armed cost is
+    one CRC pass over the published buffer per publish (server), one
+    per pull (worker, off the receiver thread), and the trailer's loss
+    of the zero-copy pull sink (one 4 MB body copy).  Host-only, like
+    BENCH_PS; mirrors BENCH_TELEMETRY.
+    """
+    import numpy as np
+
+    from byteps_tpu.server.client import PSSession
+
+    reps = int(os.environ.get("BENCH_AUDIT_REPS", "30"))
+    x = np.random.default_rng(0).standard_normal(
+        1 << 20, dtype=np.float32)                # 4 MB, one partition
+
+    def measure(audit: bool, health: int) -> tuple:
+        extra = {"BYTEPS_TPU_AUDIT": "1"} if audit else {}
+        proc, port = _boot_ps_server(engine_threads=2, extra_env=extra)
+        try:
+            sess = PSSession(["127.0.0.1"], [port], worker_id=0,
+                             num_servers=1, audit=audit,
+                             health_sample_rounds=health)
+            sess.push_pull(1, x)                  # init + warm
+            for _ in range(5):                    # settle
+                sess.push_pull(1, x)
+            times = []
+            for _ in range(reps):
+                t0 = time.perf_counter()
+                sess.push_pull(1, x)
+                times.append(time.perf_counter() - t0)
+            checked = sess.audit_stats()["checked"] if audit else 0
+            sess.close()
+            return sorted(times)[len(times) // 2], checked
+        finally:
+            proc.kill()
+            proc.wait()
+
+    off_med, _ = measure(audit=False, health=0)
+    hot_med, checked = measure(audit=True, health=0)
+    health_med, _ = measure(audit=True, health=1)
+    delta_ms = (hot_med - off_med) * 1e3
+    print(json.dumps({
+        "metric": "audit_overhead_ms",
+        "value": round(delta_ms, 3),
+        "unit": "ms",
+        "vs_baseline": round(hot_med / off_med, 3),
+        "detail": {
+            "round_off_median_ms": round(off_med * 1e3, 2),
+            "round_hot_median_ms": round(hot_med * 1e3, 2),
+            "round_hot_health1_median_ms": round(health_med * 1e3, 2),
+            "reps": reps,
+            "audited_pulls": int(checked),
+            "note": "value = median 4MB sync round with publish digests "
+                    "+ pull trailers + worker re-digest (verify runs "
+                    "off the critical path) minus median with the "
+                    "auditor off; expected within round-to-round noise. "
+                    "round_hot_health1 additionally samples gradient "
+                    "health EVERY round (BYTEPS_TPU_HEALTH_SAMPLE_"
+                    "ROUNDS=1, the max-hostile cadence)",
+            **_note(),
+        },
+    }))
+
+
 def bench_trace():
     """Tracing-overhead benchmark: sync-round time with the distributed
     tracer HOT (worker span recording + traced wire flags + server-side
@@ -1590,6 +1690,8 @@ def main():
         bench_telemetry()    # host-only: no device backend involved
     elif os.environ.get("BENCH_TRACE", "0") == "1":
         bench_trace()        # host-only: no device backend involved
+    elif os.environ.get("BENCH_AUDIT", "0") == "1":
+        bench_audit()        # host-only: no device backend involved
     elif os.environ.get("BENCH_CNN", ""):
         # Validate the name BEFORE the (possibly minutes-long) backend
         # probe so a typo still honors the one-JSON-line contract.

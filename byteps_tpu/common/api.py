@@ -32,7 +32,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from . import telemetry
+from . import flightrec, telemetry
 from .config import Config, get_config
 from .logging import get_logger, set_level, set_rank
 from ..core.native import get_core
@@ -175,6 +175,15 @@ def init(lazy: bool = True) -> None:
                     "server clock sync unavailable (%s); trace will "
                     "carry worker spans only", e)
     _state.initialized = True
+    # Black-box flight recorder: lifecycle events always record (bounded
+    # in-memory ring, no I/O); postmortem bundles + the faulthandler
+    # crash file arm only when BYTEPS_TPU_POSTMORTEM_DIR is set.  The
+    # extra provider hands the bundle writer this process's cached
+    # membership/step/session sections — local state only, no wire.
+    flightrec.set_extra_provider(_postmortem_extra)
+    flightrec.record("init", role=cfg.role, rank=rank(), size=size())
+    if cfg.postmortem_dir:
+        flightrec.arm_postmortem(cfg.postmortem_dir)
     if size() > 1:
         # Rank-tag the log prefix now that init() knows it: multi-worker
         # stderr interleaves indistinguishably otherwise.  Single-worker
@@ -186,6 +195,7 @@ def init(lazy: bool = True) -> None:
             _state.exporter = telemetry.TelemetryExporter(
                 telemetry.get_registry(), port=cfg.metrics_port,
                 jsonl_path=cfg.metrics_log,
+                max_log_mb=cfg.metrics_log_mb,
                 refresh=_refresh_server_metrics).start()
         except OSError as e:
             # A taken port / unwritable log path must not kill training —
@@ -204,6 +214,7 @@ def init(lazy: bool = True) -> None:
 def shutdown() -> None:
     if not _state.initialized:
         return
+    flightrec.record("shutdown", step=_state.step)
     if _state.membership_poll_stop is not None:
         _state.membership_poll_stop.set()
         _state.membership_poll_stop = None
@@ -474,6 +485,8 @@ def _start_membership_poller(interval: float) -> None:
             telemetry.update_membership(m)
             if int(m.get("epoch", 0)) != last_epoch:
                 last_epoch = int(m.get("epoch", 0))
+                flightrec.record("membership_epoch", epoch=last_epoch,
+                                 alive=list(m.get("alive", ())))
                 cb = _state.membership_cb
                 if cb is not None:
                     try:
@@ -1002,6 +1015,51 @@ def get_server_stats() -> dict:
         # server from a slow one.  Old servers omit these keys.
         telemetry.update_ring(stats)
     return stats
+
+
+def _postmortem_extra() -> dict:
+    """Bundle sections the flight recorder collects at dump time —
+    strictly LOCAL state (cached membership view, step counter): a
+    bundle is written exactly when the wire may be broken, so nothing
+    here may block on it.  The live PSSession registers its own
+    "session" provider (transport/audit/ring/health) at construction,
+    so those sections ride every bundle without being computed twice."""
+    out: dict = {"step": _state.step}
+    if _state.membership is not None:
+        out["membership"] = _state.membership
+    return out
+
+
+def get_health() -> dict:
+    """The gradient-health monitor's last per-key samples
+    (``BYTEPS_TPU_HEALTH_SAMPLE_ROUNDS`` > 0, PS mode): ``{"sample_rounds",
+    "nonfinite_total", "keys": {name: {"norm", "absmax", "nonfinite",
+    "ef_residual_norm", ...}}}`` — the same values the ``bps_grad_*``
+    gauges export.  The all-empty shape outside PS mode or with the
+    monitor off."""
+    empty = {"sample_rounds": 0, "nonfinite_total": 0, "keys": {}}
+    if _state.ps_session is None:
+        return empty
+    return _state.ps_session.health_snapshot() or empty
+
+
+def get_audit(cross_check: bool = False) -> dict:
+    """The consistency auditor's verdicts (``BYTEPS_TPU_AUDIT=1``, PS
+    mode; docs/monitoring.md "Auditing & postmortem").
+
+    Default: the local counters — audited pulls checked, digest
+    mismatches, lost/skewed rounds, plus the last verdict's detail.  No
+    wire traffic.  ``cross_check=True`` instead fetches every server's
+    CMD_AUDIT publish-digest window and compares this worker's last-K
+    pulled digests against it, returning the mismatching / lost rounds
+    with their contributor sets — run it (on any worker) when a
+    mismatch ERROR fires or a loss curve goes sideways."""
+    if _state.ps_session is None:
+        return {"armed": False, "checked": 0, "mismatches": 0,
+                "round_skew": 0, "unverified": 0, "last": None}
+    if cross_check:
+        return _state.ps_session.audit_check()
+    return _state.ps_session.audit_stats()
 
 
 def get_pushpull_speed() -> tuple:

@@ -130,6 +130,25 @@ class Config:
     # keys until the stall watchdog fails them loudly (pre-ring
     # semantics).
     server_evict_timeout_s: float = 0.0      # BYTEPS_TPU_SERVER_EVICT_TIMEOUT_S
+    # Value-domain consistency auditor (docs/monitoring.md "Auditing &
+    # postmortem").  audit=True makes every pull carry the server's
+    # publish digest (re-verified on receipt, single-bit corruption and
+    # divergent sums named within one round) and arms the CMD_AUDIT
+    # last-K window cross-check.  Set the same value on servers and
+    # workers; off (default) keeps the wire byte-identical to pre-audit.
+    audit: bool = False                      # BYTEPS_TPU_AUDIT
+    audit_window: int = 16                   # BYTEPS_TPU_AUDIT_WINDOW
+    # Gradient-health monitor: sample every key's norm/absmax/NaN/Inf/
+    # EF-residual every N rounds on the push and pull paths (bps_grad_*
+    # gauges, bps.get_health()); non-finite values fire a structured
+    # ERROR naming key/round/worker/epoch.  0 (default) = off.
+    health_sample_rounds: int = 0            # BYTEPS_TPU_HEALTH_SAMPLE_ROUNDS
+    # Black-box flight recorder (common/flightrec.py): bounded in-memory
+    # event ring (0 disables recording) dumped into a postmortem bundle
+    # by the stall watchdog / failover / auditor / atexit hooks whenever
+    # postmortem_dir is set.  Empty dir (default) = no files ever.
+    flightrec_events: int = 4096             # BYTEPS_TPU_FLIGHTREC_EVENTS
+    postmortem_dir: str = ""                 # BYTEPS_TPU_POSTMORTEM_DIR
     server_engine_threads: int = 4           # BYTEPS_SERVER_ENGINE_THREAD
     server_enable_schedule: bool = False     # BYTEPS_SERVER_ENABLE_SCHEDULE
     enable_async: bool = False               # BYTEPS_ENABLE_ASYNC
@@ -160,6 +179,9 @@ class Config:
     # always collects (its fast path is lock-free and O(ns)).
     metrics_port: int = 0                # BYTEPS_TPU_METRICS_PORT
     metrics_log: str = ""                # BYTEPS_TPU_METRICS_LOG
+    # Size cap (MiB) on the metrics JSONL before it rotates (.1/.2 kept,
+    # older dropped) — a long job's snapshot log must not grow unbounded.
+    metrics_log_mb: int = 64             # BYTEPS_TPU_METRICS_LOG_MB
     # Straggler detection: warn when any worker's per-worker round position
     # (from CMD_STATS) trails the lead worker by more than this many sync
     # rounds.  0 disables the warning (the lag gauges still export).
@@ -221,6 +243,12 @@ class Config:
             server_evict_timeout_s=float(
                 os.environ.get("BYTEPS_TPU_SERVER_EVICT_TIMEOUT_S")
                 or 0.0),
+            audit=_env_bool("BYTEPS_TPU_AUDIT"),
+            audit_window=_env_int("BYTEPS_TPU_AUDIT_WINDOW", 16),
+            health_sample_rounds=_env_int(
+                "BYTEPS_TPU_HEALTH_SAMPLE_ROUNDS", 0),
+            flightrec_events=_env_int("BYTEPS_TPU_FLIGHTREC_EVENTS", 4096),
+            postmortem_dir=_env_str("BYTEPS_TPU_POSTMORTEM_DIR", ""),
             server_engine_threads=_env_int("BYTEPS_SERVER_ENGINE_THREAD", 4),
             server_enable_schedule=_env_bool("BYTEPS_SERVER_ENABLE_SCHEDULE"),
             enable_async=_env_bool("BYTEPS_ENABLE_ASYNC"),
@@ -235,6 +263,7 @@ class Config:
             debug_sample_tensor=_env_str("BYTEPS_DEBUG_SAMPLE_TENSOR", ""),
             metrics_port=_env_int("BYTEPS_TPU_METRICS_PORT", 0),
             metrics_log=_env_str("BYTEPS_TPU_METRICS_LOG", ""),
+            metrics_log_mb=_env_int("BYTEPS_TPU_METRICS_LOG_MB", 64),
             straggler_rounds=_env_int("BYTEPS_TPU_STRAGGLER_ROUNDS", 10),
             log_level=_env_str("BYTEPS_LOG_LEVEL", "WARNING"),
             mesh_dp=_env_int("BYTEPS_TPU_MESH_DP", 0),

@@ -71,10 +71,20 @@ def _num_str(v) -> str:
     return str(int(f)) if f.is_integer() else repr(f)
 
 
+def _esc_label(v) -> str:
+    """Prometheus exposition label-value escaping: backslash, double
+    quote, and newline must be escaped or a value containing them (a
+    tensor name with a quote, a multi-line error string) silently
+    corrupts every series after it on the scrape."""
+    return (str(v).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
 def _label_str(labels: Optional[Dict[str, str]]) -> str:
     if not labels:
         return ""
-    inner = ",".join(f'{k}="{v}"' for k, v in sorted(labels.items()))
+    inner = ",".join(f'{k}="{_esc_label(v)}"'
+                     for k, v in sorted(labels.items()))
     return "{" + inner + "}"
 
 
@@ -507,14 +517,23 @@ class TelemetryExporter:
       are scrape-fresh.
     - ``jsonl_path``: a writer thread appends one JSON snapshot line
       every ``JSONL_INTERVAL_S`` (and once at stop, so short runs still
-      record something).
+      record something).  The file is size-capped: past ``max_log_mb``
+      MiB (``BYTEPS_TPU_METRICS_LOG_MB``, default 64) it rotates to
+      ``<path>.1`` (the previous ``.1`` becoming ``.2``, older dropped)
+      — a week-long job's snapshot log stays bounded at ~3x the cap
+      instead of growing without limit.
     """
+
+    # Rotated generations kept beyond the live file (<path>.1, <path>.2).
+    KEEP_GENERATIONS = 2
 
     def __init__(self, registry: MetricsRegistry, port: int = 0,
                  jsonl_path: str = "",
-                 refresh: Optional[Callable[[], None]] = None):
+                 refresh: Optional[Callable[[], None]] = None,
+                 max_log_mb: int = 64):
         self.registry = registry
         self.jsonl_path = jsonl_path
+        self.max_log_mb = max(1, int(max_log_mb))
         self.refresh = refresh
         self.port = 0
         self._want_port = int(port)
@@ -570,9 +589,36 @@ class TelemetryExporter:
             self._jsonl_thread.start()
         return self
 
+    def _maybe_rotate(self) -> None:
+        """Rotate the JSONL once it crosses the size cap.  Checked
+        before each append so a single write can overshoot by at most
+        one snapshot line — and a reader tailing the live path sees a
+        truncate-to-fresh-file, the standard logrotate contract."""
+        import os
+        p = self.jsonl_path
+        try:
+            if os.path.getsize(p) < self.max_log_mb * (1 << 20):
+                return
+        except OSError:
+            return          # no file yet (first write) — nothing to cap
+        try:
+            for gen in range(self.KEEP_GENERATIONS, 1, -1):
+                src = f"{p}.{gen - 1}"
+                if os.path.exists(src):
+                    os.replace(src, f"{p}.{gen}")
+            os.replace(p, f"{p}.1")
+            get_logger().info(
+                "metrics JSONL rotated at %d MiB: %s -> %s.1 "
+                "(keeping %d generations)", self.max_log_mb, p, p,
+                self.KEEP_GENERATIONS)
+        except OSError:
+            get_logger().warning("metrics JSONL rotation failed",
+                                 exc_info=True)
+
     def write_snapshot(self) -> None:
         """Append one JSONL snapshot line now (also used by the loop)."""
         self._do_refresh()
+        self._maybe_rotate()
         snap = self.registry.snapshot()
         for v in snap.values():
             if isinstance(v, dict) and "buckets" in v:

@@ -14,7 +14,8 @@
 //           | u64 key | u64 len | payload[len]
 // Response: u8 status | u32 req_id | u64 key | u64 len | payload[len]
 // cmds: 0 HELLO, 1 INIT, 2 PUSH, 3 PULL, 4 BARRIER, 5 SHUTDOWN, 6 PING,
-//       7 LR_SCALE, 8 STATS, 9 TRACE
+//       7 LR_SCALE, 8 STATS, 9 TRACE, 10 LEAVE, 11 MEMBERS, 12 RING,
+//       13 RING_SET, 14 DRAIN, 15 MIGRATE, 16 AUDIT
 //
 // req_id is client-chosen and echoed back, so one connection multiplexes
 // many outstanding requests — the redesign of ps-lite's ZPush/ZPull
@@ -58,6 +59,7 @@
 #include <condition_variable>
 #include <cstdint>
 #include <cstring>
+#include <deque>
 #include <map>
 #include <mutex>
 #include <queue>
@@ -134,7 +136,30 @@ enum Cmd : uint8_t {
                  // installed atomically on the receiving key's engine
                  // thread.  Sent with worker_id 0xFFFFFFFF so a migration
                  // can never touch worker leases.
+  kAudit = 16,   // value-domain consistency auditor (CMD_AUDIT): the
+                 // server's last-K (key -> [round, digest, epoch,
+                 // contributors]) publish-digest window as JSON, so any
+                 // worker can cross-check the digests of the rounds it
+                 // pulled against what the server actually published —
+                 // catching divergent sums, double-counts, and
+                 // failover-lost rounds.  Reader thread (audit must
+                 // answer past a wedged engine — a wedge is exactly when
+                 // it is read); recorded only when BYTEPS_TPU_AUDIT=1
+                 // arms the server, and an unarmed server answers
+                 // {"armed":0} so a probing client downgrades cleanly.
+                 // An OLD server routes the unknown command to an engine
+                 // whose default arm answers kError — "server too old".
 };
+
+// Request `dtype` marker on PULL frames: the worker asks for the 24-byte
+// audit trailer (AuditTrailer below) appended to the pull payload.  Sent
+// ONLY by an audit-armed client that probed an audit-armed server via
+// CMD_AUDIT at session bootstrap, so the unarmed wire never carries it —
+// byte-identical to the pre-audit protocol.  Deliberately far outside
+// WireDtype's value range (pull frames historically always carry dtype
+// 0, and an unarmed/old server ignores the pull dtype entirely, so a
+// mixed deployment degrades to "no trailer", never to corruption).
+enum : uint8_t { kAuditPullMark = 0xAD };
 
 // Engine-internal task (never on the wire, far above any Cmd value): a
 // membership transition fanned out to every engine so per-key round state
@@ -799,6 +824,86 @@ inline uint32_t Owner(uint64_t key,
 
 }  // namespace ring
 
+// ---------------------------------------------------------------------------
+// Value-domain consistency auditor (BYTEPS_TPU_AUDIT=1) — the cheap
+// order-independent digest of a published round's bytes.  Per 4 KiB chunk
+// a standard CRC-32 (the zlib polynomial, so the worker side can use
+// Python's C-accelerated zlib.crc32), summed mod 2^32 across chunks:
+// chunkwise so it can be computed incrementally/in parallel and so a
+// worker can digest a streamed receive without buffering, sum-combined per
+// the ISSUE's order-independent shape.  Detects single-bit wire/memory
+// corruption, a divergent published sum, and (via the round id carried
+// next to it) failover-lost rounds.  Bit-identical to the worker's
+// client.py audit_digest — parity asserted through bps_audit_digest.
+// ---------------------------------------------------------------------------
+namespace audit {
+
+// Slice-by-8 tables: a byte-at-a-time CRC runs ~0.3 GB/s, which would
+// put ~10 ms of digest on every 4 MB publish — measurably widening the
+// round.  Eight derived tables let the loop fold 8 bytes per iteration
+// (~2-3 GB/s), keeping the armed publish cost near a single memory
+// pass.  Built inside a function-local static's constructor: C++11
+// magic statics make the one-time build race-free when several engine
+// threads publish their first armed round concurrently (a DIY
+// flag-guarded build would be a TSAN-visible data race even though the
+// values are idempotent).
+struct Crc32TableSet {
+  uint32_t t[8][256];
+  Crc32TableSet() {
+    for (uint32_t i = 0; i < 256; ++i) {
+      uint32_t c = i;
+      for (int k = 0; k < 8; ++k)
+        c = (c & 1) ? 0xEDB88320u ^ (c >> 1) : (c >> 1);
+      t[0][i] = c;
+    }
+    for (int d = 1; d < 8; ++d)
+      for (uint32_t i = 0; i < 256; ++i)
+        t[d][i] = (t[d - 1][i] >> 8) ^ t[0][t[d - 1][i] & 0xFF];
+  }
+};
+
+inline const uint32_t (*Crc32Tables())[256] {
+  static const Crc32TableSet tables;
+  return tables.t;
+}
+
+inline uint32_t Crc32(const char* p, size_t n) {
+  const uint32_t (*t)[256] = Crc32Tables();
+  const unsigned char* u = reinterpret_cast<const unsigned char*>(p);
+  uint32_t c = 0xFFFFFFFFu;
+  // 8-byte folds assume little-endian lane order (every deployment
+  // target); the tail loop is the bitwise-identical reference.
+  while (n >= 8) {
+    uint32_t lo, hi;
+    std::memcpy(&lo, u, 4);
+    std::memcpy(&hi, u + 4, 4);
+    lo ^= c;
+    c = t[7][lo & 0xFF] ^ t[6][(lo >> 8) & 0xFF]
+        ^ t[5][(lo >> 16) & 0xFF] ^ t[4][lo >> 24]
+        ^ t[3][hi & 0xFF] ^ t[2][(hi >> 8) & 0xFF]
+        ^ t[1][(hi >> 16) & 0xFF] ^ t[0][hi >> 24];
+    u += 8;
+    n -= 8;
+  }
+  while (n--) c = t[0][(c ^ *u++) & 0xFF] ^ (c >> 8);
+  return c ^ 0xFFFFFFFFu;
+}
+
+// 64 KiB chunks: still fine-grained enough to localize a corruption to
+// a chunk when debugging by hand, while keeping the worker's Python
+// fallback (one zlib.crc32 call per chunk) at full C speed — 4 KiB
+// chunks cost a Python-level loop iteration per 4 KiB, halving it.
+enum : size_t { kChunk = 65536 };
+
+inline uint32_t Digest(const char* p, size_t n) {
+  uint32_t sum = 0;
+  for (size_t off = 0; off < n; off += kChunk)
+    sum += Crc32(p + off, n - off < kChunk ? n - off : kChunk);
+  return sum;
+}
+
+}  // namespace audit
+
 struct TraceSpan {
   const char* stage = "";  // static strings only ("RECV", "SUM", ...)
   uint64_t key = 0;
@@ -908,6 +1013,19 @@ struct RespHeader {
   uint64_t key;
   uint64_t len;
 };
+// 24-byte audit trailer appended to the payload of an audited pull
+// response (request dtype == kAuditPullMark on an audit-armed server):
+// the digest the server recorded when it PUBLISHED the buffer it is now
+// serving, plus the round id, the membership epoch at publish, and the
+// contributor count.  n == 0 means "no digest recorded" (pre-first
+// publish, or state that migrated in without its audit history) — the
+// client skips verification for that pull instead of flagging it.
+struct AuditTrailer {
+  uint32_t digest;
+  uint64_t round;
+  uint64_t epoch;
+  uint32_t n;
+};
 #pragma pack(pop)
 
 struct Conn {
@@ -941,6 +1059,7 @@ struct PendingPull {
                             // untraced the round mod 2^16 — RoundMatch)
   uint32_t worker = 0;      // for the PULL_SEND trace span
   bool traced = false;      // record a span when the pull finally serves
+  bool audited = false;     // append the AuditTrailer when it serves
 };
 
 // Per-key merge state — the reference's BytePSArray + update buffers
@@ -1006,6 +1125,18 @@ struct KeyState {
   // Atomic because the reader-thread stats path counts it while engines
   // flip it.
   std::atomic<bool> active{false};
+  // --- audit state (engine-owned, like the round state) -----------------
+  // Digest of the LAST published `out` buffer + the round/epoch/
+  // contributor-count recorded with it — what an audited pull's trailer
+  // carries.  Written only in PublishRound when BYTEPS_TPU_AUDIT=1;
+  // audit_n == 0 until the first armed publish (clients skip those).
+  // NOT part of the CMD_MIGRATE wire format on purpose: a migrated key's
+  // new owner starts with an empty digest (n=0 trailers) and re-records
+  // at its next publish, so mixed-version servers stay compatible.
+  uint64_t audit_round = 0;
+  uint32_t audit_digest = 0;
+  uint64_t audit_epoch = 0;
+  uint32_t audit_n = 0;
 };
 
 struct Task {
@@ -1166,6 +1297,45 @@ class Server {
     auto truthy = [](const char* v) {
       return v && v[0] && !(v[0] == '0' && v[1] == '\0');
     };
+    // Value-domain consistency auditor (BYTEPS_TPU_AUDIT=1): record a
+    // chunked-CRC digest of every published round (PublishRound), serve
+    // the last-K window over CMD_AUDIT, and append the trailer to pulls
+    // that ask for it (dtype kAuditPullMark).  Unarmed (default): no
+    // digest is ever computed, no trailer ever appended, CMD_AUDIT
+    // answers {"armed":0} — the wire is byte-identical to pre-audit.
+    audit_armed_ = truthy(std::getenv("BYTEPS_TPU_AUDIT"));
+    const char* aw = std::getenv("BYTEPS_TPU_AUDIT_WINDOW");
+    if (aw && aw[0]) {
+      char* end = nullptr;
+      uint64_t v = std::strtoull(aw, &end, 10);
+      if (end && *end == '\0' && v > 0 && v <= 4096)
+        audit_window_ = static_cast<int>(v);
+      else
+        std::fprintf(stderr,
+                     "[byteps server] ignoring invalid "
+                     "BYTEPS_TPU_AUDIT_WINDOW=%s (want 1..4096)\n", aw);
+    }
+    // Test-only single-bit fault injection ("key:round:bit"): the FIRST
+    // audited pull serving that key+round gets one bit of its payload
+    // flipped (in a copy — the store is never corrupted), simulating
+    // wire/memory corruption downstream of the publish.  The digest in
+    // the trailer is the honest pre-corruption one, so the client's
+    // re-digest must flag the mismatch — the end-to-end detection test.
+    const char* af = std::getenv("BYTEPS_TPU_AUDIT_FAULT");
+    if (af && af[0]) {
+      unsigned long long k = 0, r = 0, b = 0;
+      if (std::sscanf(af, "%llu:%llu:%llu", &k, &r, &b) == 3) {
+        fault_armed_ = true;
+        fault_key_ = k;
+        fault_round_ = r;
+        fault_bit_ = b;
+      } else {
+        std::fprintf(stderr,
+                     "[byteps server] ignoring invalid "
+                     "BYTEPS_TPU_AUDIT_FAULT=%s (want key:round:bit)\n",
+                     af);
+      }
+    }
     ring_join_ = truthy(std::getenv("BYTEPS_TPU_RING_JOIN"));
     ring_armed_ = ring_join_ || truthy(std::getenv("BYTEPS_TPU_RING"));
     const char* sid = std::getenv("DMLC_SERVER_ID");
@@ -1414,22 +1584,37 @@ class Server {
 
   void Respond(Conn* c, uint8_t status, uint32_t req_id, uint64_t key,
                const char* data, uint64_t len) {
+    RespondT(c, status, req_id, key, data, len, nullptr, 0);
+  }
+
+  // Respond with an optional trailer gathered after the payload (the
+  // audited-pull path: payload + 24-byte AuditTrailer ride the one
+  // response frame, h.len covering both, with no payload-sized copy).
+  void RespondT(Conn* c, uint8_t status, uint32_t req_id, uint64_t key,
+                const char* data, uint64_t len, const void* trailer,
+                uint64_t tlen) {
     // Member (not static) for the wire-bytes-out stat: counted at frame
     // build time — close enough for an operator-facing gauge, and the
     // alternative (counting the sendmsg return) would misreport dropped
     // peers anyway.
-    bytes_out_.fetch_add(sizeof(RespHeader) + len,
+    bytes_out_.fetch_add(sizeof(RespHeader) + len + tlen,
                          std::memory_order_relaxed);
     std::lock_guard<std::mutex> lk(c->write_mu);
-    RespHeader h{status, req_id, key, len};
-    // One sendmsg for header+payload: two send() calls under TCP_NODELAY
-    // put the 21-byte header on the wire as its own packet (extra syscall
-    // + packet + reader wakeup per response on the pull-heavy path).
-    iovec iov[2] = {{&h, sizeof(h)},
-                    {const_cast<char*>(data), static_cast<size_t>(len)}};
+    RespHeader h{status, req_id, key, len + tlen};
+    // One sendmsg for header+payload(+trailer): separate send() calls
+    // under TCP_NODELAY put the 21-byte header on the wire as its own
+    // packet (extra syscall + packet + reader wakeup per response on the
+    // pull-heavy path).
+    iovec iov[3] = {{&h, sizeof(h)}, {nullptr, 0}, {nullptr, 0}};
+    int iovcnt = 1;
+    if (len)
+      iov[iovcnt++] = {const_cast<char*>(data), static_cast<size_t>(len)};
+    if (tlen)
+      iov[iovcnt++] = {const_cast<void*>(trailer),
+                       static_cast<size_t>(tlen)};
     msghdr msg{};
     msg.msg_iov = iov;
-    msg.msg_iovlen = len ? 2 : 1;
+    msg.msg_iovlen = iovcnt;
     while (true) {
       ssize_t r = sendmsg(c->fd, &msg, MSG_NOSIGNAL);
       if (r < 0 && errno == EINTR) continue;  // signal mid-frame: resume,
@@ -1630,6 +1815,58 @@ class Server {
         js += buf;
         first = false;
       }
+    }
+    js += "}}";
+    return js;
+  }
+
+  // --- CMD_AUDIT: publish-digest window ------------------------------
+  // The last-K (round, digest, epoch, contributors) records per key,
+  // appended by PublishRound under audit_mu_ (a handful of ints + the
+  // contributor ids per publish — noise next to the digest pass itself),
+  // serialized by the reader thread here.  Shape:
+  //   {"armed":1,"window":K,"epoch":E,"ring_epoch":R,
+  //    "keys":{"<key>":[{"r":round,"d":digest,"e":epoch,"w":[ids]},...]}}
+  std::string AuditJson() {
+    char buf[256];
+    std::string js;
+    js.reserve(2048);
+    std::snprintf(buf, sizeof(buf),
+                  "{\"armed\":%d,\"window\":%d,\"epoch\":%llu,"
+                  "\"ring_epoch\":%llu,\"keys\":{",
+                  audit_armed_ ? 1 : 0, audit_window_,
+                  static_cast<unsigned long long>(
+                      epoch_atomic_.load(std::memory_order_acquire)),
+                  static_cast<unsigned long long>(
+                      ring_epoch_atomic_.load(std::memory_order_acquire)));
+    js += buf;
+    std::lock_guard<std::mutex> lk(audit_mu_);
+    bool first_key = true;
+    for (auto& kv : audit_log_) {
+      std::snprintf(buf, sizeof(buf), "%s\"%llu\":[",
+                    first_key ? "" : ",",
+                    static_cast<unsigned long long>(kv.first));
+      js += buf;
+      first_key = false;
+      bool first_rec = true;
+      for (auto& rec : kv.second) {
+        std::snprintf(buf, sizeof(buf),
+                      "%s{\"r\":%llu,\"d\":%llu,\"e\":%llu,\"w\":[",
+                      first_rec ? "" : ",",
+                      static_cast<unsigned long long>(rec.round),
+                      static_cast<unsigned long long>(rec.digest),
+                      static_cast<unsigned long long>(rec.epoch));
+        js += buf;
+        first_rec = false;
+        bool first_w = true;
+        for (uint32_t w : rec.who) {
+          std::snprintf(buf, sizeof(buf), "%s%u", first_w ? "" : ",", w);
+          js += buf;
+          first_w = false;
+        }
+        js += "]}";
+      }
+      js += "]";
     }
     js += "}}";
     return js;
@@ -2316,6 +2553,19 @@ class Server {
     ks.kwargs.clear();
     ks.round_compressed = false;
     ks.active.store(false, std::memory_order_relaxed);
+    // Drop the migrated key's digest window too: the new owner records
+    // fresh digests from its next publish, and a stale window here
+    // would make two servers answer CMD_AUDIT for the same key (the
+    // worker-side merge handles overlap, but the ex-owner's rows would
+    // go stale-forever, shadowing nothing useful).
+    if (audit_armed_) {
+      std::lock_guard<std::mutex> alk(audit_mu_);
+      audit_log_.erase(key);
+      ks.audit_round = 0;
+      ks.audit_digest = 0;
+      ks.audit_epoch = 0;
+      ks.audit_n = 0;
+    }
     return true;
   }
 
@@ -2781,6 +3031,17 @@ class Server {
           // that stopped making round progress — the exact situation
           // stats exist for.
           std::string js = StatsJson();
+          Respond(conn, kOk, h.req_id, h.key, js.data(), js.size());
+          break;
+        }
+        case kAudit: {
+          // Reader-thread digest-window read, same rationale as kStats:
+          // the auditor's cross-check must answer even when an engine is
+          // wedged mid-round — a silent wedge is one of the failure
+          // modes it exists to name.  An unarmed server answers
+          // {"armed":0} so a probing client downgrades instead of
+          // sending audit markers nothing will honor.
+          std::string js = AuditJson();
           Respond(conn, kOk, h.req_id, h.key, js.data(), js.size());
           break;
         }
@@ -3348,6 +3609,12 @@ class Server {
   void PublishRound(KeyState& ks, uint64_t key, uint32_t worker_id) {
     const uint64_t pub_round = ks.completed_round;
     const int64_t pub_t0 = ks.merge_ts.empty() ? 0 : NowUs();
+    // Contributor snapshot for the audit record, captured before the
+    // publish clears `seen` — who actually merged into this round is
+    // exactly the attribution a digest mismatch needs.
+    std::vector<uint32_t> audit_who;
+    if (audit_armed_)
+      audit_who.assign(ks.seen.begin(), ks.seen.end());
     if (ks.round_compressed && ks.bidirectional) {
       size_t ne = ks.store.size() / 4;
       float* s = reinterpret_cast<float*>(ks.store.data());
@@ -3395,8 +3662,54 @@ class Server {
                      NowUs() - pub_t0, ks.out.size());
     }
     ks.merge_ts.clear();
+    if (audit_armed_) {
+      // Digest the bytes pulls will SERVE (`out` — for bidirectional
+      // compressors that is the recompressed blob, exactly what rides
+      // the wire), and record it BEFORE the pending-pull flush below so
+      // the pulls this publish releases carry this round's trailer.
+      ks.audit_round = pub_round;
+      ks.audit_digest = audit::Digest(ks.out.data(), ks.out.size());
+      ks.audit_epoch = epoch_atomic_.load(std::memory_order_acquire);
+      ks.audit_n = static_cast<uint32_t>(audit_who.size());
+      std::lock_guard<std::mutex> lk(audit_mu_);
+      auto& dq = audit_log_[key];
+      dq.push_back(AuditRec{pub_round, ks.audit_digest, ks.audit_epoch,
+                            std::move(audit_who)});
+      while (dq.size() > static_cast<size_t>(audit_window_))
+        dq.pop_front();
+    }
     StatPublish(key, ks.completed_round);
     FlushPulls(ks, key);
+  }
+
+  // Serve one audited pull: payload + 24-byte trailer carrying the
+  // digest recorded at the served round's publish.  The test-only fault
+  // injector (BYTEPS_TPU_AUDIT_FAULT) flips one bit in a COPY of the
+  // payload here — downstream of the recorded digest, so the client's
+  // re-digest must catch it; the store itself is never touched.
+  void RespondAudited(Conn* c, uint32_t req_id, uint64_t key,
+                      KeyState& ks) {
+    AuditTrailer tr{ks.audit_digest, ks.audit_round, ks.audit_epoch,
+                    ks.audit_n};
+    if (fault_armed_ && key == fault_key_ && ks.audit_round == fault_round_
+        && !ks.out.empty()
+        && !fault_done_.exchange(true, std::memory_order_acq_rel)) {
+      std::vector<char> bad(ks.out);
+      const uint64_t bit = fault_bit_ % (bad.size() * 8ULL);
+      bad[bit / 8] = static_cast<char>(
+          static_cast<unsigned char>(bad[bit / 8]) ^ (1u << (bit & 7)));
+      std::fprintf(stderr,
+                   "[byteps server] AUDIT FAULT INJECTED: key=%llu "
+                   "round=%llu bit=%llu\n",
+                   static_cast<unsigned long long>(key),
+                   static_cast<unsigned long long>(ks.audit_round),
+                   static_cast<unsigned long long>(bit));
+      RespondT(c, kOk, req_id, key, bad.data(), bad.size(), &tr,
+               sizeof(tr));
+      return;
+    }
+    RespondT(c, kOk, req_id, key, ks.out.data(), ks.out.size(), &tr,
+             sizeof(tr));
   }
 
   void DebugLog(const char* stage, uint64_t key, uint32_t worker,
@@ -3452,6 +3765,10 @@ class Server {
     // violated the invariant would otherwise silently wait or read a
     // whole-epoch-stale buffer.
     const bool traced = (t.flags & kFlagTraced) != 0;
+    // Audited pull (dtype marker from an audit-armed client): serve with
+    // the 24-byte digest trailer.  Gated on audit_armed_ too, so a rogue
+    // dtype against an unarmed server changes nothing.
+    const bool audited = audit_armed_ && t.dtype == kAuditPullMark;
     if (!async_ && !RoundMatch(t.flags, ks.completed_round) &&
         !RoundMatch(t.flags, ks.completed_round - 1)) {
       Respond(t.conn, kError, t.req_id, t.key, nullptr, 0);
@@ -3460,14 +3777,18 @@ class Server {
     bool ready = async_ || !RoundMatch(t.flags, ks.completed_round);
     if (ready) {
       const int64_t t0 = traced ? NowUs() : 0;
-      Respond(t.conn, kOk, t.req_id, t.key, ks.out.data(), ks.out.size());
+      if (audited)
+        RespondAudited(t.conn, t.req_id, t.key, ks);
+      else
+        Respond(t.conn, kOk, t.req_id, t.key, ks.out.data(),
+                ks.out.size());
       if (traced)
         tracer_.Record("PULL_SEND", t.key, ks.completed_round,
                        t.worker_id, t0, NowUs() - t0, ks.out.size());
     } else {
       AddRef(t.conn);   // the stash outlives the task's own hold
       ks.pending.push_back({t.conn, t.req_id, t.key, t.flags,
-                            t.worker_id, traced});
+                            t.worker_id, traced, audited});
       StatPendingPulls(t.key, 1);
     }
   }
@@ -3478,7 +3799,11 @@ class Server {
     for (auto& p : ks.pending) {
       if (async_ || !RoundMatch(p.want_round, ks.completed_round)) {
         const int64_t t0 = p.traced ? NowUs() : 0;
-        Respond(p.conn, kOk, p.req_id, key, ks.out.data(), ks.out.size());
+        if (p.audited)
+          RespondAudited(p.conn, p.req_id, key, ks);
+        else
+          Respond(p.conn, kOk, p.req_id, key, ks.out.data(),
+                  ks.out.size());
         if (p.traced)
           tracer_.Record("PULL_SEND", key, ks.completed_round, p.worker,
                          t0, NowUs() - t0, ks.out.size());
@@ -3569,6 +3894,24 @@ class Server {
   std::map<uint32_t, int> peer_fds_;
   std::map<uint32_t, int64_t> peer_down_until_us_;  // negative cache
 
+  // CMD_AUDIT publish-digest window (see AuditJson / PublishRound).
+  struct AuditRec {
+    uint64_t round;
+    uint32_t digest;
+    uint64_t epoch;
+    std::vector<uint32_t> who;   // contributor ids at publish
+  };
+  bool audit_armed_ = false;     // BYTEPS_TPU_AUDIT
+  int audit_window_ = 16;        // BYTEPS_TPU_AUDIT_WINDOW (last K rounds)
+  std::mutex audit_mu_;
+  std::map<uint64_t, std::deque<AuditRec>> audit_log_;
+  // Test-only fault injection (BYTEPS_TPU_AUDIT_FAULT="key:round:bit").
+  bool fault_armed_ = false;
+  uint64_t fault_key_ = 0;
+  uint64_t fault_round_ = 0;
+  uint64_t fault_bit_ = 0;
+  std::atomic<bool> fault_done_{false};
+
   // CMD_TRACE span ring (see ServerTracer).
   ServerTracer tracer_;
 
@@ -3618,6 +3961,15 @@ int64_t bps_ring_owner(uint64_t key, const uint32_t* ids, int32_t n,
           ids[i]);
   std::sort(points.begin(), points.end());
   return static_cast<int64_t>(bps_server::ring::Owner(key, points));
+}
+
+// Audit-digest parity hook (ctypes from tests and the worker's digest
+// fallback check): the chunked-CRC publish digest computed by the SAME
+// code PublishRound runs, so the Python mirror (client.py audit_digest)
+// can be asserted bit-identical.
+__attribute__((visibility("default")))
+uint32_t bps_audit_digest(const char* data, uint64_t n) {
+  return bps_server::audit::Digest(data, static_cast<size_t>(n));
 }
 
 // Worker-side codec acceleration (ctypes from server/wire.py).  Same

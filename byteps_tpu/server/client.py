@@ -61,10 +61,12 @@ import socket
 import struct
 import threading
 import time
+from collections import deque
 from typing import Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from ..common import flightrec as _flightrec
 from ..common.config import Config
 from ..common.logging import get_logger
 from ..common.ring import DEFAULT_VNODES, RingTable
@@ -76,7 +78,8 @@ _RESP = struct.Struct("<BIQQ")     # status req_id key len
 
 CMD_HELLO, CMD_INIT, CMD_PUSH, CMD_PULL, CMD_BARRIER, CMD_SHUTDOWN, \
     CMD_PING, CMD_LR_SCALE, CMD_STATS, CMD_TRACE, CMD_LEAVE, \
-    CMD_MEMBERS, CMD_RING, CMD_RING_SET, CMD_DRAIN, CMD_MIGRATE = range(16)
+    CMD_MEMBERS, CMD_RING, CMD_RING_SET, CMD_DRAIN, CMD_MIGRATE, \
+    CMD_AUDIT = range(17)
 
 # Response status bytes (server.cc Status).  MOVED carries the server's
 # current ring table as JSON: the addressed server is not (or no longer)
@@ -87,6 +90,70 @@ STATUS_OK, STATUS_ERROR, STATUS_MOVED = 0, 1, 2
 
 # dtype byte on the wire (server.cc WireDtype)
 DT_F32, DT_RAW, DT_COMPRESSED, DT_SEED = 0, 1, 2, 3
+
+# Request dtype marker on PULL frames (server.cc kAuditPullMark): "append
+# the 24-byte audit trailer to the response payload".  Sent ONLY once the
+# session has probed an audit-armed server over CMD_AUDIT (see
+# _audit_bootstrap) — an unarmed run's wire never carries it, and an
+# unarmed/old server ignores the pull dtype entirely, so a mixed
+# deployment degrades to "no trailer", never to corruption.
+DT_AUDIT_PULL = 0xAD
+
+# Audited-pull trailer (server.cc AuditTrailer, little-endian):
+# u32 digest | u64 published round | u64 membership epoch at publish |
+# u32 contributor count (0 = no digest recorded, skip verification).
+_AUDIT_TRAILER = struct.Struct("<IQQI")
+
+# Digest chunk size — must match server.cc audit::kChunk.
+_AUDIT_CHUNK = 65536
+
+_AUDIT_C = False    # False = untried, None = unavailable, else the fn
+
+
+def _audit_c_digest():
+    """ctypes handle to the C digest in libbyteps_core.so (the exact
+    routine the server's PublishRound runs), or None — the zlib
+    fallback below is bit-identical, just ~2x slower."""
+    global _AUDIT_C
+    if _AUDIT_C is False:
+        try:
+            import ctypes
+
+            from ..core import native
+            lib = getattr(native.get_core(), "_lib", None)
+            if lib is None:
+                _AUDIT_C = None
+            else:
+                lib.bps_audit_digest.argtypes = [ctypes.c_char_p,
+                                                 ctypes.c_uint64]
+                lib.bps_audit_digest.restype = ctypes.c_uint32
+                _AUDIT_C = lib.bps_audit_digest
+        except Exception:   # pragma: no cover - defensive
+            _AUDIT_C = None
+    return _AUDIT_C
+
+
+def audit_digest(buf) -> int:
+    """Order-independent digest of a published buffer: CRC-32 (the zlib
+    polynomial) per 64 KiB chunk, summed mod 2^32 across chunks.
+    Bit-identical on both sides — the server's ``audit::Digest``
+    (core/server.cc) is the C implementation, reachable here through
+    the ``bps_audit_digest`` ctypes export (with a pure
+    ``zlib.crc32``-chunked fallback for toolchain-less installs; parity
+    asserted by tests/test_audit.py) — so a worker re-digesting the
+    bytes it pulled is directly comparable against what the server
+    recorded at publish: the single-bit-corruption / divergent-sum
+    detector."""
+    fn = _audit_c_digest()
+    if fn is not None:
+        from .wire import _c_buf
+        return int(fn(_c_buf(buf), len(buf)))
+    import zlib
+    mv = memoryview(buf)
+    s = 0
+    for off in range(0, len(mv), _AUDIT_CHUNK):
+        s = (s + zlib.crc32(mv[off:off + _AUDIT_CHUNK])) & 0xFFFFFFFF
+    return s
 
 # Header `flags` bit 15 (server.cc kFlagTraced): this frame is inside the
 # worker's trace window.  PUSH/PULL frames now carry their round in the
@@ -104,7 +171,7 @@ ROUND_MASK = 0x7FFF
 _CMD_NAMES = {0: "HELLO", 1: "INIT", 2: "PUSH", 3: "PULL", 4: "BARRIER",
               5: "SHUTDOWN", 6: "PING", 7: "LR_SCALE", 8: "STATS",
               9: "TRACE", 10: "LEAVE", 11: "MEMBERS", 12: "RING",
-              13: "RING_SET", 14: "DRAIN", 15: "MIGRATE"}
+              13: "RING_SET", 14: "DRAIN", 15: "MIGRATE", 16: "AUDIT"}
 
 
 def _round_flags(rnd: int, traced: bool) -> int:
@@ -613,6 +680,8 @@ class _ServerConn:
                 break
             elapsed = time.monotonic() - t0
             if deadline is not None and time.monotonic() >= deadline:
+                _flightrec.record("barrier_timeout", gen=gen,
+                                  elapsed_s=round(elapsed, 1))
                 raise TimeoutError(
                     f"PS barrier timed out: gen={gen} elapsed={elapsed:.1f}s"
                     f" (BYTEPS_TPU_BARRIER_TIMEOUT_S={timeout});"
@@ -620,6 +689,8 @@ class _ServerConn:
             get_logger().warning(
                 "still waiting on barrier gen=%d after %.1fs (server %s:%d;"
                 " %s)", gen, elapsed, self.host, self.port, diag_text())
+            _flightrec.record("barrier_wait", gen=gen,
+                              elapsed_s=round(elapsed, 1))
         if fut.error is not None:
             raise fut.error
         return fut.data
@@ -733,6 +804,8 @@ class _ServerConn:
             "(attempts=%d, backoff=%.0fms, %d requests parked/failed)",
             self.host, self.port, exc, self.reconnect_attempts,
             self.reconnect_backoff_ms, len(dropped))
+        _flightrec.record("conn_drop", host=self.host, port=self.port,
+                          pending=len(dropped), error=str(exc))
         for attempt in range(1, self.reconnect_attempts + 1):
             # Bounded exponential backoff with jitter (0.5x-1.5x), capped
             # at 10s per attempt, so a worker fleet never re-dials a
@@ -922,7 +995,7 @@ class _PartTask:
                  "handle", "dtype", "done_evt", "wire_ln", "bidirectional",
                  "label", "priority", "enq_ts", "push_ts", "pull_ts",
                  "ready", "enc_err", "credit_ln", "phase", "parked",
-                 "enq_mono", "send_mono", "lane_debt")
+                 "enq_mono", "send_mono", "lane_debt", "audit")
 
     def __init__(self, pkey, payload, off, ln, rnd, srv, handle,
                  dtype=DT_F32, bidirectional=False, label=""):
@@ -971,6 +1044,12 @@ class _PartTask:
         # histogram, dispatch -> ack the push-RTT histogram.
         self.enq_mono = 0.0
         self.send_mono = 0.0
+        # Auditor: this pull leg was sent with the trailer marker, so its
+        # response carries 24 trailing digest bytes to strip+verify.
+        # Recorded per ISSUE at pull-issue time (not read globally at
+        # completion) so a mid-flight audit downgrade can never make the
+        # completion path mis-split a trailerless payload.
+        self.audit = False
 
 
 class PSSession:
@@ -1022,7 +1101,10 @@ class PSSession:
                  evict_timeout_s: float = 0.0,
                  ring: bool = False,
                  ring_vnodes: int = DEFAULT_VNODES,
-                 server_evict_timeout_s: float = 0.0):
+                 server_evict_timeout_s: float = 0.0,
+                 audit: bool = False,
+                 audit_window: int = 16,
+                 health_sample_rounds: int = 0):
         self.worker_id = worker_id
         self.num_servers = max(1, num_servers)
         self.hash_fn = hash_fn
@@ -1071,6 +1153,17 @@ class PSSession:
                                           float(server_evict_timeout_s))
         self.ring_armed = bool(ring) or self.server_evict_timeout_s > 0
         self.ring_vnodes = max(1, int(ring_vnodes))
+        # Value-domain consistency auditor (BYTEPS_TPU_AUDIT=1,
+        # docs/monitoring.md "Auditing & postmortem"): every pull carries
+        # the server's publish digest and this session re-digests the
+        # received bytes, keeping a last-K (round, digest) window per key
+        # for the CMD_AUDIT cross-check.  Off (default): the wire is
+        # byte-identical to pre-audit and nothing is digested.
+        self.audit = bool(audit)
+        self.audit_window = max(1, int(audit_window))
+        # Gradient-health monitor (BYTEPS_TPU_HEALTH_SAMPLE_ROUNDS > 0):
+        # per-key norm/max/NaN/Inf/EF-residual sampling on the push path.
+        self.health_sample_rounds = max(0, int(health_sample_rounds))
         # Any failure before __init__ returns (a connect, the dispatcher,
         # the HELLO mode check) must tear down every socket and receiver
         # thread already created — the caller gets an exception, not a
@@ -1084,6 +1177,8 @@ class PSSession:
             self._hello_mode_check(worker_id)
             if self.ring_armed:
                 self._ring_bootstrap()
+            if self.audit:
+                self._audit_bootstrap()
         except Exception:
             self._abort_init()
             raise
@@ -1140,6 +1235,7 @@ class PSSession:
             recv_pool=self._recv_pool)
 
     def _abort_init(self) -> None:
+        _flightrec.remove_extra_provider("session", owner=self)
         if getattr(self, "_watchdog_stop", None) is not None:
             self._watchdog_stop.set()
         if getattr(self, "_srvdown_stop", None) is not None:
@@ -1247,6 +1343,53 @@ class PSSession:
             "bps_dispatch_queue_depth",
             help="partitions waiting in the priority scheduler",
             fn=self._queue_depth_fn)
+        # Auditor state: this worker's last-K (round, digest, epoch, n)
+        # window per partition key — what audit_check() compares against
+        # the server's CMD_AUDIT window — plus the armed-wire flag (set
+        # only once the bootstrap probe confirmed the server records
+        # digests) and the verdict counters.  bps_audit_* export through
+        # the registry so a mismatch is scrapeable, not just logged.
+        self._audit_lock = threading.Lock()
+        self._audit_window_log: Dict[int, object] = {}   # pkey -> deque
+        self._audit_wire = False
+        self._audit_stats = {"checked": 0, "mismatches": 0,
+                             "round_skew": 0, "unverified": 0}
+        self._audit_last: Optional[dict] = None   # last verdict detail
+        self._m_audit_checked = reg.counter(
+            "bps_audit_checked_total",
+            help="audited pulls whose digest was re-verified")
+        self._m_audit_mismatch = reg.counter(
+            "bps_audit_mismatch_total",
+            help="audited pulls whose re-digest differed from the "
+                 "server's publish digest (corruption/divergence)")
+        self._m_audit_skew = reg.counter(
+            "bps_audit_round_skew_total",
+            help="audited pulls served a different round than staged "
+                 "(lost/skewed round, e.g. the failover lost-round "
+                 "window)")
+        # Gradient-health monitor (BYTEPS_TPU_HEALTH_SAMPLE_ROUNDS > 0):
+        # push-path value sampling, computed on the codec pool when one
+        # exists so the caller thread never pays the norm pass.
+        # Last membership epoch this session OBSERVED (CMD_MEMBERS
+        # fetches and audit trailers both update it) — attribution
+        # context for health/audit verdicts without a wire fetch.
+        self._last_epoch = 0
+        # Postmortem bundles dumped anywhere in this process carry this
+        # session's local sections (transport/audit/ring/health) via the
+        # provider registry — computed once per dump, unregistered at
+        # close() so a dead session can't pin itself.
+        _flightrec.set_extra_provider(self._bundle_extra, name="session")
+        if self.health_sample_rounds > 0:
+            from .codec_pool import HealthMonitor
+            self._health: Optional[object] = HealthMonitor(
+                self.health_sample_rounds,
+                context=lambda: {
+                    "worker": self.worker_id,
+                    "epoch": self._last_epoch,
+                    "ring_epoch": (self._ring.epoch
+                                   if self._ring is not None else 0)})
+        else:
+            self._health = None
         self._join_timeout_s = 10.0   # close()'s thread-join budget
         # Lease heartbeat (elastic eviction armed): periodic untraced
         # CMD_PINGs keep this worker's lease warm while it is idle, so
@@ -1345,7 +1488,10 @@ class PSSession:
                    evict_timeout_s=cfg.evict_timeout_s,
                    ring=cfg.ring,
                    ring_vnodes=cfg.ring_vnodes,
-                   server_evict_timeout_s=cfg.server_evict_timeout_s)
+                   server_evict_timeout_s=cfg.server_evict_timeout_s,
+                   audit=cfg.audit,
+                   audit_window=cfg.audit_window,
+                   health_sample_rounds=cfg.health_sample_rounds)
 
     def set_lr_scale(self, scale: float) -> None:
         """One-shot EF-error rescale after a learning-rate change;
@@ -1575,12 +1721,25 @@ class PSSession:
         # come back re-encoded at a different length and take the
         # allocating path + wire_decode.  sink_live guards the in-place
         # write against a handle whose wait() already timed out.
+        #
+        # With the auditor armed, the response is payload + 24 trailer
+        # bytes, so the zero-copy sink cannot length-match: audited pulls
+        # ride a pooled buffer instead and _complete_pull splits/verifies
+        # before landing the body (one extra body copy per pull — the
+        # armed-only cost BENCH_AUDIT=1 measures; the unarmed path is
+        # untouched).  Health-SAMPLED rounds skip the sink for the same
+        # reason: the pooled payload routes through the codec pool, so
+        # the O(n) non-finite scan never runs on the receiver thread.
+        part.audit = self._audit_wire
+        health_due = (self._health is not None
+                      and self._health.pull_due(part.round))
         sink = None
-        if not part.bidirectional:
+        if not part.bidirectional and not part.audit and not health_due:
             sink = memoryview(part.handle.out).cast("B")[
                 part.off:part.off + part.ln]
         part.conn.send(
             CMD_PULL, part.pkey, worker_id=self.worker_id,
+            dtype=DT_AUDIT_PULL if part.audit else 0,
             flags=_round_flags(part.round, get_core().trace_on),
             sink=sink,
             sink_live=lambda h=part.handle: not h.failed(),
@@ -1619,15 +1778,21 @@ class PSSession:
             core.trace_record_part(part.label, "PULL", part.pull_ts,
                                    core.trace_now_us() - part.pull_ts, pkey,
                                    len(data), part.priority)
-        if (self._codec_pool is not None and part.bidirectional
+        if (self._codec_pool is not None
                 and not isinstance(data, memoryview)
-                and len(data) != part.ln):
+                and (part.audit
+                     or (self._health is not None
+                         and self._health.pull_due(part.round))
+                     or (part.bidirectional
+                         and len(data) != part.ln))):
             # Compressed pull payload: decode OFF the receiver thread, so
             # one slow decode cannot stall every other partition's
             # response parsing on this socket (the reference's DECOMPRESS
             # loop thread, core_loops.cc:618-646).  The part already left
             # _inflight above, so a staged re-push of the same key
-            # proceeds while this round's payload decodes.
+            # proceeds while this round's payload decodes.  Audited pulls
+            # route here too: the digest pass (and the body copy the
+            # trailer forces) runs on a codec thread, not the receiver.
             try:
                 self._codec_pool.submit(
                     part.priority, pkey,
@@ -1647,6 +1812,7 @@ class PSSession:
         (compress_threads=0) keeps everything on the receiver thread.
         """
         core = get_core()
+        verify = None
         try:
             n = part.ln // 4
             if isinstance(data, memoryview):
@@ -1655,6 +1821,14 @@ class PSSession:
                 pass
             else:
                 raw = data.mv if isinstance(data, _PooledBuf) else data
+                if part.audit:
+                    # Audited pull: the last 24 bytes are the server's
+                    # publish-digest trailer.  Stripping is immediate;
+                    # the digest pass itself is DEFERRED until after the
+                    # handle resolves (bottom of this function) — the
+                    # auditor observes, it never fails the handle, so
+                    # its CRC belongs off the round's critical path.
+                    raw, verify = self._audit_split(part, raw)
                 if part.bidirectional and len(raw) != part.ln:
                     # Bidirectional compressor: the merged buffer came back
                     # re-compressed; decode it (reference: worker DECOMPRESS
@@ -1695,7 +1869,29 @@ class PSSession:
                         get_logger().debug(
                             "discarding late pull for key %d: handle "
                             "already timed out", part.pkey)
+            if self._health is not None and not part.handle.failed():
+                # Pull-side value health: the landed sum, sampled at the
+                # monitor's cadence — a NaN storm that originated on
+                # ANOTHER worker is caught here within the same round.
+                off = part.off // 4
+                self._health.check_pull(
+                    part.label, part.round,
+                    part.handle.out[off:off + n], worker=self.worker_id)
             part.handle._part_done(pkey=part.pkey)
+            if part.handle.done() and not part.handle.failed():
+                # Flight-recorder round marker: one event per tensor per
+                # completed sync round — the timeline postmortem.py merges
+                # across workers to name where trajectories diverged.
+                _flightrec.record(
+                    "round", key=part.label.rsplit(".part", 1)[0],
+                    round=part.round)
+            if verify is not None:
+                # Digest + verdict AFTER the handle resolved: the caller
+                # is already staging the next round while this CRC runs
+                # (on the codec pool thread the audited path rode in
+                # on).  The pooled buffer is still checked out — release
+                # below happens strictly after.
+                verify()
         except Exception as e:
             part.handle._part_done(e, pkey=part.pkey)
         finally:
@@ -1780,6 +1976,8 @@ class PSSession:
         loudly now (the fail-fast contract, just delayed by the backoff)."""
         with self._transport_lock:
             self._tstats["reconnects_failed"] += 1
+        _flightrec.record("conn_gave_up", host=conn.host, port=conn.port,
+                          error=str(exc), worker=self.worker_id)
         with self._inflight_lock:
             mine = [p for p in self._inflight.values()
                     if p.conn is conn and p.parked]
@@ -1844,6 +2042,34 @@ class PSSession:
             get_logger().error("PS reconnect handshake failed: %s", e)
             self._fail_parked_on(conn, e)
             return
+        if self._audit_wire:
+            # The peer may be a REPLACEMENT server booted without
+            # BYTEPS_TPU_AUDIT: its pulls would carry no trailer, and a
+            # marker-sending client would strip 24 bytes of real payload.
+            # Downgrade the whole session loudly BEFORE any pull replays
+            # (the auditor is an observer — losing it must never corrupt
+            # the data path it watches).
+            try:
+                doc = self._audit_probe(conn)
+                if not doc.get("armed"):
+                    get_logger().error(
+                        "PS server at %s:%d came back WITHOUT "
+                        "BYTEPS_TPU_AUDIT; disabling pull auditing for "
+                        "this session (redeploy the server audit-armed "
+                        "to restore it)", conn.host, conn.port)
+                    self._audit_wire = False
+            except ConnectionError as e:
+                get_logger().warning(
+                    "PS reconnect audit re-probe interrupted: %s", e)
+                return
+            except Exception as e:
+                get_logger().error(
+                    "PS server at %s:%d no longer answers CMD_AUDIT "
+                    "(%s); disabling pull auditing for this session",
+                    conn.host, conn.port, e)
+                self._audit_wire = False
+        _flightrec.record("reconnected", host=conn.host, port=conn.port,
+                          worker=self.worker_id)
         # Invalidate the re-declare cache for every key planned on this
         # conn's SERVER: a server restart lost its store sizes and round
         # counters, and the next _init_parts must re-seed from live state.
@@ -1860,6 +2086,8 @@ class PSSession:
             get_logger().warning(
                 "replaying %d parked partition(s) on %s:%d",
                 len(mine), conn.host, conn.port)
+            _flightrec.record("replay", host=conn.host, port=conn.port,
+                              parts=len(mine), worker=self.worker_id)
         for part in mine:
             try:
                 self._replay_part(conn, part)
@@ -1981,6 +2209,15 @@ class PSSession:
             self._dump_stall(outstanding, elapsed)
             with self._transport_lock:
                 self._tstats["watchdog_trips"] += 1
+            _flightrec.record(
+                "stall", elapsed_s=round(elapsed, 2),
+                stuck_keys=sorted(p.pkey for p in outstanding)[:16],
+                worker=self.worker_id)
+            # The black-box moment the flight recorder exists for: dump
+            # the ring + local state into a postmortem bundle BEFORE
+            # failing the handles (the evidence must survive whatever
+            # the caller does with the error).
+            _flightrec.dump_bundle("stall")
             err = RuntimeError(
                 f"PS round stalled: no partition completed for "
                 f"{elapsed:.1f}s (BYTEPS_TPU_STALL_TIMEOUT_S="
@@ -2093,6 +2330,11 @@ class PSSession:
             "re-admitting via HELLO.  Rounds merged while evicted did "
             "not include this worker's pushes.", self.worker_id,
             self.evict_timeout_s)
+        _flightrec.record("evicted", worker=self.worker_id,
+                          epoch=int(m.get("epoch", 0)), self_heal=True)
+        # An eviction is a they-declared-us-dead moment: the bundle
+        # preserves which rounds went on without this worker.
+        _flightrec.dump_bundle("evicted")
         for c in self.conns:
             try:
                 c.request(CMD_HELLO, worker_id=self.worker_id,
@@ -2159,7 +2401,10 @@ class PSSession:
                     f"CMD_MEMBERS (server too old — rebuild/redeploy the "
                     f"server tier to match this client): {e}") from e
             views.append(_json.loads(bytes(raw).decode()))
-        return merge_membership(views)
+        merged = merge_membership(views)
+        if int(merged.get("epoch", 0)) > self._last_epoch:
+            self._last_epoch = int(merged["epoch"])
+        return merged
 
     def _barrier_diag_text(self, generation: int) -> str:
         """One line naming who the barrier is waiting on: live epoch
@@ -2391,6 +2636,24 @@ class PSSession:
                 "PS server (%s) — will retry on the next redirect",
                 table.epoch, e)
             return False
+        if self._audit_wire:
+            # A joiner that is not audit-armed would answer trailerless
+            # pulls a marker-sending client mis-splits: downgrade the
+            # session loudly BEFORE the adoption commits (pulls issued
+            # from here on are unmarked; in-flight marked pulls ride
+            # only the already-verified members).
+            for sid, h, p, pool in dialed:
+                try:
+                    armed = bool(self._audit_probe(pool[0]).get("armed"))
+                except Exception:
+                    armed = False
+                if not armed:
+                    get_logger().error(
+                        "joining PS server %d (%s:%d) is not audit-armed "
+                        "(BYTEPS_TPU_AUDIT); disabling pull auditing for "
+                        "this session", sid, h, p)
+                    self._audit_wire = False
+                    break
         with self._ring_lock:
             if self._ring is None or table.epoch <= self._ring.epoch:
                 # Another adoption won while we were dialing.
@@ -2445,6 +2708,8 @@ class PSSession:
         get_logger().warning(
             "adopted PS ring epoch %d: servers %s", epoch,
             sorted(slots))
+        _flightrec.record("ring_epoch", epoch=epoch,
+                          servers=sorted(slots), worker=self.worker_id)
         return True
 
     def _park_for_remap(self, pkey: int,
@@ -2667,6 +2932,14 @@ class PSSession:
         self._adopt_ring_doc(adopted)
         with self._transport_lock:
             self._tstats["server_failovers"] += 1
+        _flightrec.record(
+            "server_dead", server=sid, host=self.conns[slot].host,
+            port=self.conns[slot].port, down_s=round(age, 2),
+            epoch=int(adopted.get("epoch", 0)), worker=self.worker_id)
+        # Failover is a they-died moment: drop a postmortem bundle so the
+        # lost-round window (if any) has its evidence on disk even if the
+        # job later looks healthy.
+        _flightrec.dump_bundle("server-failover")
         # Park-and-remap everything routed at the corpse, THEN close its
         # conns (ending the background re-dial loops).  Parked parts in
         # the scheduler queue are skipped by the dispatcher until the
@@ -2808,6 +3081,303 @@ class PSSession:
                     prev["round"] = min(int(prev.get("round", 0)),
                                         int(v.get("round", 0)))
         return merged
+
+    # -- value-domain consistency auditor (docs/monitoring.md) --------------
+    def _audit_probe(self, conn: "_ServerConn",
+                     timeout: float = 10.0) -> dict:
+        """One CMD_AUDIT round trip, parsed.  A pre-audit server routes
+        the unknown command to an engine whose default arm answers an
+        error status — surfaced as a clean "server too old" RuntimeError,
+        never a hang (the kStats pattern)."""
+        import json as _json
+        try:
+            raw = conn.request(CMD_AUDIT, worker_id=self.worker_id,
+                               timeout=timeout)
+        except RuntimeError as e:
+            raise RuntimeError(
+                f"PS server at {conn.host}:{conn.port} does not support "
+                f"CMD_AUDIT (server too old — rebuild/redeploy the server "
+                f"tier to match this client): {e}") from e
+        return _json.loads(bytes(raw).decode())
+
+    def _audit_bootstrap(self) -> None:
+        """Arm the pull-side digest wire — but only after proving the
+        server tier actually records digests (CMD_AUDIT probe).  A
+        mixed/old/async deployment downgrades loudly to "auditing off"
+        instead of sending trailer markers nothing will honor; the
+        unarmed wire therefore stays byte-identical whichever side is
+        missing the feature."""
+        if self.server_async:
+            get_logger().warning(
+                "BYTEPS_TPU_AUDIT armed but the server tier runs ASYNC "
+                "mode (no sync rounds, nothing publishes a digest); pull "
+                "auditing disabled")
+            return
+        # EVERY server must be armed: a mixed fleet would return
+        # trailerless pulls from the unarmed members, and a
+        # marker-sending client would strip 24 bytes of real payload.
+        for c in self.conns:
+            try:
+                doc = self._audit_probe(c)
+            except Exception as e:
+                get_logger().warning(
+                    "BYTEPS_TPU_AUDIT armed but the server tier cannot "
+                    "answer CMD_AUDIT (%s); pull auditing disabled", e)
+                return
+            if not doc.get("armed"):
+                get_logger().warning(
+                    "BYTEPS_TPU_AUDIT armed on this worker but NOT on "
+                    "PS server %s:%d (set BYTEPS_TPU_AUDIT=1 on every "
+                    "server); pull auditing disabled", c.host, c.port)
+                return
+        self._audit_wire = True
+        get_logger().info(
+            "consistency auditor armed: pulls carry publish digests "
+            "(last-%d window per key)", self.audit_window)
+
+    def _audit_split(self, part: "_PartTask", raw):
+        """Strip one audited pull's 24-byte trailer.  Returns ``(body,
+        verify)`` where ``verify`` is a no-arg closure running the
+        digest pass + verdict — or None when there is nothing to verify
+        (short frame, no digest recorded).  The split is O(1); the
+        caller runs ``verify`` only after the handle resolved, keeping
+        the CRC off the round's critical path."""
+        mv = raw if isinstance(raw, memoryview) else memoryview(raw)
+        if len(mv) < _AUDIT_TRAILER.size:
+            get_logger().error(
+                "AUDIT: pull for key %d returned %d bytes — too short to "
+                "carry the trailer an audit-armed server always appends; "
+                "treating as unverified", part.pkey, len(mv))
+            with self._audit_lock:
+                self._audit_stats["unverified"] += 1
+            return mv, None
+        body = mv[:-_AUDIT_TRAILER.size]
+        digest, rnd, epoch, n_contrib = _AUDIT_TRAILER.unpack(
+            mv[-_AUDIT_TRAILER.size:])
+        if n_contrib == 0:
+            # No digest recorded for the served buffer (pre-first armed
+            # publish, or state freshly migrated in): skip, don't flag.
+            with self._audit_lock:
+                self._audit_stats["unverified"] += 1
+            return body, None
+        return body, lambda: self._audit_verify(part, body, digest, rnd,
+                                                epoch, n_contrib)
+
+    def _audit_verify(self, part: "_PartTask", body, digest: int,
+                      rnd: int, epoch: int, n_contrib: int) -> None:
+        """Re-digest one audited pull's body and verify it against what
+        the server recorded at publish.  Verdicts are observations: a
+        mismatch fires a structured ERROR naming key/round/contributors/
+        epoch, bumps the counters, flight-records the event, and (once)
+        drops a postmortem bundle — the payload already landed, because
+        a detected-corrupt round that loudly names itself beats a handle
+        failure that throws away the evidence."""
+        local = audit_digest(body)
+        if epoch > self._last_epoch:
+            self._last_epoch = int(epoch)   # trailer-borne epoch observation
+        with self._audit_lock:
+            self._audit_stats["checked"] += 1
+            dq = self._audit_window_log.get(part.pkey)
+            if dq is None:
+                dq = self._audit_window_log[part.pkey] = deque(
+                    maxlen=self.audit_window)
+            dq.append((int(rnd), int(local), int(epoch), int(n_contrib)))
+        self._m_audit_checked.inc()
+        ring_epoch = self._ring.epoch if self._ring is not None else 0
+        if local != digest:
+            with self._audit_lock:
+                self._audit_stats["mismatches"] += 1
+                first = self._audit_stats["mismatches"] == 1
+                self._audit_last = {
+                    "kind": "digest_mismatch", "key": part.pkey,
+                    "label": part.label, "round": int(rnd),
+                    "local": int(local), "server": int(digest),
+                    "contributors": int(n_contrib), "epoch": int(epoch),
+                    "ring_epoch": int(ring_epoch)}
+            self._m_audit_mismatch.inc()
+            get_logger().error(
+                "AUDIT MISMATCH: pulled bytes for key %d (%s) round %d "
+                "differ from the server's publish digest "
+                "(local=%08x server=%08x; %d contributors, membership "
+                "epoch %d, ring epoch %d, worker %d) — single-bit "
+                "corruption in transit, or a divergent published sum; "
+                "run bps.get_audit(cross_check=True) or "
+                "tools/postmortem.py for cross-worker attribution",
+                part.pkey, part.label, rnd, local, digest, n_contrib,
+                epoch, ring_epoch, self.worker_id)
+            _flightrec.record(
+                "audit_mismatch", key=part.pkey, label=part.label,
+                round=int(rnd), local=int(local), server=int(digest),
+                contributors=int(n_contrib), epoch=int(epoch),
+                ring_epoch=int(ring_epoch), worker=self.worker_id)
+            if first:
+                _flightrec.dump_bundle("audit-mismatch")
+        elif int(rnd) != part.round:
+            # The digest matches the bytes — but they are a DIFFERENT
+            # round than this worker staged: a lost/skewed round (the
+            # elastic failover publish-to-last-pull window,
+            # docs/elasticity.md) now detected instead of silently
+            # training on a stale sum.
+            with self._audit_lock:
+                self._audit_stats["round_skew"] += 1
+                self._audit_last = {
+                    "kind": "round_skew", "key": part.pkey,
+                    "label": part.label, "staged_round": part.round,
+                    "served_round": int(rnd), "epoch": int(epoch),
+                    "ring_epoch": int(ring_epoch)}
+            self._m_audit_skew.inc()
+            get_logger().error(
+                "AUDIT LOST ROUND: pull for key %d (%s) staged round %d "
+                "but the server served round %d's publish (%d "
+                "contributors, membership epoch %d, ring epoch %d, "
+                "worker %d) — a round was lost or skewed across a "
+                "failover/restart boundary (docs/elasticity.md)",
+                part.pkey, part.label, part.round, rnd, n_contrib,
+                epoch, ring_epoch, self.worker_id)
+            _flightrec.record(
+                "audit_lost_round", key=part.pkey, label=part.label,
+                staged_round=part.round, served_round=int(rnd),
+                epoch=int(epoch), ring_epoch=int(ring_epoch),
+                worker=self.worker_id)
+
+    def fetch_server_audit(self, timeout: float = 10.0) -> dict:
+        """Drain every live server's CMD_AUDIT window, merged (keys are
+        disjoint across servers).  ``{"armed", "window", "epoch",
+        "ring_epoch", "keys": {pkey: [{"r","d","e","w"}, ...]}}``."""
+        merged = {"armed": False, "window": 0, "epoch": 0,
+                  "ring_epoch": 0, "keys": {}, "servers_down": 0}
+        for slot, c in enumerate(self.conns):
+            if slot in self._dead_slots:
+                merged["servers_down"] += 1
+                continue
+            try:
+                doc = self._audit_probe(c, timeout=timeout)
+            except (ConnectionError, OSError, TimeoutError):
+                # A dead server must not break the audit plane — it is
+                # exactly when the operator reads it.
+                merged["servers_down"] += 1
+                continue
+            merged["armed"] = merged["armed"] or bool(doc.get("armed"))
+            merged["window"] = max(merged["window"],
+                                   int(doc.get("window", 0)))
+            merged["epoch"] = max(merged["epoch"],
+                                  int(doc.get("epoch", 0)))
+            merged["ring_epoch"] = max(merged["ring_epoch"],
+                                       int(doc.get("ring_epoch", 0)))
+            for k, rows in (doc.get("keys") or {}).items():
+                # Merge BY ROUND, not dict-overwrite: around a key
+                # migration two servers may briefly both hold rows for
+                # the key (the old owner's pre-migration rounds, the new
+                # owner's post-migration ones) — dropping either half
+                # would blind the cross-check exactly at the boundary it
+                # exists for.  A same-round collision keeps the later
+                # server's row (the current owner republishes it).
+                by_round = {int(r["r"]): r
+                            for r in merged["keys"].get(int(k), ())}
+                for r in rows:
+                    by_round[int(r["r"])] = r
+                merged["keys"][int(k)] = [by_round[r]
+                                          for r in sorted(by_round)]
+        return merged
+
+    def audit_check(self, timeout: float = 10.0) -> dict:
+        """Cross-check this worker's last-K pulled-digest window against
+        the servers' published-digest windows (CMD_AUDIT).
+
+        Catches what the per-pull trailer check cannot: a round this
+        worker pulled that the server no longer agrees on (divergence
+        after the fact), and rounds missing from the server's window
+        while inside its span (lost rounds across a failover).  Returns
+        ``{"armed", "compared", "mismatches": [...], "lost_rounds":
+        [...], "counters": {...}}``."""
+        report = {"armed": self._audit_wire, "compared": 0,
+                  "mismatches": [], "lost_rounds": []}
+        with self._audit_lock:
+            local = {k: list(dq)
+                     for k, dq in self._audit_window_log.items()}
+            report["counters"] = dict(self._audit_stats)
+        if not self._audit_wire:
+            return report
+        srv = self.fetch_server_audit(timeout=timeout)
+        report["servers_down"] = srv.get("servers_down", 0)
+        for pkey, recs in local.items():
+            rows = {int(r["r"]): r
+                    for r in srv["keys"].get(pkey, ())}
+            for rnd, dig, epoch, n in recs:
+                row = rows.get(rnd)
+                if row is None:
+                    if rows and min(rows) <= rnd <= max(rows):
+                        # Inside the server's retained window yet absent:
+                        # the server never published (or lost) this round.
+                        report["lost_rounds"].append(
+                            {"key": pkey, "round": rnd})
+                    continue
+                report["compared"] += 1
+                if int(row["d"]) != dig:
+                    report["mismatches"].append({
+                        "key": pkey, "round": rnd, "local": dig,
+                        "server": int(row["d"]),
+                        "contributors": row.get("w", [])})
+        if report["mismatches"] or report["lost_rounds"]:
+            _flightrec.record(
+                "audit_cross_check",
+                mismatches=len(report["mismatches"]),
+                lost_rounds=len(report["lost_rounds"]),
+                worker=self.worker_id)
+        return report
+
+    def audit_stats(self) -> dict:
+        """Local auditor counters + the last verdict detail (no wire
+        traffic; ``audit_check()`` is the cross-checking sibling)."""
+        with self._audit_lock:
+            return {"armed": self._audit_wire,
+                    "window": self.audit_window,
+                    **self._audit_stats,
+                    "last": dict(self._audit_last)
+                            if self._audit_last else None}
+
+    def health_snapshot(self) -> dict:
+        """The gradient-health monitor's last per-key samples (empty when
+        BYTEPS_TPU_HEALTH_SAMPLE_ROUNDS is 0)."""
+        return self._health.snapshot() if self._health is not None else {}
+
+    def _bundle_extra(self) -> dict:
+        """Session sections for a postmortem bundle — everything here is
+        LOCAL state (no wire fetches): a bundle is dumped exactly when
+        the wire may be the broken part."""
+        out: dict = {"worker_id": self.worker_id}
+        try:
+            out["transport"] = self.transport_stats()
+        except Exception:
+            pass
+        try:
+            out["audit"] = self.audit_stats()
+            # The worker's pulled-digest window rides the bundle so
+            # tools/postmortem.py can compare (key, round) digests
+            # ACROSS workers' bundles — two workers that pulled
+            # different bytes for the same round is the silent
+            # divergence this whole plane exists to name.
+            with self._audit_lock:
+                out["audit_window"] = {
+                    str(k): [list(r) for r in dq]
+                    for k, dq in self._audit_window_log.items()}
+        except Exception:
+            pass
+        try:
+            out["health"] = self.health_snapshot()
+        except Exception:
+            pass
+        try:
+            with self._ring_lock:
+                if self._ring is not None:
+                    out["ring"] = {"epoch": self._ring.epoch,
+                                   "vnodes": self._ring.vnodes,
+                                   "servers": list(self._ring.servers),
+                                   "dead_slots":
+                                       sorted(self._dead_slots)}
+        except Exception:
+            pass
+        return out
 
     # -- distributed tracing: clock sync + server span fetch ----------------
     def _ping_server_clock(self, conn: "_ServerConn", samples: int = 5,
@@ -3074,6 +3644,16 @@ class PSSession:
         comp = self._compressors.get(declared_key)
         kw_bytes = comp.kwargs_string().encode() if comp else b""
         label = self._label(declared_key)
+        if self._health is not None and not raw and not seed:
+            # Push-side value health (every Nth round of this key):
+            # norm/absmax/NaN/Inf of the gradient about to ride the
+            # wire, plus the EF residual when a compressor carries one.
+            # Keyed by the key's REAL round (first partition's counter)
+            # so push and pull samples align; the numpy pass runs on the
+            # codec pool over a snapshot when there is one.
+            self._health.sample_push(
+                label, payload, self._round.get(plan[0][0], 0),
+                pool=self._codec_pool, comp=comp)
         parts: list = []
         for attempt in range(4):
             try:
@@ -3329,6 +3909,9 @@ class PSSession:
         with self._cv:
             self._closed = True
             self._cv.notify_all()
+        # Detach the bundle provider (only if still ours — a later
+        # session owns the slot otherwise).
+        _flightrec.remove_extra_provider("session", owner=self)
         self._watchdog_stop.set()
         self._srvdown_stop.set()
         self._clock_sync_stop.set()

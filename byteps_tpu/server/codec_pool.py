@@ -25,6 +25,7 @@ from __future__ import annotations
 
 import heapq
 import threading
+import time
 from typing import Callable, List
 
 from ..common.logging import get_logger
@@ -144,3 +145,164 @@ class CompressionPool:
             self._cv.notify_all()
         for t in self._threads:
             t.join(timeout=10)
+
+
+class HealthMonitor:
+    """Gradient value-health sampler (``BYTEPS_TPU_HEALTH_SAMPLE_ROUNDS``
+    > 0; docs/monitoring.md "Auditing & postmortem").
+
+    The time-domain planes (metrics/traces) say nothing about the
+    VALUES riding the wire: an fp16 overflow turning a codec's output
+    into a NaN storm, or an error-feedback residual growing without
+    bound, is invisible until the loss curve goes sideways hours later.
+    This monitor samples every Nth round per key on the push path (the
+    staged gradient, before the wire) and the pull path (the landed
+    sum), exporting ``bps_grad_*`` gauges through the PR 4 registry and
+    firing a structured ERROR — key, round, worker, membership/ring
+    epoch — the moment a non-finite value appears.
+
+    The sampling pass is O(n) numpy over the staged buffer; push-side
+    samples run on the codec pool when the session has one, so the
+    caller thread never pays it.  ``sample_rounds`` gates the cadence —
+    with the knob at 0 the session never constructs a monitor and the
+    hot path carries zero new work.
+    """
+
+    def __init__(self, sample_rounds: int, context=None):
+        import numpy as _np  # noqa: F401  (fail construction early)
+        self.sample_rounds = max(1, int(sample_rounds))
+        self._context = context          # () -> {"worker", "ring_epoch"}
+        self._lock = threading.Lock()
+        self._snap: dict = {}            # label -> last sample record
+        self.nonfinite_total = 0
+        from ..common import telemetry as _tm
+        self._reg = _tm.get_registry()
+        self._m_nonfinite = self._reg.counter(
+            "bps_grad_nonfinite_total",
+            help="sampled tensors containing NaN/Inf values")
+
+    def _ctx(self) -> dict:
+        try:
+            return dict(self._context()) if self._context else {}
+        except Exception:
+            return {}
+
+    def sample_push(self, label: str, arr, rnd: int,
+                    pool: "CompressionPool" = None, comp=None) -> bool:
+        """Maybe-sample one staged (push-side) tensor; returns True when
+        round ``rnd`` (the key's actual sync round — so push and pull
+        samples land on the same rounds and survive a failover rebase)
+        was due.  The numpy pass runs on ``pool`` when given, over a
+        SNAPSHOT of the buffer: the caller's zero-copy no-mutate
+        contract ends when the handle resolves, which does not wait for
+        a deferred observer job — sampling the live buffer late would
+        attribute round N+1's values (and NaNs) to round N."""
+        if rnd % self.sample_rounds:
+            return False
+        if pool is not None:
+            import numpy as np
+            snap = np.array(arr, copy=True)
+            try:
+                pool.submit(0, 0, lambda: self._compute(
+                    label, snap, "push", rnd, comp))
+                return True
+            except RuntimeError:
+                pass                     # pool closing: sample inline
+        self._compute(label, arr, "push", rnd, comp)
+        return True
+
+    def pull_due(self, rnd: int) -> bool:
+        """True when round ``rnd`` is a sampled round — the session uses
+        this at pull-ISSUE time to skip the zero-copy sink for sampled
+        rounds, so the check below runs on a codec-pool thread over the
+        pooled buffer instead of stalling the receiver thread."""
+        return rnd % self.sample_rounds == 0
+
+    def check_pull(self, part_label: str, rnd: int, arr,
+                   worker: int = 0) -> None:
+        """Maybe-check one landed (pull-side) partition for non-finite
+        values — the sum a NaN storm on ANY worker poisons.  Gated by
+        the round id so every worker samples the same rounds."""
+        if not self.pull_due(rnd):
+            return
+        import numpy as np
+        a = np.asarray(arr)
+        nonfinite = int(a.size - np.isfinite(a).sum())
+        if nonfinite:
+            label = part_label.rsplit(".part", 1)[0]
+            self._flag_nonfinite(label, "pull", rnd, nonfinite, a.size)
+
+    # -- internals ----------------------------------------------------------
+    def _compute(self, label: str, arr, direction: str, rnd: int,
+                 comp=None) -> None:
+        import numpy as np
+        try:
+            a = np.asarray(arr, dtype=np.float32).ravel()
+            finite_mask = np.isfinite(a)
+            n_bad = int(a.size - finite_mask.sum())
+            vals = a if n_bad == 0 else a[finite_mask]
+            norm = float(np.sqrt(float(np.dot(vals, vals)))) \
+                if vals.size else 0.0
+            absmax = float(np.max(np.abs(vals))) if vals.size else 0.0
+            ef = None
+            if comp is not None and hasattr(comp, "ef_residual_norm"):
+                ef = float(comp.ef_residual_norm())
+            rec = {"direction": direction, "round": int(rnd),
+                   "norm": norm, "absmax": absmax, "nonfinite": n_bad,
+                   "size": int(a.size), "ts": time.time()}
+            lbl = {"key": label}
+            self._reg.gauge(
+                "bps_grad_norm", labels=lbl,
+                help="l2 norm of the last sampled gradient "
+                     "(finite values)").set(norm)
+            self._reg.gauge(
+                "bps_grad_absmax", labels=lbl,
+                help="largest |value| in the last sampled gradient "
+                     "(finite values)").set(absmax)
+            self._reg.gauge(
+                "bps_grad_nonfinite", labels=lbl,
+                help="NaN/Inf count in the last sampled gradient"
+                ).set(n_bad)
+            if ef is not None:
+                rec["ef_residual_norm"] = ef
+                self._reg.gauge(
+                    "bps_grad_ef_residual_norm", labels=lbl,
+                    help="l2 norm of the worker-side error-feedback "
+                         "residual carried for this key").set(ef)
+            with self._lock:
+                self._snap[label] = rec
+            if n_bad:
+                self._flag_nonfinite(label, direction, rnd, n_bad,
+                                     int(a.size))
+        except Exception:
+            get_logger().exception("gradient-health sample failed")
+
+    def _flag_nonfinite(self, label: str, direction: str, rnd: int,
+                        n_bad: int, size: int) -> None:
+        ctx = self._ctx()
+        with self._lock:
+            self.nonfinite_total += 1
+            rec = self._snap.setdefault(label, {})
+            rec["nonfinite"] = n_bad
+            rec["nonfinite_round"] = int(rnd)
+        self._m_nonfinite.inc()
+        get_logger().error(
+            "GRADIENT HEALTH: non-finite values in %s tensor %r round %d "
+            "(%d of %d elements NaN/Inf; worker %s, membership epoch %s, "
+            "ring epoch %s) — overflowing codec, fp16 blowup, or a "
+            "poisoned sum from a peer; see docs/troubleshooting.md "
+            "\"My loss diverged\"",
+            direction, label, rnd, n_bad, size,
+            ctx.get("worker", "?"), ctx.get("epoch", "?"),
+            ctx.get("ring_epoch", "?"))
+        from ..common import flightrec as _fr
+        _fr.record("nonfinite", key=label, direction=direction,
+                   round=int(rnd), count=n_bad, size=size, **ctx)
+
+    def snapshot(self) -> dict:
+        """Last sample per key + the running non-finite total — the
+        ``bps.get_health()`` payload."""
+        with self._lock:
+            return {"sample_rounds": self.sample_rounds,
+                    "nonfinite_total": self.nonfinite_total,
+                    "keys": {k: dict(v) for k, v in self._snap.items()}}

@@ -295,6 +295,21 @@ class WireCompressor:
             for k in self._err:
                 self._err[k] = self._err[k] * s
 
+    def ef_residual_norm(self) -> float:
+        """l2 norm of the carried error-feedback residual across this
+        tensor's partitions (0.0 without EF).  The gradient-health
+        monitor samples it: a residual growing without bound means the
+        compressor is systematically under-shooting (e.g. a scale stuck
+        at an overflow) and the "correction" will eventually dwarf the
+        gradient itself."""
+        if not self.ef:
+            return 0.0
+        with self._state_lock:
+            total = 0.0
+            for e in self._err.values():
+                total += float(np.dot(e, e))
+        return float(np.sqrt(total))
+
     def wire_cap_bytes(self, n: int) -> int:
         """Worst-case wire payload size for an n-element partition.
 

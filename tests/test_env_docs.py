@@ -1,0 +1,32 @@
+"""Tier-1 guard: every BYTEPS_TPU_* knob read in byteps_tpu/ must be
+documented in docs/env.md, and every documented knob must still exist
+(tools/check_env_docs.py).  Undocumented knobs and stale docs both
+drift in one PR at a time unless a fast test pins them."""
+
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+import check_env_docs  # noqa: E402
+
+
+def test_env_docs_in_sync():
+    problems = check_env_docs.check(REPO)
+    assert not problems, "\n" + "\n".join(problems)
+
+
+def test_checker_catches_drift(tmp_path):
+    """The checker itself must actually detect both directions — a
+    vacuously-green guard is worse than none."""
+    pkg = tmp_path / "byteps_tpu"
+    pkg.mkdir()
+    (pkg / "x.py").write_text(
+        'import os; os.environ.get("BYTEPS_TPU_UNDOCUMENTED_KNOB")\n')
+    docs = tmp_path / "docs"
+    docs.mkdir()
+    (docs / "env.md").write_text("| `BYTEPS_TPU_STALE_KNOB` | 0 | x |\n")
+    problems = check_env_docs.check(str(tmp_path))
+    assert any("BYTEPS_TPU_UNDOCUMENTED_KNOB" in p for p in problems)
+    assert any("BYTEPS_TPU_STALE_KNOB" in p for p in problems)

@@ -343,3 +343,47 @@ def test_log_formatter_rank_tag():
     finally:
         bl.set_rank(None)
     assert lg.handlers[0].formatter._fmt == fmt_before
+
+
+# ---------------------------------------------------------------------------
+# JSONL size cap / rotation + label escaping (PR 10 satellites)
+# ---------------------------------------------------------------------------
+def test_jsonl_rotation_keeps_two_generations(tmp_path):
+    reg = tm.MetricsRegistry()
+    reg.counter("t_rotate_total").inc(1)
+    jsonl = tmp_path / "m.jsonl"
+    exp = tm.TelemetryExporter(reg, jsonl_path=str(jsonl), max_log_mb=1)
+    # three oversize generations: each write_snapshot call first rotates
+    # the too-big live file, so .1 and .2 fill and the oldest drops
+    for gen in range(4):
+        jsonl.write_bytes(b"x" * (1 << 20))
+        exp.write_snapshot()
+    assert jsonl.exists()
+    assert (tmp_path / "m.jsonl.1").exists()
+    assert (tmp_path / "m.jsonl.2").exists()
+    assert not (tmp_path / "m.jsonl.3").exists()
+    # the live file holds exactly the fresh snapshot line, parseable
+    lines = jsonl.read_text().splitlines()
+    assert len(lines) == 1
+    assert json.loads(lines[0])["metrics"]["t_rotate_total"] == 1
+    exp.stop()
+
+
+def test_jsonl_under_cap_never_rotates(tmp_path):
+    reg = tm.MetricsRegistry()
+    jsonl = tmp_path / "m.jsonl"
+    exp = tm.TelemetryExporter(reg, jsonl_path=str(jsonl), max_log_mb=64)
+    exp.write_snapshot()
+    exp.write_snapshot()
+    assert len(jsonl.read_text().splitlines()) == 2
+    assert not (tmp_path / "m.jsonl.1").exists()
+    exp.stop()
+
+
+def test_prometheus_label_values_escaped():
+    reg = tm.MetricsRegistry()
+    reg.gauge("t_esc", labels={"key": 'a"b\\c\nd'}).set(1)
+    text = reg.render_prometheus()
+    line = next(l for l in text.splitlines() if l.startswith("t_esc"))
+    assert '\n' not in line
+    assert line == 't_esc{key="a\\"b\\\\c\\nd"} 1'

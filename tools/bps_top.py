@@ -6,8 +6,10 @@ Polls the Prometheus text endpoint a worker serves when launched with
 interesting slices: push-pull throughput, push RTT / dispatcher-queue
 latency percentiles, codec latency, the step critical-path breakdown
 from the last analyzed trace window (``bps_step_critical_path_*``, see
-docs/timeline.md), per-worker round lag (straggler view), and the
-codec/transport/fusion counter panels.
+docs/timeline.md), the gradient-health / audit panel (``bps_grad_*`` and
+``bps_audit_*``, see docs/monitoring.md "Auditing & postmortem"),
+per-worker round lag (straggler view), and the codec/transport/fusion
+counter panels.
 
 Usage:
     python tools/bps_top.py --url http://host:9100/metrics
@@ -148,6 +150,43 @@ def render(metrics: dict, prev: dict, dt: float) -> list:
             if v > 0:
                 wid = dict(key).get("worker", "?")
                 lines.append(f"  peers waited {_fmt_s(v)} on worker {wid}")
+        lines.append("")
+
+    # Gradient-health panel (BYTEPS_TPU_HEALTH_SAMPLE_ROUNDS > 0 /
+    # BYTEPS_TPU_AUDIT=1): per-key value stats, non-finite keys first —
+    # a NaN storm or audit mismatch must be the first thing on screen.
+    norms = metrics.get("bps_grad_norm") or {}
+    if norms or _get(metrics, "bps_audit_checked_total"):
+        checked = int(_get(metrics, "bps_audit_checked_total"))
+        mism = int(_get(metrics, "bps_audit_mismatch_total"))
+        skew = int(_get(metrics, "bps_audit_round_skew_total"))
+        bad = int(_get(metrics, "bps_grad_nonfinite_total"))
+        head = "gradient health"
+        if checked:
+            head += (f"   [audit: {checked} checked, {mism} mismatch, "
+                     f"{skew} lost-round]")
+        if mism or skew:
+            head += "  <-- AUDIT FAILURE"
+        lines.append(head)
+        absmax = {dict(k).get("key"): v for k, v in
+                  (metrics.get("bps_grad_absmax") or {}).items()}
+        nonfin = {dict(k).get("key"): v for k, v in
+                  (metrics.get("bps_grad_nonfinite") or {}).items()}
+        efres = {dict(k).get("key"): v for k, v in
+                 (metrics.get("bps_grad_ef_residual_norm") or {}).items()}
+        ranked = sorted(norms.items(),
+                        key=lambda kv: (-nonfin.get(
+                            dict(kv[0]).get("key"), 0), -kv[1]))
+        for key, v in ranked[:12]:
+            name = dict(key).get("key", "?")
+            nf = int(nonfin.get(name, 0))
+            ef = efres.get(name)
+            eftxt = f"  ef {ef:10.3g}" if ef else ""
+            flag = f"  <-- {nf} NaN/Inf" if nf else ""
+            lines.append(f"  {name[:28]:<28} norm {v:10.3g}  max "
+                         f"{absmax.get(name, 0.0):10.3g}{eftxt}{flag}")
+        if bad:
+            lines.append(f"  non-finite samples total: {bad}")
         lines.append("")
 
     srv_alive = metrics.get("bps_server_alive") or {}

@@ -1,0 +1,107 @@
+#!/usr/bin/env python
+"""check_env_docs — assert every BYTEPS_TPU_* knob is documented.
+
+Every ``BYTEPS_TPU_*`` environment variable read anywhere under
+``byteps_tpu/`` (Python or C++) must have a row (or at least a mention)
+in ``docs/env.md`` — and every ``BYTEPS_TPU_*`` name docs/env.md
+mentions must still exist in the code.  Undocumented knobs are how
+operators end up reading source to configure a job, and stale docs are
+how they set knobs that silently do nothing; both directions drift one
+PR at a time unless a test pins them.
+
+Wired as a fast tier-1 test (tests/test_env_docs.py); also runnable
+standalone:
+
+    python tools/check_env_docs.py [repo_root]
+
+Exit 0 = in sync; 1 = drift (each missing name printed with where it
+was seen).
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+from typing import Dict, List, Set
+
+ENV_RE = re.compile(r"BYTEPS_TPU_[A-Z0-9_]+")
+
+# Names that LOOK like knobs to the regex but are not real environment
+# variables: prefixes used in prose ("the BYTEPS_TPU_MESH_* family") or
+# incomplete stems.  Keep this list short and literal — every entry is a
+# hole in the check.
+IGNORE = {
+    "BYTEPS_TPU_MESH_",      # prose referring to the family
+    "BYTEPS_TPU_",           # bare prefix in prose
+}
+
+CODE_DIRS = ("byteps_tpu",)
+CODE_EXTS = (".py", ".cc", ".h")
+DOC_FILE = os.path.join("docs", "env.md")
+
+
+def _names_in_file(path: str) -> Set[str]:
+    try:
+        with open(path, errors="replace") as f:
+            text = f.read()
+    except OSError:
+        return set()
+    return {m for m in ENV_RE.findall(text) if m not in IGNORE
+            and not m.endswith("_")}
+
+
+def scan_code(root: str) -> Dict[str, List[str]]:
+    """{env_name: [files mentioning it]} across the package sources."""
+    out: Dict[str, List[str]] = {}
+    for d in CODE_DIRS:
+        for dirpath, _dirnames, filenames in os.walk(os.path.join(root,
+                                                                  d)):
+            for fn in filenames:
+                if not fn.endswith(CODE_EXTS):
+                    continue
+                p = os.path.join(dirpath, fn)
+                for name in _names_in_file(p):
+                    out.setdefault(name, []).append(
+                        os.path.relpath(p, root))
+    return out
+
+
+def scan_docs(root: str) -> Set[str]:
+    return _names_in_file(os.path.join(root, DOC_FILE))
+
+
+def check(root: str) -> List[str]:
+    """Drift report lines; empty = in sync."""
+    code = scan_code(root)
+    docs = scan_docs(root)
+    problems = []
+    for name in sorted(set(code) - docs):
+        problems.append(
+            f"UNDOCUMENTED: {name} is read in "
+            f"{', '.join(sorted(code[name])[:3])} but has no row in "
+            f"{DOC_FILE}")
+    for name in sorted(docs - set(code)):
+        problems.append(
+            f"STALE DOC: {name} appears in {DOC_FILE} but nothing under "
+            f"{CODE_DIRS[0]}/ reads it")
+    return problems
+
+
+def main(argv=None) -> int:
+    args = argv if argv is not None else sys.argv[1:]
+    root = args[0] if args else os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__)))
+    problems = check(root)
+    if problems:
+        print("\n".join(problems))
+        print(f"\n{len(problems)} env-doc drift problem(s); every "
+              f"BYTEPS_TPU_* knob must appear in {DOC_FILE} (and vice "
+              f"versa)")
+        return 1
+    print("env docs in sync")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

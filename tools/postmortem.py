@@ -1,0 +1,253 @@
+#!/usr/bin/env python
+"""postmortem — render byteps_tpu flight-recorder bundles into one
+merged, clock-aligned timeline and name the first divergent event.
+
+When anything dies with ``BYTEPS_TPU_POSTMORTEM_DIR`` set, each worker
+drops a self-contained JSON bundle (common/flightrec.py: flight-ring
+events + final metrics snapshot + config + membership/ring/transport/
+audit state).  This tool merges bundles from any number of workers:
+
+    python tools/postmortem.py /path/to/postmortem-dir
+    python tools/postmortem.py bundle1.json bundle2.json --json
+
+It prints, in order:
+  - a per-bundle header (rank, host, dump reason, event counts),
+  - the merged cross-worker timeline, aligned on the wall clock each
+    event was stamped with (bundles also carry a wall/monotonic anchor
+    pair; wall-clock skew between hosts bounds the alignment error, and
+    the tool warns when two bundles' anchors disagree suspiciously),
+  - a cross-worker audit comparison: any (key, round) whose pulled
+    digest differs between workers' audit windows — the silent
+    divergence signature,
+  - the FIRST BAD EVENT verdict: the earliest value-domain divergence
+    (audit mismatch / lost round / non-finite gradient), else the
+    earliest fatal transition (stall, dead server, eviction), else a
+    clean bill.
+
+``--json`` emits the same analysis machine-readable (one object), for
+scripting and the test suite.  No dependencies beyond the stdlib.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+import time
+from typing import List, Optional
+
+BUNDLE_SCHEMA = "bps-postmortem-v1"
+
+# Event kinds by severity class.  DIVERGENT = the values went wrong
+# (what the auditor/health monitor exist to catch); FATAL = a component
+# died or wedged; NOTABLE = transitions worth an eye on the timeline.
+DIVERGENT_KINDS = ("audit_mismatch", "audit_lost_round", "nonfinite",
+                   "audit_cross_check")
+FATAL_KINDS = ("stall", "server_dead", "conn_gave_up", "evicted",
+               "barrier_timeout")
+NOTABLE_KINDS = ("conn_drop", "reconnected", "ring_epoch",
+                 "membership_epoch", "init", "shutdown", "exit")
+
+
+def load_bundles(paths: List[str]) -> List[dict]:
+    """Bundles from explicit files and/or directories (globbed for
+    ``bps-postmortem-*.json``).  Unparseable or foreign JSON is skipped
+    with a warning — one corrupt file must not hide the others."""
+    files: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            files.extend(sorted(glob.glob(
+                os.path.join(p, "bps-postmortem-*.json"))))
+        else:
+            files.append(p)
+    bundles = []
+    for f in files:
+        try:
+            with open(f) as fh:
+                doc = json.load(fh)
+        except (OSError, ValueError) as e:
+            print(f"postmortem: skipping {f}: {e}", file=sys.stderr)
+            continue
+        if doc.get("schema") != BUNDLE_SCHEMA:
+            print(f"postmortem: skipping {f}: not a {BUNDLE_SCHEMA} "
+                  f"bundle", file=sys.stderr)
+            continue
+        doc["_path"] = f
+        bundles.append(doc)
+    return bundles
+
+
+def merged_timeline(bundles: List[dict]) -> List[dict]:
+    """Every bundle's events, rank-tagged and sorted by the wall clock
+    they were stamped with.  Bundles from one host share a clock
+    exactly; across hosts the alignment error is the hosts' wall-clock
+    skew (NTP-grade in any real deployment — and the per-bundle anchor
+    pair lets a reader bound it)."""
+    events = []
+    for b in bundles:
+        rank = b.get("rank", "?")
+        for ev in b.get("events", ()):
+            e = dict(ev)
+            e["_rank"] = rank
+            events.append(e)
+    events.sort(key=lambda e: e.get("t", 0.0))
+    return events
+
+
+def cross_audit(bundles: List[dict]) -> List[dict]:
+    """(key, round) rows whose pulled digest DIFFERS between workers'
+    audit windows — each row names the key, the round, and every
+    worker's digest, i.e. exactly which round diverged and who saw
+    what."""
+    # (key, round) -> {rank: digest}
+    seen: dict = {}
+    for b in bundles:
+        rank = b.get("rank", "?")
+        win = (b.get("extra") or {}).get("audit_window") or {}
+        for key, rows in win.items():
+            for row in rows:
+                rnd, digest = int(row[0]), int(row[1])
+                seen.setdefault((int(key), rnd), {})[rank] = digest
+    out = []
+    for (key, rnd), per_rank in sorted(seen.items()):
+        if len(set(per_rank.values())) > 1:
+            out.append({"key": key, "round": rnd,
+                        "digests": {str(r): d
+                                    for r, d in sorted(per_rank.items())}})
+    return out
+
+
+def first_bad_event(events: List[dict]) -> Optional[dict]:
+    """The earliest value-domain divergence, else the earliest fatal
+    transition, else None."""
+    for kinds in (DIVERGENT_KINDS, FATAL_KINDS):
+        for ev in events:
+            if ev.get("kind") in kinds:
+                return ev
+    return None
+
+
+def last_rounds(events: List[dict]) -> dict:
+    """Per worker, per key: the last completed round recorded — where
+    each worker's trajectory stopped (a worker whose last round trails
+    the others marks the loss boundary)."""
+    out: dict = {}
+    for ev in events:
+        if ev.get("kind") == "round":
+            out.setdefault(str(ev["_rank"]), {})[str(ev.get("key"))] = \
+                int(ev.get("round", 0))
+    return out
+
+
+def _fmt_ts(t: float) -> str:
+    return time.strftime("%H:%M:%S", time.localtime(t)) + \
+        f".{int((t % 1) * 1000):03d}"
+
+
+def _fmt_event(ev: dict) -> str:
+    skip = {"t", "mono", "kind", "_rank"}
+    fields = " ".join(f"{k}={ev[k]}" for k in ev if k not in skip)
+    return (f"{_fmt_ts(ev.get('t', 0.0))}  r{ev['_rank']:<3} "
+            f"{ev.get('kind', '?'):<18} {fields}")
+
+
+def analyze(bundles: List[dict]) -> dict:
+    events = merged_timeline(bundles)
+    return {
+        "bundles": [{"path": b["_path"], "rank": b.get("rank"),
+                     "host": b.get("host"), "reason": b.get("reason"),
+                     "events": len(b.get("events", ())),
+                     "events_dropped": b.get("events_dropped", 0)}
+                    for b in bundles],
+        "events": events,
+        "cross_audit": cross_audit(bundles),
+        "first_bad": first_bad_event(events),
+        "last_rounds": last_rounds(events),
+    }
+
+
+def render(analysis: dict, max_events: int = 200) -> str:
+    lines = []
+    bl = analysis["bundles"]
+    ranks = sorted({b["rank"] for b in bl})
+    lines.append(f"postmortem: {len(bl)} bundle(s) from "
+                 f"{len(ranks)} worker(s)")
+    for b in bl:
+        lines.append(f"  r{b['rank']}  host={b['host']}  "
+                     f"reason={b['reason']}  events={b['events']}"
+                     + (f" ({b['events_dropped']} dropped)"
+                        if b.get("events_dropped") else ""))
+    lines.append("")
+    events = analysis["events"]
+    shown = events[-max_events:]
+    lines.append(f"merged timeline (wall clock"
+                 + (f"; last {len(shown)} of {len(events)} events"
+                    if len(shown) < len(events) else "") + "):")
+    for ev in shown:
+        lines.append("  " + _fmt_event(ev))
+    lines.append("")
+    lr = analysis["last_rounds"]
+    if lr:
+        lines.append("last completed round per worker:")
+        keys = sorted({k for rounds in lr.values() for k in rounds})
+        for key in keys:
+            per = {r: rounds.get(key) for r, rounds in sorted(lr.items())}
+            spread = {v for v in per.values() if v is not None}
+            tag = "  <-- workers disagree" if len(spread) > 1 else ""
+            lines.append(
+                f"  {key}: " + "  ".join(
+                    f"r{r}={v if v is not None else '-'}"
+                    for r, v in per.items()) + tag)
+        lines.append("")
+    ca = analysis["cross_audit"]
+    if ca:
+        lines.append("cross-worker audit: DIVERGENT (key, round) pulls:")
+        for row in ca:
+            digs = "  ".join(f"r{r}={d:08x}"
+                             for r, d in row["digests"].items())
+            lines.append(f"  key {row['key']} round {row['round']}: "
+                         f"{digs}")
+        lines.append("")
+    elif len(ranks) > 1:
+        lines.append("cross-worker audit: no divergent (key, round) "
+                     "digests across bundles")
+        lines.append("")
+    fb = analysis["first_bad"]
+    if fb is not None:
+        cls = ("value-domain divergence"
+               if fb.get("kind") in DIVERGENT_KINDS else "fatal transition")
+        lines.append(f"FIRST BAD EVENT ({cls}):")
+        lines.append("  " + _fmt_event(fb))
+    else:
+        lines.append("FIRST BAD EVENT: none recorded — no divergence or "
+                     "fatal transition in any bundle's window")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("paths", nargs="+",
+                    help="bundle files and/or directories to merge")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the analysis as one JSON object")
+    ap.add_argument("--max-events", type=int, default=200,
+                    help="timeline lines to print (default 200)")
+    args = ap.parse_args(argv)
+    bundles = load_bundles(args.paths)
+    if not bundles:
+        print("postmortem: no bundles found (is "
+              "BYTEPS_TPU_POSTMORTEM_DIR set on the workers?)",
+              file=sys.stderr)
+        return 1
+    analysis = analyze(bundles)
+    if args.json:
+        print(json.dumps(analysis))
+    else:
+        print(render(analysis, max_events=args.max_events))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
