@@ -42,6 +42,9 @@ Modes:
                      consistency auditor hot (publish digests, pull
                      trailers, re-digest, health sampling) vs off —
                      audit_overhead_ms, expected within noise
+  BENCH_DOCTOR=1     signal-plane/doctor-overhead bench: sync-round time
+                     with the windowed key-signal plane + doctor rules
+                     hot vs off, plus the per-window roll cost
   BENCH_TELEMETRY=1  telemetry-overhead bench: sync-round time with the
                      metrics endpoint scraped at 20Hz vs export plane off
                      (emits telemetry_overhead_ms; expected within noise)
@@ -1247,6 +1250,111 @@ def bench_audit():
     }))
 
 
+def bench_doctor():
+    """Signal-plane overhead benchmark (BENCH_DOCTOR=1): sync-round time
+    with the windowed key-signal plane + doctor rules HOT (window
+    rolling every 0.5 s, per-part feeds live, CMD_STATS refresh per
+    window, all 9 rules evaluated) vs OFF (BYTEPS_TPU_SIGNAL_WINDOW_S=0
+    semantics: the module plane is None and every feed is a global
+    read + None check).
+
+    `signal_plane_overhead_ms` is the median per-round delta for a 4 MB
+    partition, expected within round-to-round noise — the armed
+    hot-path cost is one small dict update under a short lock per
+    partition round trip; the per-window cost (one registry snapshot +
+    rule pass, measured separately as `window_roll_ms`) runs on its own
+    thread once per window.  Host-only, like BENCH_PS; mirrors
+    BENCH_TELEMETRY.
+    """
+    import numpy as np
+
+    from byteps_tpu.common import doctor as doctor_mod
+    from byteps_tpu.common import signals
+    from byteps_tpu.server.client import PSSession
+
+    reps = int(os.environ.get("BENCH_DOCTOR_REPS", "30"))
+    proc, port = _boot_ps_server(engine_threads=2)
+    try:
+        sess = PSSession(["127.0.0.1"], [port], worker_id=0,
+                         num_servers=1)
+        x = np.random.default_rng(0).standard_normal(
+            1 << 20, dtype=np.float32)            # 4 MB, one partition
+        sess.push_pull(1, x)                      # init + warm
+
+        def rounds(n):
+            times = []
+            for _ in range(n):
+                t0 = time.perf_counter()
+                sess.push_pull(1, x)
+                times.append(time.perf_counter() - t0)
+            return times
+
+        rounds(5)                                 # settle
+        off = rounds(reps)                        # plane off (None)
+
+        eng = doctor_mod.DoctorEngine()
+        plane = signals.arm(
+            window_s=0.5, history=32,
+            refresh=lambda: sess.server_stats(),
+            providers={"transport": sess.transport_stats},
+            on_window=eng.observe)
+        rounds(5)                                 # settle under windows
+        hot = rounds(reps)                        # plane + doctor hot
+
+        # Per-window roll cost over LOADED windows: the background
+        # thread drains the accumulators every 0.5s, so stop it and
+        # feed one round before each timed roll — timing back-to-back
+        # rolls would fold empty windows and underreport exactly the
+        # per-key work this number exists to quantify.
+        signals.disarm()
+        plane = signals.arm(
+            window_s=60.0, history=32, start_thread=False,
+            refresh=lambda: sess.server_stats(),
+            providers={"transport": sess.transport_stats},
+            on_window=eng.observe)
+        rounds(1)
+        keys_seen = len(plane.roll()["keys"])
+        n_rolls = 10
+        roll_total = 0.0
+        for _ in range(n_rolls):
+            rounds(1)                         # re-load the window
+            t0 = time.perf_counter()
+            plane.roll()
+            roll_total += time.perf_counter() - t0
+        roll_ms = roll_total / n_rolls * 1e3
+        signals.disarm()
+        sess.close()
+
+        off_med = sorted(off)[len(off) // 2]
+        hot_med = sorted(hot)[len(hot) // 2]
+        delta_ms = (hot_med - off_med) * 1e3
+        print(json.dumps({
+            "metric": "signal_plane_overhead_ms",
+            "value": round(delta_ms, 3),
+            "unit": "ms",
+            "vs_baseline": round(hot_med / off_med, 3),
+            "detail": {
+                "round_off_median_ms": round(off_med * 1e3, 2),
+                "round_hot_median_ms": round(hot_med * 1e3, 2),
+                "window_roll_ms": round(roll_ms, 3),
+                "window_s": 0.5,
+                "reps": reps,
+                "keys_tracked": keys_seen,
+                "note": "value = median 4MB sync round with the signal "
+                        "plane rolling 0.5s windows + doctor rules + "
+                        "CMD_STATS refresh per window minus median "
+                        "with the plane off; expected within "
+                        "round-to-round noise.  window_roll_ms is the "
+                        "off-thread per-window cost (registry snapshot "
+                        "+ classification + 9-rule pass)",
+                **_note(),
+            },
+        }))
+    finally:
+        proc.kill()
+        proc.wait()
+
+
 def bench_trace():
     """Tracing-overhead benchmark: sync-round time with the distributed
     tracer HOT (worker span recording + traced wire flags + server-side
@@ -1692,6 +1800,8 @@ def main():
         bench_trace()        # host-only: no device backend involved
     elif os.environ.get("BENCH_AUDIT", "0") == "1":
         bench_audit()        # host-only: no device backend involved
+    elif os.environ.get("BENCH_DOCTOR", "0") == "1":
+        bench_doctor()       # host-only: no device backend involved
     elif os.environ.get("BENCH_CNN", ""):
         # Validate the name BEFORE the (possibly minutes-long) backend
         # probe so a typo still honors the one-JSON-line contract.

@@ -22,7 +22,7 @@ from .common.api import (
     broadcast_parameters, broadcast_optimizer_state,
     get_pushpull_speed, get_codec_stats, get_fusion_stats,
     get_transport_stats, get_metrics, get_server_stats,
-    get_health, get_audit,
+    get_health, get_audit, get_key_signals, get_diagnosis,
     mark_step, current_step,
 )
 from .parallel.async_ps import AsyncPSTrainer
@@ -67,7 +67,7 @@ __all__ = [
     "broadcast_parameters", "broadcast_optimizer_state",
     "get_pushpull_speed", "get_codec_stats", "get_fusion_stats",
     "get_transport_stats", "get_metrics", "get_server_stats",
-    "get_health", "get_audit",
+    "get_health", "get_audit", "get_key_signals", "get_diagnosis",
     "mark_step", "current_step",
     "Compression", "collectives",
     "DistributedOptimizer", "DistributedGradientTransformation",

@@ -32,7 +32,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from . import flightrec, telemetry
+from . import doctor as doctor_mod
+from . import flightrec, signals, telemetry
 from .config import Config, get_config
 from .logging import get_logger, set_level, set_rank
 from ..core.native import get_core
@@ -61,6 +62,14 @@ class _State:
     membership_poll_stop: Optional[Any] = None
     membership_poll_thread: Optional[Any] = None
     membership_poll_interval: float = 2.0
+    # Windowed key-signal plane + doctor (BYTEPS_TPU_SIGNAL_WINDOW_S>0):
+    # the SignalPlane rolls one summary per window, the DoctorEngine
+    # evaluates the rules over it; the final verdict is emitted exactly
+    # once (shutdown or the atexit guard, whichever runs first).
+    signal_plane: Optional[Any] = None
+    doctor: Optional[Any] = None
+    doctor_verdict_done: bool = False
+    doctor_atexit: bool = False
 
 
 _state = _State()
@@ -190,13 +199,20 @@ def init(lazy: bool = True) -> None:
         # runs (and everything logged before init) keep the old format.
         set_rank(rank())
     _register_builtin_collectors()
+    # One knob, one meaning: the plane arms iff SIGNAL_WINDOW_S > 0.
+    # Deliberately NOT gated on BYTEPS_TELEMETRY_ON (which only governs
+    # the throughput/step-time feeds) — a hidden second condition would
+    # make "I set the window and got no doctor" undiagnosable.
+    if cfg.signal_window_s > 0:
+        _start_signal_plane(cfg)
     if cfg.metrics_port > 0 or cfg.metrics_log:
         try:
             _state.exporter = telemetry.TelemetryExporter(
                 telemetry.get_registry(), port=cfg.metrics_port,
                 jsonl_path=cfg.metrics_log,
                 max_log_mb=cfg.metrics_log_mb,
-                refresh=_refresh_server_metrics).start()
+                refresh=_refresh_server_metrics,
+                routes=_signal_routes()).start()
         except OSError as e:
             # A taken port / unwritable log path must not kill training —
             # the metrics plane is an observer, never a dependency.
@@ -221,6 +237,10 @@ def shutdown() -> None:
         _state.membership_poll_thread = None
         _state.membership_cb = None
     _state.membership = None
+    # Close the signal plane's last window and emit the doctor verdict
+    # BEFORE the session teardown: the final roll's CMD_STATS refresh
+    # and the verdict's finding set both want the live session.
+    _stop_signal_plane()
     if _state.exporter is not None:
         # Before the session teardown: the exporter's refresh hook polls
         # the live session for CMD_STATS.
@@ -1028,6 +1048,138 @@ def _postmortem_extra() -> dict:
     if _state.membership is not None:
         out["membership"] = _state.membership
     return out
+
+
+def _start_signal_plane(cfg) -> None:
+    """Arm the windowed key-signal plane + doctor engine
+    (``BYTEPS_TPU_SIGNAL_WINDOW_S`` > 0; docs/monitoring.md "Doctor").
+
+    The plane is strictly local: its one optional wire touch is the
+    per-window CMD_STATS refresh (PS mode, best-effort) that keeps the
+    round-lag/ring gauges window-fresh — the same poll every metrics
+    scrape already does.  The doctor's findings ride the log, the
+    flight recorder, ``bps_doctor_findings_total`` and
+    ``bps.get_diagnosis()``; postmortem bundles gain a ``diagnosis``
+    section (+ the recent window history) through the flight-recorder
+    provider registered here."""
+    eng = doctor_mod.DoctorEngine()
+    sess = _state.ps_session
+    providers = {}
+    if sess is not None:
+        providers = {"transport": sess.transport_stats,
+                     "health": sess.health_snapshot,
+                     "audit": sess.audit_stats}
+
+    def _refresh():
+        if _state.ps_session is None:
+            return None
+        try:
+            return get_server_stats()
+        except Exception as e:
+            get_logger().debug("signal window CMD_STATS poll failed: %s",
+                               e)
+            return None
+
+    plane = signals.arm(window_s=cfg.signal_window_s,
+                        history=cfg.signal_history,
+                        refresh=_refresh, providers=providers,
+                        on_window=eng.observe)
+    _state.signal_plane = plane
+    _state.doctor = eng
+    _state.doctor_verdict_done = False
+    flightrec.set_extra_provider(
+        lambda: {"diagnosis": eng.diagnosis(),
+                 "signals": plane.history()},
+        name="doctor")
+    if not _state.doctor_atexit:
+        # Crash guard: a run that never reaches shutdown() still logs
+        # its one-line verdict (and the postmortem bundle's diagnosis
+        # section is dumped by flightrec's own atexit hook).
+        import atexit
+        atexit.register(_emit_doctor_verdict)
+        _state.doctor_atexit = True
+
+
+def _emit_doctor_verdict() -> None:
+    """Log the final doctor verdict exactly once per plane lifetime."""
+    eng = _state.doctor
+    if eng is None or _state.doctor_verdict_done:
+        return
+    _state.doctor_verdict_done = True
+    try:
+        line = eng.verdict_line()
+        diag = eng.diagnosis()
+        if diag.get("healthy"):
+            get_logger().info(line)
+        else:
+            get_logger().warning(line)
+    except Exception:
+        pass
+
+
+def _stop_signal_plane() -> None:
+    if _state.signal_plane is None:
+        return
+    try:
+        _state.signal_plane.stop(final_roll=True)   # close the last window
+    except Exception:
+        pass
+    _emit_doctor_verdict()
+    # Freeze the final diagnosis + window history into a static provider:
+    # the atexit postmortem bundle (flightrec's own exit hook runs AFTER
+    # shutdown) must still carry the run's verdict, or the one bundle an
+    # operator actually reads would be the one missing the diagnosis.
+    try:
+        final = {"diagnosis": _state.doctor.diagnosis(),
+                 "signals": _state.signal_plane.history()}
+        flightrec.set_extra_provider(lambda: final, name="doctor")
+    except Exception:
+        flightrec.set_extra_provider(None, name="doctor")
+    signals.disarm()
+    _state.signal_plane = None
+    _state.doctor = None
+
+
+def _signal_routes() -> dict:
+    """JSON routes for the metrics endpoint: ``/signals`` (the window
+    history — what tools/bps_doctor.py polls in live mode) and
+    ``/diagnosis`` (the doctor's current verdict — what the bps_top
+    panel shows).  Empty when the plane is off: the endpoint then 404s
+    the paths, which the consumers treat as "not armed"."""
+    if _state.signal_plane is None:
+        return {}
+    plane, eng = _state.signal_plane, _state.doctor
+    return {"/signals": lambda: {"schema": signals.SCHEMA,
+                                 "window_s": plane.window_s,
+                                 "windows": plane.history()},
+            "/diagnosis": lambda: eng.diagnosis()}
+
+
+def get_key_signals() -> dict:
+    """The signal plane's last closed window: per-key ``KeySignal``
+    records — wire bytes/throughput, critical-path component shares
+    (queue/push_wire/serve/encode/decode), value-plane health, and the
+    ``wire_bound | compute_bound | straggler_bound | tiny | unhealthy``
+    classification.  The adaptive-compression tuner's input surface.
+    Returns the empty shape when the plane is off
+    (``BYTEPS_TPU_SIGNAL_WINDOW_S=0``)."""
+    if _state.signal_plane is None:
+        return {"schema": signals.SCHEMA, "armed": False, "window": -1,
+                "keys": {}}
+    out = _state.signal_plane.key_signals()
+    out["armed"] = True
+    return out
+
+
+def get_diagnosis() -> dict:
+    """The doctor's current verdict: open findings (severity-ranked,
+    each with rule id, subject, evidence, and a playbook anchor into
+    docs/troubleshooting.md), plus the recent finding history.  Returns
+    ``{"armed": False, "healthy": True}`` when the plane is off."""
+    if _state.doctor is None:
+        return {"armed": False, "healthy": True, "open": [],
+                "findings_total": 0}
+    return _state.doctor.diagnosis()
 
 
 def get_health() -> dict:

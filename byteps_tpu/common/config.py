@@ -186,6 +186,16 @@ class Config:
     # (from CMD_STATS) trails the lead worker by more than this many sync
     # rounds.  0 disables the warning (the lag gauges still export).
     straggler_rounds: int = 10           # BYTEPS_TPU_STRAGGLER_ROUNDS
+    # Windowed key-signal plane + continuous diagnosis (common/signals.py
+    # + common/doctor.py): every window, per-key timers/metrics/value
+    # verdicts join into classified KeySignal records
+    # (bps.get_key_signals()) and the doctor rules run over the window
+    # history (bps.get_diagnosis()).  0 = off: nothing is armed, zero
+    # hot-path work, wire untouched (it never touches the wire anyway).
+    signal_window_s: float = 10.0        # BYTEPS_TPU_SIGNAL_WINDOW_S
+    # Window summaries kept in memory (and shipped in postmortem
+    # bundles' diagnosis section) — bounds the plane's footprint.
+    signal_history: int = 32             # BYTEPS_TPU_SIGNAL_HISTORY
 
     # ---- logging ----
     log_level: str = "WARNING"           # BYTEPS_LOG_LEVEL
@@ -265,6 +275,9 @@ class Config:
             metrics_log=_env_str("BYTEPS_TPU_METRICS_LOG", ""),
             metrics_log_mb=_env_int("BYTEPS_TPU_METRICS_LOG_MB", 64),
             straggler_rounds=_env_int("BYTEPS_TPU_STRAGGLER_ROUNDS", 10),
+            signal_window_s=float(
+                os.environ.get("BYTEPS_TPU_SIGNAL_WINDOW_S") or 10.0),
+            signal_history=_env_int("BYTEPS_TPU_SIGNAL_HISTORY", 32),
             log_level=_env_str("BYTEPS_LOG_LEVEL", "WARNING"),
             mesh_dp=_env_int("BYTEPS_TPU_MESH_DP", 0),
             mesh_tp=_env_int("BYTEPS_TPU_MESH_TP", 1),

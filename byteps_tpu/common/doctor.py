@@ -1,0 +1,628 @@
+"""Continuous diagnosis engine (`bps doctor`): declarative rules over
+the windowed signal plane.
+
+``common/signals.py`` closes one window summary every
+``BYTEPS_TPU_SIGNAL_WINDOW_S`` seconds; this module evaluates a fixed
+set of **rules** against the window history so the system names its own
+bottlenecks and failures instead of waiting for a human to correlate
+bps_top, trace_analyze and postmortem.py by eye.  Every firing produces
+a structured **Finding**::
+
+    {"rule", "severity", "subject", "summary", "evidence",
+     "playbook", "window", "first_window", "ts"}
+
+fed four ways: the log (WARNING/ERROR on open, once), the flight
+recorder (``doctor_finding`` events, so findings land on postmortem
+timelines), the ``bps_doctor_findings_total{rule=}`` counter, and
+``bps.get_diagnosis()``.  ``playbook`` is a stable anchor into
+``docs/troubleshooting.md`` (``#rule-<id>``) — drift between rule ids
+and playbook anchors is pinned by ``tools/check_doctor_docs.py`` as a
+tier-1 test.
+
+The SAME rules run offline: ``tools/bps_doctor.py`` replays them over a
+postmortem bundle's recorded window history or a metrics JSONL from a
+dead run — rules therefore consume only what both paths carry (the
+scalar metrics series, event counts, and the optional
+transport/server sections), via the :class:`RuleCtx` helpers.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+import threading
+import time
+from collections import deque
+from typing import Callable, Dict, List, Optional
+
+from .logging import get_logger
+
+PLAYBOOK = "docs/troubleshooting.md"
+
+SEV_WARN = "warn"
+SEV_ERROR = "error"
+SEV_CRITICAL = "critical"
+_SEV_ORDER = {SEV_WARN: 0, SEV_ERROR: 1, SEV_CRITICAL: 2}
+
+# Default thresholds, merged with per-engine overrides.  Every number a
+# rule compares against lives here so tests can pin boundaries and
+# operators can retune without touching rule code.
+DEFAULT_THRESHOLDS = {
+    # persistent_straggler: same worker is the max-lag worker with lag
+    # >= straggler_lag for >= straggler_windows consecutive windows.
+    "straggler_lag": 1,
+    "straggler_windows": 2,
+    # round_lag_growth: a worker's lag strictly grew across this many
+    # consecutive windows (it is not just behind — it is falling).
+    "lag_growth_windows": 3,
+    # lane_credit_imbalance: with >= 2 lanes to a server, the busiest
+    # lane carries > imbalance_ratio x its sibling lanes COMBINED, above
+    # a traffic floor (idle lanes on a quiet link are not a finding).
+    "lane_imbalance_ratio": 4.0,
+    "lane_min_bytes": 16 * 1024 * 1024,
+    # recv_pool_miss_rate: in-window miss fraction above this, with at
+    # least pool_min_events checkouts in the window.
+    "pool_miss_rate": 0.5,
+    "pool_min_events": 32,
+    # fusion_dilution: deadline flushes dominate bucket flushes — the
+    # fusion layer is shipping mostly-empty buckets (threshold too big
+    # for the model, or the producer trickles leaves).
+    "fusion_min_flushes": 4,
+    "fusion_deadline_ratio": 2.0,
+    # server_hot_shard: one server's load share (keys_owned weighted by
+    # bytes when per-server bytes are known) above hot_shard_ratio x the
+    # fair share, with >= 2 servers and >= hot_shard_min_keys total.
+    "hot_shard_ratio": 2.0,
+    "hot_shard_min_keys": 8,
+}
+
+_SERIES_RE = re.compile(r'^([A-Za-z_:][A-Za-z0-9_:]*)\{(.*)\}$')
+_LABEL_RE = re.compile(r'(\w+)="((?:[^"\\]|\\.)*)"')
+
+
+def playbook_anchor(rule_id: str) -> str:
+    return f"{PLAYBOOK}#rule-{rule_id}"
+
+
+def parse_series(metrics: dict, name: str) -> Dict[tuple, float]:
+    """Labeled series from a flat registry-snapshot dict: keys look like
+    ``bps_worker_round_lag{worker="1"}``.  Returns {((label, value),
+    ...): number}; the unlabeled series (bare ``name``) keys as ()."""
+    out: Dict[tuple, float] = {}
+    for k, v in metrics.items():
+        if not isinstance(v, (int, float)):
+            continue
+        if k == name:
+            out[()] = float(v)
+            continue
+        m = _SERIES_RE.match(k)
+        if m and m.group(1) == name:
+            labels = tuple(sorted(
+                (lk, lv.replace('\\"', '"').replace("\\\\", "\\"))
+                for lk, lv in _LABEL_RE.findall(m.group(2))))
+            out[labels] = float(v)
+    return out
+
+
+class RuleCtx:
+    """What a rule sees: the window history (oldest..newest summaries)
+    plus delta/series helpers.  Counters are cumulative in the metrics
+    snapshot, so in-window activity is the DELTA between consecutive
+    windows' snapshots; gauges are read from the newest snapshot as-is
+    — the "counter deltas vs gauge snapshots" law the aggregation tests
+    pin."""
+
+    def __init__(self, windows: List[dict],
+                 thresholds: Optional[dict] = None):
+        self.windows = list(windows)
+        self.cur = self.windows[-1] if self.windows else {}
+        self.prev = self.windows[-2] if len(self.windows) > 1 else {}
+        self.th = dict(DEFAULT_THRESHOLDS)
+        if thresholds:
+            self.th.update(thresholds)
+
+    # -- metrics helpers ----------------------------------------------------
+    def metric(self, name: str, default: float = 0.0) -> float:
+        v = (self.cur.get("metrics") or {}).get(name, default)
+        return float(v) if isinstance(v, (int, float)) else default
+
+    def series(self, name: str, window: Optional[dict] = None
+               ) -> Dict[tuple, float]:
+        w = self.cur if window is None else window
+        return parse_series(w.get("metrics") or {}, name)
+
+    def delta(self, name: str) -> float:
+        """Counter delta across the last window (clamped at 0: a process
+        restart between snapshots resets counters, which must read as
+        "no activity", not a huge negative).  With only one window there
+        is no baseline — the cumulative total could be hours old, so the
+        delta is 0, never the total (counter rules need two windows;
+        gauge rules fire from the first)."""
+        if not self.prev:
+            return 0.0
+        cur = (self.cur.get("metrics") or {}).get(name, 0.0)
+        prev = (self.prev.get("metrics") or {}).get(name, 0.0)
+        if not isinstance(cur, (int, float)) or \
+                not isinstance(prev, (int, float)):
+            return 0.0
+        return max(0.0, float(cur) - float(prev))
+
+    def events(self, kind: str) -> int:
+        return int((self.cur.get("events") or {}).get(kind, 0))
+
+    def lag_map(self, window: dict) -> Dict[str, int]:
+        """{worker_id: round lag} from one window's gauges."""
+        out: Dict[str, int] = {}
+        for labels, v in self.series("bps_worker_round_lag",
+                                     window).items():
+            d = dict(labels)
+            if "worker" in d:
+                out[d["worker"]] = int(v)
+        return out
+
+
+@dataclasses.dataclass
+class Rule:
+    id: str
+    severity: str
+    summary: str              # one-line description (docs/rule table)
+    fn: Callable[[RuleCtx], List[dict]]   # -> [{"subject", "message",
+    #                                           "evidence"}, ...]
+
+
+# ---------------------------------------------------------------------------
+# Rule implementations.  Each returns a list of firings (empty = quiet);
+# a firing's "subject" keys the finding's open/close identity across
+# windows (e.g. the straggling worker id), so a persisting condition is
+# ONE finding that stays open, not a new one per window.
+# ---------------------------------------------------------------------------
+def _r_persistent_straggler(ctx: RuleCtx) -> List[dict]:
+    need = int(ctx.th["straggler_windows"])
+    min_lag = int(ctx.th["straggler_lag"])
+    if len(ctx.windows) < need:
+        return []
+    worst: Optional[str] = None
+    lags: List[int] = []
+    for w in ctx.windows[-need:]:
+        lag = ctx.lag_map(w)
+        if not lag:
+            return []
+        wid, l = max(lag.items(), key=lambda kv: kv[1])
+        if l < min_lag:
+            return []
+        if worst is None:
+            worst = wid
+        elif wid != worst:
+            return []
+        lags.append(l)
+    return [{"subject": f"worker={worst}",
+             "message": (f"worker {worst} has trailed the lead worker by "
+                         f">= {min_lag} round(s) for {need} consecutive "
+                         f"windows (lag history {lags}); its pushes gate "
+                         f"every sync round's publish"),
+             "evidence": {"worker": worst, "lags": lags,
+                          "windows": need}}]
+
+
+def _r_round_lag_growth(ctx: RuleCtx) -> List[dict]:
+    need = int(ctx.th["lag_growth_windows"])
+    if len(ctx.windows) < need:
+        return []
+    hist = [ctx.lag_map(w) for w in ctx.windows[-need:]]
+    out = []
+    for wid in hist[-1]:
+        series = [h.get(wid) for h in hist]
+        if any(v is None for v in series):
+            continue
+        if all(series[i] < series[i + 1] for i in range(len(series) - 1)):
+            out.append({
+                "subject": f"worker={wid}",
+                "message": (f"worker {wid}'s round lag grew every window "
+                            f"for {need} windows ({series}): it is not "
+                            f"just behind, it is falling further behind "
+                            f"every round"),
+                "evidence": {"worker": wid, "lags": series}})
+    return out
+
+
+def _r_lane_credit_imbalance(ctx: RuleCtx) -> List[dict]:
+    # Lane rows carry LIFETIME byte counters — the skew that matters is
+    # this window's delta (lifetime totals both dilute a fresh wedge
+    # behind hours of balanced history and pin an old, resolved skew
+    # open forever).  No previous transport section = no baseline = no
+    # verdict, the same law ctx.delta() applies to counters.
+    cur_rows = (ctx.cur.get("transport") or {}).get("lanes")
+    prev_rows = (ctx.prev.get("transport") or {}).get("lanes")
+    if not cur_rows or prev_rows is None:
+        return []
+    prev_bytes = {(r.get("server"), r.get("lane")):
+                  int(r.get("bytes_total", 0)) for r in prev_rows}
+    by_srv: Dict[object, list] = {}
+    for row in cur_rows:
+        key = (row.get("server"), row.get("lane"))
+        d = max(0, int(row.get("bytes_total", 0))
+                - prev_bytes.get(key, 0))
+        by_srv.setdefault(row.get("server"), []).append(d)
+    out = []
+    ratio = float(ctx.th["lane_imbalance_ratio"])
+    floor = int(ctx.th["lane_min_bytes"])
+    for srv, deltas in by_srv.items():
+        if len(deltas) < 2:
+            continue
+        total = sum(deltas)
+        if total < floor:
+            continue
+        worst = max(deltas)
+        rest = total - worst
+        # vs the REST COMBINED, not the mean: with k lanes the max can
+        # never exceed k x the mean, so a mean-ratio test can't fire on
+        # 2 lanes no matter how skewed they are.
+        if worst > ratio * max(1, rest):
+            out.append({
+                "subject": f"server={srv}",
+                "message": (f"server {srv}'s busiest data lane carried "
+                            f"{worst} of {total} bytes this window "
+                            f"(> {ratio:g}x its {len(deltas) - 1} "
+                            f"sibling lane(s) combined): the "
+                            f"byte-credit scheduler is pinned to one "
+                            f"lane — look for one giant partition or a "
+                            f"wedged lane"),
+                "evidence": {"server": srv, "lane_bytes": deltas,
+                             "total": total}})
+    return out
+
+
+def _r_recv_pool_miss_rate(ctx: RuleCtx) -> List[dict]:
+    hits = ctx.delta("bps_transport_pool_hits")
+    misses = ctx.delta("bps_transport_pool_misses")
+    events = hits + misses
+    if events < int(ctx.th["pool_min_events"]):
+        return []
+    rate = misses / events
+    if rate <= float(ctx.th["pool_miss_rate"]):
+        return []
+    return [{"subject": "recv_pool",
+             "message": (f"receive-buffer pool missed on "
+                         f"{rate:.0%} of {events:.0f} checkouts this "
+                         f"window: payloads exceed the pool's size "
+                         f"classes or churn outruns its depth — every "
+                         f"miss is a fresh allocation on the receiver "
+                         f"thread"),
+             "evidence": {"hits": hits, "misses": misses,
+                          "miss_rate": round(rate, 4)}}]
+
+
+def _r_fusion_dilution(ctx: RuleCtx) -> List[dict]:
+    deadline = ctx.delta("bps_fusion_deadline_flushes")
+    full = ctx.delta("bps_fusion_full_flushes")
+    if deadline + full < int(ctx.th["fusion_min_flushes"]):
+        return []
+    if deadline <= float(ctx.th["fusion_deadline_ratio"]) * max(1.0, full):
+        return []
+    return [{"subject": "fusion",
+             "message": (f"{deadline:.0f} fusion buckets flushed on the "
+                         f"FLUSH_MS deadline vs {full:.0f} flushed full "
+                         f"this window: buckets ship mostly empty — "
+                         f"lower BYTEPS_TPU_FUSION_BYTES or raise "
+                         f"FLUSH_MS to match the producer's pace"),
+             "evidence": {"deadline_flushes": deadline,
+                          "full_flushes": full}}]
+
+
+def _r_server_hot_shard(ctx: RuleCtx) -> List[dict]:
+    owned = {dict(k).get("server"): v
+             for k, v in ctx.series("bps_keys_owned").items()}
+    owned = {s: int(v) for s, v in owned.items() if s is not None}
+    if len(owned) < 2:
+        return []
+    total = sum(owned.values())
+    if total < int(ctx.th["hot_shard_min_keys"]):
+        return []
+    # Weight by per-server bytes when the server sections carry a row
+    # for EVERY owned server in this window AND the previous one (the
+    # weight is the in-window bytes_in delta — bytes_in is a lifetime
+    # counter, and a partial section, e.g. one momentarily-unreachable
+    # server's row missing, would otherwise zero that server's load and
+    # crown whoever has a row the "hot" one).  keys_owned alone
+    # otherwise.
+    def _bytes_rows(window: dict) -> Dict[str, float]:
+        out: Dict[str, float] = {}
+        for sid, row in ((window.get("server") or {}).get("servers")
+                         or {}).items():
+            if isinstance(row, dict) and isinstance(
+                    row.get("bytes_in"), (int, float)):
+                out[str(sid)] = float(row["bytes_in"])
+        return out
+
+    cur_b, prev_b = _bytes_rows(ctx.cur), _bytes_rows(ctx.prev)
+    have_all = all(s in cur_b and s in prev_b for s in owned)
+    delta_b = ({s: max(0.0, cur_b[s] - prev_b[s]) for s in owned}
+               if have_all else {})
+    if have_all and sum(delta_b.values()) > 0:
+        load = {s: owned.get(s, 0) * delta_b[s] for s in owned}
+        basis = "keys_owned x bytes_in"
+    else:
+        load = {s: float(v) for s, v in owned.items()}
+        basis = "keys_owned"
+    tot = sum(load.values())
+    if tot <= 0:
+        return []
+    fair = tot / len(load)
+    hot, hot_load = max(load.items(), key=lambda kv: kv[1])
+    if hot_load <= float(ctx.th["hot_shard_ratio"]) * fair:
+        return []
+    return [{"subject": f"server={hot}",
+             "message": (f"server {hot} carries {hot_load / tot:.0%} of "
+                         f"the {basis} load across {len(load)} servers "
+                         f"(fair share {1 / len(load):.0%}): a hot "
+                         f"shard — rebalance the ring (vnodes) or drain "
+                         f"keys off it"),
+             "evidence": {"server": hot, "basis": basis,
+                          "load": {s: round(v, 1)
+                                   for s, v in load.items()},
+                          "keys_owned": owned}}]
+
+
+def _r_nonfinite_gradients(ctx: RuleCtx) -> List[dict]:
+    d = ctx.delta("bps_grad_nonfinite_total")
+    if d <= 0:
+        return []
+    bad_keys = sorted(
+        dict(labels).get("key", "?")
+        for labels, v in ctx.series("bps_grad_nonfinite").items()
+        if v > 0)
+    return [{"subject": "nonfinite",
+             "message": (f"{d:.0f} non-finite gradient sample(s) this "
+                         f"window (keys: {', '.join(bad_keys) or '?'}): "
+                         f"NaN/Inf is in the training values — see the "
+                         f"GRADIENT HEALTH errors for key/round/worker "
+                         f"attribution"),
+             "evidence": {"new_samples": d, "keys": bad_keys}}]
+
+
+def _r_audit_mismatch(ctx: RuleCtx) -> List[dict]:
+    mism = ctx.delta("bps_audit_mismatch_total")
+    skew = ctx.delta("bps_audit_round_skew_total")
+    if mism <= 0 and skew <= 0:
+        return []
+    what = []
+    if mism:
+        what.append(f"{mism:.0f} digest mismatch(es)")
+    if skew:
+        what.append(f"{skew:.0f} lost/skewed round(s)")
+    return [{"subject": "audit",
+             "message": (f"consistency auditor flagged "
+                         f"{' and '.join(what)} this window: pulled "
+                         f"bytes differ from what the server published "
+                         f"— see the AUDIT errors and "
+                         f"bps.get_audit(cross_check=True)"),
+             "evidence": {"mismatches": mism, "round_skew": skew}}]
+
+
+def _r_barrier_stall(ctx: RuleCtx) -> List[dict]:
+    trips = ctx.delta("bps_transport_watchdog_trips")
+    barrier = ctx.events("barrier_timeout")
+    stall = ctx.events("stall")
+    if trips <= 0 and barrier <= 0 and stall <= 0:
+        return []
+    return [{"subject": "stall",
+             "message": (f"progress stalled this window "
+                         f"(watchdog trips {trips:.0f}, stall events "
+                         f"{stall}, barrier timeouts {barrier}): a round "
+                         f"or barrier stopped advancing — check the "
+                         f"watchdog dump for the blocked keys and "
+                         f"whether a peer is gone vs slow"),
+             "evidence": {"watchdog_trips": trips, "stall_events": stall,
+                          "barrier_timeouts": barrier}}]
+
+
+RULES: List[Rule] = [
+    Rule("persistent_straggler", SEV_WARN,
+         "one worker trails the lead for consecutive windows",
+         _r_persistent_straggler),
+    Rule("round_lag_growth", SEV_ERROR,
+         "a worker's round lag grows every window",
+         _r_round_lag_growth),
+    Rule("lane_credit_imbalance", SEV_WARN,
+         "one data lane carries nearly all of a server's bytes",
+         _r_lane_credit_imbalance),
+    Rule("recv_pool_miss_rate", SEV_WARN,
+         "receive-buffer pool misses dominate checkouts",
+         _r_recv_pool_miss_rate),
+    Rule("fusion_dilution", SEV_WARN,
+         "fusion buckets ship on the deadline instead of full",
+         _r_fusion_dilution),
+    Rule("server_hot_shard", SEV_WARN,
+         "one PS server carries an outsized keys x bytes load",
+         _r_server_hot_shard),
+    Rule("nonfinite_gradients", SEV_CRITICAL,
+         "NaN/Inf gradient samples appeared",
+         _r_nonfinite_gradients),
+    Rule("audit_mismatch", SEV_CRITICAL,
+         "the consistency auditor saw divergent or lost rounds",
+         _r_audit_mismatch),
+    Rule("barrier_stall", SEV_ERROR,
+         "a round or barrier stopped advancing",
+         _r_barrier_stall),
+]
+
+RULE_IDS = tuple(r.id for r in RULES)
+
+
+class DoctorEngine:
+    """Evaluates the rule set against each closing window.
+
+    Findings are identity-keyed by (rule, subject): a condition that
+    persists across windows stays ONE open finding (evidence refreshed,
+    logged once); a condition that stops firing closes.  ``emit=False``
+    turns off the side effects (log/flightrec/counter) — the offline
+    replay mode ``tools/bps_doctor.py`` uses, so live and offline runs
+    of the same rules differ only in plumbing."""
+
+    def __init__(self, rules: Optional[List[Rule]] = None,
+                 thresholds: Optional[dict] = None,
+                 history: int = 8, emit: bool = True):
+        self.rules = list(rules if rules is not None else RULES)
+        self.thresholds = dict(thresholds or {})
+        self.emit = emit
+        self._lock = threading.Lock()
+        self._windows: deque = deque(maxlen=max(2, int(history)))
+        self._open: Dict[tuple, dict] = {}
+        # Recent findings OPENED (bounded: a finding flapping at a rule
+        # threshold every window must not grow memory for the life of a
+        # multi-day job) + the lifetime open count.
+        self._all: deque = deque(maxlen=200)
+        self._total_opened = 0
+        self._last_window = -1
+        self._last_ts = 0.0
+
+    # -- evaluation ---------------------------------------------------------
+    def observe(self, summary: dict) -> List[dict]:
+        """Fold one window summary in; returns the findings that fired
+        this window (open + newly opened)."""
+        with self._lock:
+            self._windows.append(summary)
+            ctx = RuleCtx(list(self._windows), self.thresholds)
+            self._last_window = int(summary.get("window", -1))
+            self._last_ts = float(summary.get("ts", time.time()))
+            fired: List[dict] = []
+            seen: set = set()
+            for rule in self.rules:
+                try:
+                    hits = rule.fn(ctx) or []
+                except Exception:
+                    get_logger().exception("doctor rule %r failed",
+                                           rule.id)
+                    # A crashed rule says NOTHING about its condition:
+                    # keep its open findings open (closing them here
+                    # would re-open them next window as fresh findings
+                    # — double-logged, double-counted, identity reset).
+                    for key in self._open:
+                        if key[0] == rule.id:
+                            seen.add(key)
+                    continue
+                for hit in hits:
+                    key = (rule.id, hit.get("subject", ""))
+                    seen.add(key)
+                    prior = self._open.get(key)
+                    finding = {
+                        "rule": rule.id,
+                        "severity": hit.get("severity", rule.severity),
+                        "subject": hit.get("subject", ""),
+                        "summary": hit.get("message", rule.summary),
+                        "evidence": hit.get("evidence", {}),
+                        "playbook": playbook_anchor(rule.id),
+                        "window": self._last_window,
+                        "first_window": (prior["first_window"] if prior
+                                         else self._last_window),
+                        "ts": self._last_ts,
+                    }
+                    self._open[key] = finding
+                    fired.append(finding)
+                    if prior is None:
+                        self._all.append(finding)
+                        self._total_opened += 1
+                        if self.emit:
+                            self._emit_new(finding)
+            closed = [k for k in self._open if k not in seen]
+            for k in closed:
+                f = self._open.pop(k)
+                if self.emit:
+                    get_logger().info(
+                        "bps doctor: %s (%s) cleared after window %d",
+                        f["rule"], f["subject"], self._last_window)
+            return fired
+
+    def _emit_new(self, f: dict) -> None:
+        log = get_logger()
+        line = (f"bps doctor [{f['severity'].upper()}] {f['rule']} "
+                f"({f['subject']}): {f['summary']}  -> see {f['playbook']}")
+        if f["severity"] == SEV_WARN:
+            log.warning(line)
+        else:
+            log.error(line)
+        try:
+            from . import telemetry
+            telemetry.get_registry().counter(
+                "bps_doctor_findings_total",
+                help="doctor findings opened, by rule",
+                labels={"rule": f["rule"]}).inc()
+        except Exception:
+            pass
+        try:
+            from . import flightrec
+            flightrec.record("doctor_finding", rule=f["rule"],
+                             severity=f["severity"],
+                             subject=f["subject"],
+                             summary=f["summary"],
+                             playbook=f["playbook"],
+                             window=f["window"])
+        except Exception:
+            pass
+
+    # -- read surfaces ------------------------------------------------------
+    def diagnosis(self) -> dict:
+        """The ``bps.get_diagnosis()`` payload."""
+        with self._lock:
+            open_f = sorted(
+                self._open.values(),
+                key=lambda f: (-_SEV_ORDER.get(f["severity"], 0),
+                               f["rule"], f["subject"]))
+            return {"armed": True,
+                    "window": self._last_window,
+                    "ts": self._last_ts,
+                    "healthy": not open_f,
+                    "open": [dict(f) for f in open_f],
+                    "findings_total": self._total_opened,
+                    "history": [dict(f)
+                                for f in list(self._all)[-50:]]}
+
+    def verdict_line(self) -> str:
+        """One-line shutdown/atexit verdict."""
+        with self._lock:
+            if not self._open:
+                seen = self._total_opened
+                return ("bps doctor: healthy — no open findings"
+                        + (f" ({seen} cleared during the run)"
+                           if seen else ""))
+            parts = [f"{f['rule']}({f['subject']})"
+                     for f in self._open.values()]
+            return (f"bps doctor: {len(self._open)} open finding(s) at "
+                    f"shutdown: {', '.join(sorted(parts))} — see "
+                    f"{PLAYBOOK}")
+
+
+def evaluate_stream(summaries: List[dict],
+                    thresholds: Optional[dict] = None,
+                    history: int = 8) -> dict:
+    """Offline evaluation: replay window summaries through a silent
+    engine (identical rules, no side effects) and return its final
+    diagnosis plus every finding opened along the way.  This is the one
+    entry point ``tools/bps_doctor.py`` uses for bundles and metrics
+    JSONLs — live/offline parity is by construction."""
+    eng = DoctorEngine(thresholds=thresholds, history=history, emit=False)
+    for s in summaries:
+        eng.observe(s)
+    diag = eng.diagnosis()
+    diag["windows_evaluated"] = len(summaries)
+    return diag
+
+
+def summaries_from_metrics_jsonl(lines: List[dict]) -> List[dict]:
+    """Window summaries from metrics-JSONL snapshot lines
+    ({"ts", "metrics"} — the BYTEPS_TPU_METRICS_LOG format).  Each line
+    becomes one window: scalars only (rules ignore histogram dicts),
+    no per-key signal records or flight events — the rules that need
+    those simply stay quiet, and a live doctor over the same stream
+    agrees (parity-tested)."""
+    out = []
+    prev_ts: Optional[float] = None
+    for i, line in enumerate(lines):
+        metrics = {k: v for k, v in (line.get("metrics") or {}).items()
+                   if isinstance(v, (int, float))}
+        ts = float(line.get("ts", 0.0))
+        out.append({"schema": "bps-signal-window-v1", "window": i,
+                    "ts": ts, "dur_s": (ts - prev_ts) if prev_ts else 0.0,
+                    "keys": {}, "metrics": metrics, "events": {}})
+        prev_ts = ts
+    return out

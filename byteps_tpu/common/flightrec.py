@@ -167,15 +167,12 @@ def _sanitize(obj):
     """Make a metrics/extra tree strict-JSON-safe: histogram +Inf bucket
     bounds (and any other non-finite float) become strings — a bare
     ``Infinity`` in the output would make the bundle unparseable by
-    exactly the tool it exists for."""
-    if isinstance(obj, dict):
-        return {str(k): _sanitize(v) for k, v in obj.items()}
-    if isinstance(obj, (list, tuple)):
-        return [_sanitize(v) for v in obj]
-    if isinstance(obj, float) and (obj != obj or obj in
-                                   (float("inf"), float("-inf"))):
-        return str(obj)
-    return obj
+    exactly the tool it exists for.  Delegates to the one shared walk
+    (telemetry.json_safe, also behind the /signals and /diagnosis
+    routes) so bundles and routes can never encode the same value
+    differently."""
+    from .telemetry import json_safe
+    return json_safe(obj)
 
 
 def dump_bundle(reason: str, extra: Optional[dict] = None,

@@ -508,6 +508,22 @@ def update_round_lag(server_stats: dict, straggler_rounds: int,
 JSONL_INTERVAL_S = 10.0
 
 
+def json_safe(obj):
+    """Strict-JSON sanitation: non-finite floats become strings (a bare
+    ``Infinity`` would make the payload unparseable by the tools that
+    exist to parse it).  The ONE copy of this walk — the JSON routes
+    here and flightrec's postmortem bundles both ride it, so the two
+    surfaces can never diverge on how the same value encodes."""
+    if isinstance(obj, dict):
+        return {str(k): json_safe(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [json_safe(v) for v in obj]
+    if isinstance(obj, float) and (obj != obj or obj in
+                                   (float("inf"), float("-inf"))):
+        return str(obj)
+    return obj
+
+
 class TelemetryExporter:
     """Background export plane.
 
@@ -530,11 +546,17 @@ class TelemetryExporter:
     def __init__(self, registry: MetricsRegistry, port: int = 0,
                  jsonl_path: str = "",
                  refresh: Optional[Callable[[], None]] = None,
-                 max_log_mb: int = 64):
+                 max_log_mb: int = 64,
+                 routes: Optional[Dict[str, Callable[[], object]]] = None):
         self.registry = registry
         self.jsonl_path = jsonl_path
         self.max_log_mb = max(1, int(max_log_mb))
         self.refresh = refresh
+        # Extra JSON routes ({"/signals": fn, "/diagnosis": fn}): each
+        # GET renders fn()'s return value as sanitized JSON — the signal
+        # plane and doctor ride the SAME endpoint the Prometheus scrape
+        # uses, so one open port serves all three.
+        self.routes = dict(routes or {})
         self.port = 0
         self._want_port = int(port)
         self._httpd = None
@@ -557,7 +579,26 @@ class TelemetryExporter:
 
             class Handler(http.server.BaseHTTPRequestHandler):
                 def do_GET(self):        # noqa: N802 (stdlib API)
-                    if self.path.split("?")[0] not in ("/metrics", "/"):
+                    path = self.path.split("?")[0]
+                    route = exporter.routes.get(path)
+                    if route is not None:
+                        try:
+                            body = json.dumps(
+                                json_safe(route()), default=str).encode()
+                        except Exception:
+                            get_logger().exception(
+                                "metrics route %s failed", path)
+                            self.send_error(500)
+                            return
+                        self.send_response(200)
+                        self.send_header("Content-Type",
+                                         "application/json")
+                        self.send_header("Content-Length",
+                                         str(len(body)))
+                        self.end_headers()
+                        self.wfile.write(body)
+                        return
+                    if path not in ("/metrics", "/"):
                         self.send_error(404)
                         return
                     exporter._do_refresh()

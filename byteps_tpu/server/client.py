@@ -67,6 +67,7 @@ from typing import Callable, Dict, List, Optional, Tuple
 import numpy as np
 
 from ..common import flightrec as _flightrec
+from ..common import signals as _signals
 from ..common.config import Config
 from ..common.logging import get_logger
 from ..common.ring import DEFAULT_VNODES, RingTable
@@ -995,7 +996,8 @@ class _PartTask:
                  "handle", "dtype", "done_evt", "wire_ln", "bidirectional",
                  "label", "priority", "enq_ts", "push_ts", "pull_ts",
                  "ready", "enc_err", "credit_ln", "phase", "parked",
-                 "enq_mono", "send_mono", "lane_debt", "audit")
+                 "enq_mono", "send_mono", "ack_mono", "lane_debt",
+                 "audit")
 
     def __init__(self, pkey, payload, off, ln, rnd, srv, handle,
                  dtype=DT_F32, bidirectional=False, label=""):
@@ -1041,9 +1043,13 @@ class _PartTask:
         self.parked = False
         # Telemetry timestamps (time.monotonic; always set, unlike the
         # trace-gated *_ts fields): enqueue -> dispatch feeds the queue-wait
-        # histogram, dispatch -> ack the push-RTT histogram.
+        # histogram, dispatch -> ack the push-RTT histogram, and ack ->
+        # pull-data (`ack_mono`) the signal plane's per-key serve-wait
+        # component (the cheap always-on straggler-wait stand-in for the
+        # trace plane's MERGE_WAIT spans).
         self.enq_mono = 0.0
         self.send_mono = 0.0
+        self.ack_mono = 0.0
         # Auditor: this pull leg was sent with the trailer marker, so its
         # response carries 24 trailing digest bytes to strip+verify.
         # Recorded per ISSUE at pull-issue time (not read globally at
@@ -1699,8 +1705,9 @@ class PSSession:
                 part.phase = "pull"   # push acked: only the pull remains
         if part is None:
             return
+        part.ack_mono = time.monotonic()
         if part.send_mono:
-            self._m_push_rtt.observe(time.monotonic() - part.send_mono)
+            self._m_push_rtt.observe(part.ack_mono - part.send_mono)
         core = get_core()
         if core.trace_on and part.push_ts:
             part.pull_ts = core.trace_now_us()
@@ -1773,6 +1780,23 @@ class PSSession:
                 data.release()
             return
         self._lane_settle(part)     # round trip done: return lane credit
+        if _signals.plane() is not None:
+            # Per-key timer feed for the windowed signal plane: one call
+            # per completed partition round trip, module-None-checked so
+            # an unarmed run (SIGNAL_WINDOW_S=0) pays a single global
+            # read.  serve = push-ack -> pull-data: the server's merge
+            # wait on peers' pushes (+ the pull wire) — the always-on
+            # straggler component.
+            now_m = time.monotonic()
+            _signals.note_part(
+                part.label or f"key_{pkey >> 16}",
+                part.ln, part.ln, wire_bytes=part.wire_ln,
+                queue_s=(part.send_mono - part.enq_mono
+                         if part.enq_mono and part.send_mono else 0.0),
+                rtt_s=(part.ack_mono - part.send_mono
+                       if part.send_mono and part.ack_mono else 0.0),
+                serve_s=(now_m - part.ack_mono if part.ack_mono
+                         else 0.0))
         core = get_core()
         if core.trace_on and part.pull_ts:
             core.trace_record_part(part.label, "PULL", part.pull_ts,
@@ -1841,7 +1865,9 @@ class PSSession:
                     # failed() check only skips dead work.
                     from .wire import decode as wire_decode
                     t0 = (core.trace_now_us()
-                          if core.trace_on or self._codec_pool is not None
+                          if core.trace_on
+                          or self._codec_pool is not None
+                          or _signals.plane() is not None
                           else 0)
                     if part.handle.failed():
                         get_logger().debug(
@@ -1859,6 +1885,9 @@ class PSSession:
                                 len(raw), part.priority)
                         if self._codec_pool is not None:
                             self._codec_pool.record("DECODE", dur)
+                        _signals.note_codec(
+                            part.label or f"key_{part.pkey >> 16}",
+                            "decode", dur)
                 else:
                     got = np.frombuffer(raw, np.float32)
                     if got.size != n:
@@ -3054,6 +3083,12 @@ class PSSession:
                 "migrations_in": int(st.get("migrations_in", 0)),
                 "migrations_out": int(st.get("migrations_out", 0)),
                 "moved_frames": int(st.get("moved_frames", 0)),
+                # Per-server wire volume, kept on the row (not just the
+                # merged totals): the doctor's server_hot_shard rule
+                # weights keys_owned by per-window bytes_in deltas to
+                # name the byte-heavy server, not just the key-heavy one.
+                "bytes_in": int(st.get("bytes_in", 0)),
+                "bytes_out": int(st.get("bytes_out", 0)),
             }
             merged["bytes_in"] += int(st.get("bytes_in", 0))
             merged["bytes_out"] += int(st.get("bytes_out", 0))
@@ -3794,6 +3829,8 @@ class PSSession:
                                        part.pkey, part.wire_ln,
                                        part.priority)
             self._codec_pool.record("ENCODE", dur)
+            _signals.note_codec(part.label or f"key_{part.pkey >> 16}",
+                                "encode", dur)
 
     def _stage_parts(self, plan, payload, mv, comp, kw_bytes, handle,
                      parts, raw, seed, label="", priority=0) -> None:
@@ -3808,14 +3845,22 @@ class PSSession:
             if use_comp and pool is None:
                 # Inline fallback (BYTEPS_TPU_COMPRESS_THREADS=0): encode
                 # on the caller thread, the pre-pipeline data path.
-                t0 = core.trace_now_us() if core.trace_on else 0
+                t0 = (core.trace_now_us()
+                      if core.trace_on or _signals.plane() is not None
+                      else 0)
                 wire_payload = comp.encode(
                     pkey, payload[off // 4:(off + ln) // 4])
                 if t0:
-                    core.trace_record_part(
-                        f"{label}.part{pkey & 0xFFFF}", "ENCODE", t0,
-                        core.trace_now_us() - t0, pkey, len(wire_payload),
-                        priority)
+                    dur = core.trace_now_us() - t0
+                    if core.trace_on:
+                        core.trace_record_part(
+                            f"{label}.part{pkey & 0xFFFF}", "ENCODE", t0,
+                            dur, pkey, len(wire_payload), priority)
+                    # Inline encodes must feed the signal plane too, or
+                    # the compute_bound class is unreachable in the
+                    # compress_threads=0 config.
+                    _signals.note_codec(
+                        label or f"key_{pkey >> 16}", "encode", dur)
                 dtype = DT_COMPRESSED
             elif use_comp:
                 wire_payload = None     # pipelined: the pool fills it in

@@ -1,0 +1,130 @@
+"""Bench-trajectory regression gate self-test (tools/bench_compare.py,
+ISSUE 12 satellite): the gate that keeps future PRs from silently
+regressing the r04 on-chip baseline must itself be pinned — synthetic
+record series exercise the flag/no-flag boundary, fallback-baseline
+exclusion, direction inference, and the CLI contract against the real
+repo history.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TOOLS = os.path.join(ROOT, "tools")
+if TOOLS not in sys.path:
+    sys.path.insert(0, TOOLS)
+
+import bench_compare  # noqa: E402
+
+
+def R(seq, metric, value, unit="tokens_per_sec", platform="tpu",
+      fallback=False):
+    return {"file": f"BENCH_r{seq:02d}.json", "seq": seq,
+            "metric": metric, "value": value, "unit": unit,
+            "platform": platform, "fallback": fallback}
+
+
+def test_flags_regression_over_threshold():
+    recs = [R(1, "throughput", 100.0), R(2, "throughput", 110.0),
+            R(3, "throughput", 95.0)]       # -13.6% vs best prior (110)
+    rep = bench_compare.check(recs, threshold=0.10)
+    assert len(rep["regressions"]) == 1
+    row = rep["regressions"][0]
+    assert row["baseline"] == 110.0 and row["latest"] == 95.0
+    assert row["status"] == "REGRESSED"
+    # Within threshold: ok.
+    recs[-1] = R(3, "throughput", 100.0)    # -9.1%
+    assert bench_compare.check(recs, threshold=0.10)["regressions"] == []
+
+
+def test_fallback_records_never_baseline():
+    """The r05 lesson: a fallback record must not become the bar the
+    next honest record is judged against — and fallback candidates only
+    compare within their own platform group."""
+    recs = [R(1, "throughput", 100.0),
+            R(2, "throughput", 500.0, fallback=True),  # bogus number
+            R(3, "throughput", 99.0)]
+    rep = bench_compare.check(recs, threshold=0.10)
+    assert rep["regressions"] == []          # judged vs 100, not 500
+    (row,) = [r for r in rep["groups"] if r["metric"] == "throughput"]
+    assert row["baseline"] == 100.0
+    # A series with ONLY fallback priors has no baseline at all.
+    rep = bench_compare.check(
+        [R(1, "m", 100.0, fallback=True), R(2, "m", 1.0)])
+    assert rep["groups"][0]["status"] == "no-baseline"
+    assert rep["regressions"] == []
+
+
+def test_lower_is_better_direction():
+    recs = [R(1, "fault_recovery_ms", 80.0, unit="ms"),
+            R(2, "fault_recovery_ms", 100.0, unit="ms")]  # +25% worse
+    rep = bench_compare.check(recs, threshold=0.10)
+    assert len(rep["regressions"]) == 1
+    # Getting faster is never a regression.
+    recs[-1] = R(2, "fault_recovery_ms", 40.0, unit="ms")
+    assert bench_compare.check(recs)["regressions"] == []
+
+
+def test_platforms_compared_separately():
+    recs = [R(1, "eff", 1.0, platform="tpu"),
+            R(2, "eff", 0.2, platform="cpu"),   # different hardware
+            R(3, "eff", 0.98, platform="tpu")]
+    rep = bench_compare.check(recs, threshold=0.10)
+    assert rep["regressions"] == []
+    assert len(rep["groups"]) == 2
+
+
+def test_load_records_shapes(tmp_path):
+    """Loader handles the bench.py wrapper shape, the raw shape, the
+    MULTICHIP ok-record shape, and skips garbage."""
+    (tmp_path / "BENCH_r01.json").write_text(json.dumps({
+        "n": 1, "rc": 0,
+        "parsed": {"metric": "m1", "value": 10.0, "unit": "x",
+                   "detail": {"device_platform": "tpu"}}}))
+    (tmp_path / "BENCH_r02.json").write_text(json.dumps({
+        "metric": "m1", "value": 12.0, "unit": "x",
+        "detail": {"device_platform": "tpu"}}))
+    (tmp_path / "BENCH_r03.json").write_text(json.dumps({
+        "n": 3, "rc": 0,
+        "parsed": {"metric": "m1", "value": 11.0,
+                   "unit": "cpu_fallback_x",
+                   "detail": {"note": "cpu-fallback: tunnel wedged",
+                              "fallback": True}}}))
+    (tmp_path / "MULTICHIP_r01.json").write_text(json.dumps(
+        {"n_devices": 8, "rc": 0, "ok": True}))
+    (tmp_path / "MULTICHIP_r02.json").write_text(json.dumps(
+        {"n_devices": 8, "rc": 1, "ok": False}))
+    (tmp_path / "BENCH_r04.json").write_text("{not json")
+    recs = bench_compare.load_records(str(tmp_path))
+    by = {(r["metric"], r["seq"]): r for r in recs}
+    assert by[("m1", 1)]["platform"] == "tpu"
+    assert by[("m1", 2)]["value"] == 12.0
+    assert by[("m1", 3)]["fallback"] is True
+    assert by[("m1", 3)]["platform"] == "cpu"
+    assert by[("multichip_dryrun_ok", 2)]["value"] == 0.0
+    # The broken multichip run IS a 100% regression of its ok bit.
+    rep = bench_compare.check(recs)
+    assert any(r["metric"] == "multichip_dryrun_ok"
+               for r in rep["regressions"])
+
+
+def test_cli_on_real_repo_history():
+    """The gate runs over the repo's actual BENCH_*/MULTICHIP_* series
+    and emits valid JSON; today's history must not regress (r05's
+    fallback records are stamped and excluded as baselines — exactly
+    the loop this satellite closes)."""
+    proc = subprocess.run(
+        [sys.executable, os.path.join(TOOLS, "bench_compare.py"),
+         ROOT, "--json"],
+        capture_output=True, text=True, timeout=120)
+    doc = json.loads(proc.stdout)
+    assert proc.returncode in (0, 3)
+    assert doc["groups"], "repo history should yield at least one group"
+    if proc.returncode == 0:
+        assert doc["regressions"] == []
+    text = subprocess.run(
+        [sys.executable, os.path.join(TOOLS, "bench_compare.py"), ROOT],
+        capture_output=True, text=True, timeout=120)
+    assert "bench_compare:" in text.stdout

@@ -1,0 +1,50 @@
+"""Rule<->playbook drift check (tools/check_doctor_docs.py), wired as a
+fast tier-1 test: every doctor rule id must have a matching
+docs/troubleshooting.md anchor and vice versa — plus a self-test that
+the checker actually detects drift.
+"""
+
+import os
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TOOLS = os.path.join(ROOT, "tools")
+if TOOLS not in sys.path:
+    sys.path.insert(0, TOOLS)
+
+import check_doctor_docs  # noqa: E402
+
+
+def test_doctor_docs_in_sync():
+    problems = check_doctor_docs.check(ROOT)
+    assert problems == [], "\n".join(problems)
+
+
+def test_checker_detects_drift(tmp_path):
+    """Self-test on a doctored (ha) tree: a removed anchor and a stale
+    one must both be reported."""
+    fake = tmp_path / "repo"
+    (fake / "docs").mkdir(parents=True)
+    doc = open(os.path.join(ROOT, "docs",
+                            "troubleshooting.md")).read()
+    doc = doc.replace('<a id="rule-barrier_stall"></a>', "")
+    doc += '\n<a id="rule-no_such_rule"></a>\n### ghost\n'
+    (fake / "docs" / "troubleshooting.md").write_text(doc)
+    problems = check_doctor_docs.check(ROOT)   # real tree: still clean
+    assert problems == []
+    # The fake tree imports the REAL package (sys.path already has
+    # ROOT), so only the doc anchors differ — exactly the drift axis
+    # the checker owns.
+    fake_problems = check_doctor_docs.check(str(fake))
+    joined = "\n".join(fake_problems)
+    assert "barrier_stall" in joined and "MISSING PLAYBOOK" in joined
+    assert "no_such_rule" in joined and "STALE PLAYBOOK" in joined
+
+
+def test_cli_exit_codes():
+    import subprocess
+    proc = subprocess.run(
+        [sys.executable, os.path.join(TOOLS, "check_doctor_docs.py"),
+         ROOT], capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "in sync" in proc.stdout

@@ -1,0 +1,164 @@
+"""ISSUE-12 end-to-end attribution acceptance: a two-worker PS run with
+one worker delayed through chaos_proxy yields a `persistent_straggler`
+finding NAMING the slow worker, through BOTH `bps.get_diagnosis()`
+(live, inside the run) and `tools/bps_doctor.py` over the run's
+postmortem bundle (offline, after it).
+
+Both workers run a FIXED round count in lockstep (sync rounds need both
+pushes, so an adaptive stop on either side could deadlock the other's
+final round); worker 0 records the first finding it sees along the way.
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import time
+
+from testutil import cpu_env, free_port
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TOOLS = os.path.join(ROOT, "tools")
+if TOOLS not in sys.path:
+    sys.path.insert(0, TOOLS)
+
+from chaos_proxy import ChaosProxy  # noqa: E402
+
+ROUNDS = 35
+
+
+def _boot_server(port, num_workers):
+    env = cpu_env({
+        "DMLC_PS_ROOT_PORT": str(port - 1),
+        "DMLC_NUM_WORKER": str(num_workers),
+        "BYTEPS_SERVER_ENGINE_THREAD": "2",
+        "JAX_PLATFORMS": "cpu",
+    })
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "byteps_tpu.server"], env=env,
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        try:
+            socket.create_connection(("127.0.0.1", port), 0.5).close()
+            return proc
+        except OSError:
+            if proc.poll() is not None:
+                raise RuntimeError(f"server died rc={proc.returncode}")
+            time.sleep(0.1)
+    proc.kill()
+    raise TimeoutError("PS server did not come up")
+
+
+# Worker 0: the healthy worker — api-level rounds with the signal plane
+# + doctor armed, diagnosis polled each round, first straggler finding
+# recorded.  Worker 1: identical loop, no diagnosis, every wire byte
+# delayed through the chaos proxy.
+WORKER_CODE = """
+import json, os, sys
+import numpy as np, jax.numpy as jnp
+import byteps_tpu as bps
+bps.init()
+watch = os.environ.get("E2E_WATCH") == "1"
+x = jnp.asarray(np.arange(2048, dtype=np.float32))
+found = None
+for r in range(int(os.environ["E2E_ROUNDS"])):
+    bps.push_pull(x, name="e2e.grad", average=False)
+    bps.mark_step()
+    if watch and found is None:
+        for f in bps.get_diagnosis().get("open", []):
+            if f["rule"] == "persistent_straggler":
+                found = f
+if watch:
+    if found is None:
+        print("E2E_NO_FINDING " + json.dumps(bps.get_diagnosis()))
+        bps.shutdown()
+        sys.exit(4)
+    print("E2E_FINDING " + json.dumps(found))
+    sig = bps.get_key_signals()
+    print("E2E_SIGNALS " + json.dumps(
+        {k: v["class"] for k, v in sig["keys"].items()}))
+bps.shutdown()
+print("E2E_OK")
+"""
+
+
+def test_two_worker_straggler_attribution(tmp_path):
+    port = free_port()
+    server = _boot_server(port, num_workers=2)
+    proxy = ChaosProxy("127.0.0.1", port).start()
+    proxy.delay(100)                       # ms per forwarded chunk
+    pm_dir = str(tmp_path / "postmortems")
+    base = {
+        "BYTEPS_TPU_PS_MODE": "1",
+        "DMLC_NUM_WORKER": "2",
+        "DMLC_NUM_SERVER": "1",
+        "DMLC_PS_ROOT_PORT": str(port - 1),
+        "BYTEPS_TPU_FUSION_BYTES": "0",
+        "E2E_ROUNDS": str(ROUNDS),
+    }
+    env0 = cpu_env({**base,
+                    "DMLC_WORKER_ID": "0",
+                    "E2E_WATCH": "1",
+                    "BYTEPS_TPU_PS_HOSTS": f"127.0.0.1:{port}",
+                    # Fast windows so the finding lands in seconds; the
+                    # rule still needs 2 consecutive lagging windows.
+                    "BYTEPS_TPU_SIGNAL_WINDOW_S": "0.35",
+                    "BYTEPS_TPU_POSTMORTEM_DIR": pm_dir})
+    env1 = cpu_env({**base,
+                    "DMLC_WORKER_ID": "1",
+                    "BYTEPS_TPU_PS_HOSTS": f"127.0.0.1:{proxy.port}",
+                    "BYTEPS_TPU_SIGNAL_WINDOW_S": "0"})  # off: one-sided
+    try:
+        p1 = subprocess.Popen([sys.executable, "-c", WORKER_CODE],
+                              env=env1, stdout=subprocess.PIPE,
+                              stderr=subprocess.PIPE, text=True)
+        p0 = subprocess.Popen([sys.executable, "-c", WORKER_CODE],
+                              env=env0, stdout=subprocess.PIPE,
+                              stderr=subprocess.PIPE, text=True)
+        out0, err0 = p0.communicate(timeout=240)
+        out1, err1 = p1.communicate(timeout=240)
+        assert p0.returncode == 0, (out0[-2000:], err0[-3000:])
+        assert p1.returncode == 0, (out1[-2000:], err1[-3000:])
+    finally:
+        proxy.stop()
+        server.kill()
+        server.wait()
+
+    # LIVE half: bps.get_diagnosis() named the delayed worker.
+    line = next(l for l in out0.splitlines()
+                if l.startswith("E2E_FINDING "))
+    finding = json.loads(line[len("E2E_FINDING "):])
+    assert finding["rule"] == "persistent_straggler"
+    assert finding["subject"] == "worker=1", finding
+    assert finding["evidence"]["worker"] == "1"
+    # ... within 2 windows of the lag becoming visible (the rule's
+    # consecutive-window requirement IS the bound).
+    assert finding["evidence"]["windows"] == 2
+    assert finding["playbook"].endswith("#rule-persistent_straggler")
+    # The signal plane classified the key stream too.
+    sig_line = next(l for l in out0.splitlines()
+                    if l.startswith("E2E_SIGNALS "))
+    classes = json.loads(sig_line[len("E2E_SIGNALS "):])
+    assert classes, "signal plane recorded no keys"
+    assert "E2E_OK" in out0
+
+    # OFFLINE half: the SAME rules over the run's postmortem bundle.
+    bundles = [f for f in os.listdir(pm_dir)
+               if f.startswith("bps-postmortem-r0")]
+    assert bundles, os.listdir(pm_dir)
+    proc = subprocess.run(
+        [sys.executable, os.path.join(TOOLS, "bps_doctor.py"),
+         pm_dir, "--json"],
+        capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    doc = json.loads(proc.stdout)
+    r0 = [s for s in doc["sources"] if s["source"].startswith("r0")]
+    assert r0
+    hits = [f for s in r0
+            for f in (s["diagnosis"]["history"]
+                      + s["diagnosis"]["open"])
+            if f["rule"] == "persistent_straggler"]
+    assert hits, doc
+    assert any(f["subject"] == "worker=1" for f in hits)

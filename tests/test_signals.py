@@ -1,0 +1,337 @@
+"""Windowed key-signal plane tests (common/signals.py, ISSUE 12):
+window aggregation math, classification boundaries + stability, the
+off-is-really-off / wire-byte-identity contract, and the live session
+feed + /signals + /diagnosis routes.
+"""
+
+import json
+import struct
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from byteps_tpu.common import doctor as doctor_mod
+from byteps_tpu.common import signals
+from byteps_tpu.common import telemetry as tm
+from byteps_tpu.server.client import (PSSession, CMD_HELLO, CMD_INIT,
+                                      CMD_PUSH, CMD_PULL)
+
+from testutil import StubPSServer, free_port
+
+
+@pytest.fixture(autouse=True)
+def _clean_plane():
+    """Every test starts and ends with no process-wide plane armed."""
+    signals.disarm()
+    yield
+    signals.disarm()
+
+
+# ---------------------------------------------------------------------------
+# Window aggregation math
+# ---------------------------------------------------------------------------
+def test_window_aggregation_sums_and_rates():
+    p = signals.SignalPlane(window_s=1.0)
+    for _ in range(4):
+        p.note_part("grad.w.part0", 1 << 20, 1 << 20,
+                    queue_s=0.001, rtt_s=0.010, serve_s=0.002)
+    p.note_part("grad.w.part1", 1 << 20, 1 << 20,
+                queue_s=0.001, rtt_s=0.010, serve_s=0.002)
+    p.note_codec("grad.w.part0", "encode", 2000)   # µs
+    p.note_codec("grad.w.part1", "decode", 1000)
+    t0 = p._last_roll_mono
+    s = p.roll(now=t0 + 2.0)          # exactly 2 s window
+    rec = s["keys"]["grad.w"]         # ".partN" folds into the tensor key
+    assert rec["pushes"] == 5
+    assert rec["push_bytes"] == 5 << 20
+    assert rec["pull_bytes"] == 5 << 20
+    assert rec["wire_bytes"] == 5 << 20       # raw parts: wire == logical
+    assert rec["wire_mbps"] == pytest.approx((10 << 20) / 1e6 / 2.0)
+    c = rec["components"]
+    assert c["queue"] == pytest.approx(0.005)
+    assert c["push_wire"] == pytest.approx(0.050)
+    assert c["serve"] == pytest.approx(0.010)
+    assert c["encode"] == pytest.approx(0.002)
+    assert c["decode"] == pytest.approx(0.001)
+    assert rec["rtt_mean_s"] == pytest.approx(0.010)
+    assert sum(rec["shares"].values()) == pytest.approx(1.0)
+    # The next window starts empty: accumulators were swapped out.
+    s2 = p.roll()
+    assert s2["keys"] == {}
+    assert s2["window"] == s["window"] + 1
+
+
+def test_window_includes_scalar_metrics_only():
+    """The summary's metrics slice carries counters/gauges (what the
+    doctor's delta/series helpers read) but not histogram dicts."""
+    tm.reset_registry()
+    reg = tm.get_registry()
+    reg.counter("bps_test_ctr").inc(7)
+    reg.gauge("bps_test_gauge", labels={"worker": "1"}).set(3)
+    reg.histogram("bps_test_hist").observe(0.1)
+    p = signals.SignalPlane(window_s=1.0)
+    s = p.roll()
+    assert s["metrics"]["bps_test_ctr"] == 7
+    assert s["metrics"]['bps_test_gauge{worker="1"}'] == 3
+    assert "bps_test_hist" not in s["metrics"]
+
+
+def test_key_cap_overflows_into_other():
+    p = signals.SignalPlane(window_s=1.0)
+    cap = signals.MAX_KEYS
+    for i in range(cap + 10):
+        p.note_part(f"k{i}", 1024, 1024, rtt_s=0.001)
+    s = p.roll()
+    assert len(s["keys"]) <= cap + 1
+    assert s["keys"]["_other"]["pushes"] >= 10
+
+
+# ---------------------------------------------------------------------------
+# Classification
+# ---------------------------------------------------------------------------
+def _rec(push_bytes=10 << 20, pushes=10, queue=0.0, wire=0.0, serve=0.0,
+         enc=0.0, dec=0.0, health=None):
+    return {"pushes": pushes, "push_bytes": push_bytes,
+            "components": {"queue": queue, "push_wire": wire,
+                           "serve": serve, "encode": enc, "decode": dec},
+            "health": health or {}}
+
+
+def test_classification_boundaries():
+    assert signals.classify(_rec(wire=0.5, serve=0.1)) == "wire_bound"
+    assert signals.classify(_rec(queue=0.3, wire=0.3, enc=0.5)) \
+        == "wire_bound"            # queue counts toward the wire share
+    assert signals.classify(_rec(enc=0.4, dec=0.3, wire=0.5)) \
+        == "compute_bound"
+    assert signals.classify(_rec(serve=0.9, wire=0.5)) \
+        == "straggler_bound"
+    # tiny: mean pushed payload under the threshold, timings ignored.
+    assert signals.classify(
+        _rec(push_bytes=10 * 1024, pushes=10, wire=9.0)) == "tiny"
+    # ... judged on LOGICAL bytes: a 1 MiB key whose codec shrinks the
+    # wire blob below the threshold is a compressed medium key, never
+    # "tiny" (the tuner would otherwise be steered off exactly the keys
+    # compression is helping).
+    p = signals.SignalPlane(window_s=1.0)
+    p.note_part("c.part0", 1 << 20, 1 << 20, rtt_s=0.01,
+                wire_bytes=32 * 1024)
+    rec = p.roll()["keys"]["c"]
+    assert rec["wire_bytes"] == 32 * 1024
+    assert rec["push_bytes"] == 1 << 20
+    assert rec["class"] == "wire_bound"
+    # unhealthy trumps everything.
+    assert signals.classify(
+        _rec(wire=9.0, health={"nonfinite": 3})) == "unhealthy"
+    assert signals.classify({"pushes": 5, "push_bytes": 50 << 20,
+                             "components": {}, "audit_bad": True}) \
+        == "unhealthy"
+
+
+def test_classification_stable_on_quiet_run():
+    """Identical traffic window after window classifies identically —
+    the tuner must not see a key flapping between classes on noise-free
+    input."""
+    p = signals.SignalPlane(window_s=1.0)
+    seen = []
+    for _ in range(5):
+        for _ in range(8):
+            p.note_part("k.part0", 4 << 20, 4 << 20,
+                        queue_s=0.002, rtt_s=0.020, serve_s=0.005)
+        s = p.roll()
+        seen.append(s["keys"]["k"]["class"])
+    assert seen == ["wire_bound"] * 5
+
+
+# ---------------------------------------------------------------------------
+# Off is off: module feeds with no plane, and wire byte-identity
+# ---------------------------------------------------------------------------
+def test_module_feeds_noop_without_plane():
+    assert signals.plane() is None
+    signals.note_part("k", 1, 1, rtt_s=0.1)      # must not raise
+    signals.note_codec("k", "encode", 5.0)
+
+
+def _run_stub_roundtrip():
+    """One push_pull against a recording stub; returns the raw frames."""
+    store = {}
+
+    def handler(cmd, dt, fl, req_id, wid, key, payload):
+        if cmd == CMD_HELLO:
+            return 0, b"\x00\x00"
+        if cmd == CMD_INIT:
+            return 0, struct.pack("<Q", 0)
+        if cmd == CMD_PUSH:
+            store[key] = bytes(payload)
+            return 0, b""
+        if cmd == CMD_PULL:
+            return 0, store[key]
+        return 1, b""
+
+    srv = StubPSServer(handler, record=True)
+    try:
+        s = PSSession(["127.0.0.1"], [srv.port], worker_id=0,
+                      num_servers=1, wire_conns=1)
+        x = np.arange(256, dtype=np.float32)
+        got = s.push_pull(3, x)
+        np.testing.assert_array_equal(got, x)
+        s.close()
+        with srv.lock:
+            return list(srv.frames)
+    finally:
+        srv.close()
+
+
+def test_signal_plane_wire_byte_identity():
+    """ISSUE-12 acceptance: the signal plane is strictly local — the
+    wire with the plane ARMED is byte-identical (headers and command
+    set) to the wire with it off (BYTEPS_TPU_SIGNAL_WINDOW_S=0), against
+    a recording stub."""
+    off_frames = _run_stub_roundtrip()
+    signals.arm(window_s=60.0, start_thread=False)
+    try:
+        on_frames = _run_stub_roundtrip()
+        # The armed run really fed the plane (the feeds are live) ...
+        recs = signals.plane().roll()["keys"]
+        assert recs and next(iter(recs.values()))["pushes"] == 1
+    finally:
+        signals.disarm()
+    # ... and the wire never changed: same frame count, same bytes.
+    assert [h for h, _, _ in off_frames] == [h for h, _, _ in on_frames]
+
+
+# ---------------------------------------------------------------------------
+# Live session feed + HTTP routes (fast: stub server, real PSSession)
+# ---------------------------------------------------------------------------
+def test_session_feeds_and_routes():
+    """A real PSSession round trip lands per-key timers in the armed
+    plane; /signals and /diagnosis serve JSON next to /metrics; an
+    unarmed exporter 404s them."""
+    eng = doctor_mod.DoctorEngine(emit=False)
+    plane = signals.arm(window_s=60.0, start_thread=False,
+                        on_window=eng.observe)
+    store = {}
+
+    def handler(cmd, dt, fl, req_id, wid, key, payload):
+        if cmd == CMD_HELLO:
+            return 0, b"\x00\x00"
+        if cmd == CMD_INIT:
+            return 0, struct.pack("<Q", 0)
+        if cmd == CMD_PUSH:
+            store[key] = bytes(payload)
+            return 0, b""
+        if cmd == CMD_PULL:
+            return 0, store[key]
+        return 1, b""
+
+    srv = StubPSServer(handler)
+    try:
+        s = PSSession(["127.0.0.1"], [srv.port], worker_id=0,
+                      num_servers=1, wire_conns=1)
+        x = np.arange(1024, dtype=np.float32)
+        for _ in range(3):
+            s.push_pull(9, x)
+        s.close()
+    finally:
+        srv.close()
+    plane.roll()
+    sig = plane.key_signals()
+    (label, rec), = sig["keys"].items()
+    assert rec["pushes"] == 3
+    assert rec["push_bytes"] == 3 * x.nbytes
+    assert rec["components"]["push_wire"] > 0
+    assert rec["components"]["serve"] > 0
+    assert rec["class"] in signals.CLASSES
+
+    exp = tm.TelemetryExporter(
+        tm.get_registry(), port=free_port(),
+        routes={"/signals": lambda: {"windows": plane.history()},
+                "/diagnosis": lambda: eng.diagnosis()}).start()
+    try:
+        base = f"http://127.0.0.1:{exp.port}"
+        sig_doc = json.loads(urllib.request.urlopen(
+            base + "/signals", timeout=10).read().decode())
+        assert sig_doc["windows"][-1]["keys"][label]["pushes"] == 3
+        diag = json.loads(urllib.request.urlopen(
+            base + "/diagnosis", timeout=10).read().decode())
+        assert diag["healthy"] is True and diag["armed"] is True
+        body = urllib.request.urlopen(
+            base + "/metrics", timeout=10).read().decode()
+        assert "bps_push_rtt_seconds" in body   # /metrics untouched
+    finally:
+        exp.stop()
+
+    exp2 = tm.TelemetryExporter(tm.get_registry(),
+                                port=free_port()).start()
+    try:
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{exp2.port}/diagnosis", timeout=10)
+        assert ei.value.code == 404
+    finally:
+        exp2.stop()
+
+
+def test_audit_verdict_marks_one_window_only():
+    """The session's `last` audit verdict is sticky for its lifetime;
+    the key it names is 'unhealthy' only in the window the verdict
+    LANDED — a transient mismatch must not brand the key forever (the
+    tuner would permanently exclude a healthy key)."""
+    audit = {"armed": True, "checked": 10, "mismatches": 0,
+             "round_skew": 0, "last": None}
+    p = signals.SignalPlane(window_s=1.0,
+                            providers={"audit": lambda: dict(audit)})
+    p.note_part("k.part0", 1 << 20, 1 << 20, rtt_s=0.01)
+    assert p.roll()["keys"]["k"]["class"] == "wire_bound"
+    # Verdicts carry PARTITION labels; the window keys are base labels.
+    audit.update(mismatches=1,
+                 last={"label": "k.part3", "round": 7,
+                       "verdict": "mismatch"})
+    p.note_part("k.part0", 1 << 20, 1 << 20, rtt_s=0.01)
+    assert p.roll()["keys"]["k"]["class"] == "unhealthy"   # its window
+    p.note_part("k.part0", 1 << 20, 1 << 20, rtt_s=0.01)
+    assert p.roll()["keys"]["k"]["class"] == "wire_bound"  # recovered
+
+
+def test_failed_refresh_strips_stale_server_gauges():
+    """A window whose CMD_STATS refresh failed must not carry frozen
+    lag/ownership gauges — the doctor would otherwise diagnose a
+    'persistent straggler' off pre-outage values while the real story
+    is a dead server."""
+    tm.reset_registry()
+    reg = tm.get_registry()
+    reg.gauge("bps_worker_round_lag", labels={"worker": "1"}).set(3)
+    reg.gauge("bps_keys_owned", labels={"server": "0"}).set(9)
+    reg.counter("bps_transport_pool_misses").inc(5)
+    ok = signals.SignalPlane(window_s=1.0,
+                             refresh=lambda: {"bytes_in": 1})
+    s = ok.roll()
+    assert 'bps_worker_round_lag{worker="1"}' in s["metrics"]
+    dead = signals.SignalPlane(window_s=1.0, refresh=lambda: None)
+    s = dead.roll()
+    assert not any(k.startswith("bps_worker_round_lag")
+                   for k in s["metrics"])
+    assert not any(k.startswith("bps_keys_owned")
+                   for k in s["metrics"])
+    # Counters survive: delta rules must still see the window.
+    assert s["metrics"]["bps_transport_pool_misses"] == 5
+    # No refresh wired at all (offline-style plane): nothing stripped.
+    plain = signals.SignalPlane(window_s=1.0)
+    assert any(k.startswith("bps_worker_round_lag")
+               for k in plain.roll()["metrics"])
+
+
+def test_plane_thread_rolls_windows():
+    p = signals.SignalPlane(window_s=0.1)
+    p.note_part("k", 1024, 1024, rtt_s=0.001)
+    p.start()
+    try:
+        deadline = time.time() + 5
+        while time.time() < deadline and len(p.history()) < 2:
+            time.sleep(0.05)
+        assert len(p.history()) >= 2
+    finally:
+        p.stop()
+    # stop() closes the in-flight window too.
+    assert any(s["keys"] for s in p.history())

@@ -1,0 +1,276 @@
+#!/usr/bin/env python
+"""bps_doctor — run the continuous-diagnosis rules against a live job
+or a dead one's recordings.
+
+The SAME declarative rule set (`byteps_tpu/common/doctor.py`) that runs
+inside every worker with ``BYTEPS_TPU_SIGNAL_WINDOW_S`` > 0 runs here,
+in two modes:
+
+**Live** — poll a running worker's metrics endpoint (the ``/signals``
+JSON route serves the signal plane's window history) and evaluate each
+new window as it closes::
+
+    python tools/bps_doctor.py --url http://worker:9100   # follow
+    python tools/bps_doctor.py --port 9100 --once         # one verdict
+
+**Offline** — replay recordings from a dead run::
+
+    python tools/bps_doctor.py /shared/postmortems        # bundle dir
+    python tools/bps_doctor.py bundle.json --json         # one bundle
+    python tools/bps_doctor.py metrics.jsonl              # metrics log
+
+A postmortem bundle (``BYTEPS_TPU_POSTMORTEM_DIR``) carries the signal
+plane's recent window history in its ``diagnosis``/``signals`` extra
+sections — offline replay over a bundle therefore sees exactly what the
+live doctor saw.  A metrics JSONL (``BYTEPS_TPU_METRICS_LOG``) yields
+windows with the metrics series only (no per-key records or flight
+events); rules that need those stay quiet, identically live or offline.
+
+``--json`` emits one machine-readable object.  Exit codes: 0 = ran
+(healthy or not; read the output), 1 = no input/endpoint.  Add
+``--fail-on-findings`` to exit 3 when any finding FIRED during the
+evaluated stream — open at the end or not: for a CI gate over a dead
+run's recordings, a barrier stall that later "cleared" still deserves a
+red build.  No dependencies beyond the stdlib + the byteps_tpu package.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+import time
+import urllib.request
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:
+    sys.path.insert(0, _REPO_ROOT)
+
+from byteps_tpu.common import doctor  # noqa: E402
+
+BUNDLE_SCHEMA = "bps-postmortem-v1"
+
+
+# ---------------------------------------------------------------------------
+# Offline input loading
+# ---------------------------------------------------------------------------
+def _load_json(path: str):
+    with open(path) as f:
+        return json.load(f)
+
+
+def load_offline(paths) -> list:
+    """[(source_label, [window summaries])] from bundle files, bundle
+    directories, and metrics JSONLs.  Each source is evaluated on its
+    own (a bundle is one worker's view; merging histories would
+    double-count counters)."""
+    sources = []
+    files = []
+    for p in paths:
+        if os.path.isdir(p):
+            files.extend(sorted(glob.glob(
+                os.path.join(p, "bps-postmortem-*.json"))))
+        else:
+            files.append(p)
+    for f in files:
+        try:
+            first = open(f).read(4096).lstrip()
+        except OSError as e:
+            print(f"bps_doctor: skipping {f}: {e}", file=sys.stderr)
+            continue
+        try:
+            if first.startswith("{") and '"bps-postmortem-v1"' in first:
+                doc = _load_json(f)
+                if doc.get("schema") != BUNDLE_SCHEMA:
+                    raise ValueError("not a postmortem bundle")
+                extra = doc.get("extra") or {}
+                windows = extra.get("signals") or []
+                label = f"r{doc.get('rank', '?')}:{os.path.basename(f)}"
+                if not windows:
+                    # A bundle from a run with the plane off still has
+                    # its final metrics snapshot: evaluate what one
+                    # window's worth of gauges can say (deltas are 0).
+                    windows = [{"schema": "bps-signal-window-v1",
+                                "window": 0,
+                                "ts": (doc.get("clock") or {}).get(
+                                    "wall", 0.0),
+                                "dur_s": 0.0, "keys": {},
+                                "metrics": {
+                                    k: v for k, v in (doc.get("metrics")
+                                                      or {}).items()
+                                    if isinstance(v, (int, float))},
+                                "events": {}}]
+                recorded = (extra.get("diagnosis") or {})
+                sources.append((label, windows, recorded))
+            else:
+                # Metrics JSONL: one {"ts", "metrics"} object per line.
+                lines = []
+                with open(f) as fh:
+                    for raw in fh:
+                        raw = raw.strip()
+                        if raw:
+                            lines.append(json.loads(raw))
+                sources.append((os.path.basename(f),
+                                doctor.summaries_from_metrics_jsonl(lines),
+                                {}))
+        except (OSError, ValueError, KeyError) as e:
+            print(f"bps_doctor: skipping {f}: {e}", file=sys.stderr)
+    return sources
+
+
+def run_offline(paths, as_json: bool) -> tuple:
+    """Returns (exit_code, any_findings)."""
+    sources = load_offline(paths)
+    if not sources:
+        print("bps_doctor: no usable input (want postmortem bundles, a "
+              "bundle directory, or a metrics JSONL)", file=sys.stderr)
+        return 1, False
+    results = []
+    any_findings = False
+    for label, windows, recorded in sources:
+        diag = doctor.evaluate_stream(windows)
+        results.append({"source": label, "diagnosis": diag,
+                        "recorded_open": recorded.get("open", [])})
+        if diag["open"] or diag["history"]:
+            any_findings = True
+    if as_json:
+        print(json.dumps({"mode": "offline", "sources": results}))
+        return 0, any_findings
+    for r in results:
+        d = r["diagnosis"]
+        print(f"== {r['source']}  ({d['windows_evaluated']} window(s) "
+              f"replayed)")
+        _print_diag(d)
+        rec = r["recorded_open"]
+        if rec:
+            print(f"  recorded at dump time ({len(rec)} open):")
+            for f in rec:
+                print(f"    [{f.get('severity', '?')}] "
+                      f"{f.get('rule', '?')} ({f.get('subject', '')})")
+        print()
+    return 0, any_findings
+
+
+def _print_diag(d: dict) -> None:
+    if d.get("healthy"):
+        print(f"  healthy — no open findings "
+              f"({d.get('findings_total', 0)} opened over the run)")
+    for f in d.get("open", []):
+        print(f"  [{f['severity'].upper():<8}] {f['rule']} "
+              f"({f['subject']})")
+        print(f"      {f['summary']}")
+        print(f"      playbook: {f['playbook']}")
+    open_keys = {(g["rule"], g["subject"]) for g in d.get("open", [])}
+    closed = [f for f in d.get("history", [])
+              if (f["rule"], f["subject"]) not in open_keys]
+    if closed:
+        print(f"  cleared during the run: " + ", ".join(
+            sorted({f"{f['rule']}({f['subject']})" for f in closed})))
+
+
+# ---------------------------------------------------------------------------
+# Live mode
+# ---------------------------------------------------------------------------
+def _fetch_json(url: str, timeout: float = 5.0):
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return json.loads(r.read().decode())
+
+
+def run_live(base: str, interval: float, once: bool,
+             as_json: bool) -> tuple:
+    """Poll ``<base>/signals`` and evaluate each new window with a local
+    engine — the live job's own doctor and this one run the same rules
+    over the same summaries, so they agree by construction."""
+    eng = doctor.DoctorEngine(emit=False)
+    seen = -1
+    printed = set()
+    while True:
+        try:
+            doc = _fetch_json(base + "/signals")
+        except OSError as e:
+            print(f"bps_doctor: cannot reach {base}/signals: {e} — is "
+                  f"BYTEPS_TPU_SIGNAL_WINDOW_S > 0 and "
+                  f"BYTEPS_TPU_METRICS_PORT set on the worker?",
+                  file=sys.stderr)
+            if once:
+                return 1, False
+            time.sleep(interval)
+            continue
+        windows = doc.get("windows") or []
+        top = max((int(w.get("window", -1)) for w in windows),
+                  default=-1)
+        if top < seen:
+            # Window indices went BACKWARDS: the worker restarted (a new
+            # plane counts from 0).  Start a fresh engine — the old
+            # high-water mark would silently swallow the new run's
+            # windows for as long as its history.
+            print(f"bps_doctor: window index reset ({top} < {seen}) — "
+                  f"worker restarted, re-evaluating from scratch",
+                  file=sys.stderr)
+            eng = doctor.DoctorEngine(emit=False)
+            seen = -1
+        for w in windows:
+            if int(w.get("window", -1)) > seen:
+                seen = int(w.get("window", -1))
+                fired = eng.observe(w)
+                if not (once or as_json):
+                    for f in fired:
+                        key = (f["rule"], f["subject"],
+                               f["first_window"])
+                        if key not in printed:
+                            printed.add(key)
+                            print(f"[window {f['window']}] "
+                                  f"[{f['severity'].upper()}] "
+                                  f"{f['rule']} ({f['subject']}): "
+                                  f"{f['summary']}\n    playbook: "
+                                  f"{f['playbook']}")
+        diag = eng.diagnosis()
+        if once:
+            if as_json:
+                print(json.dumps({"mode": "live", "diagnosis": diag}))
+            else:
+                print(f"== {base}  ({len(windows)} window(s) in "
+                      f"history)")
+                _print_diag(diag)
+            return 0, bool(diag["open"] or diag["history"])
+        time.sleep(interval)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("paths", nargs="*",
+                    help="offline inputs: postmortem bundle file(s)/"
+                         "dir(s) or metrics JSONL(s)")
+    ap.add_argument("--url", help="live mode: worker metrics endpoint "
+                                  "base (http://host:port)")
+    ap.add_argument("--port", type=int,
+                    help="live mode shorthand for http://127.0.0.1:PORT")
+    ap.add_argument("--interval", type=float, default=2.0,
+                    help="live poll interval seconds (default 2)")
+    ap.add_argument("--once", action="store_true",
+                    help="live mode: one evaluation pass, then exit")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable output (implies --once live)")
+    ap.add_argument("--fail-on-findings", action="store_true",
+                    help="exit 3 when any finding fired during the "
+                         "run, even if it later cleared (CI gate)")
+    args = ap.parse_args(argv)
+    if bool(args.paths) == bool(args.url or args.port):
+        ap.error("need offline paths OR --url/--port (not both)")
+    if args.paths:
+        rc, findings = run_offline(args.paths, args.json)
+    else:
+        base = (args.url or f"http://127.0.0.1:{args.port}").rstrip("/")
+        base = base.rsplit("/metrics", 1)[0]
+        rc, findings = run_live(base, args.interval,
+                                once=args.once or args.json,
+                                as_json=args.json)
+    if rc == 0 and args.fail_on_findings and findings:
+        return 3
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -8,8 +8,11 @@ latency percentiles, codec latency, the step critical-path breakdown
 from the last analyzed trace window (``bps_step_critical_path_*``, see
 docs/timeline.md), the gradient-health / audit panel (``bps_grad_*`` and
 ``bps_audit_*``, see docs/monitoring.md "Auditing & postmortem"),
-per-worker round lag (straggler view), and the codec/transport/fusion
-counter panels.
+per-worker round lag (straggler view), the codec/transport/fusion
+counter panels, and — when the signal plane is armed
+(``BYTEPS_TPU_SIGNAL_WINDOW_S`` > 0) — the doctor panel: the open
+findings from the ``/diagnosis`` route, severity-ranked, each with its
+playbook anchor (see docs/monitoring.md "Doctor").
 
 Usage:
     python tools/bps_top.py --url http://host:9100/metrics
@@ -38,6 +41,18 @@ _LABEL = re.compile(r'(\w+)="([^"]*)"')
 def fetch(url: str, timeout: float = 3.0) -> str:
     with urllib.request.urlopen(url, timeout=timeout) as r:
         return r.read().decode()
+
+
+def fetch_diagnosis(metrics_url: str, timeout: float = 3.0):
+    """The doctor's /diagnosis JSON from the same endpoint, or None when
+    the signal plane is off (404) / unreachable — the panel then simply
+    doesn't render."""
+    import json
+    base = metrics_url.rsplit("/metrics", 1)[0]
+    try:
+        return json.loads(fetch(base + "/diagnosis", timeout=timeout))
+    except Exception:
+        return None
 
 
 def parse(text: str) -> dict:
@@ -110,7 +125,8 @@ def _fmt_bytes(v: float) -> str:
     return f"{v:8.1f}TB"
 
 
-def render(metrics: dict, prev: dict, dt: float) -> list:
+def render(metrics: dict, prev: dict, dt: float,
+           diagnosis: dict = None) -> list:
     """Dashboard lines from the current (and previous, for rates) poll."""
     lines = []
     now = time.strftime("%H:%M:%S")
@@ -120,6 +136,28 @@ def render(metrics: dict, prev: dict, dt: float) -> list:
     lines.append(f"bps_top  {now}   push_pull {_fmt_bytes(pushed)} total"
                  f"   {_fmt_bytes(rate)}/s")
     lines.append("")
+
+    # Doctor panel (/diagnosis route; BYTEPS_TPU_SIGNAL_WINDOW_S > 0).
+    # Open findings first — a diagnosed bottleneck or failure should be
+    # the first thing on screen after the throughput line.
+    if diagnosis is not None and diagnosis.get("armed", True):
+        open_f = diagnosis.get("open") or []
+        if open_f:
+            lines.append(f"doctor: {len(open_f)} open finding(s)   "
+                         f"[window {diagnosis.get('window', '?')}]")
+            for f in open_f[:8]:
+                lines.append(
+                    f"  [{f.get('severity', '?'):<8}] "
+                    f"{f.get('rule', '?')} ({f.get('subject', '')})  "
+                    f"-> {f.get('playbook', '')}")
+                summary = f.get("summary", "")
+                if summary:
+                    lines.append(f"      {summary[:100]}")
+        else:
+            lines.append(f"doctor: healthy   "
+                         f"[window {diagnosis.get('window', '?')}, "
+                         f"{diagnosis.get('findings_total', 0)} cleared]")
+        lines.append("")
 
     lines.append("latency                 p50      p95      count")
     for label, hist in (("push RTT", "bps_push_rtt_seconds"),
@@ -273,7 +311,8 @@ def run_plain(url: str, interval: float, once: bool) -> int:
             time.sleep(interval)
             continue
         now = time.monotonic()
-        lines = render(metrics, prev, now - t_prev)
+        lines = render(metrics, prev, now - t_prev,
+                       diagnosis=fetch_diagnosis(url))
         prev, t_prev = metrics, now
         if once:
             print("\n".join(lines))
@@ -296,7 +335,8 @@ def run_curses(url: str, interval: float) -> int:
             try:
                 metrics = parse(fetch(url))
                 now = time.monotonic()
-                lines = render(metrics, prev, now - t_prev)
+                lines = render(metrics, prev, now - t_prev,
+                               diagnosis=fetch_diagnosis(url))
                 prev, t_prev = metrics, now
             except OSError as e:
                 lines = [f"bps_top: cannot reach {url}", f"  {e}",

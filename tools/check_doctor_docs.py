@@ -1,0 +1,88 @@
+#!/usr/bin/env python
+"""check_doctor_docs — assert the doctor's rules and the playbook agree.
+
+Every doctor finding carries a playbook anchor
+(``docs/troubleshooting.md#rule-<id>``); an anchor that doesn't exist
+sends an operator mid-incident to a dead link, and a playbook entry for
+a deleted rule documents behavior that can never fire.  Modeled on
+``tools/check_env_docs.py``: both directions are pinned as a fast
+tier-1 test (tests/test_doctor_docs.py) so they can't drift one PR at a
+time.
+
+  - every rule id in ``byteps_tpu.common.doctor.RULE_IDS`` must have a
+    ``<a id="rule-<id>"></a>`` anchor in docs/troubleshooting.md;
+  - every ``rule-*`` anchor in docs/troubleshooting.md must name a
+    live rule.
+
+Also runnable standalone::
+
+    python tools/check_doctor_docs.py [repo_root]
+
+Exit 0 = in sync; 1 = drift (each problem printed).
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+from typing import List
+
+ANCHOR_RE = re.compile(r'<a id="rule-([a-z0-9_]+)">')
+DOC_FILE = os.path.join("docs", "troubleshooting.md")
+
+
+def _rule_ids(root: str) -> List[str]:
+    if root not in sys.path:
+        sys.path.insert(0, root)
+    from byteps_tpu.common.doctor import RULE_IDS
+    return list(RULE_IDS)
+
+
+def _doc_anchors(root: str) -> List[str]:
+    try:
+        with open(os.path.join(root, DOC_FILE), errors="replace") as f:
+            return ANCHOR_RE.findall(f.read())
+    except OSError:
+        return []
+
+
+def check(root: str) -> List[str]:
+    """Drift report lines; empty = in sync."""
+    rules = set(_rule_ids(root))
+    anchors = _doc_anchors(root)
+    problems = []
+    for rid in sorted(rules - set(anchors)):
+        problems.append(
+            f'MISSING PLAYBOOK: doctor rule "{rid}" has no '
+            f'<a id="rule-{rid}"> anchor in {DOC_FILE} — its findings '
+            f'link to a dead anchor')
+    for a in sorted(set(anchors) - rules):
+        problems.append(
+            f'STALE PLAYBOOK: {DOC_FILE} anchors "rule-{a}" but no '
+            f'doctor rule with that id exists')
+    dup = sorted({a for a in anchors if anchors.count(a) > 1})
+    for a in dup:
+        problems.append(
+            f'DUPLICATE ANCHOR: "rule-{a}" appears more than once in '
+            f'{DOC_FILE} — fragment links resolve to the first only')
+    return problems
+
+
+def main(argv=None) -> int:
+    args = argv if argv is not None else sys.argv[1:]
+    root = args[0] if args else os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__)))
+    problems = check(root)
+    if problems:
+        print("\n".join(problems))
+        print(f"\n{len(problems)} doctor-doc drift problem(s); every "
+              f"rule id must have a matching anchor in {DOC_FILE} "
+              f"(and vice versa)")
+        return 1
+    print("doctor docs in sync")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -48,7 +48,8 @@ DIVERGENT_KINDS = ("audit_mismatch", "audit_lost_round", "nonfinite",
 FATAL_KINDS = ("stall", "server_dead", "conn_gave_up", "evicted",
                "barrier_timeout")
 NOTABLE_KINDS = ("conn_drop", "reconnected", "ring_epoch",
-                 "membership_epoch", "init", "shutdown", "exit")
+                 "membership_epoch", "init", "shutdown", "exit",
+                 "doctor_finding")
 
 
 def load_bundles(paths: List[str]) -> List[dict]:
@@ -153,6 +154,23 @@ def _fmt_event(ev: dict) -> str:
             f"{ev.get('kind', '?'):<18} {fields}")
 
 
+def diagnosis_rows(bundles: List[dict]) -> List[dict]:
+    """Doctor findings open at each bundle's dump time (the ``diagnosis``
+    extra section a signal-plane-armed run records) — the run's own
+    verdict, rendered alongside the event timeline."""
+    rows = []
+    for b in bundles:
+        diag = (b.get("extra") or {}).get("diagnosis") or {}
+        for f in diag.get("open", []):
+            rows.append({"rank": b.get("rank", "?"),
+                         "rule": f.get("rule", "?"),
+                         "severity": f.get("severity", "?"),
+                         "subject": f.get("subject", ""),
+                         "summary": f.get("summary", ""),
+                         "playbook": f.get("playbook", "")})
+    return rows
+
+
 def analyze(bundles: List[dict]) -> dict:
     events = merged_timeline(bundles)
     return {
@@ -165,6 +183,7 @@ def analyze(bundles: List[dict]) -> dict:
         "cross_audit": cross_audit(bundles),
         "first_bad": first_bad_event(events),
         "last_rounds": last_rounds(events),
+        "diagnosis": diagnosis_rows(bundles),
     }
 
 
@@ -213,6 +232,16 @@ def render(analysis: dict, max_events: int = 200) -> str:
     elif len(ranks) > 1:
         lines.append("cross-worker audit: no divergent (key, round) "
                      "digests across bundles")
+        lines.append("")
+    diag = analysis.get("diagnosis") or []
+    if diag:
+        lines.append("doctor findings open at dump time "
+                     "(replay the full rule set with: "
+                     "python tools/bps_doctor.py <bundles>):")
+        for row in diag:
+            lines.append(f"  r{row['rank']}  [{row['severity']}] "
+                         f"{row['rule']} ({row['subject']})  "
+                         f"-> {row['playbook']}")
         lines.append("")
     fb = analysis["first_bad"]
     if fb is not None:
