@@ -70,6 +70,10 @@ class _State:
     doctor: Optional[Any] = None
     doctor_verdict_done: bool = False
     doctor_atexit: bool = False
+    # Adaptive-compression tuner (BYTEPS_TPU_TUNER=1): chained onto the
+    # same window stream as the doctor; worker 0 proposes CMD_CODEC
+    # switches, everyone else observes/adopts.
+    tuner: Optional[Any] = None
 
 
 _state = _State()
@@ -205,6 +209,12 @@ def init(lazy: bool = True) -> None:
     # make "I set the window and got no doctor" undiagnosable.
     if cfg.signal_window_s > 0:
         _start_signal_plane(cfg)
+    elif cfg.tuner:
+        get_logger().warning(
+            "BYTEPS_TPU_TUNER=1 but the signal plane is off "
+            "(BYTEPS_TPU_SIGNAL_WINDOW_S=0): the tuner consumes the "
+            "plane's classified windows and cannot run without it — "
+            "set a window to arm the loop")
     if cfg.metrics_port > 0 or cfg.metrics_log:
         try:
             _state.exporter = telemetry.TelemetryExporter(
@@ -1080,12 +1090,38 @@ def _start_signal_plane(cfg) -> None:
                                e)
             return None
 
+    tuner = None
+    if cfg.tuner:
+        if sess is None:
+            get_logger().warning(
+                "BYTEPS_TPU_TUNER=1 outside PS mode: the tuner drives "
+                "the PS wire codec table and has nothing to tune here")
+        else:
+            from . import tuner as tuner_mod
+            # One proposer per job (worker 0): racing proposers would
+            # converge through the server's epoch arbitration anyway,
+            # but a single control loop keeps decisions explainable.
+            tuner = tuner_mod.Tuner(
+                sess, propose=(cfg.worker_id == 0),
+                hold=cfg.tuner_hold, blacklist=cfg.tuner_blacklist,
+                margin_rounds=cfg.tuner_margin_rounds,
+                regress_frac=cfg.tuner_regress_frac)
+
+    def _on_window(summary):
+        eng.observe(summary)
+        if tuner is not None:
+            try:
+                tuner.observe(summary)
+            except Exception:
+                get_logger().exception("tuner window pass failed")
+
     plane = signals.arm(window_s=cfg.signal_window_s,
                         history=cfg.signal_history,
                         refresh=_refresh, providers=providers,
-                        on_window=eng.observe)
+                        on_window=_on_window)
     _state.signal_plane = plane
     _state.doctor = eng
+    _state.tuner = tuner
     _state.doctor_verdict_done = False
     flightrec.set_extra_provider(
         lambda: {"diagnosis": eng.diagnosis(),
@@ -1138,6 +1174,7 @@ def _stop_signal_plane() -> None:
     signals.disarm()
     _state.signal_plane = None
     _state.doctor = None
+    _state.tuner = None
 
 
 def _signal_routes() -> dict:
@@ -1149,10 +1186,14 @@ def _signal_routes() -> dict:
     if _state.signal_plane is None:
         return {}
     plane, eng = _state.signal_plane, _state.doctor
-    return {"/signals": lambda: {"schema": signals.SCHEMA,
-                                 "window_s": plane.window_s,
-                                 "windows": plane.history()},
-            "/diagnosis": lambda: eng.diagnosis()}
+    routes = {"/signals": lambda: {"schema": signals.SCHEMA,
+                                   "window_s": plane.window_s,
+                                   "windows": plane.history()},
+              "/diagnosis": lambda: eng.diagnosis()}
+    if _state.tuner is not None:
+        tuner = _state.tuner
+        routes["/tuner"] = lambda: tuner.state()
+    return routes
 
 
 def get_key_signals() -> dict:
@@ -1180,6 +1221,19 @@ def get_diagnosis() -> dict:
         return {"armed": False, "healthy": True, "open": [],
                 "findings_total": 0}
     return _state.doctor.diagnosis()
+
+
+def get_tuner() -> dict:
+    """The adaptive-compression tuner's state (``BYTEPS_TPU_TUNER=1``):
+    per-key dial position / class history / blacklist state, total
+    switches and reverts, and the advisory knob proposals
+    (FUSION_BYTES / COMPRESS_THREADS / PARTITION_BYTES / WIRE_CONNS —
+    logged, never silently applied).  ``{"armed": False}`` when the
+    tuner is off."""
+    if _state.tuner is None:
+        return {"armed": False, "switches_total": 0, "keys": {},
+                "knob_proposals": []}
+    return _state.tuner.state()
 
 
 def get_health() -> dict:

@@ -196,6 +196,27 @@ class Config:
     # Window summaries kept in memory (and shipped in postmortem
     # bundles' diagnosis section) — bounds the plane's footprint.
     signal_history: int = 32             # BYTEPS_TPU_SIGNAL_HISTORY
+    # Adaptive-compression tuner (common/tuner.py): each signal window,
+    # wire-bound keys step toward harder codecs (raw -> onebit -> elias
+    # -> qblock), compute-bound/tiny keys toward raw, unhealthy keys pin
+    # raw; switches are epoch-versioned CMD_CODEC renegotiations that
+    # take effect at a future round boundary on every worker atomically.
+    # Off (default): no tuner is constructed and the wire is
+    # byte-identical to the untuned run.  Requires the signal plane
+    # (BYTEPS_TPU_SIGNAL_WINDOW_S > 0).
+    tuner: bool = False                  # BYTEPS_TPU_TUNER
+    # Windows a key's class must persist before the tuner switches it
+    # (hysteresis — the loop must not chase one noisy window).
+    tuner_hold: int = 2                  # BYTEPS_TPU_TUNER_HOLD
+    # Windows a reverted (or unhealthy-pinned) key stays frozen.
+    tuner_blacklist: int = 8             # BYTEPS_TPU_TUNER_BLACKLIST
+    # How many rounds ahead a proposed switch's boundary is placed —
+    # headroom for every worker to learn of it before crossing (the
+    # server's CODEC_STALE replay covers whoever still misses it).
+    tuner_margin_rounds: int = 2         # BYTEPS_TPU_TUNER_MARGIN_ROUNDS
+    # Fractional per-push round-time regression (vs the pre-switch
+    # baseline) that reverts a switch and blacklists the key.
+    tuner_regress_frac: float = 0.2      # BYTEPS_TPU_TUNER_REGRESS_FRAC
 
     # ---- logging ----
     log_level: str = "WARNING"           # BYTEPS_LOG_LEVEL
@@ -278,6 +299,13 @@ class Config:
             signal_window_s=float(
                 os.environ.get("BYTEPS_TPU_SIGNAL_WINDOW_S") or 10.0),
             signal_history=_env_int("BYTEPS_TPU_SIGNAL_HISTORY", 32),
+            tuner=_env_bool("BYTEPS_TPU_TUNER"),
+            tuner_hold=_env_int("BYTEPS_TPU_TUNER_HOLD", 2),
+            tuner_blacklist=_env_int("BYTEPS_TPU_TUNER_BLACKLIST", 8),
+            tuner_margin_rounds=_env_int(
+                "BYTEPS_TPU_TUNER_MARGIN_ROUNDS", 2),
+            tuner_regress_frac=float(
+                os.environ.get("BYTEPS_TPU_TUNER_REGRESS_FRAC") or 0.2),
             log_level=_env_str("BYTEPS_LOG_LEVEL", "WARNING"),
             mesh_dp=_env_int("BYTEPS_TPU_MESH_DP", 0),
             mesh_tp=_env_int("BYTEPS_TPU_MESH_TP", 1),

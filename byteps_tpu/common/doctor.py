@@ -74,6 +74,13 @@ DEFAULT_THRESHOLDS = {
     # fair share, with >= 2 servers and >= hot_shard_min_keys total.
     "hot_shard_ratio": 2.0,
     "hot_shard_min_keys": 8,
+    # tuner_thrash: a key switched codecs in MORE THAN thrash_switches
+    # of the last thrash_windows windows — the adaptive-compression
+    # loop is oscillating instead of converging (hysteresis too short
+    # for the workload's class noise, or a key genuinely on a
+    # wire/compute boundary).
+    "tuner_thrash_windows": 6,
+    "tuner_thrash_switches": 2,
 }
 
 _SERIES_RE = re.compile(r'^([A-Za-z_:][A-Za-z0-9_:]*)\{(.*)\}$')
@@ -399,6 +406,49 @@ def _r_audit_mismatch(ctx: RuleCtx) -> List[dict]:
              "evidence": {"mismatches": mism, "round_skew": skew}}]
 
 
+def _r_tuner_thrash(ctx: RuleCtx) -> List[dict]:
+    m = int(ctx.th["tuner_thrash_windows"])
+    n = int(ctx.th["tuner_thrash_switches"])
+    if len(ctx.windows) < 2:
+        return []
+    wins = ctx.windows[-(m + 1):]
+    # A "switch window" for a key = its bps_tuner_key_switches_total
+    # series grew across that window (counter delta law: consecutive
+    # snapshot pairs, restart-clamped).
+    switch_windows: Dict[str, int] = {}
+    for prev, cur in zip(wins, wins[1:]):
+        pm = parse_series(prev.get("metrics") or {},
+                          "bps_tuner_key_switches_total")
+        cm = parse_series(cur.get("metrics") or {},
+                          "bps_tuner_key_switches_total")
+        prev_by_key = {dict(lbl).get("key"): v for lbl, v in pm.items()}
+        for lbl, v in cm.items():
+            key = dict(lbl).get("key")
+            if key is None:
+                continue
+            if v - float(prev_by_key.get(key, 0.0)) > 0:
+                switch_windows[key] = switch_windows.get(key, 0) + 1
+    out = []
+    for key, cnt in sorted(switch_windows.items()):
+        if cnt <= n:
+            continue
+        classes = [
+            ((w.get("keys") or {}).get(key) or {}).get("class", "-")
+            for w in wins[1:]]
+        out.append({
+            "subject": f"key={key}",
+            "message": (f"key {key} switched codecs in {cnt} of the "
+                        f"last {len(wins) - 1} windows (class history "
+                        f"{classes}): the adaptive-compression tuner is "
+                        f"thrashing instead of converging — raise "
+                        f"BYTEPS_TPU_TUNER_HOLD / _BLACKLIST, or pin "
+                        f"this key's codec by hand"),
+            "evidence": {"key": key, "switch_windows": cnt,
+                         "windows": len(wins) - 1,
+                         "class_history": classes}})
+    return out
+
+
 def _r_barrier_stall(ctx: RuleCtx) -> List[dict]:
     trips = ctx.delta("bps_transport_watchdog_trips")
     barrier = ctx.events("barrier_timeout")
@@ -444,6 +494,9 @@ RULES: List[Rule] = [
     Rule("barrier_stall", SEV_ERROR,
          "a round or barrier stopped advancing",
          _r_barrier_stall),
+    Rule("tuner_thrash", SEV_WARN,
+         "the adaptive-compression tuner keeps flipping a key's codec",
+         _r_tuner_thrash),
 ]
 
 RULE_IDS = tuple(r.id for r in RULES)

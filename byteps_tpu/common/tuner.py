@@ -1,0 +1,428 @@
+"""Adaptive-compression tuner: the self-tuning control loop that picks
+the wire codec (and proposes the knobs) per key, per signal window.
+
+BytePS ships a static compression registry — the user picks a codec per
+tensor up front and lives with it, even though the right choice depends
+on whether a key is wire-bound or compute-bound *right now*.  This
+module closes that loop (arXiv 2105.07829, "Compressed Communication
+for Distributed Training: Adaptive Methods and System"): each window it
+walks the signal plane's classified ``KeySignal`` records
+(``bps.get_key_signals()``, PR 12) and steps every key along the dial
+
+    raw -> onebit -> elias -> qblock
+
+  - ``wire_bound`` keys (queue wait + push RTT dominate) step toward
+    harder codecs — their bytes are what the dispatcher and the wire
+    are busy with;
+  - ``compute_bound`` and ``tiny`` keys step toward raw — codec work
+    (or per-message overhead) dominates, so compressing harder only
+    moves the bottleneck;
+  - ``straggler_bound`` keys are left alone — the serve wait is peers'
+    pushes, and no local codec changes that;
+  - ``unhealthy`` keys are PINNED raw and the tuner backs off — the
+    doctor's nonfinite/audit verdicts trump bandwidth, always.
+
+Decisions are hysteretic so the loop cannot oscillate: a key must hold
+its class for ``hold`` consecutive windows before a switch, every
+switch is re-measured the next window and REVERTED (then blacklisted
+for ``blacklist`` windows) if the key's per-push round time regressed
+by more than ``regress_frac``, and keys carrying a user-configured
+off-dial codec (topk/randomk/dense dithering) are never touched.
+
+Actuation rides the CMD_CODEC renegotiation protocol
+(``PSSession.propose_codec``): epoch-versioned, applied at a declared
+future round boundary on every worker and the server atomically, EF
+residuals carried across the switch.  Only ONE worker proposes
+(worker 0 by default) — the others run the same loop in observe mode,
+polling the codec table and relying on the server's CODEC_STALE
+backstop, so racing proposers can't fight.
+
+The same loop also inspects the global knobs —
+``BYTEPS_TPU_FUSION_BYTES``, ``BYTEPS_TPU_COMPRESS_THREADS``,
+``BYTEPS_PARTITION_BYTES``, ``BYTEPS_TPU_WIRE_CONNS`` — and PROPOSES
+adjustments where the evidence supports them.  None of these are
+safely re-appliable mid-job in this codebase (fusion bytes change
+bucket key identity, the codec pool's width and the lane pools are
+fixed at session init, partition size changes the key space), so
+proposals are logged once and surfaced through ``bps.get_tuner()``,
+never silently applied — restart with the suggested values.
+
+Armed by ``BYTEPS_TPU_TUNER=1`` (requires the signal plane,
+``BYTEPS_TPU_SIGNAL_WINDOW_S`` > 0).  Off by default: nothing is
+constructed, no CMD_CODEC frame is ever sent, and the wire is
+byte-identical to the untuned run (asserted by tests/test_tuner.py
+against a recording stub).
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+from typing import Dict, List, Optional
+
+from .logging import get_logger
+
+# The dial, softest to hardest.  Position names are stable — the docs'
+# class->action table, bps_top's tuner column and the tests key off
+# them.  "qblock" (EQuARX-flavored blockwise int4, arXiv 2506.17615) is
+# the aggressive end: dense layout, deterministic, cheap flat-loop
+# encode/decode, EF-capable on both legs.
+DIAL = ("raw", "onebit", "elias", "qblock")
+
+DIAL_KWARGS = {
+    "raw": None,
+    "onebit": {"compressor": "onebit", "ef": "vanilla"},
+    "elias": {"compressor": "dithering", "k": "15", "coding": "elias",
+              "ef": "vanilla"},
+    "qblock": {"compressor": "qblock", "bits": "4", "block": "256",
+               "ef": "vanilla"},
+}
+
+# Wire comp ids for the bps_codec_active gauge / bps_top column.
+DIAL_COMP_ID = {"raw": 0, "onebit": 1, "elias": 4, "qblock": 5}
+
+DEFAULT_HOLD = 2          # windows a class must persist before a switch
+DEFAULT_BLACKLIST = 8     # windows a reverted key stays frozen
+DEFAULT_MARGIN_ROUNDS = 2  # switch takes effect this many rounds ahead
+DEFAULT_REGRESS_FRAC = 0.2  # per-push time regression that triggers revert
+
+
+def dial_of(comp) -> Optional[int]:
+    """Map a session compressor (or None) onto a dial position; None if
+    the key carries an off-dial user codec the tuner must not touch."""
+    if comp is None:
+        return 0
+    name = getattr(comp, "name", None)
+    if name == "onebit":
+        return 1
+    if name == "dithering" and getattr(comp, "coding", "") == "elias":
+        return 2
+    if name == "qblock":
+        return 3
+    return None
+
+
+class _KeyTune:
+    """One key's controller state."""
+
+    __slots__ = ("dial", "classes", "blacklist_until", "pinned",
+                 "baseline_ms", "eval_window", "prev_dial", "switches",
+                 "declared_key", "off_dial_warned")
+
+    def __init__(self, dial: int, declared_key: Optional[int]):
+        self.dial = dial                 # current DIAL index
+        self.classes: deque = deque(maxlen=16)
+        self.blacklist_until = -1        # window index; -1 = clear
+        self.pinned = False              # unhealthy -> raw, frozen
+        self.baseline_ms: Optional[float] = None   # per-push time
+        self.eval_window = -1            # window whose summary judges the
+        #                                  last switch (-1 = none pending)
+        self.prev_dial = dial
+        self.switches = 0
+        self.declared_key = declared_key
+        self.off_dial_warned = False
+
+
+class Tuner:
+    """The control loop.  ``observe(summary)`` is chained onto the
+    signal plane's ``on_window`` (after the doctor), so it runs once per
+    closed window on the plane's thread — never on the hot path."""
+
+    def __init__(self, session, propose: bool = True,
+                 hold: int = DEFAULT_HOLD,
+                 blacklist: int = DEFAULT_BLACKLIST,
+                 margin_rounds: int = DEFAULT_MARGIN_ROUNDS,
+                 regress_frac: float = DEFAULT_REGRESS_FRAC,
+                 max_dial: int = len(DIAL) - 1):
+        self._session = session
+        self.propose = bool(propose)
+        self.hold = max(1, int(hold))
+        self.blacklist = max(1, int(blacklist))
+        self.margin_rounds = max(1, int(margin_rounds))
+        self.regress_frac = max(0.0, float(regress_frac))
+        self.max_dial = min(len(DIAL) - 1, max(0, int(max_dial)))
+        self._lock = threading.Lock()
+        self._keys: Dict[str, _KeyTune] = {}
+        self._window = -1
+        self.switches_total = 0
+        self.reverts_total = 0
+        self._proposals: List[dict] = []
+        self._proposed_knobs: set = set()
+        from . import telemetry as _tm
+        reg = _tm.get_registry()
+        self._m_switches = reg.counter(
+            "bps_tuner_switches_total",
+            help="codec renegotiations the tuner initiated")
+        self._reg = reg
+
+    # -- the per-window pass ------------------------------------------------
+    def observe(self, summary: dict) -> None:
+        # Poll BEFORE taking the tuner lock: CMD_CODEC GETs are blocking
+        # wire round trips (up to seconds against a slow server), and
+        # holding the lock across them would stall get_tuner()/the
+        # /tuner route — and, since observe runs on the signal plane's
+        # on_window callback, the window rolls behind it.  The poll only
+        # touches session state under the session's own locks.
+        try:
+            # Everyone polls: the proposer to catch races it lost,
+            # observers to learn pending switches before their round
+            # counters cross the boundary (CODEC_STALE remains the
+            # correctness backstop either way).
+            self._session.poll_codec()
+        except Exception:
+            get_logger().debug("tuner codec poll failed", exc_info=True)
+        with self._lock:
+            self._window = int(summary.get("window", self._window + 1))
+            for label, rec in (summary.get("keys") or {}).items():
+                if label == "_other" or not rec.get("pushes"):
+                    continue
+                try:
+                    self._observe_key(label, rec)
+                except Exception:
+                    get_logger().exception("tuner pass failed for key %r",
+                                           label)
+            self._propose_knobs(summary)
+
+    def _resolve_key(self, label: str) -> Optional[int]:
+        try:
+            from ..core.native import get_core
+            dk = get_core().get_declared_key(label)
+            if dk is not None and dk >= 0:
+                return int(dk)
+        except Exception:
+            pass
+        if label.startswith("key_"):
+            try:
+                return int(label[4:])
+            except ValueError:
+                return None
+        return None
+
+    def _state_for(self, label: str) -> Optional[_KeyTune]:
+        kt = self._keys.get(label)
+        if kt is None:
+            dk = self._resolve_key(label)
+            if dk is None:
+                return None
+            comp = self._session._compressors.get(dk)
+            kt = self._keys[label] = _KeyTune(dial_of(comp) or 0, dk)
+            if dial_of(comp) is None:
+                kt.dial = -1          # off-dial user codec: hands off
+        return kt
+
+    def _per_push_ms(self, rec: dict) -> float:
+        comps = rec.get("components") or {}
+        pushes = max(1, int(rec.get("pushes", 1)))
+        return sum(comps.values()) / pushes * 1e3
+
+    def _observe_key(self, label: str, rec: dict) -> None:
+        kt = self._state_for(label)
+        if kt is None:
+            return
+        cls = rec.get("class", "")
+        kt.classes.append(cls)
+        if kt.dial < 0:
+            if not kt.off_dial_warned:
+                kt.off_dial_warned = True
+                get_logger().info(
+                    "tuner: key %s carries a user-configured off-dial "
+                    "codec; leaving it alone", label)
+            return
+        # A switch the fleet has not finished applying (the session still
+        # carries a pending CMD_CODEC entry for this key) must neither be
+        # judged nor re-proposed: on slow-stepping jobs the effective
+        # round can lie windows away, and re-proposing would stage an
+        # ever-later boundary that never gets crossed (a livelock that
+        # also inflates the thrash counters).
+        pending = bool(getattr(self._session, "_codec_next",
+                               {}).get(kt.declared_key))
+        # Keep the mirror honest on non-proposing workers (and after
+        # CODEC_STALE adoptions): the session's actual compressor wins —
+        # but never while a pending switch is still in flight, where
+        # "actual" is by construction the OLD codec.
+        actual = dial_of(self._session._compressors.get(kt.declared_key))
+        if actual is not None and actual != kt.dial \
+                and kt.eval_window < 0 and not pending:
+            kt.dial = actual
+        per_push = self._per_push_ms(rec)
+        # Post-switch evaluation: the first full window AFTER the switch
+        # actually applied judges it — a regression reverts and
+        # blacklists, success re-baselines.
+        if kt.eval_window >= 0 and self._window > kt.eval_window:
+            if pending:
+                kt.eval_window = self._window   # not applied yet: wait
+            else:
+                kt.eval_window = -1
+                if (kt.baseline_ms is not None and self.regress_frac > 0
+                        and per_push > kt.baseline_ms
+                        * (1.0 + self.regress_frac)):
+                    self.reverts_total += 1
+                    kt.blacklist_until = self._window + self.blacklist
+                    get_logger().warning(
+                        "tuner: switch of key %s to %s regressed "
+                        "per-push time %.2fms -> %.2fms; reverting to "
+                        "%s and blacklisting for %d windows", label,
+                        DIAL[kt.dial], kt.baseline_ms, per_push,
+                        DIAL[kt.prev_dial], self.blacklist)
+                    self._switch(label, kt, kt.prev_dial, "revert")
+                    return
+                kt.baseline_ms = per_push
+        if kt.baseline_ms is None:
+            kt.baseline_ms = per_push
+        # Value-domain damage trumps bandwidth: pin unhealthy keys raw
+        # and back off; unpin only after a full healthy hold.
+        if cls == "unhealthy":
+            if kt.dial != 0:
+                get_logger().warning(
+                    "tuner: key %s is unhealthy; pinning raw", label)
+                self._switch(label, kt, 0, "unhealthy")
+            kt.pinned = True
+            kt.blacklist_until = max(kt.blacklist_until,
+                                     self._window + self.blacklist)
+            return
+        if kt.pinned:
+            healthy = list(kt.classes)[-self.hold:]
+            if len(healthy) >= self.hold and all(
+                    c != "unhealthy" for c in healthy):
+                kt.pinned = False
+            else:
+                return
+        if not self.propose or pending \
+                or self._window <= kt.blacklist_until \
+                or kt.eval_window >= 0:
+            return
+        # Hysteresis: the class must have held for `hold` windows.
+        recent = list(kt.classes)[-self.hold:]
+        if len(recent) < self.hold or len(set(recent)) != 1:
+            return
+        target = kt.dial
+        if cls == "wire_bound":
+            target = min(kt.dial + 1, self.max_dial)
+        elif cls in ("compute_bound", "tiny"):
+            target = max(kt.dial - 1, 0)
+        if target != kt.dial:
+            kt.baseline_ms = self._per_push_ms(rec)
+            self._switch(label, kt, target, cls)
+
+    def _switch(self, label: str, kt: _KeyTune, target: int,
+                why: str) -> None:
+        if not self.propose or kt.declared_key is None:
+            kt.dial = target
+            return
+        try:
+            res = self._session.propose_codec(
+                kt.declared_key, DIAL_KWARGS[DIAL[target]],
+                margin_rounds=self.margin_rounds)
+        except Exception as e:
+            get_logger().warning("tuner: codec proposal for %s failed: %s",
+                                 label, e)
+            kt.blacklist_until = self._window + 2   # retry later, no spin
+            return
+        kt.prev_dial, kt.dial = kt.dial, target
+        kt.switches += 1
+        self.switches_total += 1
+        kt.classes.clear()              # fresh hysteresis for the new codec
+        if why in ("revert", "unhealthy"):
+            # A revert (or a safety pin) is terminal, not an experiment:
+            # judging IT against the pre-switch baseline could flip the
+            # key right back onto the codec that just regressed — the
+            # oscillation the blacklist exists to prevent.  Re-baseline
+            # from the next ambient window instead.
+            kt.eval_window = -1
+            kt.baseline_ms = None
+        else:
+            # A forward switch lands mid-window; judge it on the FIRST
+            # FULL window after it has applied.
+            kt.eval_window = self._window + 1
+        self._m_switches.inc()
+        self._reg.counter(
+            "bps_tuner_key_switches_total", labels={"key": label},
+            help="tuner codec switches per key (the thrash signal)").inc()
+        get_logger().info(
+            "tuner: key %s %s -> %s (%s; effective round %s, %s)",
+            label, DIAL[kt.prev_dial], DIAL[target], why,
+            res.get("effective_round"),
+            "accepted" if res.get("accepted") else "superseded")
+
+    # -- advisory knob proposals --------------------------------------------
+    def _propose_knobs(self, summary: dict) -> None:
+        keys = summary.get("keys") or {}
+        if not keys:
+            return
+        from .config import get_config
+        cfg = get_config()
+        counts: Dict[str, int] = {}
+        for rec in keys.values():
+            counts[rec.get("class", "?")] = counts.get(
+                rec.get("class", "?"), 0) + 1
+        total = sum(counts.values())
+
+        def propose(knob: str, current, suggested, reason: str,
+                    appliable: bool = False) -> None:
+            if knob in self._proposed_knobs:
+                return
+            self._proposed_knobs.add(knob)
+            row = {"knob": knob, "current": current,
+                   "proposed": suggested, "reason": reason,
+                   "applied": False, "window": self._window}
+            self._proposals.append(row)
+            # None of these knobs are safely re-appliable mid-job here
+            # (bucket identity / fixed pools / key space) — log, never
+            # silently apply.
+            get_logger().info(
+                "tuner proposal (advisory, NOT auto-applied — restart "
+                "with it): %s=%s (now %s): %s", knob, suggested, current,
+                reason)
+
+        if counts.get("tiny", 0) > total / 2 and cfg.fusion_bytes > 0:
+            propose("BYTEPS_TPU_FUSION_BYTES", cfg.fusion_bytes,
+                    cfg.fusion_bytes * 2,
+                    f"{counts['tiny']}/{total} keys are tiny (<64KiB "
+                    f"mean payload): per-message overhead dominates — "
+                    f"bigger fusion buckets amortize it")
+        if counts.get("compute_bound", 0) > total / 2:
+            propose("BYTEPS_TPU_COMPRESS_THREADS", cfg.compress_threads,
+                    max(4, cfg.compress_threads * 2),
+                    f"{counts['compute_bound']}/{total} keys are "
+                    f"compute-bound: codec work dominates their round "
+                    f"time — widen the codec pool")
+        if counts.get("wire_bound", 0) > total / 2:
+            at_max = all(
+                kt.dial >= self.max_dial for kt in self._keys.values()
+                if kt.dial >= 0)
+            if at_max and self._keys:
+                propose("BYTEPS_TPU_WIRE_CONNS", cfg.wire_conns,
+                        cfg.wire_conns * 2,
+                        f"{counts['wire_bound']}/{total} keys stay "
+                        f"wire-bound at the hardest codec: more data "
+                        f"lanes per server is the next dial")
+                propose("BYTEPS_PARTITION_BYTES", cfg.partition_bytes,
+                        max(1 << 20, cfg.partition_bytes // 2),
+                        "wire-bound at the hardest codec: smaller "
+                        "partitions overlap push/pull legs more finely")
+
+    # -- read surface -------------------------------------------------------
+    def state(self) -> dict:
+        """The ``bps.get_tuner()`` payload."""
+        with self._lock:
+            keys = {}
+            for label, kt in self._keys.items():
+                keys[label] = {
+                    "codec": DIAL[kt.dial] if kt.dial >= 0 else "user",
+                    "dial": kt.dial,
+                    "class_history": list(kt.classes),
+                    "pinned": kt.pinned,
+                    "blacklisted_until": kt.blacklist_until,
+                    "baseline_per_push_ms": kt.baseline_ms,
+                    "switches": kt.switches,
+                }
+            return {
+                "armed": True,
+                "proposer": self.propose,
+                "window": self._window,
+                "dial": list(DIAL),
+                "switches_total": self.switches_total,
+                "reverts_total": self.reverts_total,
+                "keys": keys,
+                "knob_proposals": [dict(p) for p in self._proposals],
+            }

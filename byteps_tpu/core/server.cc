@@ -149,6 +149,20 @@ enum Cmd : uint8_t {
                  // {"armed":0} so a probing client downgrades cleanly.
                  // An OLD server routes the unknown command to an engine
                  // whose default arm answers kError — "server too old".
+  kCodec = 17,   // per-key codec table (CMD_CODEC): epoch-versioned wire
+                 // compressor renegotiation, the adaptive-compression
+                 // tuner's control op.  flags bit0 = SET (payload:
+                 // u32 epoch | u64 effective_round | u32 klen | kwargs;
+                 // "" = raw): applied only when the proposed epoch is
+                 // NEWER than the key's current one — the CMD_RING_SET
+                 // idempotency law, so racing proposers converge — and
+                 // the new codec takes effect at the first round boundary
+                 // with completed_round >= effective_round, so no round
+                 // ever mixes wire formats.  GET (bit0 clear) and SET
+                 // both answer the authoritative codec JSON.  Engine
+                 // thread (the table is per-key engine-owned state, like
+                 // the round it gates).  Old servers answer kError via
+                 // the engine default arm — "server too old".
 };
 
 // Request `dtype` marker on PULL frames: the worker asks for the 24-byte
@@ -177,7 +191,15 @@ enum : uint8_t { kRingTask = 201 };
 // client re-plans and re-routes without an extra round trip.  Emitted
 // only once the ring epoch has advanced past 0 — a fixed-topology job
 // (and any pre-ring client) never sees status 2.
-enum Status : uint8_t { kOk = 0, kError = 1, kMoved = 2 };
+// kCodecStale: a push's wire format does not match the key's codec-table
+// entry for the round currently merging (the sender missed — or jumped
+// ahead of — a CMD_CODEC renegotiation).  The response payload is the
+// authoritative codec JSON; the client re-encodes the SAME gradient with
+// the right codec and replays, so no round ever mixes wire formats and
+// no contribution is lost.  Emitted only for keys whose codec epoch has
+// advanced past 0 — a job that never renegotiates (and any pre-codec
+// client) never sees status 3.
+enum Status : uint8_t { kOk = 0, kError = 1, kMoved = 2, kCodecStale = 3 };
 
 // Header `flags` bit 15: this frame is inside the sending worker's trace
 // window.  PUSH/PULL frames carry their round in the LOW 15 BITS always;
@@ -231,7 +253,17 @@ enum WireDtype : uint8_t {
 namespace codec {
 
 enum CompId : uint8_t {
-  kNone = 0, kOnebit = 1, kTopk = 2, kRandomk = 3, kDithering = 4
+  kNone = 0, kOnebit = 1, kTopk = 2, kRandomk = 3, kDithering = 4,
+  // EQuARX-flavored blockwise integer quantization (arXiv 2506.17615):
+  //   qblock(5): u8 bits(4|8) | u16 block | f32 scale[nblocks] | ints
+  // Per `block` elements one f32 scale = absmax/qmax, then each element
+  // quantizes to round-half-even(x/scale) in [-qmax, qmax] (qmax =
+  // 2^(bits-1)-1); bits=4 packs two two's-complement nibbles per byte,
+  // low nibble first.  Dense layout, flat decode loop, deterministic
+  // (no PRNG) — the aggressive end of the adaptive-compression dial,
+  // with EF supported on both the worker leg and the server recompress
+  // leg under the same law as onebit.
+  kQblock = 5
 };
 
 struct Reader {
@@ -490,6 +522,36 @@ inline bool DecompressTo(const char* data, size_t size, float* dst,
       }
       return true;
     }
+    case kQblock: {
+      uint8_t bits = 0;
+      uint16_t block = 0;
+      if (!r.Take(&bits, 1) || !r.Take(&block, 2)) return false;
+      if ((bits != 4 && bits != 8) || block == 0) return false;
+      uint64_t nblocks = (static_cast<uint64_t>(n) + block - 1) / block;
+      size_t qbytes = bits == 8 ? n : (static_cast<size_t>(n) + 1) / 2;
+      if (r.left < nblocks * 4 + qbytes) return false;
+      const char* scales = r.p;
+      const unsigned char* q =
+          reinterpret_cast<const unsigned char*>(r.p) + nblocks * 4;
+      for (uint64_t b = 0; b < nblocks; ++b) {
+        float scale = 0;
+        std::memcpy(&scale, scales + b * 4, 4);
+        uint32_t lo = static_cast<uint32_t>(b * block);
+        uint32_t hi = lo + block < n ? lo + block : n;
+        if (bits == 8) {
+          const signed char* qq = reinterpret_cast<const signed char*>(q);
+          for (uint32_t i = lo; i < hi; ++i)
+            dst[i] = static_cast<float>(qq[i]) * scale;
+        } else {
+          for (uint32_t i = lo; i < hi; ++i) {
+            int v = (i & 1) ? (q[i >> 1] >> 4) : (q[i >> 1] & 0xF);
+            v = (v ^ 8) - 8;   // sign-extend the two's-complement nibble
+            dst[i] = static_cast<float>(v) * scale;
+          }
+        }
+      }
+      return true;
+    }
     default:
       return false;
   }
@@ -552,6 +614,88 @@ inline void CompressOnebit(const std::vector<char>& store, bool scaled,
   }
   std::memcpy(p + 5, &scale, 4);
   PackSigns(x, n, reinterpret_cast<unsigned char*>(p + 9));
+}
+
+// Blockwise integer quantization encode (kQblock) — shared by the
+// worker's ctypes export (bps_wire_encode_qblock) and the server's
+// bidirectional recompress leg (CompressQblock), so both sides emit
+// bit-identical payloads.  Per-element float ops match the numpy
+// reference in server/wire.py exactly (true f32 division by the scale —
+// NOT multiply-by-inverse, whose ULP drift would flip round-half-even
+// boundaries — then rintf, both round-half-to-even like np.rint), so a
+// C-encoded blob is indistinguishable from a numpy-encoded one.  When
+// `recon` is non-null the dequantized reconstruction is written there
+// (the EF leg).  Returns bytes written, -1 on bad args / short cap.
+inline int64_t EncodeQblock(const float* x, uint32_t n, int bits,
+                            uint32_t block, float* recon,
+                            unsigned char* out, uint64_t cap) {
+  if ((bits != 4 && bits != 8) || block == 0 || block > 0xFFFF) return -1;
+  const uint64_t nblocks = (static_cast<uint64_t>(n) + block - 1) / block;
+  const size_t qbytes = bits == 8 ? n : (static_cast<size_t>(n) + 1) / 2;
+  const size_t need = 8 + static_cast<size_t>(nblocks) * 4 + qbytes;
+  if (cap < need) return -1;
+  out[0] = static_cast<unsigned char>(kQblock);
+  std::memcpy(out + 1, &n, 4);
+  out[5] = static_cast<unsigned char>(bits);
+  uint16_t blk16 = static_cast<uint16_t>(block);
+  std::memcpy(out + 6, &blk16, 2);
+  unsigned char* sp = out + 8;
+  unsigned char* qp = out + 8 + nblocks * 4;
+  const int qmax = (1 << (bits - 1)) - 1;
+  if (bits == 4) std::memset(qp, 0, qbytes);   // nibble ORs need zeros
+  for (uint64_t b = 0; b < nblocks; ++b) {
+    const uint32_t lo = static_cast<uint32_t>(b * block);
+    const uint32_t hi = lo + block < n ? lo + block : n;
+    float amax = 0.0f;
+    for (uint32_t i = lo; i < hi; ++i) {
+      float a = std::fabs(x[i]);
+      if (a > amax) amax = a;
+    }
+    const float scale = amax > 0.0f
+        ? amax / static_cast<float>(qmax) : 0.0f;
+    std::memcpy(sp + b * 4, &scale, 4);
+    for (uint32_t i = lo; i < hi; ++i) {
+      int qi = 0;
+      if (scale > 0.0f) {
+        qi = static_cast<int>(std::lrintf(x[i] / scale));
+        if (qi > qmax) qi = qmax;
+        if (qi < -qmax) qi = -qmax;
+      }
+      if (bits == 8)
+        reinterpret_cast<signed char*>(qp)[i] =
+            static_cast<signed char>(qi);
+      else
+        qp[i >> 1] |= static_cast<unsigned char>(
+            (qi & 0xF) << ((i & 1) * 4));
+      if (recon) recon[i] = static_cast<float>(qi) * scale;
+    }
+  }
+  return static_cast<int64_t>(need);
+}
+
+// Re-compress the merged f32 buffer with qblock — the bidirectional pull
+// leg for a key whose codec table selected the quantized-block format.
+// When `ef_err` is non-null, vanilla EF runs under the same law as the
+// onebit leg: the caller already folded last round's error into `store`;
+// here the requantization error store[i] - recon[i] is written back.
+inline void CompressQblock(const std::vector<char>& store, int bits,
+                           uint32_t block, std::vector<char>* out,
+                           std::vector<float>* ef_err) {
+  const size_t n = store.size() / 4;
+  const float* x = reinterpret_cast<const float*>(store.data());
+  const uint64_t nblocks =
+      block ? (static_cast<uint64_t>(n) + block - 1) / block : 0;
+  const size_t qbytes = bits == 8 ? n : (n + 1) / 2;
+  out->assign(8 + static_cast<size_t>(nblocks) * 4 + qbytes, 0);
+  if (ef_err) ef_err->resize(n);
+  EncodeQblock(x, static_cast<uint32_t>(n), bits, block,
+               ef_err ? ef_err->data() : nullptr,
+               reinterpret_cast<unsigned char*>(out->data()),
+               out->size());
+  if (ef_err) {
+    float* e = ef_err->data();
+    for (size_t i = 0; i < n; ++i) e[i] = x[i] - e[i];
+  }
 }
 
 // ---------------------------------------------------------------------------
@@ -1137,6 +1281,30 @@ struct KeyState {
   uint32_t audit_digest = 0;
   uint64_t audit_epoch = 0;
   uint32_t audit_n = 0;
+  // --- per-key codec table (engine-owned; CMD_CODEC) --------------------
+  // Epoch-versioned wire-compressor renegotiation: `codec_epoch` is the
+  // newest accepted proposal (0 = launch config — INIT kwargs govern and
+  // nothing below is ever consulted, keeping the pre-codec wire
+  // byte-identical); while `codec_pending`, `codec_next` holds the
+  // proposed kwargs ("" = raw) that take effect at the FIRST round
+  // boundary with completed_round >= codec_effective
+  // (ApplyPendingCodec).  Once the epoch has advanced, every push's wire
+  // format is checked against the active codec and mismatches draw
+  // kCodecStale — no round ever mixes formats.  Rides CMD_MIGRATE so a
+  // migrated key keeps its *current* codec epoch, not its launch config.
+  uint32_t codec_epoch = 0;
+  uint32_t codec_applied_epoch = 0;
+  bool codec_pending = false;
+  uint64_t codec_effective = 0;
+  std::string codec_next;
+  // A switch away from a server-EF codec must never silently drop the
+  // accumulated requantization error: this flag folds ef_err into the
+  // next published sum exactly once (PublishRound), then clears it.
+  bool ef_fold_pending = false;
+  // Bidirectional recompress codec + qblock params (from kwargs).
+  uint8_t pull_comp = 1;        // codec::kOnebit
+  uint8_t qblock_bits = 8;
+  uint16_t qblock_block = 256;
 };
 
 struct Task {
@@ -1741,7 +1909,8 @@ class Server {
                   "\"server_id\":%u,\"ring_armed\":%d,\"ring_epoch\":%llu,"
                   "\"draining\":%d,\"keys_owned\":%llu,"
                   "\"migrations_in\":%llu,\"migrations_out\":%llu,"
-                  "\"moved_frames\":%llu,\"keys\":{",
+                  "\"moved_frames\":%llu,\"codec_sets\":%llu,"
+                  "\"codec_stale_frames\":%llu,\"keys\":{",
                   static_cast<unsigned long long>(
                       bytes_in_.load(std::memory_order_relaxed)),
                   static_cast<unsigned long long>(
@@ -1763,7 +1932,11 @@ class Server {
                   static_cast<unsigned long long>(
                       migrations_out_.load(std::memory_order_relaxed)),
                   static_cast<unsigned long long>(
-                      moved_frames_.load(std::memory_order_relaxed)));
+                      moved_frames_.load(std::memory_order_relaxed)),
+                  static_cast<unsigned long long>(
+                      codec_sets_.load(std::memory_order_relaxed)),
+                  static_cast<unsigned long long>(
+                      codec_stale_.load(std::memory_order_relaxed)));
     js += buf;
     std::lock_guard<std::mutex> lk(stats_mu_);
     bool first = true;
@@ -2481,6 +2654,22 @@ class Server {
     cnt = static_cast<uint32_t>(ks.round_members.size());
     put(&cnt, 4);
     for (uint32_t w : ks.round_members) put(&w, 4);
+    // Codec-table trailer (appended so pre-codec receivers, which parse
+    // positionally and ignore trailing bytes, stay compatible): a
+    // migrated key must carry its CURRENT codec epoch — active kwargs
+    // already rode above; this adds the epoch/pending half so a
+    // renegotiated key keeps renegotiating where it lands instead of
+    // snapping back to its launch config.
+    put(&ks.codec_epoch, 4);
+    put(&ks.codec_applied_epoch, 4);
+    uint8_t pend = ks.codec_pending ? 1 : 0;
+    put(&pend, 1);
+    put(&ks.codec_effective, 8);
+    uint32_t nklen = static_cast<uint32_t>(ks.codec_next.size());
+    put(&nklen, 4);
+    put(ks.codec_next.data(), nklen);
+    uint8_t fold = ks.ef_fold_pending ? 1 : 0;
+    put(&fold, 1);
     return out;
   }
 
@@ -2552,6 +2741,18 @@ class Server {
     ks.ef_err.shrink_to_fit();
     ks.kwargs.clear();
     ks.round_compressed = false;
+    // Codec table rode the migration blob; the retired copy resets so a
+    // later ownership return re-seeds from INIT/CMD_CODEC, not a stale
+    // epoch.
+    ks.codec_epoch = 0;
+    ks.codec_applied_epoch = 0;
+    ks.codec_pending = false;
+    ks.codec_effective = 0;
+    ks.codec_next.clear();
+    ks.ef_fold_pending = false;
+    ks.pull_comp = codec::kOnebit;
+    ks.qblock_bits = 8;
+    ks.qblock_block = 256;
     ks.active.store(false, std::memory_order_relaxed);
     // Drop the migrated key's digest window too: the new owner records
     // fresh digests from its next publish, and a stale window here
@@ -2710,6 +2911,45 @@ class Server {
       uint32_t w = 0;
       std::memcpy(&w, p.data() + members_at + i * 4ull, 4);
       ks.round_members.insert(w);
+    }
+    pos = members_at + static_cast<size_t>(n_members) * 4;
+    // Codec-table trailer (absent from pre-codec senders: every field
+    // then keeps its reset default and the key behaves exactly as a
+    // launch-config key — version-tolerant by the remaining()-based
+    // parse).  Re-derive the kwargs-dependent flags through the ONE
+    // parse (ApplyCodecKwargs) so pull_comp/qblock params can never
+    // drift from the kwargs that rode the legacy fields above; the
+    // explicit flag bits above still win for bidirectional/scaled/EF
+    // (they are what the old owner actually ran).
+    ks.codec_epoch = 0;
+    ks.codec_applied_epoch = 0;
+    ks.codec_pending = false;
+    ks.codec_effective = 0;
+    ks.codec_next.clear();
+    ks.ef_fold_pending = false;
+    ks.pull_comp = codec::kOnebit;
+    ks.qblock_bits = 8;
+    ks.qblock_block = 256;
+    {
+      const std::string kw_now = ks.kwargs;
+      ApplyCodecKwargs(ks, kw_now);
+      ks.bidirectional = (flags & 1) != 0;
+      ks.onebit_scaled = (flags & 2) != 0;
+      ks.server_ef = (flags & 4) != 0;
+      ks.ef_fold_pending = false;   // trailer (or default) decides below
+    }
+    uint32_t cep = 0, caep = 0, nklen = 0;
+    uint8_t pend = 0, fold = 0;
+    uint64_t ceff = 0;
+    if (take(&cep, 4) && take(&caep, 4) && take(&pend, 1) &&
+        take(&ceff, 8) && take(&nklen, 4) && nklen <= remaining()) {
+      ks.codec_epoch = cep;
+      ks.codec_applied_epoch = caep;
+      ks.codec_pending = pend != 0;
+      ks.codec_effective = ceff;
+      ks.codec_next.assign(p.data() + pos, nklen);
+      pos += nklen;
+      if (take(&fold, 1)) ks.ef_fold_pending = fold != 0;
     }
     ks.merge_ts.clear();
     ks.push_count.store(pushes, std::memory_order_relaxed);
@@ -3193,6 +3433,7 @@ class Server {
           else Respond(t.conn, kError, t.req_id, t.key, nullptr, 0);
           break;
         case kMigrate: HandleMigrate(t); break;
+        case kCodec: HandleCodec(t); break;
         default: Respond(t.conn, kError, t.req_id, t.key, nullptr, 0);
       }
       // The task's hold ends here (a deferred pull took its OWN ref in
@@ -3298,6 +3539,143 @@ class Server {
     }
   }
 
+  // -- per-key codec table (CMD_CODEC) ------------------------------------
+  // Small "k=v,k=v" integer lookup (the kwargs strings are the same ones
+  // the worker registry ships at INIT).
+  static int KwInt(const std::string& kw, const char* name, int dflt) {
+    std::string pat = std::string(name) + "=";
+    size_t at = kw.find(pat);
+    // Must start a pair ("bits=" must not match "qbits=").
+    while (at != std::string::npos && at != 0 && kw[at - 1] != ',')
+      at = kw.find(pat, at + 1);
+    if (at == std::string::npos) return dflt;
+    return std::atoi(kw.c_str() + at + pat.size());
+  }
+
+  // The wire comp id the active kwargs imply for pushes of this key —
+  // what the format-enforcement check compares against (0 = raw).
+  static uint8_t ExpectedComp(const std::string& kw) {
+    if (kw.find("compressor=onebit") != std::string::npos)
+      return codec::kOnebit;
+    if (kw.find("compressor=topk") != std::string::npos)
+      return codec::kTopk;
+    if (kw.find("compressor=randomk") != std::string::npos)
+      return codec::kRandomk;
+    if (kw.find("compressor=dithering") != std::string::npos)
+      return codec::kDithering;
+    if (kw.find("compressor=qblock") != std::string::npos)
+      return codec::kQblock;
+    return codec::kNone;
+  }
+
+  // Install one kwargs string as a key's ACTIVE codec: the single parse
+  // shared by INIT (epoch 0 only), ApplyPendingCodec, and migrate
+  // install, so the derived flags can never drift between paths.  A
+  // switch away from an in-use server-EF leg arms the publish-time
+  // residual fold (ef_fold_pending) instead of dropping the error.
+  void ApplyCodecKwargs(KeyState& ks, const std::string& kw) {
+    const bool ef_was_live = ks.server_ef && ks.bidirectional;
+    ks.kwargs = kw;
+    const bool onebit = kw.find("compressor=onebit") != std::string::npos;
+    const bool qblock = kw.find("compressor=qblock") != std::string::npos;
+    ks.bidirectional = onebit || qblock;
+    ks.pull_comp = qblock ? codec::kQblock : codec::kOnebit;
+    ks.onebit_scaled =
+        kw.find("onebit_scaling=0") == std::string::npos;
+    ks.server_ef = kw.find("ef=vanilla") != std::string::npos;
+    int bits = KwInt(kw, "bits", 8);
+    ks.qblock_bits = (bits == 4) ? 4 : 8;
+    int block = KwInt(kw, "block", 256);
+    if (block < 1) block = 1;
+    if (block > 0xFFFF) block = 0xFFFF;
+    ks.qblock_block = static_cast<uint16_t>(block);
+    if (ef_was_live && !(ks.server_ef && ks.bidirectional) &&
+        !ks.ef_err.empty())
+      ks.ef_fold_pending = true;
+  }
+
+  void ApplyPendingCodec(KeyState& ks) {
+    if (!ks.codec_pending) return;
+    ApplyCodecKwargs(ks, ks.codec_next);
+    ks.codec_applied_epoch = ks.codec_epoch;
+    ks.codec_pending = false;
+    ks.codec_next.clear();
+  }
+
+  static void JsonEscapeInto(std::string* out, const std::string& s) {
+    for (char c : s) {
+      if (c == '"' || c == '\\') out->push_back('\\');
+      if (static_cast<unsigned char>(c) < 0x20) { out->push_back('?');
+                                                  continue; }
+      out->push_back(c);
+    }
+  }
+
+  // The authoritative codec doc for one key — the SET/GET response and
+  // the kCodecStale payload.  `kwargs` is always the ACTIVE codec (what
+  // the round currently merging requires); `kwargs_next`/`effective_
+  // round` describe the pending switch while one is staged.
+  std::string CodecJson(uint64_t key, const KeyState& ks) {
+    std::string js = "{\"key\":" + std::to_string(key) +
+        ",\"epoch\":" + std::to_string(ks.codec_epoch) +
+        ",\"applied_epoch\":" + std::to_string(ks.codec_applied_epoch) +
+        ",\"pending\":" + (ks.codec_pending ? "1" : "0") +
+        ",\"effective_round\":" + std::to_string(ks.codec_effective) +
+        ",\"completed_round\":" + std::to_string(ks.completed_round) +
+        ",\"kwargs\":\"";
+    JsonEscapeInto(&js, ks.kwargs);
+    js += "\",\"kwargs_next\":\"";
+    JsonEscapeInto(&js, ks.codec_next);
+    js += "\"}";
+    return js;
+  }
+
+  void RespondCodecStale(Task& t, KeyState& ks) {
+    codec_stale_.fetch_add(1, std::memory_order_relaxed);
+    std::string js = CodecJson(t.key, ks);
+    Respond(t.conn, kCodecStale, t.req_id, t.key, js.data(), js.size());
+  }
+
+  void HandleCodec(Task& t) {
+    // Ring gate first, like every per-key op: a codec entry written on a
+    // non-owner would be lost to the fleet (the owner's table is the one
+    // CMD_MIGRATE carries and pushes are checked against).
+    if (RingMisplaced(t.key)) {
+      RespondMoved(t, FindState(t.key));
+      return;
+    }
+    KeyState& ks = StateFor(t.key);
+    if (t.flags & 1) {   // SET: u32 epoch | u64 effective | u32 klen | kw
+      if (t.payload.size() < 16) {
+        Respond(t.conn, kError, t.req_id, t.key, nullptr, 0);
+        return;
+      }
+      uint32_t epoch = 0, klen = 0;
+      uint64_t eff = 0;
+      std::memcpy(&epoch, t.payload.data(), 4);
+      std::memcpy(&eff, t.payload.data() + 4, 8);
+      std::memcpy(&klen, t.payload.data() + 12, 4);
+      if (t.payload.size() < 16ull + klen) {
+        Respond(t.conn, kError, t.req_id, t.key, nullptr, 0);
+        return;
+      }
+      // Applied only if newer — racing proposers are idempotent, and a
+      // losing proposer reads the winner's doc from the response.
+      if (epoch > ks.codec_epoch) {
+        ks.codec_epoch = epoch;
+        ks.codec_next.assign(t.payload.data() + 16, klen);
+        ks.codec_effective = eff;
+        ks.codec_pending = true;
+        codec_sets_.fetch_add(1, std::memory_order_relaxed);
+        // Async mode has no rounds to hold the boundary for: the table
+        // applies immediately (pushes are independent deltas anyway).
+        if (async_) ApplyPendingCodec(ks);
+      }
+    }
+    std::string js = CodecJson(t.key, ks);
+    Respond(t.conn, kOk, t.req_id, t.key, js.data(), js.size());
+  }
+
   void HandleInit(Task& t) {
     // Init allocates the merged store; like the reference's init push it is
     // idempotent and sized by the declared length (reference:
@@ -3324,15 +3702,14 @@ class Server {
       uint32_t klen = 0;
       std::memcpy(&klen, t.payload.data() + 8, 4);
       if (t.payload.size() >= 12 + klen) {
-        ks.kwargs.assign(t.payload.data() + 12, klen);
         // "k=v,k=v" kwargs, same strings the reference ships in its
         // kCompressedPushPull init (reference: server.cc:232-261).
-        ks.bidirectional =
-            ks.kwargs.find("compressor=onebit") != std::string::npos;
-        ks.onebit_scaled =
-            ks.kwargs.find("onebit_scaling=0") == std::string::npos;
-        ks.server_ef =
-            ks.kwargs.find("ef=vanilla") != std::string::npos;
+        // Once the key's codec epoch has advanced, the TABLE governs:
+        // a reconnecting worker's re-declare (or a replayed launch
+        // config) must not reset a renegotiated codec mid-round — the
+        // worker learns the live codec from CMD_CODEC / kCodecStale.
+        if (ks.codec_epoch == 0)
+          ApplyCodecKwargs(ks, std::string(t.payload.data() + 12, klen));
       }
     }
     if (ks.store.size() != n) {
@@ -3479,6 +3856,29 @@ class Server {
         return;
       }
     }
+    // Per-key codec table: a pending renegotiation takes effect at the
+    // FIRST round boundary at/after its declared effective round — never
+    // mid-round — and once the epoch has advanced every push's wire
+    // format must match the active codec.  A mismatch (the sender missed
+    // — or jumped ahead of — the switch) draws kCodecStale carrying the
+    // authoritative doc BEFORE any state mutates: the worker re-encodes
+    // the same gradient and replays, so the round stays format-uniform
+    // and no contribution is lost.  Epoch 0 (no renegotiation ever) pays
+    // one integer compare and behaves exactly as before.
+    if (!async_ && ks.codec_epoch != 0) {
+      if (ks.codec_pending && ks.seen.empty() &&
+          ks.completed_round >= ks.codec_effective)
+        ApplyPendingCodec(ks);
+      if (t.dtype == kF32 || t.dtype == kCompressed) {
+        const uint8_t got =
+            (t.dtype == kCompressed && !t.payload.empty())
+                ? static_cast<uint8_t>(t.payload[0]) : codec::kNone;
+        if (got != ExpectedComp(ks.kwargs)) {
+          RespondCodecStale(t, ks);
+          return;
+        }
+      }
+    }
     // SUM span start: everything from here to the merge landing
     // (decompress + validate + sum/copy-first) is this push's share of
     // engine work.
@@ -3498,6 +3898,7 @@ class Server {
         bool need_zero = true;
         uint8_t comp = static_cast<uint8_t>(t.payload[0]);
         if (comp == codec::kOnebit) need_zero = false;
+        if (comp == codec::kQblock) need_zero = false;
         if (comp == codec::kDithering && t.payload.size() > 5
             && !(static_cast<uint8_t>(t.payload[5]) & 2))
           need_zero = false;
@@ -3615,25 +4016,54 @@ class Server {
     std::vector<uint32_t> audit_who;
     if (audit_armed_)
       audit_who.assign(ks.seen.begin(), ks.seen.end());
+    if (ks.ef_fold_pending) {
+      // A codec switch retired the server-EF recompress leg while a
+      // requantization residual was still carried: fold it into this
+      // publish exactly once — a renegotiation must never silently drop
+      // accumulated error (the EF-across-switch law; the worker side
+      // applies the same law in _apply_codec_local).
+      size_t ne = ks.store.size() / 4;
+      if (ne && ks.ef_err.size() == ne) {
+        float* s = reinterpret_cast<float*>(ks.store.data());
+        for (size_t i = 0; i < ne; ++i) s[i] += ks.ef_err[i];
+      }
+      ks.ef_err.clear();
+      ks.ef_err.shrink_to_fit();
+      ks.ef_fold_pending = false;
+    }
     if (ks.round_compressed && ks.bidirectional) {
       size_t ne = ks.store.size() / 4;
       float* s = reinterpret_cast<float*>(ks.store.data());
-      if (ks.server_ef) {
-        // Vanilla EF on the requantization: fold last round's error into
-        // the merged gradient before compressing (the store is a fresh
-        // COPY_FIRST merge every round, so the in-place add is safe).
-        if (ks.ef_err.size() != ne) ks.ef_err.assign(ne, 0.0f);
-        for (size_t i = 0; i < ne; ++i) s[i] += ks.ef_err[i];
-      }
-      codec::CompressOnebit(ks.store, ks.onebit_scaled, &ks.out);
-      if (ks.server_ef) {
-        // The decoded onebit value is just +-scale with the sign bit
-        // taken from the corrected gradient — compute the error inline
-        // instead of a full decompress round-trip + allocation.
-        float scale = 1.0f;
-        std::memcpy(&scale, ks.out.data() + 5, 4);
-        for (size_t i = 0; i < ne; ++i)
-          ks.ef_err[i] = s[i] - (s[i] < 0.0f ? -scale : scale);
+      if (ks.pull_comp == codec::kQblock) {
+        // Quantized-block recompress leg, same EF law as onebit below.
+        if (ks.server_ef) {
+          if (ks.ef_err.size() != ne) ks.ef_err.assign(ne, 0.0f);
+          for (size_t i = 0; i < ne; ++i) s[i] += ks.ef_err[i];
+          codec::CompressQblock(ks.store, ks.qblock_bits,
+                                ks.qblock_block, &ks.out, &ks.ef_err);
+        } else {
+          codec::CompressQblock(ks.store, ks.qblock_bits,
+                                ks.qblock_block, &ks.out, nullptr);
+        }
+      } else {
+        if (ks.server_ef) {
+          // Vanilla EF on the requantization: fold last round's error
+          // into the merged gradient before compressing (the store is a
+          // fresh COPY_FIRST merge every round, so the in-place add is
+          // safe).
+          if (ks.ef_err.size() != ne) ks.ef_err.assign(ne, 0.0f);
+          for (size_t i = 0; i < ne; ++i) s[i] += ks.ef_err[i];
+        }
+        codec::CompressOnebit(ks.store, ks.onebit_scaled, &ks.out);
+        if (ks.server_ef) {
+          // The decoded onebit value is just +-scale with the sign bit
+          // taken from the corrected gradient — compute the error inline
+          // instead of a full decompress round-trip + allocation.
+          float scale = 1.0f;
+          std::memcpy(&scale, ks.out.data() + 5, 4);
+          for (size_t i = 0; i < ne; ++i)
+            ks.ef_err[i] = s[i] - (s[i] < 0.0f ? -scale : scale);
+        }
       }
       // Log BEFORE the increment so all_recv and its contributing
       // push_recv lines carry the same round number (the compressed
@@ -3890,6 +4320,10 @@ class Server {
   std::atomic<uint64_t> migrations_in_{0};
   std::atomic<uint64_t> migrations_out_{0};
   std::atomic<uint64_t> moved_frames_{0};
+  // CMD_CODEC accepted proposals / format-mismatch rejections (the
+  // renegotiation race backstop firing) — CMD_STATS observability.
+  std::atomic<uint64_t> codec_sets_{0};
+  std::atomic<uint64_t> codec_stale_{0};
   std::mutex peer_mu_;
   std::map<uint32_t, int> peer_fds_;
   std::map<uint32_t, int64_t> peer_down_until_us_;  // negative cache
@@ -4022,6 +4456,21 @@ void bps_wire_onebit_pack(const float* x, uint64_t n, float scale,
       float q = x[i] < 0.0f ? -scale : scale;   // compiles to a blend
       err_out[i] = x[i] - q;
     }
+}
+
+// Quantized-block encode (see codec::EncodeQblock) — the worker-side
+// qblock fast path, the exact routine the server's recompress leg runs
+// (CompressQblock), so C-path and numpy-path workers stay byte- and
+// EF-state-identical.  `recon`, when non-null, receives the dequantized
+// reconstruction (the worker EF leg).  Returns bytes written, -1 on bad
+// args / insufficient cap.
+__attribute__((visibility("default")))
+int64_t bps_wire_encode_qblock(const float* x, uint64_t n, int bits,
+                               uint32_t block, float* recon,
+                               unsigned char* out, uint64_t cap) {
+  if (n > 0xFFFFFFFFULL) return -1;
+  return bps_server::codec::EncodeQblock(
+      x, static_cast<uint32_t>(n), bits, block, recon, out, cap);
 }
 
 // Dithering encode (see codec::EncodeDithering).  Returns bytes

@@ -80,14 +80,19 @@ _RESP = struct.Struct("<BIQQ")     # status req_id key len
 CMD_HELLO, CMD_INIT, CMD_PUSH, CMD_PULL, CMD_BARRIER, CMD_SHUTDOWN, \
     CMD_PING, CMD_LR_SCALE, CMD_STATS, CMD_TRACE, CMD_LEAVE, \
     CMD_MEMBERS, CMD_RING, CMD_RING_SET, CMD_DRAIN, CMD_MIGRATE, \
-    CMD_AUDIT = range(17)
+    CMD_AUDIT, CMD_CODEC = range(18)
 
 # Response status bytes (server.cc Status).  MOVED carries the server's
 # current ring table as JSON: the addressed server is not (or no longer)
 # the consistent-hash owner of the frame's key — re-plan and re-route.
 # Emitted only once the ring epoch has advanced, so a fixed-topology job
-# never sees it.
-STATUS_OK, STATUS_ERROR, STATUS_MOVED = 0, 1, 2
+# never sees it.  CODEC_STALE carries the key's authoritative codec doc:
+# this push's wire format does not match the codec-table entry for the
+# round currently merging (the sender missed — or jumped ahead of — a
+# CMD_CODEC renegotiation); the session re-encodes the SAME gradient
+# with the right codec and replays.  Emitted only once the key's codec
+# epoch has advanced, so a job that never renegotiates never sees it.
+STATUS_OK, STATUS_ERROR, STATUS_MOVED, STATUS_CODEC_STALE = 0, 1, 2, 3
 
 # dtype byte on the wire (server.cc WireDtype)
 DT_F32, DT_RAW, DT_COMPRESSED, DT_SEED = 0, 1, 2, 3
@@ -172,7 +177,8 @@ ROUND_MASK = 0x7FFF
 _CMD_NAMES = {0: "HELLO", 1: "INIT", 2: "PUSH", 3: "PULL", 4: "BARRIER",
               5: "SHUTDOWN", 6: "PING", 7: "LR_SCALE", 8: "STATS",
               9: "TRACE", 10: "LEAVE", 11: "MEMBERS", 12: "RING",
-              13: "RING_SET", 14: "DRAIN", 15: "MIGRATE", 16: "AUDIT"}
+              13: "RING_SET", 14: "DRAIN", 15: "MIGRATE", 16: "AUDIT",
+              17: "CODEC"}
 
 
 def _round_flags(rnd: int, traced: bool) -> int:
@@ -261,6 +267,22 @@ class _KeyMoved(Exception):
 
     def __init__(self, key: int, doc: dict):
         super().__init__(f"key {key} moved (ring epoch "
+                         f"{doc.get('epoch', '?')})")
+        self.key = key
+        self.doc = doc
+
+
+class _CodecStale(Exception):
+    """A push drew status CODEC_STALE: its wire format does not match
+    the key's codec-table entry for the round being merged.  ``doc`` is
+    the server's authoritative codec doc (the CODEC_STALE payload) —
+    the session adopts it, re-encodes the partition from its staged
+    gradient with the right codec (EF residual carried, never dropped),
+    and replays the push — so no round ever mixes wire formats and no
+    contribution is lost."""
+
+    def __init__(self, key: int, doc: dict):
+        super().__init__(f"key {key} codec stale (epoch "
                          f"{doc.get('epoch', '?')})")
         self.key = key
         self.doc = doc
@@ -766,6 +788,15 @@ class _ServerConn:
                 except Exception:
                     doc = {}
                 err = _KeyMoved(rkey, doc)
+            elif status == STATUS_CODEC_STALE:
+                # Codec renegotiation race: the payload is the key's
+                # authoritative codec doc — tiny, parsed here like MOVED.
+                import json as _json
+                try:
+                    doc = _json.loads(bytes(data).decode())
+                except Exception:
+                    doc = {}
+                err = _CodecStale(rkey, doc)
             elif status != 0:
                 err = RuntimeError(f"PS server error for key {rkey}")
             try:
@@ -997,7 +1028,7 @@ class _PartTask:
                  "label", "priority", "enq_ts", "push_ts", "pull_ts",
                  "ready", "enc_err", "credit_ln", "phase", "parked",
                  "enq_mono", "send_mono", "ack_mono", "lane_debt",
-                 "audit")
+                 "audit", "seg", "stale_retries")
 
     def __init__(self, pkey, payload, off, ln, rnd, srv, handle,
                  dtype=DT_F32, bidirectional=False, label=""):
@@ -1056,6 +1087,17 @@ class _PartTask:
         # completion) so a mid-flight audit downgrade can never make the
         # completion path mis-split a trailerless payload.
         self.audit = False
+        # The staged f32 view this partition was encoded from (None for
+        # raw parts, whose payload IS the f32 bytes).  Held so a
+        # CODEC_STALE rejection can re-encode the same gradient with the
+        # renegotiated codec — a reference into memory the zero-copy
+        # contract already keeps alive until the handle completes.
+        self.seg = None
+        # CODEC_STALE replays of THIS partition: the retry loop is
+        # bounded (a persistent format mismatch — e.g. per-worker
+        # MIN_COMPRESS_BYTES disagreement — must fail loudly, never
+        # spin the push hot forever while the round wedges silently).
+        self.stale_retries = 0
 
 
 class PSSession:
@@ -1079,6 +1121,8 @@ class PSSession:
         "parked_total": 0,        # partitions ever parked
         "watchdog_trips": 0,      # stall-watchdog dumps fired
         "ring_redirects": 0,      # partitions re-routed by status MOVED
+        "codec_switches": 0,      # per-key codec renegotiations applied
+        "codec_stale_retries": 0,  # pushes re-encoded after CODEC_STALE
         "server_failovers": 0,    # dead servers this worker failed over
         "pool_hits": 0,           # recv buffers served from the pool
         "pool_misses": 0,         # recv buffers freshly allocated
@@ -1266,6 +1310,28 @@ class PSSession:
         self._inited: Dict[int, tuple] = {}     # pkey -> (length, kwargs)
         self._round: Dict[int, int] = {}        # pkey -> next round index
         self._compressors: Dict[int, object] = {}  # declared_key -> codec
+        # Per-key codec renegotiation table (CMD_CODEC; the adaptive-
+        # compression tuner's actuation surface).  All keyed by DECLARED
+        # key: `_codec_epoch` = newest epoch this session has seen
+        # accepted (0 = launch config, the unarmed state — none of this
+        # machinery touches the wire until a proposal is made),
+        # `_codec_applied` = the epoch of the compressor currently
+        # installed, `_codec_next` = a pending switch {"epoch",
+        # "effective_round", "kwargs_str"} applied at stage time once the
+        # key's round counter reaches effective_round — the same round
+        # the server applies its half, so no round mixes wire formats
+        # (the CODEC_STALE replay is the race backstop).  `_ef_fold`
+        # holds per-PARTITION EF residuals detached by a switch to a
+        # codec that cannot carry them (raw / no EF): each is folded
+        # into that partition's next push exactly once — a switch never
+        # silently drops accumulated error.
+        self._codec_lock = threading.Lock()
+        self._codec_epoch: Dict[int, int] = {}
+        self._codec_applied: Dict[int, int] = {}
+        self._codec_next: Dict[int, dict] = {}
+        self._ef_fold: Dict[int, np.ndarray] = {}
+        self._codec_retry_queue: List[tuple] = []
+        self._codec_retry_thread: Optional[threading.Thread] = None
         self._server_load = [0] * len(self.conns)
         self._plans: Dict[Tuple[int, int], list] = {}
         # _plan's read-modify-write of _plans/_server_load must be atomic:
@@ -1530,6 +1596,361 @@ class PSSession:
         self._compressors[declared_key] = WireCompressor(
             {str(k): str(v) for k, v in kwargs.items()})
 
+    # -- per-key codec renegotiation (CMD_CODEC) ----------------------------
+    @staticmethod
+    def _kwargs_to_str(kwargs: Optional[dict]) -> str:
+        """Canonical kwargs string for a codec proposal ("" = raw) —
+        normalized through WireCompressor so every worker proposing the
+        same config emits the same bytes (the server compares strings)."""
+        if not kwargs:
+            return ""
+        from .wire import WireCompressor
+        return WireCompressor(
+            {str(k): str(v) for k, v in kwargs.items()}).kwargs_string()
+
+    @staticmethod
+    def _kwargs_from_str(kwstr: str) -> Optional[dict]:
+        if not kwstr:
+            return None
+        return dict(kv.split("=", 1) for kv in kwstr.split(",") if "=" in kv)
+
+    def _codec_pkeys(self, declared_key: int) -> list:
+        """This key's already-declared partition keys that actually ride
+        the codec (>= the MIN_COMPRESS_BYTES floor — smaller partitions
+        always go raw, so renegotiating them would only manufacture
+        CODEC_STALE noise)."""
+        return sorted(
+            pk for pk, (ln, _) in self._inited.items()
+            if pk >> 16 == declared_key and ln >= self.min_compress_bytes)
+
+    def propose_codec(self, declared_key: int, kwargs: Optional[dict],
+                      margin_rounds: int = 2,
+                      effective_round: Optional[int] = None) -> dict:
+        """Propose switching ``declared_key``'s wire codec (None = raw),
+        atomically at a future round boundary.
+
+        Sends an epoch-versioned CMD_CODEC SET for each of the key's
+        codec-eligible partitions to its owner server ("applied only if
+        newer", the CMD_RING_SET idempotency law — racing proposers
+        converge on one winner, and the losers adopt the winner's doc
+        from the response).  The switch takes effect at the first round
+        boundary at/after ``effective_round`` (default: the key's current
+        round + ``margin_rounds``); workers that miss the memo are caught
+        by the server's format check and replay via CODEC_STALE, so no
+        round ever mixes wire formats.  Returns {"accepted", "epoch",
+        "effective_round", "doc"}."""
+        import json as _json
+        kwstr = self._kwargs_to_str(kwargs)
+        pkeys = self._codec_pkeys(declared_key)
+        if not pkeys:
+            # Never pushed (or every partition below the compress floor):
+            # there is no wire state to renegotiate — install locally so
+            # the first INIT ships the new config.
+            with self._codec_lock:
+                self._apply_codec_locked(declared_key, kwstr, epoch=0)
+            return {"accepted": True, "epoch": 0, "effective_round": 0,
+                    "doc": None}
+        with self._codec_lock:
+            epoch = self._codec_epoch.get(declared_key, 0) + 1
+        eff = (int(effective_round) if effective_round is not None
+               else max(self._round.get(pk, 0) for pk in pkeys)
+               + max(1, int(margin_rounds)))
+        kb = kwstr.encode()
+        payload = struct.pack("<IQI", epoch, eff, len(kb)) + kb
+        best: Optional[dict] = None
+        for pk in pkeys:
+            srv = self._pkey_srv.get(pk, 0)
+            for attempt in range(3):
+                conn = self.conns[srv]
+                try:
+                    resp = conn.request(CMD_CODEC, pk, payload,
+                                        worker_id=self.worker_id,
+                                        flags=1, timeout=30.0)
+                except _KeyMoved as e:
+                    # Ring transition mid-proposal: adopt, re-aim at the
+                    # new owner, retry (bounded — a healthy ring settles
+                    # in one hop).
+                    self._safe_adopt_ring(e.doc)
+                    srv = self._pkey_srv.get(pk, srv)
+                    continue
+                except RuntimeError as e:
+                    raise RuntimeError(
+                        "CMD_CODEC failed — server too old for codec "
+                        "renegotiation (rebuild libbyteps_core.so)"
+                    ) from e
+                doc = _json.loads(bytes(resp).decode())
+                if best is None or int(doc.get("epoch", 0)) > int(
+                        best.get("epoch", 0)):
+                    best = doc
+                break
+        accepted = bool(best) and int(best.get("epoch", -1)) == epoch and (
+            (int(best.get("pending", 0)) == 1
+             and best.get("kwargs_next", "") == kwstr)
+            or (int(best.get("pending", 0)) == 0
+                and best.get("kwargs", "") == kwstr))
+        if best is not None:
+            self._adopt_codec_doc(declared_key, best)
+        get_logger().info(
+            "codec proposal for key %d (%s): %s -> %r at round >= %d "
+            "(epoch %d)", declared_key, self._label(declared_key),
+            "accepted" if accepted else "superseded", kwstr or "raw",
+            eff, epoch)
+        return {"accepted": accepted, "epoch": epoch,
+                "effective_round": eff, "doc": best}
+
+    def poll_codec(self) -> None:
+        """Refresh this session's view of every renegotiated key's codec
+        doc (CMD_CODEC GET on the key's first eligible partition) — how a
+        non-proposing worker learns of pending switches BEFORE its round
+        counter crosses the boundary; the CODEC_STALE replay remains the
+        correctness backstop either way.  Keys this session has never
+        seen renegotiated are not polled (nothing to refresh, no wire
+        noise) — they discover switches through CODEC_STALE."""
+        import json as _json
+        with self._codec_lock:
+            dks = list(self._codec_epoch)
+        for dk in dks:
+            pkeys = self._codec_pkeys(dk)
+            if not pkeys:
+                continue
+            pk = pkeys[0]
+            try:
+                resp = self.conns[self._pkey_srv.get(pk, 0)].request(
+                    CMD_CODEC, pk, b"", worker_id=self.worker_id,
+                    timeout=10.0)
+                self._adopt_codec_doc(dk, _json.loads(bytes(resp).decode()))
+            except Exception as e:
+                get_logger().debug("codec poll for key %d failed: %s",
+                                   dk, e)
+
+    def _adopt_codec_doc(self, declared_key: int, doc: dict) -> None:
+        """Fold one authoritative codec doc into the local table: apply
+        anything the server already applied (epoch-gated), stage anything
+        still pending for the stage-time boundary check."""
+        with self._codec_lock:
+            epoch = int(doc.get("epoch", 0))
+            applied = int(doc.get("applied_epoch", 0))
+            if applied > self._codec_applied.get(declared_key, 0):
+                self._apply_codec_locked(declared_key,
+                                         str(doc.get("kwargs", "")),
+                                         applied)
+            if (int(doc.get("pending", 0))
+                    and epoch > self._codec_applied.get(declared_key, 0)):
+                self._codec_next[declared_key] = {
+                    "epoch": epoch,
+                    "effective_round": int(doc.get("effective_round", 0)),
+                    "kwargs_str": str(doc.get("kwargs_next", "")),
+                }
+            if epoch > self._codec_epoch.get(declared_key, 0):
+                self._codec_epoch[declared_key] = epoch
+
+    def _apply_codec_locked(self, declared_key: int, kwstr: str,
+                            epoch: int) -> None:
+        """Install ``kwstr`` ("" = raw) as the key's active codec (caller
+        holds _codec_lock).  The EF-across-switch law: residuals carried
+        by the outgoing compressor transfer to the new one when both run
+        vanilla EF, and otherwise stage per-partition folds that the next
+        push adds in — accumulated error is never dropped."""
+        from .wire import WireCompressor
+        old = self._compressors.get(declared_key)
+        kw = self._kwargs_from_str(kwstr)
+        new = WireCompressor(kw) if kw else None
+        if old is not None and getattr(old, "ef", False):
+            err = old.take_ef_state()
+            if new is not None and new.ef:
+                new.adopt_ef_state(err)
+            else:
+                for pk, e in err.items():
+                    prev = self._ef_fold.get(pk)
+                    self._ef_fold[pk] = (e if prev is None
+                                         or prev.size != e.size
+                                         else prev + e)
+        if old is not None and new is not None \
+                and getattr(old, "momentum_mu", 0.0) \
+                and new.momentum_mu == old.momentum_mu:
+            # Same momentum law on both sides: carry the velocity too.
+            with old._state_lock:
+                mom, old._mom = old._mom, {}
+            with new._state_lock:
+                new._mom.update(mom)
+        if new is not None:
+            self._compressors[declared_key] = new
+        else:
+            self._compressors.pop(declared_key, None)
+        self._codec_applied[declared_key] = epoch
+        self._codec_epoch[declared_key] = max(
+            self._codec_epoch.get(declared_key, 0), epoch)
+        pend = self._codec_next.get(declared_key)
+        if pend is not None and pend["epoch"] <= epoch:
+            self._codec_next.pop(declared_key, None)
+        if epoch > 0:
+            with self._transport_lock:
+                self._tstats["codec_switches"] += 1
+            label = self._label(declared_key)
+            comp_id = new.comp_id if new is not None else 0
+            try:
+                from ..common import telemetry as _tm
+                _tm.get_registry().gauge(
+                    "bps_codec_active", labels={"key": label},
+                    help="active wire codec per key (0=raw 1=onebit "
+                         "2=topk 3=randomk 4=dithering 5=qblock)"
+                ).set(comp_id)
+            except Exception:
+                pass
+            _flightrec.record("codec_switch", key=label, epoch=epoch,
+                              kwargs=kwstr, comp_id=comp_id,
+                              worker=self.worker_id)
+            get_logger().info(
+                "codec switch applied: key %s -> %s (epoch %d)",
+                label, kwstr or "raw", epoch)
+
+    def _current_compressor(self, declared_key: int, plan) -> object:
+        """The compressor to stage this push with, applying any pending
+        renegotiation whose effective round the key has reached — the
+        worker half of the atomic switch (the server applies its half at
+        the same round's first push).  Safe here: the sequential-use
+        guard means the previous round's encodes fully completed before
+        this round stages, so no encoder still holds the old state."""
+        pend = self._codec_next.get(declared_key)
+        if pend is not None:
+            rnd = max((self._round.get(pk, 0) for pk, _, _, _ in plan),
+                      default=0)
+            if rnd >= pend["effective_round"]:
+                with self._codec_lock:
+                    pend = self._codec_next.get(declared_key)
+                    if pend is not None and rnd >= pend["effective_round"]:
+                        self._apply_codec_locked(
+                            declared_key, pend["kwargs_str"],
+                            pend["epoch"])
+        return self._compressors.get(declared_key)
+
+    def codec_table(self) -> dict:
+        """Per-key codec state for tooling (bps.get_tuner / bps_top):
+        {label: {"epoch", "applied_epoch", "name", "pending",
+        "effective_round"}} for every key whose codec epoch advanced."""
+        out = {}
+        with self._codec_lock:
+            for dk, ep in self._codec_epoch.items():
+                comp = self._compressors.get(dk)
+                pend = self._codec_next.get(dk)
+                out[self._label(dk)] = {
+                    "declared_key": dk,
+                    "epoch": ep,
+                    "applied_epoch": self._codec_applied.get(dk, 0),
+                    "name": getattr(comp, "name", None) or "raw",
+                    "pending": (dict(pend) if pend else None),
+                }
+        return out
+
+    # -- CODEC_STALE replay (the renegotiation race backstop) ---------------
+    def _on_codec_stale(self, pkey: int, phase: str,
+                        err: "_CodecStale") -> None:
+        """A push was rejected for carrying the wrong wire format: park
+        the partition and hand it — with the authoritative codec doc —
+        to the retry worker, which adopts the doc, re-encodes the SAME
+        staged gradient with the right codec, and replays.  Runs on a
+        receiver-callback thread, so it must never block."""
+        claimed = self._park_for_remap(pkey, phase)
+        with self._transport_lock:
+            self._tstats["codec_stale_retries"] += 1
+        with self._codec_lock:
+            self._codec_retry_queue.append((pkey if claimed else None,
+                                            err.doc))
+            if self._codec_retry_thread is None:
+                self._codec_retry_thread = threading.Thread(
+                    target=self._codec_retry_loop, daemon=True,
+                    name="bps-ps-codec-retry")
+                self._codec_retry_thread.start()
+
+    def _codec_retry_loop(self) -> None:
+        while True:
+            with self._codec_lock:
+                if not self._codec_retry_queue:
+                    self._codec_retry_thread = None
+                    return
+                pkey, doc = self._codec_retry_queue.pop(0)
+            try:
+                if doc:
+                    self._adopt_codec_doc((pkey if pkey is not None
+                                           else int(doc.get("key", 0)))
+                                          >> 16, doc)
+            except Exception:
+                get_logger().exception("codec doc adoption failed")
+            if pkey is None:
+                continue
+            with self._inflight_lock:
+                part = self._inflight.get(pkey)
+            if part is None or not self._unpark(part):
+                continue
+            part.stale_retries += 1
+            if part.stale_retries > 4:
+                # Bounded like every other replay path (_KeyMoved is
+                # bounded by ring settlement): a mismatch that survives
+                # several authoritative-doc adoptions is a config
+                # disagreement (e.g. this worker's MIN_COMPRESS_BYTES
+                # floor excludes a partition the proposer renegotiated)
+                # — fail the handle loudly instead of replaying the
+                # same rejected push forever while the round wedges.
+                self._finish_part(pkey, RuntimeError(
+                    f"push for key {pkey} was rejected CODEC_STALE "
+                    f"{part.stale_retries} times in a row despite "
+                    f"adopting the server's codec doc each time — the "
+                    f"re-encoded format still mismatches the table "
+                    f"(check that BYTEPS_MIN_COMPRESS_BYTES and codec "
+                    f"config agree across workers)"))
+                continue
+            try:
+                self._reencode_part(part)
+            except Exception as e:
+                self._finish_part(pkey, e)
+                continue
+            with self._transport_lock:
+                self._tstats["replayed_pushes"] += 1
+            with self._cv:
+                self._queue.add(part.pkey, part.priority, part.credit_ln)
+                self._cv.notify_all()
+
+    def _reencode_part(self, part: "_PartTask") -> None:
+        """Re-produce one rejected partition's wire payload under the
+        key's CURRENT codec.  The input is what the rejected payload
+        would have delivered (its decode) — so for an EF codec whose
+        residual already moved to the new compressor at switch time, the
+        conservation law holds exactly: decode(old) + carried residual
+        == gradient + pre-switch residual."""
+        from .wire import decode as wire_decode
+        n = part.ln // 4
+        if part.dtype == DT_COMPRESSED and part.payload is not None:
+            x = wire_decode(bytes(part.payload), n)
+        elif part.seg is not None:
+            x = np.ascontiguousarray(part.seg, np.float32)
+        else:
+            x = np.frombuffer(bytes(part.payload), np.float32).copy()
+        dk = part.pkey >> 16
+        comp = self._compressors.get(dk)
+        fold = self._ef_fold.pop(part.pkey, None)
+        use_comp = (comp is not None
+                    and part.dtype in (DT_F32, DT_COMPRESSED)
+                    and part.ln >= self.min_compress_bytes)
+        if fold is not None and fold.size == n:
+            if use_comp and comp.ef:
+                comp.adopt_ef_state({part.pkey: fold})
+            else:
+                x = x + fold
+        if use_comp:
+            blob = comp.encode(part.pkey, x)
+            part.payload = blob
+            part.wire_ln = len(blob)
+            part.dtype = DT_COMPRESSED
+            part.bidirectional = comp.bidirectional
+        else:
+            buf = np.ascontiguousarray(x, np.float32)
+            part.payload = buf.tobytes()
+            part.wire_ln = part.ln
+            part.dtype = DT_F32
+            part.bidirectional = False
+        part.phase = "push"
+        part.ready = None   # payload is materialized; dispatcher sends it
+
     # -- partition planning -------------------------------------------------
     def _plan(self, declared_key: int, nbytes: int) -> list:
         """[(pkey, offset, length, server_idx)] for a tensor of `nbytes`
@@ -1690,6 +2111,12 @@ class PSSession:
             # seen-dedup keeps it single-counted).
             if isinstance(error, _KeyMoved):
                 self._on_key_moved(pkey, "push", error)
+                return
+            # Codec renegotiation race: the push carried the wrong wire
+            # format for the round being merged — re-encode the same
+            # gradient under the authoritative codec and replay.
+            if isinstance(error, _CodecStale):
+                self._on_codec_stale(pkey, "push", error)
                 return
             # A reconnect-tagged loss parks the partition for replay (the
             # ack never arrived, so the push phase must be re-run — the
@@ -3041,7 +3468,8 @@ class PSSession:
         merged = {"bytes_in": 0, "bytes_out": 0, "async": False,
                   "num_workers": 0, "scatter_frames": 0, "keys": {},
                   "workers": {}, "epoch": 0, "deferred_joins": 0,
-                  "members": {}, "ring_epoch": 0, "servers": {}}
+                  "members": {}, "ring_epoch": 0, "servers": {},
+                  "codec_sets": 0, "codec_stale_frames": 0}
         import json as _json
         for slot, c in enumerate(self.conns):
             sid = self._slot_srv.get(slot, slot)
@@ -3101,6 +3529,11 @@ class PSSession:
             # Old servers omit these keys entirely.
             merged["epoch"] = max(merged["epoch"], int(st.get("epoch", 0)))
             merged["deferred_joins"] += int(st.get("deferred_joins", 0))
+            # Codec renegotiation counters (accepted proposals /
+            # format-mismatch rejections); old servers omit them.
+            merged["codec_sets"] += int(st.get("codec_sets", 0))
+            merged["codec_stale_frames"] += int(
+                st.get("codec_stale_frames", 0))
             for w, rec in (st.get("members") or {}).items():
                 _merge_member_rec(merged["members"], int(w), rec)
             for k, v in (st.get("keys") or {}).items():
@@ -3676,7 +4109,10 @@ class PSSession:
         handle = PSHandle(arr.shape, arr.dtype, len(plan),
                           np.empty(payload.nbytes // 4, np.float32))
         mv = memoryview(payload).cast("B")
-        comp = self._compressors.get(declared_key)
+        # Pending codec renegotiation whose round boundary this push
+        # reaches applies HERE, before the kwargs/INIT and any encode —
+        # the worker half of the atomic switch.
+        comp = self._current_compressor(declared_key, plan)
         kw_bytes = comp.kwargs_string().encode() if comp else b""
         label = self._label(declared_key)
         if self._health is not None and not raw and not seed:
@@ -3690,11 +4126,12 @@ class PSSession:
                 label, payload, self._round.get(plan[0][0], 0),
                 pool=self._codec_pool, comp=comp)
         parts: list = []
+        consumed_folds: dict = {}
         for attempt in range(4):
             try:
                 self._stage_parts(plan, payload, mv, comp, kw_bytes,
                                   handle, parts, raw, seed, label,
-                                  priority)
+                                  priority, consumed_folds)
                 return handle, parts
             except _KeyMoved as e:
                 # A staging INIT hit a ring transition: roll back, adopt
@@ -3702,6 +4139,7 @@ class PSSession:
                 # BOUNDS are placement-independent, so the handle stays
                 # valid).  Bounded — a healthy ring settles in one hop.
                 self._rollback_stage(parts)
+                self._restore_folds(consumed_folds)
                 parts = []
                 self._adopt_ring_doc(e.doc)
                 if attempt == 3:
@@ -3715,8 +4153,19 @@ class PSSession:
                 # sequential-use guard waits on done_evt, which nothing
                 # would ever set).
                 self._rollback_stage(parts)
+                self._restore_folds(consumed_folds)
                 raise
         return handle, parts
+
+    def _restore_folds(self, consumed: dict) -> None:
+        """Re-stage EF folds a rolled-back staging attempt consumed (the
+        residual must ride the RETRY, not vanish with the rollback).
+        Folds adopted into an EF compressor's state need no restore —
+        that state survives the rollback."""
+        for pkey, fold in consumed.items():
+            if pkey not in self._ef_fold:
+                self._ef_fold[pkey] = fold
+        consumed.clear()
 
     def _rollback_stage(self, parts: list) -> None:
         with self._inflight_lock:
@@ -3833,23 +4282,41 @@ class PSSession:
                                 "encode", dur)
 
     def _stage_parts(self, plan, payload, mv, comp, kw_bytes, handle,
-                     parts, raw, seed, label="", priority=0) -> None:
+                     parts, raw, seed, label="", priority=0,
+                     consumed_folds=None) -> None:
         self._init_parts(plan, kw_bytes)
         pool = self._codec_pool
         core = get_core()
         for pkey, off, ln, srv in plan:
+            seg = payload[off // 4:(off + ln) // 4]
             # BYTEPS_MIN_COMPRESS_BYTES floor: small partitions go raw
             # (reference: operations.cc:362-364).
             use_comp = (comp is not None and not raw and not seed
                         and ln >= self.min_compress_bytes)
+            # EF residual detached by a codec switch whose target cannot
+            # carry it: fold it into this partition's push exactly once
+            # (the EF-across-switch conservation law).  If the current
+            # codec CAN carry it (a later switch back to an EF codec),
+            # adopt it instead — same total either way.
+            folded = False
+            fold = self._ef_fold.get(pkey)
+            if fold is not None and not raw and not seed \
+                    and fold.size == ln // 4:
+                self._ef_fold.pop(pkey, None)
+                if use_comp and comp.ef:
+                    comp.adopt_ef_state({pkey: fold})
+                else:
+                    seg = (seg + fold).astype(np.float32)
+                    folded = True
+                    if consumed_folds is not None:
+                        consumed_folds[pkey] = fold
             if use_comp and pool is None:
                 # Inline fallback (BYTEPS_TPU_COMPRESS_THREADS=0): encode
                 # on the caller thread, the pre-pipeline data path.
                 t0 = (core.trace_now_us()
                       if core.trace_on or _signals.plane() is not None
                       else 0)
-                wire_payload = comp.encode(
-                    pkey, payload[off // 4:(off + ln) // 4])
+                wire_payload = comp.encode(pkey, seg)
                 if t0:
                     dur = core.trace_now_us() - t0
                     if core.trace_on:
@@ -3866,7 +4333,11 @@ class PSSession:
                 wire_payload = None     # pipelined: the pool fills it in
                 dtype = DT_COMPRESSED
             else:
-                wire_payload = mv[off:off + ln]
+                # A folded segment is a fresh array: its bytes ride the
+                # wire (part.seg keeps it alive); otherwise the caller's
+                # buffer rides zero-copy as before.
+                wire_payload = (memoryview(seg).cast("B") if folded
+                                else mv[off:off + ln])
                 dtype = DT_SEED if seed else (DT_RAW if raw else DT_F32)
             # Sequential-use guard: a second async push_pull of the same
             # tensor before the first completed waits for that partition.
@@ -3884,6 +4355,8 @@ class PSSession:
                             bidirectional=use_comp and comp.bidirectional,
                             label=f"{label}.part{pkey & 0xFFFF}")
                         part.priority = priority
+                        if not raw and not seed:
+                            part.seg = seg   # re-encode source (CODEC_STALE)
                         if wire_payload is None:
                             part.ready = threading.Event()
                             # Credit charge for a not-yet-encoded part:
@@ -3904,7 +4377,6 @@ class PSSession:
                 # drains jobs in (priority desc, key asc) order, ahead of
                 # the dispatcher's identical order, overlapping partition
                 # k's wire send with the encode of k+1.
-                seg = payload[off // 4:(off + ln) // 4]
                 pool.submit(priority, pkey,
                             lambda part=part, seg=seg:
                                 self._encode_part(part, comp, seg))

@@ -35,6 +35,16 @@ Wire layout (little-endian):
                   within bytes; within one code, MSB-of-code-first.
                   Denser than the dense form whenever most levels
                   quantize to 0 (typical gradients).
+    qblock(5):    u8 bits(4|8) | u16 block | f32 scale[nblocks] | ints
+                  — EQuARX-flavored blockwise integer quantization
+                  (arXiv 2506.17615): per `block` elements one f32
+                  scale = absmax/qmax (qmax = 2^(bits-1)-1), each
+                  element round-half-even(x/scale) clipped to
+                  [-qmax, qmax]; bits=4 packs two two's-complement
+                  nibbles per byte, low nibble first.  Dense layout,
+                  flat decode, deterministic (no PRNG) — the aggressive
+                  end of the adaptive-compression dial, EF-capable on
+                  both legs under the same law as onebit.
 """
 
 from __future__ import annotations
@@ -45,10 +55,12 @@ from typing import Dict, Optional, Tuple
 
 import numpy as np
 
-COMP_ONEBIT, COMP_TOPK, COMP_RANDOMK, COMP_DITHERING = 1, 2, 3, 4
+COMP_ONEBIT, COMP_TOPK, COMP_RANDOMK, COMP_DITHERING, COMP_QBLOCK = \
+    1, 2, 3, 4, 5
 
 _NAMES = {"onebit": COMP_ONEBIT, "topk": COMP_TOPK,
-          "randomk": COMP_RANDOMK, "dithering": COMP_DITHERING}
+          "randomk": COMP_RANDOMK, "dithering": COMP_DITHERING,
+          "qblock": COMP_QBLOCK}
 
 _CWIRE = False   # False = untried, None = unavailable, else the CDLL
 
@@ -87,6 +99,10 @@ def _c_wire():
                     ctypes.c_void_p, u64, ctypes.c_float, ctypes.c_void_p,
                     ctypes.c_void_p]
                 lib.bps_wire_onebit_pack.restype = None
+                lib.bps_wire_encode_qblock.argtypes = [
+                    ctypes.c_void_p, u64, ctypes.c_int, u32,
+                    ctypes.c_void_p, ctypes.c_void_p, u64]
+                lib.bps_wire_encode_qblock.restype = ctypes.c_int64
                 _CWIRE = lib
         except Exception:   # pragma: no cover - defensive
             _CWIRE = None
@@ -258,7 +274,14 @@ class WireCompressor:
                              f"options: dense, elias")
         if ctype in ("topk", "randomk") and self.k <= 0:
             raise ValueError(f"{ctype} requires k > 0")
-        self.bidirectional = ctype == "onebit"
+        # Quantized-block params (EQuARX-flavored dense int format).
+        self.qb_bits = int(_get(kwargs, "bits", 8)) if ctype == "qblock" \
+            else 0
+        self.qb_block = min(0xFFFF, max(1, int(_get(kwargs, "block", 256)))
+                            ) if ctype == "qblock" else 0
+        if ctype == "qblock" and self.qb_bits not in (4, 8):
+            raise ValueError(f"qblock bits={self.qb_bits}; options: 4, 8")
+        self.bidirectional = ctype in ("onebit", "qblock")
         # Worker-side vanilla error feedback (reference:
         # error_feedback.cc:22-34: grad += e; c = Compress(grad);
         # e = grad - Decompress(c)), per partition key.  The server never
@@ -310,6 +333,32 @@ class WireCompressor:
                 total += float(np.dot(e, e))
         return float(np.sqrt(total))
 
+    def take_ef_state(self) -> Dict[int, np.ndarray]:
+        """Detach and return the carried per-partition EF residuals — the
+        codec-switch handoff: when source and target codecs share vanilla
+        EF semantics (an additive residual in gradient space, true for
+        every EF-capable wire codec here) the new compressor adopts them
+        via :meth:`adopt_ef_state`; otherwise the session folds each
+        residual into the key's next push, so a switch can never silently
+        drop accumulated error."""
+        with self._state_lock:
+            err, self._err = self._err, {}
+        return err
+
+    def adopt_ef_state(self, err: Dict[int, np.ndarray]) -> None:
+        """Adopt residuals from a predecessor codec (see take_ef_state).
+        Adds into any residual this compressor already carries — the
+        conservation law, not last-write-wins."""
+        if not self.ef or not err:
+            return
+        with self._state_lock:
+            for pk, e in err.items():
+                mine = self._err.get(pk)
+                if mine is not None and mine.size == e.size:
+                    self._err[pk] = mine + e
+                else:
+                    self._err[pk] = np.asarray(e, np.float32)
+
     def wire_cap_bytes(self, n: int) -> int:
         """Worst-case wire payload size for an n-element partition.
 
@@ -325,6 +374,9 @@ class WireCompressor:
         elias's worst case exceeds raw by its ~80-byte framing."""
         if self.comp_id == COMP_ONEBIT:
             return 9 + (n + 7) // 8
+        if self.comp_id == COMP_QBLOCK:
+            nb = (n + self.qb_block - 1) // self.qb_block
+            return 8 + 4 * nb + (n if self.qb_bits == 8 else (n + 1) // 2)
         if self.comp_id in (COMP_TOPK, COMP_RANDOMK):
             return 9 + 8 * min(self.k, n)
         # dithering — the same caps the C encoder is given (elias's
@@ -343,6 +395,8 @@ class WireCompressor:
             kw["momentum_mu"] = repr(self.momentum_mu)
         if self.name == "onebit":
             kw["onebit_scaling"] = "1" if self.scaled else "0"
+        if self.name == "qblock":
+            kw.update(bits=str(self.qb_bits), block=str(self.qb_block))
         if self.name in ("topk", "randomk"):
             kw["k"] = str(self.k)
         if self.name == "randomk":
@@ -461,6 +515,8 @@ class WireCompressor:
             idx = np.argpartition(np.abs(x), -k)[-k:].astype(np.int32)
             return (hdr + struct.pack("<I", k) + idx.tobytes()
                     + x[idx].tobytes())
+        if self.comp_id == COMP_QBLOCK:
+            return self._encode_qblock(hdr, x, n)
         if self.comp_id == COMP_RANDOMK:
             k = min(self.k, n)
             rng = self._rng.get(pkey)
@@ -559,6 +615,51 @@ class WireCompressor:
                 + _pack_levels(level, s).tobytes()
                 + _pack_bits(signs).tobytes())
 
+    def _encode_qblock(self, hdr: bytes, x: np.ndarray, n: int) -> bytes:
+        """Blockwise int4/int8 quantization (COMP_QBLOCK).  The C path is
+        byte-identical to the numpy fallback below: both compute the
+        per-block scale as f32 absmax/qmax, quantize by TRUE f32 division
+        then round-half-to-even (np.rint / rintf), and reconstruct as
+        q * scale — asserted by tests/test_tuner.py."""
+        bits, block = self.qb_bits, self.qb_block
+        qmax = (1 << (bits - 1)) - 1
+        nb = (n + block - 1) // block
+        lib = _c_wire()
+        if lib is not None and n:
+            cap = 8 + 4 * nb + (n if bits == 8 else (n + 1) // 2)
+            out = np.empty(cap, np.uint8)
+            recon = np.empty(n, np.float32) if self.ef else None
+            wrote = lib.bps_wire_encode_qblock(
+                x.ctypes.data, n, bits, block,
+                recon.ctypes.data if recon is not None else None,
+                out.ctypes.data, cap)
+            if wrote > 0:
+                if recon is not None:
+                    self._last_recon = recon
+                return out[:wrote].tobytes()
+        xp = np.zeros(nb * block, np.float32)
+        xp[:n] = x
+        xb = xp.reshape(nb, block)
+        amax = np.abs(xb).max(axis=1) if n else np.zeros(nb, np.float32)
+        scale = (amax / np.float32(qmax)).astype(np.float32)
+        safe = np.where(scale > 0, scale, np.float32(1)).astype(np.float32)
+        q = np.clip(np.rint(xb / safe[:, None]), -qmax, qmax)
+        q = np.where(scale[:, None] > 0, q, 0).astype(np.int8)
+        if self.ef:
+            self._last_recon = (q.astype(np.float32)
+                                * scale[:, None]).ravel()[:n].astype(
+                                    np.float32)
+        qflat = q.ravel()[:n]
+        if bits == 8:
+            body = qflat.tobytes()
+        else:
+            u = (qflat.astype(np.int16) & 0xF).astype(np.uint8)
+            if n % 2:
+                u = np.append(u, np.uint8(0))
+            body = (u[0::2] | (u[1::2] << 4)).astype(np.uint8).tobytes()
+        return (hdr + struct.pack("<BH", bits, block)
+                + scale.tobytes() + body)
+
     def _levels(self) -> np.ndarray:
         s = self.s
         if self.partition == "linear":
@@ -634,6 +735,25 @@ def _decode_py(data: bytes, n: int) -> np.ndarray:
         out = np.zeros(n, np.float32)
         np.add.at(out, idx, val)
         return out
+    if comp == COMP_QBLOCK:
+        bits, block = struct.unpack_from("<BH", body, 0)
+        if bits not in (4, 8) or block == 0:
+            raise ValueError(f"qblock bits={bits} block={block}")
+        nb = (n + block - 1) // block
+        scales = np.frombuffer(body[3:3 + 4 * nb], np.float32)
+        qb = body[3 + 4 * nb:]
+        if bits == 8:
+            q = np.frombuffer(qb[:n], np.int8).astype(np.float32)
+        else:
+            u = np.frombuffer(qb[:(n + 1) // 2], np.uint8)
+            nib = np.empty(2 * u.size, np.uint8)
+            nib[0::2] = u & 0xF
+            nib[1::2] = u >> 4
+            q = (((nib[:n].astype(np.int16)) ^ 8) - 8).astype(np.float32)
+        qp = np.zeros(nb * block, np.float32)
+        qp[:n] = q
+        return (qp.reshape(nb, block)
+                * scales[:, None]).ravel()[:n].astype(np.float32)
     if comp == COMP_DITHERING:
         flags, s, norm = struct.unpack_from("<BBf", body, 0)
         if flags & 2:

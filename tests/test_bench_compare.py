@@ -67,6 +67,22 @@ def test_lower_is_better_direction():
     assert bench_compare.check(recs)["regressions"] == []
 
 
+def test_autotune_family_direction():
+    """BENCH_AUTOTUNE records (ISSUE 13): the headline is the step-time
+    GAP vs the hand-tuned config — lower is better, even though the
+    "pct" unit would otherwise read as higher-is-better."""
+    assert bench_compare._lower_is_better(
+        "autotune_step_time_gap_pct", "pct_gap")
+    recs = [R(1, "autotune_step_time_gap_pct", 3.0, unit="pct_gap"),
+            R(2, "autotune_step_time_gap_pct", 20.0, unit="pct_gap")]
+    rep = bench_compare.check(recs, threshold=0.10)
+    assert len(rep["regressions"]) == 1      # the gap WIDENED: regression
+    # The tuner converging (gap shrinking, even negative) is never a
+    # regression.
+    recs[-1] = R(2, "autotune_step_time_gap_pct", -5.0, unit="pct_gap")
+    assert bench_compare.check(recs)["regressions"] == []
+
+
 def test_platforms_compared_separately():
     recs = [R(1, "eff", 1.0, platform="tpu"),
             R(2, "eff", 0.2, platform="cpu"),   # different hardware

@@ -203,13 +203,42 @@ def test_barrier_stall_boundary():
     assert "barrier_stall" not in rules_fired([W(0), W(1)])
 
 
+def test_tuner_thrash_boundary():
+    """Fires when a key's switch counter grew in > N of the last M
+    windows; names the key and carries its class history."""
+    def sw(v, cls="wire_bound"):
+        return {"metrics": {'bps_tuner_key_switches_total{key="k1"}': v},
+                "keys": {"k1": {"class": cls}}}
+
+    # 3 switch windows out of 6 (> default 2): fires.
+    hot = [W(i, **sw(v)) for i, v in enumerate([0, 1, 2, 3, 3, 3, 3])]
+    fired = rules_fired(hot)
+    assert "tuner_thrash" in fired
+    diag = doctor.evaluate_stream(hot)
+    f = next(x for x in diag["history"] if x["rule"] == "tuner_thrash")
+    assert f["subject"] == "key=k1"
+    assert f["evidence"]["switch_windows"] == 3
+    assert "wire_bound" in f["evidence"]["class_history"]
+    # Exactly N switch windows: quiet (boundary is strict >).
+    warm = [W(i, **sw(v)) for i, v in enumerate([0, 1, 2, 2, 2, 2, 2])]
+    assert "tuner_thrash" not in rules_fired(warm)
+    # A converged tuner (counter flat): quiet.
+    cold = [W(i, **sw(3)) for i in range(7)]
+    assert "tuner_thrash" not in rules_fired(cold)
+    # Counter restart (delta clamps at 0): quiet.
+    reset = [W(0, **sw(5)), W(1, **sw(0)), W(2, **sw(0)),
+             W(3, **sw(0)), W(4, **sw(0)), W(5, **sw(0)), W(6, **sw(0))]
+    assert "tuner_thrash" not in rules_fired(reset)
+
+
 def test_every_rule_has_a_boundary_test():
     """The fire/no-fire coverage above must track the rule set: a new
     rule without a test here is exactly the drift this file pins."""
     covered = {"persistent_straggler", "round_lag_growth",
                "lane_credit_imbalance", "recv_pool_miss_rate",
                "fusion_dilution", "server_hot_shard",
-               "nonfinite_gradients", "audit_mismatch", "barrier_stall"}
+               "nonfinite_gradients", "audit_mismatch", "barrier_stall",
+               "tuner_thrash"}
     assert set(doctor.RULE_IDS) == covered
 
 

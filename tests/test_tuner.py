@@ -1,0 +1,721 @@
+"""Adaptive compression (ISSUE 13): the qblock codec, the CMD_CODEC
+per-key renegotiation protocol (atomic round-boundary switches, the
+CODEC_STALE race backstop, the EF-across-switch conservation law), the
+tuner control loop's hysteresis/revert/pin behavior, wire byte-identity
+when unarmed, and the codec-epoch survival regressions (server
+migration via CMD_MIGRATE, worker replay via reconnect re-declare).
+"""
+
+import json
+import os
+import socket
+import struct
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from byteps_tpu.common import signals
+from byteps_tpu.common import telemetry as tm
+from byteps_tpu.common.tuner import DIAL, DIAL_KWARGS, Tuner, dial_of
+from byteps_tpu.server import wire
+from byteps_tpu.server.client import (CMD_CODEC, CMD_HELLO, CMD_INIT,
+                                      CMD_PULL, CMD_PUSH, PSSession)
+
+from testutil import StubPSServer, cpu_env
+
+TOOLS = os.path.join(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))), "tools")
+if TOOLS not in sys.path:
+    sys.path.insert(0, TOOLS)
+from chaos_proxy import ChaosProxy  # noqa: E402
+
+
+# ---------------------------------------------------------------------------
+# server fixtures (the test_ps_server / test_server_elastic patterns)
+# ---------------------------------------------------------------------------
+def _wait_up(port, procs, deadline_s=30):
+    deadline = time.time() + deadline_s
+    while True:
+        try:
+            socket.create_connection(("127.0.0.1", port), 0.5).close()
+            return
+        except OSError:
+            for p in procs:
+                if p.poll() is not None:
+                    raise RuntimeError(f"server died rc={p.returncode}")
+            if time.time() > deadline:
+                raise TimeoutError("PS server did not come up")
+            time.sleep(0.1)
+
+
+@pytest.fixture
+def ps_server():
+    made = []
+
+    def start(num_workers=1, extra_env=None):
+        last = None
+        for _ in range(3):
+            with socket.socket() as sk:
+                sk.bind(("127.0.0.1", 0))
+                port = sk.getsockname()[1]
+            env = cpu_env({
+                "DMLC_PS_ROOT_PORT": str(port - 1),
+                "DMLC_NUM_WORKER": str(num_workers),
+                "BYTEPS_SERVER_ENGINE_THREAD": "2",
+                "JAX_PLATFORMS": "cpu",
+                **(extra_env or {}),
+            })
+            proc = subprocess.Popen(
+                [sys.executable, "-m", "byteps_tpu.server"], env=env,
+                stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+            made.append(proc)
+            try:
+                _wait_up(port, [proc])
+                return port
+            except (RuntimeError, TimeoutError) as e:
+                last = e
+        raise last
+
+    yield start
+    for p in made:
+        p.kill()
+        p.wait()
+
+
+@pytest.fixture
+def ring_servers():
+    """N ring-armed servers on consecutive ports (root+1+id convention),
+    for the migration-survival regression."""
+    made = []
+
+    def start(n, num_workers=1):
+        last = None
+        for _ in range(4):
+            try:
+                return _start_group(n, num_workers)
+            except (RuntimeError, TimeoutError) as e:
+                last = e
+        raise last
+
+    def _start_group(n, num_workers):
+        with socket.socket() as sk:
+            sk.bind(("127.0.0.1", 0))
+            base = sk.getsockname()[1]
+        ports = [base + i for i in range(n)]
+        procs = []
+        for i in range(n):
+            env = cpu_env({
+                "DMLC_PS_ROOT_PORT": str(base - 1),
+                "DMLC_NUM_WORKER": str(num_workers),
+                "DMLC_NUM_SERVER": str(n),
+                "DMLC_SERVER_ID": str(i),
+                "BYTEPS_TPU_RING": "1",
+                "BYTEPS_SERVER_ENGINE_THREAD": "2",
+                "JAX_PLATFORMS": "cpu",
+            })
+            procs.append(subprocess.Popen(
+                [sys.executable, "-m", "byteps_tpu.server"], env=env,
+                stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL))
+        made.extend(procs)
+        for p in ports:
+            _wait_up(p, procs)
+        return ports
+
+    yield start
+    for p in made:
+        p.kill()
+        p.wait()
+
+
+# ---------------------------------------------------------------------------
+# fast: qblock codec — parity, EF law, server roundtrip
+# ---------------------------------------------------------------------------
+def test_qblock_c_numpy_byte_parity():
+    """The C encoder (bps_wire_encode_qblock — the exact routine the
+    server's recompress leg runs) and the numpy fallback emit
+    byte-identical blobs with identical EF state, across bits/sizes
+    including partial blocks and nibble-odd lengths."""
+    if wire._c_wire() is None:
+        pytest.skip("native codec not built")
+    rng = np.random.RandomState(0)
+    for bits in (8, 4):
+        for n in (1, 7, 255, 256, 257, 4096, 100001):
+            x = (rng.randn(n) * (rng.rand(n) < 0.5)).astype(np.float32)
+            kw = {"compressor": "qblock", "bits": str(bits),
+                  "block": "256", "ef": "vanilla"}
+            c_path = wire.WireCompressor(kw)
+            blob_c = c_path.encode(7, x)
+            saved, wire._CWIRE = wire._CWIRE, None
+            try:
+                py_path = wire.WireCompressor(kw)
+                blob_py = py_path.encode(7, x)
+                dec_py = wire._decode_py(blob_c, n)
+            finally:
+                wire._CWIRE = saved
+            assert blob_c == blob_py, (bits, n)
+            np.testing.assert_array_equal(wire.decode(blob_c, n), dec_py)
+            np.testing.assert_array_equal(c_path._err[7], py_path._err[7])
+
+
+def test_qblock_ef_conservation_and_ratio():
+    """decode(blob) + carried_error == input (+ previous error), and the
+    wire size matches the documented ratio (~4x int8, ~7.8x int4)."""
+    rng = np.random.RandomState(1)
+    n = 1 << 14
+    x = rng.randn(n).astype(np.float32)
+    for bits, lo, hi in ((8, 3.7, 4.1), (4, 7.2, 8.0)):
+        wc = wire.WireCompressor({"compressor": "qblock",
+                                  "bits": str(bits), "block": "256",
+                                  "ef": "vanilla"})
+        blob = wc.encode(3, x)
+        np.testing.assert_allclose(wire.decode(blob, n) + wc._err[3], x,
+                                   rtol=0, atol=1e-5)
+        assert lo < x.nbytes / len(blob) < hi
+        # Second push folds the residual: decode2 + err2 == x2 + err1.
+        err1 = wc._err[3].copy()
+        x2 = rng.randn(n).astype(np.float32)
+        blob2 = wc.encode(3, x2)
+        np.testing.assert_allclose(
+            wire.decode(blob2, n) + wc._err[3], x2 + err1,
+            rtol=0, atol=1e-5)
+
+
+def test_qblock_server_roundtrip_with_ef(ps_server):
+    """qblock through the real server: the bidirectional recompress leg
+    (per-block requantized sum comes back as a qblock blob) with vanilla
+    EF on the server side — pushing the same gradient repeatedly, the
+    mean of pulled sums converges on the true value (EF's defining
+    property), and each pull is within one quantization step."""
+    port = ps_server()
+    s = PSSession(["127.0.0.1"], [port], worker_id=0, num_servers=1)
+    try:
+        n = 1 << 14                       # 64 KiB >= the compress floor
+        s.register_compressor(9, {"compressor": "qblock", "bits": "4",
+                                  "block": "256", "ef": "vanilla"})
+        rng = np.random.RandomState(2)
+        x = rng.randn(n).astype(np.float32)
+        pulls = [np.asarray(s.push_pull(9, x)) for _ in range(16)]
+        step = np.abs(x).max() / 7.0      # int4 qmax = 7, per-block <=
+        for p in pulls:
+            assert np.abs(p - x).max() <= 2 * step + 1e-5
+        mean = np.mean(pulls, axis=0)
+        assert np.abs(mean - x).max() < np.abs(pulls[0] - x).max() + 1e-6
+        assert np.abs(mean - x).mean() < 0.25 * step
+    finally:
+        s.close()
+
+
+# ---------------------------------------------------------------------------
+# fast: the atomic mid-job switch (ISSUE acceptance)
+# ---------------------------------------------------------------------------
+def _table_row(sess, dk):
+    """codec_table row by declared key — labels depend on what earlier
+    tests left in the process-wide declare registry, so never assume
+    the key_N fallback name."""
+    return next(v for v in sess.codec_table().values()
+                if v["declared_key"] == dk)
+
+
+def _both(s0, s1, key, x0, x1, timeout=30.0):
+    out = [None, None]
+    t = threading.Thread(
+        target=lambda: out.__setitem__(1, s1.push_pull(key, x1)))
+    t.start()
+    out[0] = s0.push_pull(key, x0)
+    t.join(timeout)
+    assert not t.is_alive()
+    return out
+
+
+def test_codec_switch_mid_job_atomic(ps_server):
+    """The acceptance scenario: a raw key renegotiates to onebit at a
+    declared future round boundary.  Rounds before the boundary are
+    raw-exact; the boundary round publishes onebit on BOTH workers even
+    though worker 1 never learned of the switch (the server's
+    CODEC_STALE rejection forces its re-encode — no mixed-format round);
+    a revert proposal switches back just as atomically."""
+    port = ps_server(num_workers=2)
+    s0 = PSSession(["127.0.0.1"], [port], worker_id=0, num_servers=1)
+    s1 = PSSession(["127.0.0.1"], [port], worker_id=1, num_servers=1)
+    try:
+        n = 1 << 14
+        x0 = np.random.RandomState(0).randn(n).astype(np.float32)
+        x1 = np.random.RandomState(1).randn(n).astype(np.float32)
+        for _ in range(2):                             # rounds 0-1: raw
+            o = _both(s0, s1, 5, x0, x1)
+            np.testing.assert_allclose(o[0], x0 + x1, rtol=1e-6)
+            np.testing.assert_array_equal(o[0], o[1])
+        res = s0.propose_codec(5, {"compressor": "onebit",
+                                   "ef": "vanilla"}, effective_round=4)
+        assert res["accepted"]
+        assert res["doc"]["pending"] == 1
+        assert res["doc"]["effective_round"] == 4
+        for r in range(2, 6):
+            o = _both(s0, s1, 5, x0, x1)
+            # No round ever mixes formats: both workers pull the SAME
+            # published bytes every round.
+            np.testing.assert_array_equal(o[0], o[1], err_msg=f"round {r}")
+            if r < 4:                                  # pre-boundary: raw
+                np.testing.assert_allclose(o[0], x0 + x1, rtol=1e-6)
+            else:                                      # onebit publishes
+                assert len(np.unique(np.abs(o[0]))) == 1, f"round {r}"
+        # Worker 1 was never told: it pushed raw at the boundary and was
+        # forced through the CODEC_STALE replay exactly as designed.
+        assert s1.transport_stats()["codec_stale_retries"] >= 1
+        assert s0.transport_stats()["codec_stale_retries"] == 0
+        # Both sessions converged on the authoritative table.
+        assert _table_row(s0, 5)["name"] == "onebit"
+        assert _table_row(s1, 5)["name"] == "onebit"
+        st = s0.server_stats()
+        assert st.get("codec_sets", 0) >= 1
+        assert st.get("codec_stale_frames", 0) >= 1
+        # Renegotiate BACK to raw (the revert path's actuation): exact
+        # sums return once the boundary passes.
+        res2 = s0.propose_codec(5, None, effective_round=8)
+        assert res2["accepted"]
+        for r in range(6, 10):
+            o = _both(s0, s1, 5, x0, x1)
+            np.testing.assert_array_equal(o[0], o[1])
+        assert len(np.unique(np.abs(o[0]))) > 1        # raw again
+        assert _table_row(s0, 5)["name"] == "raw"
+    finally:
+        s0.close()
+        s1.close()
+
+
+def test_ef_across_switch_sum_conservation(ps_server):
+    """EF residual accounted across a switch (the ISSUE's sum check): a
+    single worker pushes the same gradient under onebit+EF, then
+    switches to raw.  The cumulative pulled sum over all rounds must
+    equal rounds * x EXACTLY (up to f32 addition) — the residual carried
+    at switch time is folded into the first raw push, never dropped."""
+    port = ps_server()
+    s = PSSession(["127.0.0.1"], [port], worker_id=0, num_servers=1)
+    try:
+        n = 1 << 14
+        x = np.random.RandomState(3).randn(n).astype(np.float32)
+        s.register_compressor(7, {"compressor": "onebit",
+                                  "ef": "vanilla"})
+        total = np.zeros(n, np.float64)
+        for _ in range(4):                  # rounds 0-3: onebit+EF
+            total += np.asarray(s.push_pull(7, x), np.float64)
+        # Lossy so far: the cumulative sum misses exactly the residual.
+        resid = s._compressors[7].ef_residual_norm()
+        assert resid > 0
+        res = s.propose_codec(7, None, effective_round=5)
+        assert res["accepted"]
+        for r in range(4, 8):               # round 5 onward: raw
+            total += np.asarray(s.push_pull(7, x), np.float64)
+        # Worker EF residual: zero (folded).  Cumulative: exact.
+        assert not s._ef_fold
+        assert s._compressors.get(7) is None
+        np.testing.assert_allclose(total, 8.0 * x.astype(np.float64),
+                                   rtol=0, atol=2e-2)
+        err = float(np.abs(total - 8.0 * x).max())
+        assert err < 1e-2, err
+    finally:
+        s.close()
+
+
+def test_redeclare_after_switch_keeps_new_codec(ps_server):
+    """The PR 3 idempotent re-declare path must carry the key's CURRENT
+    codec epoch, not its launch config: after a switch, a forced
+    re-declare (the reconnect path's _inited invalidation) re-INITs with
+    the new kwargs, the server ignores INIT kwargs for table-governed
+    keys, and pushes keep flowing with zero CODEC_STALE noise."""
+    port = ps_server()
+    s = PSSession(["127.0.0.1"], [port], worker_id=0, num_servers=1)
+    try:
+        n = 1 << 14
+        x = np.arange(n, dtype=np.float32)
+        for _ in range(2):
+            s.push_pull(11, x)
+        res = s.propose_codec(11, {"compressor": "onebit",
+                                   "ef": "vanilla"}, effective_round=3)
+        assert res["accepted"]
+        for _ in range(3):
+            s.push_pull(11, x)
+        assert _table_row(s, 11)["name"] == "onebit"
+        # Forced re-declare — exactly what a reconnect replay performs.
+        s._inited.clear()
+        out = np.asarray(s.push_pull(11, x))
+        assert len(np.unique(np.abs(out))) == 1   # still onebit
+        assert s.transport_stats()["codec_stale_retries"] == 0
+        # And the server-side doc still carries the renegotiated epoch.
+        pk = next(k for k in s._pkey_srv if k >> 16 == 11)
+        doc = json.loads(bytes(s.conns[0].request(
+            CMD_CODEC, pk, worker_id=0, timeout=10.0)).decode())
+        assert doc["applied_epoch"] == 1
+        assert "onebit" in doc["kwargs"]
+    finally:
+        s.close()
+
+
+def test_codec_stale_retries_are_bounded(ps_server):
+    """A PERSISTENT format mismatch (here: a worker whose
+    MIN_COMPRESS_BYTES floor excludes the partition the proposer
+    renegotiated, so its re-encode is raw every time) must fail the
+    push loudly after a bounded number of CODEC_STALE replays — never
+    spin hot while the round silently wedges."""
+    port = ps_server(num_workers=2)
+    s0 = PSSession(["127.0.0.1"], [port], worker_id=0, num_servers=1)
+    s1 = PSSession(["127.0.0.1"], [port], worker_id=1, num_servers=1,
+                   min_compress_bytes=1 << 20)   # floor excludes the key
+    try:
+        n = 1 << 14
+        x = np.arange(n, dtype=np.float32)
+        o = _both(s0, s1, 17, x, x)
+        np.testing.assert_allclose(o[0], 2 * x, rtol=1e-6)
+        assert s0.propose_codec(17, {"compressor": "onebit"},
+                                effective_round=1)["accepted"]
+        h0 = s0.push_pull_async(17, x)           # never completes: ok
+
+        err = []
+
+        def _push():
+            try:
+                s1.push_pull_async(17, x).wait(30)
+            except Exception as e:
+                err.append(e)
+
+        t = threading.Thread(target=_push)
+        t.start()
+        t.join(60)
+        assert not t.is_alive()
+        assert err and isinstance(err[0], RuntimeError), err
+        assert "CODEC_STALE" in str(err[0])
+        assert 1 <= s1.transport_stats()["codec_stale_retries"] <= 6
+        del h0
+    finally:
+        s0.close()
+        s1.close()
+
+
+# ---------------------------------------------------------------------------
+# fast: codec epoch survives migration and worker replay (regressions)
+# ---------------------------------------------------------------------------
+def test_renegotiated_codec_survives_migration(ring_servers):
+    """ISSUE satellite: a key whose compressor was re-registered mid-job
+    survives server drain/migration with the NEW codec (CMD_MIGRATE
+    carries the codec-table trailer) — trajectory bit-identical to an
+    undrained run."""
+    def run(ports, drain_round):
+        s = PSSession(["127.0.0.1"] * len(ports), list(ports),
+                      worker_id=0, num_servers=len(ports), ring=True,
+                      wire_conns=1, partition_bytes=1 << 16)
+        outs = []
+        try:
+            n = 1 << 14
+            x = np.random.RandomState(5).randn(n).astype(np.float32)
+            for _ in range(2):
+                outs.append(np.asarray(s.push_pull(3, x)))
+            assert s.propose_codec(
+                3, {"compressor": "onebit", "ef": "vanilla"},
+                effective_round=3)["accepted"]
+            for r in range(2, 10):
+                if r == drain_round:
+                    target = next(srv for pk, srv in s._pkey_srv.items()
+                                  if pk >> 16 == 3)
+                    doc = s.drain_server(target)
+                    assert doc["keys_owned"] == 0
+                outs.append(np.asarray(s.push_pull(3, x)))
+            # Post-drain the key is table-governed on its NEW owner.
+            pk = next(k for k in s._pkey_srv if k >> 16 == 3)
+            slot = s._pkey_srv[pk]
+            cdoc = json.loads(bytes(s.conns[slot].request(
+                CMD_CODEC, pk, worker_id=0, timeout=10.0)).decode())
+            assert cdoc["applied_epoch"] == 1, cdoc
+            assert "onebit" in cdoc["kwargs"]
+        finally:
+            s.close()
+        return outs
+
+    ref = run(ring_servers(2), drain_round=None)
+    got = run(ring_servers(2), drain_round=6)   # mid-job, post-switch
+    for i, (r, g) in enumerate(zip(ref, got)):
+        np.testing.assert_array_equal(r, g, err_msg=f"round {i}")
+
+
+def test_replay_after_reset_carries_new_codec(ps_server):
+    """ISSUE satellite (worker half): a mid-payload connection reset
+    AFTER a codec switch replays through reconnect + re-declare with the
+    new codec — trajectory bit-identical to an unfaulted run."""
+    n = 1 << 14
+    rng = np.random.RandomState(6)
+    rounds = [rng.randn(n).astype(np.float32) for _ in range(8)]
+
+    def run(port, proxy=None):
+        s = PSSession(["127.0.0.1"], [port], worker_id=0, num_servers=1,
+                      wire_conns=1, reconnect_attempts=8,
+                      reconnect_backoff_ms=20.0)
+        outs = []
+        try:
+            for i, g in enumerate(rounds):
+                if i == 2:
+                    assert s.propose_codec(
+                        13, {"compressor": "onebit", "ef": "vanilla"},
+                        effective_round=3)["accepted"]
+                if proxy is not None and i == 5:
+                    proxy.reset_after(1024)       # mid-blob, one-shot
+                outs.append(np.asarray(s.push_pull(13, g)))
+            st = s.transport_stats()
+        finally:
+            s.close()
+        return outs, st
+
+    ref, _ = run(ps_server())
+    with ChaosProxy("127.0.0.1", ps_server()) as proxy:
+        got, st = run(proxy.port, proxy=proxy)
+        assert st["reconnects"] >= 1, st
+    for i, (r, g) in enumerate(zip(ref, got)):
+        np.testing.assert_array_equal(r, g, err_msg=f"round {i}")
+
+
+# ---------------------------------------------------------------------------
+# fast: the tuner control loop (stub session — pure decision logic)
+# ---------------------------------------------------------------------------
+class _StubSession:
+    """Just enough PSSession surface for the Tuner: records proposals,
+    mirrors them into _compressors like the real apply path would."""
+
+    def __init__(self):
+        self._compressors = {}
+        self.proposals = []
+        self.polls = 0
+
+    def poll_codec(self):
+        self.polls += 1
+
+    def propose_codec(self, dk, kwargs, margin_rounds=2,
+                      effective_round=None):
+        self.proposals.append((dk, None if kwargs is None
+                               else dict(kwargs)))
+        if kwargs is None:
+            self._compressors.pop(dk, None)
+        else:
+            self._compressors[dk] = wire.WireCompressor(
+                {str(k): str(v) for k, v in kwargs.items()})
+        return {"accepted": True, "epoch": len(self.proposals),
+                "effective_round": 100 + len(self.proposals), "doc": {}}
+
+
+def _win(idx, cls, per_push_s=0.01, pushes=10, key="key_42"):
+    comps = {"queue": 0.0, "push_wire": 0.0, "serve": 0.0,
+             "encode": 0.0, "decode": 0.0}
+    # Put the whole budget on a component consistent with the class.
+    comp = {"wire_bound": "push_wire", "compute_bound": "encode",
+            "straggler_bound": "serve", "tiny": "queue",
+            "unhealthy": "push_wire"}[cls]
+    comps[comp] = per_push_s * pushes
+    return {"window": idx, "keys": {key: {
+        "pushes": pushes, "push_bytes": pushes << 20,
+        "components": comps, "class": cls}}}
+
+
+def test_tuner_steps_harder_after_hold():
+    tm.reset_registry()
+    sess = _StubSession()
+    t = Tuner(sess, propose=True, hold=2)
+    t.observe(_win(0, "wire_bound"))
+    assert sess.proposals == []           # one window: hysteresis holds
+    t.observe(_win(1, "wire_bound"))
+    assert sess.proposals == [(42, DIAL_KWARGS["onebit"])]
+    # Class history resets after a switch; the eval window gates next.
+    t.observe(_win(2, "wire_bound"))      # eval window: no new switch
+    t.observe(_win(3, "wire_bound"))
+    t.observe(_win(4, "wire_bound"))
+    assert sess.proposals[-1] == (42, DIAL_KWARGS["elias"])
+    st = t.state()
+    assert st["keys"]["key_42"]["codec"] == "elias"
+    assert st["switches_total"] == 2
+    assert sess.polls > 0                 # every window polls
+
+
+def test_tuner_reverts_on_regression_and_blacklists():
+    tm.reset_registry()
+    sess = _StubSession()
+    t = Tuner(sess, propose=True, hold=1, blacklist=5, regress_frac=0.2)
+    t.observe(_win(0, "wire_bound", per_push_s=0.010))
+    assert sess.proposals == [(42, DIAL_KWARGS["onebit"])]
+    # Next full window: per-push time BLEW UP -> revert + blacklist.
+    t.observe(_win(1, "wire_bound", per_push_s=0.010))   # eval window
+    t.observe(_win(2, "wire_bound", per_push_s=0.050))   # judged here
+    assert sess.proposals[-1] == (42, DIAL_KWARGS["raw"])
+    assert t.reverts_total == 1
+    st = t.state()["keys"]["key_42"]
+    assert st["codec"] == "raw"
+    assert st["blacklisted_until"] >= 2 + 5 - 1
+    # Blacklisted: wire_bound windows change nothing.
+    before = len(sess.proposals)
+    for i in range(3, 7):
+        t.observe(_win(i, "wire_bound", per_push_s=0.010))
+    assert len(sess.proposals) == before
+
+
+def test_tuner_pins_unhealthy_raw():
+    tm.reset_registry()
+    sess = _StubSession()
+    sess._compressors[42] = wire.WireCompressor(
+        {"compressor": "onebit", "ef": "vanilla"})
+    t = Tuner(sess, propose=True, hold=1)
+    t.observe(_win(0, "unhealthy"))
+    # Pinned raw immediately — no hold, the doctor's verdict trumps.
+    assert sess.proposals == [(42, None)]
+    assert t.state()["keys"]["key_42"]["pinned"]
+    # And wire pressure cannot un-pin it while blacklisted.
+    t.observe(_win(1, "wire_bound"))
+    t.observe(_win(2, "wire_bound"))
+    assert len(sess.proposals) == 1
+
+
+def test_tuner_steps_softer_and_leaves_user_codecs():
+    tm.reset_registry()
+    sess = _StubSession()
+    sess._compressors[42] = wire.WireCompressor(
+        {"compressor": "onebit", "ef": "vanilla"})
+    t = Tuner(sess, propose=True, hold=2)
+    t.observe(_win(0, "compute_bound"))
+    t.observe(_win(1, "compute_bound"))
+    assert sess.proposals == [(42, DIAL_KWARGS["raw"])]
+    # Off-dial user codec (topk): hands off, forever.
+    sess2 = _StubSession()
+    sess2._compressors[42] = wire.WireCompressor(
+        {"compressor": "topk", "k": "64"})
+    t2 = Tuner(sess2, propose=True, hold=1)
+    for i in range(4):
+        t2.observe(_win(i, "wire_bound"))
+    assert sess2.proposals == []
+    assert t2.state()["keys"]["key_42"]["codec"] == "user"
+
+
+def test_tuner_knob_proposals_are_advisory():
+    """Global-knob proposals (FUSION_BYTES & co) are surfaced and
+    logged, never applied — each knob at most once."""
+    tm.reset_registry()
+    sess = _StubSession()
+    t = Tuner(sess, propose=True, hold=1)
+    win = {"window": 0, "keys": {
+        f"k{i}": {"pushes": 5, "push_bytes": 5 * 1024,
+                  "components": {"queue": 0.01}, "class": "tiny"}
+        for i in range(4)}}
+    t.observe(win)
+    win["window"] = 1
+    t.observe(win)
+    props = t.state()["knob_proposals"]
+    assert [p["knob"] for p in props] == ["BYTEPS_TPU_FUSION_BYTES"]
+    assert props[0]["applied"] is False
+    assert props[0]["proposed"] > props[0]["current"]
+    assert sess.proposals == []          # tiny keys at raw: no switch
+
+
+def test_dial_of_mapping():
+    assert dial_of(None) == 0
+    assert dial_of(wire.WireCompressor({"compressor": "onebit"})) == 1
+    assert dial_of(wire.WireCompressor(
+        {"compressor": "dithering", "k": "15", "coding": "elias"})) == 2
+    assert dial_of(wire.WireCompressor(
+        {"compressor": "qblock", "bits": "4"})) == 3
+    assert dial_of(wire.WireCompressor(
+        {"compressor": "dithering", "k": "15"})) is None   # dense: user
+    assert [DIAL_KWARGS[d] for d in DIAL][0] is None
+
+
+# ---------------------------------------------------------------------------
+# fast: the armed loop end to end — real session, real signal windows
+# ---------------------------------------------------------------------------
+def test_tuner_live_loop_switches_real_session(ps_server):
+    """ISSUE acceptance (armed half): with the signal plane feeding real
+    per-key timers, the tuner classifies a raw medium key wire_bound and
+    renegotiates it up the dial live; the workload keeps producing
+    correct sums through every switch (single worker: raw rounds exact,
+    onebit rounds obey the EF law — cumulative sum conserved)."""
+    tm.reset_registry()
+    port = ps_server()
+    s = PSSession(["127.0.0.1"], [port], worker_id=0, num_servers=1)
+    plane = signals.arm(window_s=60.0, start_thread=False)
+    tuner = Tuner(s, propose=True, hold=2, margin_rounds=1)
+    try:
+        n = 1 << 16                        # 256 KiB: medium, never tiny
+        x = np.random.RandomState(9).randn(n).astype(np.float32)
+        total = np.zeros(n, np.float64)
+        rounds = 0
+        for _ in range(6):                 # 6 windows x 2 rounds each
+            for _ in range(2):
+                total += np.asarray(s.push_pull(21, x), np.float64)
+                rounds += 1
+            tuner.observe(plane.roll())
+        st = tuner.state()
+        # A raw key on a loopback wire is wire_bound by construction
+        # (zero codec time), so the tuner must have stepped the dial.
+        assert tuner.switches_total >= 1, st
+        (tuned_key,) = st["keys"].values()   # the one key pushed
+        assert tuned_key["codec"] != "raw" \
+            or tuner.reverts_total >= 1, st
+        assert s.codec_table()                   # renegotiated for real
+        # Correctness through every switch: EF conservation bounds the
+        # cumulative error by the LAST round's residual only.
+        comp = s._compressors.get(21)
+        resid = comp.ef_residual_norm() if comp is not None else 0.0
+        drift = np.linalg.norm(total - rounds * x.astype(np.float64))
+        assert drift <= resid + 1e-3, (drift, resid)
+    finally:
+        signals.disarm()
+        s.close()
+
+
+# ---------------------------------------------------------------------------
+# fast: unarmed wire byte-identity (the every-prior-plane contract)
+# ---------------------------------------------------------------------------
+def _stub_roundtrip(with_tuner):
+    store = {}
+
+    def handler(cmd, dt, fl, req_id, wid, key, payload):
+        if cmd == CMD_HELLO:
+            return 0, b"\x00\x00"
+        if cmd == CMD_INIT:
+            return 0, struct.pack("<Q", 0)
+        if cmd == CMD_PUSH:
+            store[key] = bytes(payload)
+            return 0, b""
+        if cmd == CMD_PULL:
+            return 0, store[key]
+        return 1, b""
+
+    srv = StubPSServer(handler, record=True)
+    try:
+        s = PSSession(["127.0.0.1"], [srv.port], worker_id=0,
+                      num_servers=1, wire_conns=1)
+        tuner = Tuner(s, propose=True, hold=2) if with_tuner else None
+        x = np.arange(256, dtype=np.float32)      # 1 KiB: class = tiny
+        for _ in range(3):
+            np.testing.assert_array_equal(s.push_pull(3, x), x)
+            if tuner is not None:
+                tuner.observe(signals.plane().roll())
+        s.close()
+        with srv.lock:
+            return list(srv.frames)
+    finally:
+        srv.close()
+
+
+def test_tuner_unarmed_and_idle_wire_byte_identity():
+    """BYTEPS_TPU_TUNER unset => the wire is byte-identical to PR 12
+    (nothing here even constructs a tuner); and an ARMED tuner whose
+    keys never warrant a switch (tiny) sends no CMD_CODEC frame either
+    — same frames, same bytes, against a recording stub."""
+    signals.arm(window_s=60.0, start_thread=False)
+    try:
+        off = _stub_roundtrip(with_tuner=False)
+    finally:
+        signals.disarm()
+    signals.arm(window_s=60.0, start_thread=False)
+    try:
+        on = _stub_roundtrip(with_tuner=True)
+    finally:
+        signals.disarm()
+    assert [h for h, _, _ in off] == [h for h, _, _ in on]
+    assert all(c != CMD_CODEC for _, c, _ in on)

@@ -65,6 +65,36 @@ def test_wire_bench_quick_smoke():
 
 
 @pytest.mark.slow
+def test_wire_bench_codec_sweep_smoke():
+    """--codec-sweep structural smoke (ISSUE 13 satellite): every dial
+    codec reports throughput + ratio at every swept size, and the
+    ratios land where the dial's documentation claims (onebit ~32x,
+    qblock8 ~4x, qblock4 ~8x)."""
+    r = subprocess.run(
+        [sys.executable, _TOOL, "--codec-sweep", "--quick", "--json"],
+        env=cpu_env(), capture_output=True, text=True, timeout=600)
+    assert r.returncode == 0, r.stderr[-3000:]
+    doc = json.loads(r.stdout)
+    rows = doc["codec_sweep"]
+    sizes = {row["size_bytes"] for row in rows}
+    assert len(sizes) >= 2
+    by = {(row["codec"], row["size_bytes"]): row for row in rows}
+    for size in sizes:
+        assert ("raw", size) in by
+        for codec in ("onebit+ef", "elias+ef", "qblock8+ef",
+                      "qblock4+ef"):
+            row = by[(codec, size)]
+            assert row["encode_MBps"] > 0 and row["decode_MBps"] > 0
+            assert row["ratio"] > 1.0
+        assert by[("onebit+ef", size)]["ratio"] == pytest.approx(
+            32.0, rel=0.05)
+        assert by[("qblock8+ef", size)]["ratio"] == pytest.approx(
+            4.0, rel=0.05)
+        assert by[("qblock4+ef", size)]["ratio"] == pytest.approx(
+            8.0, rel=0.1)
+
+
+@pytest.mark.slow
 @pytest.mark.parametrize("uds", [False, True], ids=["tcp", "uds"])
 def test_wire_bench_echo_floor_smoke(uds):
     """--echo-floor structural smoke on both transports: the bench emits

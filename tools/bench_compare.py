@@ -69,6 +69,14 @@ def _lower_is_better(metric: str, unit: str) -> bool:
         "cpu_fallback_") else unit
     if metric.endswith(("_ms", "_ns", "_s", "_seconds", "_latency")):
         return True
+    # BENCH_AUTOTUNE family: the headline is the step-time GAP between
+    # the untuned-with-tuner run and the hand-tuned config — a
+    # percentage where smaller means the tuner closed more of the gap
+    # (0 = converged to hand-tuned).  Without this, "pct" would read as
+    # higher-is-better and a converging tuner would flag as a
+    # regression.
+    if metric.endswith("_gap_pct") or unit == "pct_gap":
+        return True
     return unit in ("ms", "ns", "s", "seconds", "us")
 
 

@@ -159,6 +159,30 @@ def render(metrics: dict, prev: dict, dt: float,
                          f"{diagnosis.get('findings_total', 0)} cleared]")
         lines.append("")
 
+    # Tuner panel (BYTEPS_TPU_TUNER=1): the current wire codec per key
+    # (bps_codec_active gauge — set at every renegotiation apply) with
+    # per-key switch counts, hottest-switching first.  Absent when no
+    # key ever renegotiated.
+    active = metrics.get("bps_codec_active") or {}
+    if active:
+        switches = {dict(k).get("key"): int(v) for k, v in
+                    (metrics.get("bps_tuner_key_switches_total")
+                     or {}).items()}
+        total_sw = int(_get(metrics, "bps_tuner_switches_total"))
+        lines.append(f"tuner: {len(active)} renegotiated key(s), "
+                     f"{total_sw} switch(es) total")
+        names = {0: "raw", 1: "onebit", 2: "topk", 3: "randomk",
+                 4: "dither", 5: "qblock"}
+        ranked = sorted(active.items(),
+                        key=lambda kv: -switches.get(
+                            dict(kv[0]).get("key"), 0))
+        for key, v in ranked[:12]:
+            name = dict(key).get("key", "?")
+            lines.append(
+                f"  {name[:28]:<28} codec {names.get(int(v), '?'):<8}"
+                f" switches {switches.get(name, 0):3d}")
+        lines.append("")
+
     lines.append("latency                 p50      p95      count")
     for label, hist in (("push RTT", "bps_push_rtt_seconds"),
                         ("queue wait", "bps_dispatch_queue_wait_seconds"),

@@ -61,7 +61,66 @@ _CODECS = [
     ("dithering-elias", {"compressor": "dithering", "k": "15",
                          "coding": "elias"}),
     ("topk", {"compressor": "topk", "k": "4096"}),
+    ("qblock8", {"compressor": "qblock", "bits": "8", "block": "256"}),
+    ("qblock4+ef", {"compressor": "qblock", "bits": "4", "block": "256",
+                    "ef": "vanilla"}),
 ]
+
+# The adaptive-compression dial (common/tuner.py DIAL) for --codec-sweep:
+# the sweep is the tuner's cost-model ground truth — per-codec
+# encode/decode throughput and compression ratio across the real
+# partition-size range, so the dial's "step harder under wire pressure"
+# direction can be sanity-checked against measured numbers.
+_SWEEP_CODECS = [
+    ("onebit+ef", {"compressor": "onebit", "ef": "vanilla"}),
+    ("elias+ef", {"compressor": "dithering", "k": "15",
+                  "coding": "elias", "ef": "vanilla"}),
+    ("qblock8+ef", {"compressor": "qblock", "bits": "8", "block": "256",
+                    "ef": "vanilla"}),
+    ("qblock4+ef", {"compressor": "qblock", "bits": "4", "block": "256",
+                    "ef": "vanilla"}),
+]
+
+
+def codec_sweep(sizes_bytes, reps: int) -> list:
+    """Per-(codec, size) encode/decode throughput + ratio table — the
+    tuner's cost-model seed (``--codec-sweep``).  Sizes are partition
+    payload bytes (f32 elements = bytes/4), spanning the fusion floor
+    (64 KiB) to the 16 MiB receive-pool ceiling."""
+    out = []
+    for nbytes in sizes_bytes:
+        n = nbytes // 4
+        x = _gradient(n)
+        raw_row = {"codec": "raw", "size_bytes": nbytes,
+                   "encode_MBps": None, "decode_MBps": None, "ratio": 1.0}
+        out.append(raw_row)
+        for name, kw in _SWEEP_CODECS:
+            wc = wire.WireCompressor(dict(kw))
+            blob = wc.encode(1, x)                 # warm (+ EF state)
+            t0 = time.perf_counter()
+            for _ in range(reps):
+                blob = wc.encode(1, x)
+            enc = (time.perf_counter() - t0) / reps
+            wire.decode(blob, n)                   # warm
+            t0 = time.perf_counter()
+            for _ in range(reps):
+                wire.decode(blob, n)
+            dec = (time.perf_counter() - t0) / reps
+            row = {
+                "codec": name,
+                "size_bytes": nbytes,
+                "encode_MBps": round(x.nbytes / enc / 1e6, 1),
+                "decode_MBps": round(x.nbytes / dec / 1e6, 1),
+                "ratio": round(x.nbytes / len(blob), 2),
+                "wire_bytes": len(blob),
+                "native": wire._c_wire() is not None,
+            }
+            out.append(row)
+            _log(f"  {nbytes >> 10:6d} KiB  {name:12s} "
+                 f"enc {row['encode_MBps']:8.1f} MB/s   "
+                 f"dec {row['decode_MBps']:8.1f} MB/s   "
+                 f"{row['ratio']:6.1f}x")
+    return out
 
 
 def _log(msg: str) -> None:
@@ -532,6 +591,11 @@ def main(argv=None) -> int:
     ap.add_argument("--fusion-leaves", type=int, default=None,
                     help="leaf count for the fusion A/B (default 512, "
                          "128 with --quick)")
+    ap.add_argument("--codec-sweep", action="store_true",
+                    help="run only the per-codec encode/decode "
+                         "throughput + ratio sweep across partition "
+                         "sizes (64 KiB - 16 MiB) — the adaptive-"
+                         "compression tuner's cost-model ground truth")
     args = ap.parse_args(argv)
 
     quick = args.quick
@@ -540,6 +604,20 @@ def main(argv=None) -> int:
     mb = args.mb if args.mb is not None else (8.0 if quick else 32.0)
     part_kb = args.part_kb or (512 if quick else 1024)
     rounds = args.rounds or (9 if quick else 15)
+
+    if args.codec_sweep:
+        sizes = ([64 << 10, 1 << 20] if quick
+                 else [64 << 10, 256 << 10, 1 << 20, 4 << 20, 16 << 20])
+        sweep_reps = 2 if quick else 5
+        _log(f"wire_bench: codec sweep ({len(sizes)} sizes x "
+             f"{len(_SWEEP_CODECS)} codecs, {sweep_reps} reps)")
+        sweep = codec_sweep(sizes, sweep_reps)
+        doc = {"codec_sweep": sweep,
+               "config": {"quick": quick, "cpus": os.cpu_count(),
+                          "native": wire._c_wire() is not None}}
+        if args.json:
+            print(json.dumps(doc, indent=1))
+        return 0
 
     if args.echo_floor:
         # The acceptance workload: 4 MiB partitions, raw f32, same-host
