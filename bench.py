@@ -52,6 +52,12 @@ Modes:
                      autotune_step_time_gap_pct (target: within a few %)
                      plus switch counts and the per-key final codec
                      assignments
+  BENCH_SERVEROPT=1  server-resident-optimizer bench: the same Adam
+                     workload with the update stage on the PS tier
+                     (push grads, pull params) vs worker-local optax;
+                     emits serveropt_step_time_gap_pct plus the
+                     structural detail (worker optimizer-state bytes ->
+                     0 in server mode, param_version == rounds)
   BENCH_TELEMETRY=1  telemetry-overhead bench: sync-round time with the
                      metrics endpoint scraped at 20Hz vs export plane off
                      (emits telemetry_overhead_ms; expected within noise)
@@ -1475,6 +1481,106 @@ def bench_autotune():
         proc.wait()
 
 
+def bench_serveropt():
+    """Server-resident-optimizer benchmark (BENCH_SERVEROPT=1): step
+    time and per-worker optimizer-state bytes, server-side update stage
+    vs the worker-local optax baseline, on the same workload — the
+    ISSUE-14 headline.
+
+    Workload: one ~4.2 MB flat Adam-trained parameter vector (two 2 MB
+    "layers" + a 16 KiB bias, flattened — the BENCH_AUTOTUNE key mix),
+    synchronous rounds against the real native server over loopback.
+    LOCAL pulls the gradient sum and runs optax here (N workers would
+    each hold the full m/v slots and run the identical step N times);
+    SERVER pushes the same gradients and pulls post-update parameters
+    (CMD_OPT — the slots live in the server's KeyState, once).
+    `serveropt_step_time_gap_pct` = (server - local) / local * 100;
+    lower is better, and the structural win is in the detail:
+    `worker_opt_state_bytes` collapses to 0 in server mode while
+    `server_opt_slot_bytes` picks the state up exactly once, and
+    `param_version` == rounds proves exactly-one update.  Host-only
+    honesty: on a 2-core loopback container the wire round trip
+    dominates and the eliminated local optax pass can land within
+    noise — the number being measured is the redundancy moved, not a
+    loopback speedup.
+    """
+    import numpy as np
+
+    from byteps_tpu.parallel.server_opt import ServerOptTrainer
+    from byteps_tpu.server.client import PSSession
+
+    reps = int(os.environ.get("BENCH_SERVEROPT_REPS", "30"))
+    rng = np.random.default_rng(0)
+    params = {"layer_a": rng.standard_normal(1 << 19, dtype=np.float32),
+              "layer_b": rng.standard_normal(1 << 19, dtype=np.float32),
+              "bias": rng.standard_normal(1 << 12, dtype=np.float32)}
+    grads = {k: rng.standard_normal(v.shape, dtype=np.float32)
+             for k, v in params.items()}
+    kw = {"opt": "adam", "lr": 1e-3}
+
+    results = {}
+    for mode in ("local", "server"):
+        proc, port = _boot_ps_server(engine_threads=2)
+        try:
+            sess = PSSession(["127.0.0.1"], [port], worker_id=0,
+                             num_servers=1)
+            tr = ServerOptTrainer(sess, params, kw,
+                                  name=f"bench_{mode}", mode=mode)
+            for _ in range(6):
+                tr.step(grads)                      # settle
+            times = []
+            for _ in range(reps):
+                t0 = time.perf_counter()
+                tr.step(grads)
+                times.append(time.perf_counter() - t0)
+            med = sorted(times)[len(times) // 2]
+            st = sess.server_stats()
+            results[mode] = {
+                "step_ms": med * 1e3,
+                "worker_opt_state_bytes": tr.opt_state_bytes(),
+                "server_opt_slot_bytes": int(st.get("opt_slot_bytes",
+                                                    0)),
+                "opt_updates": int(st.get("opt_updates", 0)),
+                "rounds": tr.rounds,
+                "param_version": max(
+                    [int(d.get("param_version", 0))
+                     for d in tr.server_docs().values()] or [0]),
+            }
+            sess.close()
+        finally:
+            proc.kill()
+            proc.wait()
+
+    loc, srv = results["local"], results["server"]
+    gap_pct = (srv["step_ms"] - loc["step_ms"]) / loc["step_ms"] * 100.0
+    print(json.dumps({
+        "metric": "serveropt_step_time_gap_pct",
+        "value": round(gap_pct, 2),
+        "unit": "pct_gap",
+        "vs_baseline": round(srv["step_ms"] / loc["step_ms"], 3),
+        "detail": {
+            "local_step_ms": round(loc["step_ms"], 3),
+            "server_step_ms": round(srv["step_ms"], 3),
+            "local_worker_opt_state_bytes":
+                loc["worker_opt_state_bytes"],
+            "server_worker_opt_state_bytes":
+                srv["worker_opt_state_bytes"],
+            "server_opt_slot_bytes": srv["server_opt_slot_bytes"],
+            "server_param_version": srv["param_version"],
+            "server_rounds": srv["rounds"],
+            "reps": reps,
+            "note": "value = (server-resident - worker-local) / "
+                    "worker-local Adam step time in %; the structural "
+                    "claim is worker_opt_state_bytes -> 0 in server "
+                    "mode (slots live once, server-side) and "
+                    "param_version == rounds (exactly-one update). "
+                    "Loopback on a small host can put both within "
+                    "noise — the redundancy moved is the headline",
+            **_note(),
+        },
+    }))
+
+
 def bench_trace():
     """Tracing-overhead benchmark: sync-round time with the distributed
     tracer HOT (worker span recording + traced wire flags + server-side
@@ -1922,6 +2028,8 @@ def main():
         bench_audit()        # host-only: no device backend involved
     elif os.environ.get("BENCH_DOCTOR", "0") == "1":
         bench_doctor()       # host-only: no device backend involved
+    elif os.environ.get("BENCH_SERVEROPT", "0") == "1":
+        bench_serveropt()    # host-only: no device backend involved
     elif os.environ.get("BENCH_AUTOTUNE", "0") == "1":
         bench_autotune()     # host-only: no device backend involved
     elif os.environ.get("BENCH_CNN", ""):

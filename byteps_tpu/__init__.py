@@ -27,6 +27,7 @@ from .common.api import (
     mark_step, current_step,
 )
 from .parallel.async_ps import AsyncPSTrainer
+from .parallel.server_opt import ServerOptTrainer
 from .ops.compression import Compression
 from .ops import collectives
 from .parallel.data_parallel import (
@@ -64,7 +65,7 @@ __all__ = [
     "get_ring", "drain_ps_server",
     "declare", "declared_key", "register_compressor", "get_ps_session",
     "push_pull", "push_pull_async", "push_pull_tree", "synchronize",
-    "poll", "AsyncPSTrainer",
+    "poll", "AsyncPSTrainer", "ServerOptTrainer",
     "broadcast_parameters", "broadcast_optimizer_state",
     "get_pushpull_speed", "get_codec_stats", "get_fusion_stats",
     "get_transport_stats", "get_metrics", "get_server_stats",

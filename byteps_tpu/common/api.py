@@ -1044,6 +1044,10 @@ def get_server_stats() -> dict:
         # bps_keys_owned so every scrape can tell a dead or draining
         # server from a slow one.  Old servers omit these keys.
         telemetry.update_ring(stats)
+    # Server-resident optimizer plane: bps_param_version{key=} +
+    # bps_opt_slot_bytes{server=}.  Quiet (no gauges registered) unless
+    # some key actually runs a server-side update stage.
+    telemetry.update_server_opt(stats)
     return stats
 
 

@@ -1,0 +1,63 @@
+"""JAX version compatibility shims.
+
+One module owns every "where does JAX keep this today" decision, so a
+JAX upgrade (or downgrade) is a one-file change instead of a grep
+across the tree.
+
+``shard_map`` is the one that matters right now: new JAX exposes it as
+``jax.shard_map`` with a ``check_vma`` kwarg; 0.4.x keeps it at
+``jax.experimental.shard_map.shard_map`` where the same knob is called
+``check_rep``.  Every call site in this repo routes through
+:func:`shard_map` below, which resolves the best available
+implementation once at import and translates the kwarg.
+"""
+
+from __future__ import annotations
+
+import jax
+
+# Resolved once: the public binding when this JAX has it, else the
+# experimental one (present since 0.4.x).  getattr-based so importing
+# this module never hard-fails on either side of the move.
+_PUBLIC = getattr(jax, "shard_map", None)
+if _PUBLIC is None:
+    from jax.experimental.shard_map import shard_map as _EXPERIMENTAL
+else:
+    _EXPERIMENTAL = None
+
+
+def tree_flatten_with_path(tree, is_leaf=None):
+    """``jax.tree.flatten_with_path`` where it exists; the
+    ``jax.tree_util.tree_flatten_with_path`` spelling on 0.4.x."""
+    fn = getattr(jax.tree, "flatten_with_path", None)
+    if fn is None:
+        fn = jax.tree_util.tree_flatten_with_path
+    return fn(tree, is_leaf=is_leaf)
+
+
+def axis_size(axis_name):
+    """``jax.lax.axis_size`` where it exists; on 0.4.x the classic
+    ``psum(1, axis)`` spelling, which JAX constant-folds to the mapped
+    axis size at trace time (so ``range(axis_size(...))``-style Python
+    control flow keeps working on both paths)."""
+    from jax import lax
+    fn = getattr(lax, "axis_size", None)
+    if fn is not None:
+        return fn(axis_name)
+    return lax.psum(1, axis_name)
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma=None, **kwargs):
+    """``jax.shard_map`` on new JAX, ``jax.experimental.shard_map`` on
+    0.4.x — with ``check_vma`` translated to the old ``check_rep``
+    spelling when the fallback is in use.  ``check_vma=None`` leaves
+    the implementation's default in place on both paths."""
+    if _PUBLIC is not None:
+        if check_vma is not None:
+            kwargs["check_vma"] = check_vma
+        return _PUBLIC(f, mesh=mesh, in_specs=in_specs,
+                       out_specs=out_specs, **kwargs)
+    if check_vma is not None:
+        kwargs["check_rep"] = check_vma
+    return _EXPERIMENTAL(f, mesh=mesh, in_specs=in_specs,
+                         out_specs=out_specs, **kwargs)

@@ -81,6 +81,12 @@ DEFAULT_THRESHOLDS = {
     # wire/compute boundary).
     "tuner_thrash_windows": 6,
     "tuner_thrash_switches": 2,
+    # param_version_stall: an opt-armed key's completed_round grew while
+    # its param_version did not, for this many consecutive windows — the
+    # server-resident update stage is wedged or misconfigured (params
+    # never seeded, a gradient/params length mismatch, or a mode switch
+    # that silently reverted to sums).
+    "param_stall_windows": 2,
 }
 
 _SERIES_RE = re.compile(r'^([A-Za-z_:][A-Za-z0-9_:]*)\{(.*)\}$')
@@ -449,6 +455,73 @@ def _r_tuner_thrash(ctx: RuleCtx) -> List[dict]:
     return out
 
 
+def _r_param_version_stall(ctx: RuleCtx) -> List[dict]:
+    """Server-resident optimizer wedge: a key whose rounds keep
+    completing (completed_round grows) while its param_version does not
+    — the update stage stopped publishing parameters (unseeded params,
+    a gradient/params length mismatch, or a silent revert to sums).
+    Reads the CMD_STATS server section both modes carry, so the offline
+    bundle replay fires identically (and stays quiet when the section
+    is absent)."""
+    need = int(ctx.th["param_stall_windows"])
+    if len(ctx.windows) < need + 1:
+        return []
+    wins = ctx.windows[-(need + 1):]
+
+    def _opt_rows(window: dict) -> Dict[str, dict]:
+        # Live windows carry the minimal `opt_keys` slice (signals.py
+        # strips the full per-key map); raw CMD_STATS payloads (offline
+        # replays, tests) carry `keys` — read both.
+        sec = window.get("server") or {}
+        out: Dict[str, dict] = {}
+        for src in (sec.get("opt_keys"), sec.get("keys")):
+            for k, row in (src or {}).items():
+                if isinstance(row, dict) and int(row.get("opt_mode", 0)):
+                    out.setdefault(str(k), row)
+        return out
+
+    newest = _opt_rows(wins[-1])
+    if not newest:
+        return []
+    out = []
+    for k, row in sorted(newest.items()):
+        stalled = 0
+        for prev, cur in zip(wins, wins[1:]):
+            pr = _opt_rows(prev).get(k)
+            cr = _opt_rows(cur).get(k)
+            if pr is None or cr is None:
+                break
+            dr = int(cr.get("completed_round", 0)) \
+                - int(pr.get("completed_round", 0))
+            dv = int(cr.get("param_version", 0)) \
+                - int(pr.get("param_version", 0))
+            if dr > 0 and dv <= 0:
+                stalled += 1
+            else:
+                break
+        if stalled < need:
+            continue
+        out.append({
+            "subject": f"key={k}",
+            "message": (f"key {k} completed "
+                        f"{int(row.get('completed_round', 0))} rounds "
+                        f"but param_version sits at "
+                        f"{int(row.get('param_version', 0))} for "
+                        f"{stalled} consecutive windows: the "
+                        f"server-resident update stage is wedged or "
+                        f"mode-mismatched — check the server log for "
+                        f"unseeded-params / length-mismatch warnings "
+                        f"and the CMD_OPT doc (fetch_opt_docs)"),
+            "evidence": {"key": k,
+                         "completed_round":
+                             int(row.get("completed_round", 0)),
+                         "param_version":
+                             int(row.get("param_version", 0)),
+                         "opt_mode": int(row.get("opt_mode", 0)),
+                         "stalled_windows": stalled}})
+    return out
+
+
 def _r_barrier_stall(ctx: RuleCtx) -> List[dict]:
     trips = ctx.delta("bps_transport_watchdog_trips")
     barrier = ctx.events("barrier_timeout")
@@ -497,6 +570,9 @@ RULES: List[Rule] = [
     Rule("tuner_thrash", SEV_WARN,
          "the adaptive-compression tuner keeps flipping a key's codec",
          _r_tuner_thrash),
+    Rule("param_version_stall", SEV_ERROR,
+         "a server-resident optimizer key stopped publishing updates",
+         _r_param_version_stall),
 ]
 
 RULE_IDS = tuple(r.id for r in RULES)

@@ -286,8 +286,24 @@ class SignalPlane:
                 # per-worker tables — a thousand-key model would
                 # otherwise ship its whole CMD_STATS payload in every
                 # retained window, bundle, and /signals response.
+                # EXCEPTION: server-resident-optimizer rows (opt_mode
+                # != 0) survive as a minimal `opt_keys` slice — the
+                # param_version_stall rule needs completed_round vs
+                # param_version per armed key, and armed keys are the
+                # model's few declared tensors, not the key space.
+                opt_keys = {
+                    str(k): {"completed_round":
+                                 int(row.get("completed_round", 0)),
+                             "param_version":
+                                 int(row.get("param_version", 0)),
+                             "opt_mode": int(row.get("opt_mode", 0))}
+                    for k, row in (server.get("keys") or {}).items()
+                    if isinstance(row, dict)
+                    and int(row.get("opt_mode", 0))}
                 server = {k: v for k, v in server.items()
                           if k not in ("keys", "workers", "members")}
+                if opt_keys:
+                    server["opt_keys"] = opt_keys
         sections: Dict[str, dict] = {}
         for name, fn in self._providers.items():
             try:

@@ -461,6 +461,41 @@ def update_ring(server_stats: dict, registry: Optional[MetricsRegistry]
                       ).set(int(rec.get(f"migrations_{direction}", 0)))
 
 
+def update_server_opt(server_stats: dict,
+                      registry: Optional[MetricsRegistry] = None) -> None:
+    """Fold the server-resident optimizer plane from a merged CMD_STATS
+    payload into the registry gauges.
+
+    Exports ``bps_param_version{key=}`` (published optimizer updates per
+    key — a key whose completed_round grows while this stalls has a
+    wedged or misconfigured update stage, doctor rule
+    ``param_version_stall``) and ``bps_opt_slot_bytes{server=}`` (bytes
+    of server-owned optimizer slots: params + m + v — the state that no
+    longer lives N times on the workers).  Quiet for sum-only runs: no
+    key carries an opt mode, so no gauge is registered and the snapshot
+    is unchanged."""
+    reg = registry or get_registry()
+    for k, row in (server_stats.get("keys") or {}).items():
+        if not isinstance(row, dict) or not int(row.get("opt_mode", 0)):
+            continue
+        reg.gauge("bps_param_version",
+                  help="server-resident optimizer updates published for "
+                       "this key (exactly one per completed round)",
+                  labels={"key": str(k)}).set(
+                      int(row.get("param_version", 0)))
+    for sid, rec in (server_stats.get("servers") or {}).items():
+        if not isinstance(rec, dict) or "opt_slot_bytes" not in rec:
+            continue
+        if int(rec.get("opt_slot_bytes", 0)) == 0 \
+                and not int(server_stats.get("opt_updates", 0)):
+            continue
+        reg.gauge("bps_opt_slot_bytes",
+                  help="bytes of server-owned optimizer slots "
+                       "(params + m + v) held by this server",
+                  labels={"server": str(sid)}).set(
+                      int(rec.get("opt_slot_bytes", 0)))
+
+
 def update_round_lag(server_stats: dict, straggler_rounds: int,
                      registry: Optional[MetricsRegistry] = None
                      ) -> Dict[int, int]:

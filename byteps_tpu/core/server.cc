@@ -163,6 +163,28 @@ enum Cmd : uint8_t {
                  // thread (the table is per-key engine-owned state, like
                  // the round it gates).  Old servers answer kError via
                  // the engine default arm — "server too old".
+  kOpt = 18,     // server-resident optimizer plane (CMD_OPT): per-key
+                 // epoch-versioned optimizer declaration, modeled on the
+                 // CMD_CODEC renegotiation law.  flags bit0 = SET
+                 // (payload: u32 epoch | u64 effective_round | u32 klen |
+                 // kwargs, e.g. "opt=adam,lr=0.001,..."; "" = off):
+                 // applied only when the proposed epoch is NEWER than the
+                 // key's current one (racing proposers converge), taking
+                 // effect at the first round boundary with
+                 // completed_round >= effective_round — no round ever
+                 // mixes update modes (a round publishes EITHER the sum
+                 // OR the post-update parameters, decided atomically at
+                 // its publish).  flags bit1 = PARAM SEED (payload: raw
+                 // f32 initial parameters): applied only while the key
+                 // holds no params — idempotent across racing workers
+                 // shipping the same broadcast weights, and harmless
+                 // after a migration installed state.  GET (no flag bits)
+                 // and both writes answer the authoritative opt JSON doc
+                 // (epoch/pending/param_version/slots_crc...).  Engine
+                 // thread (the table and the slots are per-key
+                 // engine-owned state, exactly like the codec table).
+                 // Old servers answer kError via the engine default arm —
+                 // "server too old".
 };
 
 // Request `dtype` marker on PULL frames: the worker asks for the 24-byte
@@ -1305,6 +1327,45 @@ struct KeyState {
   uint8_t pull_comp = 1;        // codec::kOnebit
   uint8_t qblock_bits = 8;
   uint16_t qblock_block = 256;
+  // --- server-resident optimizer plane (CMD_OPT; engine-owned) ----------
+  // Epoch-versioned like the codec table above: `opt_epoch` 0 = the
+  // plane is unarmed and NOTHING below is consulted — an undeclared run
+  // publishes sums and stays wire byte-identical.  While `opt_pending`,
+  // `opt_next` holds the proposed kwargs ("" = off) that take effect at
+  // the first round boundary with completed_round >= opt_effective, so
+  // no round ever mixes update modes.  Once a mode is ACTIVE, every
+  // publish runs merge -> optimizer step -> publish *parameters*
+  // (OptUpdateStage): the optimizer consumes exactly the bytes a
+  // sum-mode pull would have served (codec/EF law untouched), updates
+  // the server-owned slots below, and replaces `out` with the updated
+  // params.  param_version increments exactly once per update — the
+  // exactly-one-update proof replays and migrations are audited against.
+  uint32_t opt_epoch = 0;
+  uint32_t opt_applied_epoch = 0;
+  bool opt_pending = false;
+  uint64_t opt_effective = 0;
+  std::string opt_next;         // pending kwargs
+  std::string opt_kwargs;       // active kwargs ("" = off)
+  uint8_t opt_kind = 0;         // 0 off, 1 sgd, 2 momentum, 3 adam
+  // Hyperparams kept as the DOUBLES the kwargs decimals parse to (the
+  // same f64 the worker-local optax baseline holds); every update-stage
+  // constant derives from them with optax's exact rounding, e.g.
+  // (float)(1.0 - b1) — f32-parity depends on this.
+  double opt_lr = 0.01, opt_mu = 0.9, opt_b1 = 0.9, opt_b2 = 0.999,
+         opt_eps = 1e-8, opt_gscale = 1.0;
+  std::vector<float> params;    // the authoritative weights
+  std::vector<float> opt_m;     // momentum trace / Adam first moment
+  std::vector<float> opt_v;     // Adam second moment
+  uint64_t opt_step = 0;        // optimizer step count (Adam bias corr,
+                                // mirrors optax safe_int32_increment)
+  uint64_t param_version = 0;   // ++ per published optimizer update
+  uint64_t opt_slot_acc = 0;    // bytes last accounted to opt_slot_bytes_
+  bool opt_warned = false;      // one unseeded-params warning per key
+  // Update-stage gradient scratch, reused round to round (a fresh
+  // zero-filled vector per publish would put an alloc + full-buffer
+  // memset on the engine's critical path).  Transient — never rides
+  // CMD_MIGRATE.
+  std::vector<float> opt_scratch;
 };
 
 struct Task {
@@ -1854,6 +1915,8 @@ class Server {
                                   // waits on)
     uint64_t pending_pulls = 0;   // pulls parked for an unpublished round
     uint64_t bytes = 0;           // wire payload bytes pushed
+    uint64_t param_version = 0;   // server-opt: published update count
+    uint8_t opt_mode = 0;         // server-opt: active optimizer (0=off)
   };
   struct WorkerStat {
     uint64_t pushes = 0;  // accepted merges from this worker
@@ -1887,6 +1950,13 @@ class Server {
     ks.round_pushes = 0;   // fresh round: no one has pushed into it yet
   }
 
+  void StatOpt(uint64_t key, uint64_t param_version, uint8_t opt_mode) {
+    std::lock_guard<std::mutex> lk(stats_mu_);
+    KeyStat& ks = key_stats_[key];
+    ks.param_version = param_version;
+    ks.opt_mode = opt_mode;
+  }
+
   void StatPendingPulls(uint64_t key, int64_t delta) {
     std::lock_guard<std::mutex> lk(stats_mu_);
     uint64_t& p = key_stats_[key].pending_pulls;
@@ -1898,7 +1968,7 @@ class Server {
     // Worst-case row: the header now carries ~13 numeric fields at up
     // to 20 digits + ~270 chars of labels — keep comfortable headroom
     // (snprintf truncation would silently corrupt the JSON).
-    char buf[640];
+    char buf[832];
     std::string js;
     js.reserve(4096);
     const uint64_t keys_owned = ring_armed_ ? KeysOwned() : 0;
@@ -1910,7 +1980,9 @@ class Server {
                   "\"draining\":%d,\"keys_owned\":%llu,"
                   "\"migrations_in\":%llu,\"migrations_out\":%llu,"
                   "\"moved_frames\":%llu,\"codec_sets\":%llu,"
-                  "\"codec_stale_frames\":%llu,\"keys\":{",
+                  "\"codec_stale_frames\":%llu,\"opt_sets\":%llu,"
+                  "\"opt_updates\":%llu,\"opt_slot_bytes\":%llu,"
+                  "\"keys\":{",
                   static_cast<unsigned long long>(
                       bytes_in_.load(std::memory_order_relaxed)),
                   static_cast<unsigned long long>(
@@ -1936,7 +2008,13 @@ class Server {
                   static_cast<unsigned long long>(
                       codec_sets_.load(std::memory_order_relaxed)),
                   static_cast<unsigned long long>(
-                      codec_stale_.load(std::memory_order_relaxed)));
+                      codec_stale_.load(std::memory_order_relaxed)),
+                  static_cast<unsigned long long>(
+                      opt_sets_.load(std::memory_order_relaxed)),
+                  static_cast<unsigned long long>(
+                      opt_updates_.load(std::memory_order_relaxed)),
+                  static_cast<unsigned long long>(
+                      opt_slot_bytes_.load(std::memory_order_relaxed)));
     js += buf;
     std::lock_guard<std::mutex> lk(stats_mu_);
     bool first = true;
@@ -1944,7 +2022,8 @@ class Server {
       std::snprintf(buf, sizeof(buf),
                     "%s\"%llu\":{\"pushes\":%llu,\"merges\":%llu,"
                     "\"completed_round\":%llu,\"round_pushes\":%llu,"
-                    "\"pending_pulls\":%llu,\"bytes\":%llu}",
+                    "\"pending_pulls\":%llu,\"bytes\":%llu,"
+                    "\"param_version\":%llu,\"opt_mode\":%u}",
                     first ? "" : ",",
                     static_cast<unsigned long long>(kv.first),
                     static_cast<unsigned long long>(kv.second.pushes),
@@ -1955,7 +2034,10 @@ class Server {
                         kv.second.round_pushes),
                     static_cast<unsigned long long>(
                         kv.second.pending_pulls),
-                    static_cast<unsigned long long>(kv.second.bytes));
+                    static_cast<unsigned long long>(kv.second.bytes),
+                    static_cast<unsigned long long>(
+                        kv.second.param_version),
+                    static_cast<unsigned>(kv.second.opt_mode));
       js += buf;
       first = false;
     }
@@ -2670,6 +2752,36 @@ class Server {
     put(ks.codec_next.data(), nklen);
     uint8_t fold = ks.ef_fold_pending ? 1 : 0;
     put(&fold, 1);
+    // Optimizer-plane trailer (appended AFTER the codec trailer, same
+    // version-tolerance law: pre-subsystem receivers parse positionally
+    // and ignore trailing bytes; pre-subsystem SENDERS simply omit it
+    // and the receiver's remaining()-based parse leaves every opt field
+    // at its reset default).  A migrated key's new owner continues the
+    // exact optimizer trajectory: table epoch, hyperparams, params and
+    // m/v slots, step count, and param_version all ride along —
+    // byte-equal, which the chaos tests assert through slots_crc.
+    put(&ks.opt_epoch, 4);
+    put(&ks.opt_applied_epoch, 4);
+    uint8_t opend = ks.opt_pending ? 1 : 0;
+    put(&opend, 1);
+    put(&ks.opt_effective, 8);
+    uint32_t oklen = static_cast<uint32_t>(ks.opt_kwargs.size());
+    put(&oklen, 4);
+    put(ks.opt_kwargs.data(), oklen);
+    uint32_t onlen = static_cast<uint32_t>(ks.opt_next.size());
+    put(&onlen, 4);
+    put(ks.opt_next.data(), onlen);
+    put(&ks.param_version, 8);
+    put(&ks.opt_step, 8);
+    uint64_t fn = ks.params.size();
+    put(&fn, 8);
+    put(ks.params.data(), fn * 4);
+    fn = ks.opt_m.size();
+    put(&fn, 8);
+    put(ks.opt_m.data(), fn * 4);
+    fn = ks.opt_v.size();
+    put(&fn, 8);
+    put(ks.opt_v.data(), fn * 4);
     return out;
   }
 
@@ -2753,6 +2865,30 @@ class Server {
     ks.pull_comp = codec::kOnebit;
     ks.qblock_bits = 8;
     ks.qblock_block = 256;
+    // Optimizer plane rode the migration blob (table, params, slots,
+    // param_version); the retired copy resets like the codec table so a
+    // later ownership return re-seeds from CMD_OPT, never a stale epoch
+    // — and releases the slot memory it was accounting.
+    ks.opt_epoch = 0;
+    ks.opt_applied_epoch = 0;
+    ks.opt_pending = false;
+    ks.opt_effective = 0;
+    ks.opt_next.clear();
+    ks.opt_kwargs.clear();
+    ks.opt_kind = 0;
+    ks.params.clear();
+    ks.params.shrink_to_fit();
+    ks.opt_m.clear();
+    ks.opt_m.shrink_to_fit();
+    ks.opt_v.clear();
+    ks.opt_v.shrink_to_fit();
+    ks.opt_scratch.clear();
+    ks.opt_scratch.shrink_to_fit();
+    ks.opt_step = 0;
+    ks.param_version = 0;
+    ks.opt_warned = false;
+    OptSlotAccount(ks);
+    StatOpt(key, 0, 0);
     ks.active.store(false, std::memory_order_relaxed);
     // Drop the migrated key's digest window too: the new owner records
     // fresh digests from its next publish, and a stale window here
@@ -2951,6 +3087,73 @@ class Server {
       pos += nklen;
       if (take(&fold, 1)) ks.ef_fold_pending = fold != 0;
     }
+    // Optimizer-plane trailer (absent from pre-subsystem senders: the
+    // reset defaults below then hold and the key behaves exactly as a
+    // sum-only key — version-tolerant by the same remaining()-based
+    // parse as the codec trailer above).
+    ks.opt_epoch = 0;
+    ks.opt_applied_epoch = 0;
+    ks.opt_pending = false;
+    ks.opt_effective = 0;
+    ks.opt_next.clear();
+    ks.opt_kwargs.clear();
+    ks.opt_kind = 0;
+    ks.params.clear();
+    ks.opt_m.clear();
+    ks.opt_v.clear();
+    ks.opt_step = 0;
+    ks.param_version = 0;
+    ks.opt_warned = false;
+    {
+      uint32_t oep = 0, oaep = 0, oklen = 0;
+      uint8_t opend = 0;
+      uint64_t oeff = 0;
+      if (take(&oep, 4) && take(&oaep, 4) && take(&opend, 1) &&
+          take(&oeff, 8) && take(&oklen, 4) && oklen <= remaining()) {
+        std::string okw(p.data() + pos, oklen);
+        pos += oklen;
+        uint32_t onlen = 0;
+        uint64_t pv = 0, ostep = 0, pn = 0, mn = 0, vn = 0;
+        if (take(&onlen, 4) && onlen <= remaining()) {
+          std::string onext(p.data() + pos, onlen);
+          pos += onlen;
+          if (take(&pv, 8) && take(&ostep, 8) &&
+              take(&pn, 8) && pn <= remaining() / 4) {
+            size_t pn_at = pos;
+            pos += static_cast<size_t>(pn) * 4;
+            if (take(&mn, 8) && mn <= remaining() / 4) {
+              size_t mn_at = pos;
+              pos += static_cast<size_t>(mn) * 4;
+              if (take(&vn, 8) && vn <= remaining() / 4) {
+                ks.opt_epoch = oep;
+                ks.opt_applied_epoch = oaep;
+                ks.opt_pending = opend != 0;
+                ks.opt_effective = oeff;
+                ks.opt_next = std::move(onext);
+                ApplyOptKwargs(ks, okw);   // sets kind + hyperparams
+                ks.param_version = pv;
+                ks.opt_step = ostep;
+                ks.params.resize(pn);
+                if (pn)
+                  std::memcpy(ks.params.data(), p.data() + pn_at,
+                              static_cast<size_t>(pn) * 4);
+                ks.opt_m.resize(mn);
+                if (mn)
+                  std::memcpy(ks.opt_m.data(), p.data() + mn_at,
+                              static_cast<size_t>(mn) * 4);
+                ks.opt_v.resize(vn);
+                if (vn)
+                  std::memcpy(ks.opt_v.data(), p.data() + pos,
+                              static_cast<size_t>(vn) * 4);
+                pos += static_cast<size_t>(vn) * 4;
+              }
+            }
+          }
+        }
+      }
+    }
+    OptSlotAccount(ks);
+    StatOpt(t.key, ks.param_version, ks.opt_kind);
     ks.merge_ts.clear();
     ks.push_count.store(pushes, std::memory_order_relaxed);
     ks.declared_len.store(declared, std::memory_order_release);
@@ -3434,6 +3637,7 @@ class Server {
           break;
         case kMigrate: HandleMigrate(t); break;
         case kCodec: HandleCodec(t); break;
+        case kOpt: HandleOpt(t); break;
         default: Respond(t.conn, kError, t.req_id, t.key, nullptr, 0);
       }
       // The task's hold ends here (a deferred pull took its OWN ref in
@@ -3676,6 +3880,307 @@ class Server {
     Respond(t.conn, kOk, t.req_id, t.key, js.data(), js.size());
   }
 
+  // -- server-resident optimizer plane (CMD_OPT) --------------------------
+  // "k=v" double lookup, the float sibling of KwInt: strtod yields the
+  // SAME f64 the worker-local optax baseline holds for the hyperparam
+  // (Python repr round-trips through strtod exactly), so every f32
+  // constant the update stage derives matches optax's rounding.
+  static double KwFloat(const std::string& kw, const char* name,
+                        double dflt) {
+    std::string pat = std::string(name) + "=";
+    size_t at = kw.find(pat);
+    while (at != std::string::npos && at != 0 && kw[at - 1] != ',')
+      at = kw.find(pat, at + 1);
+    if (at == std::string::npos) return dflt;
+    return std::strtod(kw.c_str() + at + pat.size(), nullptr);
+  }
+
+  // f32 integer power by square-and-multiply, op-for-op identical to
+  // jax.lax.integer_pow's unrolling — which is what the worker-local
+  // optax baseline computes for the Adam bias correction `decay**count`
+  // when the count is concrete (eager/disable_jit execution) — with f32
+  // rounding at every multiply.  NOT std::pow: libm's powf and XLA's
+  // traced pow both round differently, and the equivalence law is
+  // bitwise.
+  static float IntPowF32(float x, uint64_t y) {
+    if (y == 0) return 1.0f;
+    float acc = 0.0f;
+    bool have = false;
+    while (y > 0) {
+      if (y & 1) {
+        acc = have ? acc * x : x;
+        have = true;
+      }
+      y >>= 1;
+      if (y > 0) x = x * x;
+    }
+    return acc;
+  }
+
+  // Install one kwargs string as a key's ACTIVE optimizer ("" = off) —
+  // the single parse shared by ApplyPendingOpt and migrate install, the
+  // ApplyCodecKwargs discipline.
+  void ApplyOptKwargs(KeyState& ks, const std::string& kw) {
+    ks.opt_kwargs = kw;
+    uint8_t kind = 0;
+    if (kw.find("opt=sgd") != std::string::npos) kind = 1;
+    else if (kw.find("opt=momentum") != std::string::npos) kind = 2;
+    else if (kw.find("opt=adam") != std::string::npos) kind = 3;
+    ks.opt_kind = kind;
+    ks.opt_lr = KwFloat(kw, "lr", 0.01);
+    ks.opt_mu = KwFloat(kw, "mu", 0.9);
+    ks.opt_b1 = KwFloat(kw, "b1", 0.9);
+    ks.opt_b2 = KwFloat(kw, "b2", 0.999);
+    ks.opt_eps = KwFloat(kw, "eps", 1e-8);
+    ks.opt_gscale = KwFloat(kw, "gscale", 1.0);
+  }
+
+  void ApplyPendingOpt(KeyState& ks) {
+    if (!ks.opt_pending) return;
+    ApplyOptKwargs(ks, ks.opt_next);
+    ks.opt_applied_epoch = ks.opt_epoch;
+    ks.opt_pending = false;
+    ks.opt_next.clear();
+  }
+
+  // Keep the server-level optimizer-slot-bytes gauge in step with this
+  // key's params/m/v allocations (engine thread; the atomic absorbs the
+  // signed delta through unsigned wraparound).
+  void OptSlotAccount(KeyState& ks) {
+    const uint64_t now =
+        (ks.params.size() + ks.opt_m.size() + ks.opt_v.size()) * 4;
+    opt_slot_bytes_.fetch_add(now - ks.opt_slot_acc,
+                              std::memory_order_relaxed);
+    ks.opt_slot_acc = now;
+  }
+
+  // The authoritative opt doc for one key — the CMD_OPT response.
+  // slots_crc is the chunk-summed CRC over params|m|v (audit::Digest,
+  // summed per buffer): the byte-equality proof surface the migration
+  // chaos tests compare across an ownership handoff.  Computed only on
+  // this control path, never on the data plane.
+  std::string OptJson(uint64_t key, const KeyState& ks) {
+    uint32_t crc = 0;
+    if (!ks.params.empty())
+      crc += audit::Digest(
+          reinterpret_cast<const char*>(ks.params.data()),
+          ks.params.size() * 4);
+    if (!ks.opt_m.empty())
+      crc += audit::Digest(
+          reinterpret_cast<const char*>(ks.opt_m.data()),
+          ks.opt_m.size() * 4);
+    if (!ks.opt_v.empty())
+      crc += audit::Digest(
+          reinterpret_cast<const char*>(ks.opt_v.data()),
+          ks.opt_v.size() * 4);
+    std::string js = "{\"key\":" + std::to_string(key) +
+        ",\"epoch\":" + std::to_string(ks.opt_epoch) +
+        ",\"applied_epoch\":" + std::to_string(ks.opt_applied_epoch) +
+        ",\"pending\":" + (ks.opt_pending ? "1" : "0") +
+        ",\"effective_round\":" + std::to_string(ks.opt_effective) +
+        ",\"completed_round\":" + std::to_string(ks.completed_round) +
+        ",\"param_version\":" + std::to_string(ks.param_version) +
+        ",\"opt_step\":" + std::to_string(ks.opt_step) +
+        ",\"opt_mode\":" + std::to_string(ks.opt_kind) +
+        ",\"params_n\":" + std::to_string(ks.params.size()) +
+        ",\"slot_bytes\":" + std::to_string(
+            (ks.params.size() + ks.opt_m.size() + ks.opt_v.size()) * 4) +
+        ",\"slots_crc\":" + std::to_string(crc) +
+        ",\"kwargs\":\"";
+    JsonEscapeInto(&js, ks.opt_kwargs);
+    js += "\",\"kwargs_next\":\"";
+    JsonEscapeInto(&js, ks.opt_next);
+    js += "\"}";
+    return js;
+  }
+
+  void HandleOpt(Task& t) {
+    // Ring gate first, like every per-key op: the owner's table/slots
+    // are what CMD_MIGRATE carries and publishes run against.
+    if (RingMisplaced(t.key)) {
+      RespondMoved(t, FindState(t.key));
+      return;
+    }
+    if (async_ && (t.flags & 3)) {
+      // Async mode has no rounds: there is no merge boundary for a
+      // server-side update stage to run at.  Writes fail loudly.
+      Respond(t.conn, kError, t.req_id, t.key, nullptr, 0);
+      return;
+    }
+    KeyState& ks = StateFor(t.key);
+    if (t.flags & 2) {
+      // PARAM SEED: raw f32 initial parameters, applied only while the
+      // key holds none — idempotent across racing workers (they all
+      // ship the same broadcast weights), and a no-op after a migration
+      // installed the authoritative copy (a replayed seed can never
+      // reset live training, the kSeed/INIT idempotency discipline).
+      if (!t.payload.empty() && t.payload.size() % 4 == 0 &&
+          ks.params.empty()) {
+        const float* f = reinterpret_cast<const float*>(t.payload.data());
+        ks.params.assign(f, f + t.payload.size() / 4);
+        ks.active.store(true, std::memory_order_relaxed);
+        OptSlotAccount(ks);
+        opt_seeds_.fetch_add(1, std::memory_order_relaxed);
+        StatOpt(t.key, ks.param_version, ks.opt_kind);
+      }
+    } else if (t.flags & 1) {
+      // SET: u32 epoch | u64 effective_round | u32 klen | kwargs.
+      if (t.payload.size() < 16) {
+        Respond(t.conn, kError, t.req_id, t.key, nullptr, 0);
+        return;
+      }
+      uint32_t epoch = 0, klen = 0;
+      uint64_t eff = 0;
+      std::memcpy(&epoch, t.payload.data(), 4);
+      std::memcpy(&eff, t.payload.data() + 4, 8);
+      std::memcpy(&klen, t.payload.data() + 12, 4);
+      if (t.payload.size() < 16ull + klen) {
+        Respond(t.conn, kError, t.req_id, t.key, nullptr, 0);
+        return;
+      }
+      // Applied only if newer — the CMD_CODEC/CMD_RING_SET idempotency
+      // law: racing proposers converge, a replayed declaration cannot
+      // regress the table, and the losers adopt the winner's doc from
+      // the response.
+      if (epoch > ks.opt_epoch) {
+        ks.opt_epoch = epoch;
+        ks.opt_next.assign(t.payload.data() + 16, klen);
+        ks.opt_effective = eff;
+        ks.opt_pending = true;
+        opt_sets_.fetch_add(1, std::memory_order_relaxed);
+      }
+    }
+    std::string js = OptJson(t.key, ks);
+    Respond(t.conn, kOk, t.req_id, t.key, js.data(), js.size());
+  }
+
+  // The update stage: merge -> optimizer step -> publish *parameters*.
+  // Runs inside PublishRound AFTER the codec/EF publish leg produced
+  // `out`, and consumes EXACTLY the bytes a sum-mode pull would have
+  // served — the decode of the recompressed blob for bidirectional
+  // codecs (so compression + server EF behave identically to the
+  // worker-local baseline, where every worker's optax step consumed
+  // that same decode), the raw f32 sum otherwise.  `out` is then
+  // replaced by the updated parameters: pulls adopt params, and
+  // param_version increments exactly once — the stale-round push guard
+  // upstream is what makes a replayed push unable to re-enter here.
+  // Every f32 operation matches the optax eager op sequence
+  // (docs/server-optimizer.md "Equivalence").
+  void OptUpdateStage(KeyState& ks, uint64_t key, bool served_compressed) {
+    const size_t ne = ks.params.size();
+    if (ne == 0) {
+      if (!ks.opt_warned) {
+        ks.opt_warned = true;
+        std::fprintf(stderr,
+                     "[byteps server] server-opt key %llu has an active "
+                     "optimizer but no seeded parameters; publishing "
+                     "sums until CMD_OPT seeds them (param_version "
+                     "stalls — doctor rule param_version_stall)\n",
+                     static_cast<unsigned long long>(key));
+      }
+      return;
+    }
+    // Reusable scratch: the raw path overwrites it whole (memcpy) and
+    // the compressed path lets DecompressTo zero it exactly when the
+    // codec's scatter semantics need zeros — no per-round allocation,
+    // no unconditional memset.
+    std::vector<float>& g = ks.opt_scratch;
+    if (g.size() != ne) g.resize(ne);
+    if (served_compressed) {
+      uint32_t n32 = 0;
+      if (ks.out.size() >= 5)
+        std::memcpy(&n32, ks.out.data() + 1, 4);
+      if (n32 != ne ||
+          !codec::DecompressTo(ks.out.data(), ks.out.size(), g.data(),
+                               n32, /*zero_dst=*/true)) {
+        std::fprintf(stderr,
+                     "[byteps server] server-opt key %llu: published "
+                     "blob failed to decode (n=%u, params=%zu); update "
+                     "skipped\n",
+                     static_cast<unsigned long long>(key), n32, ne);
+        return;
+      }
+    } else {
+      if (ks.out.size() != ne * 4) {
+        if (!ks.opt_warned) {
+          ks.opt_warned = true;
+          std::fprintf(stderr,
+                       "[byteps server] server-opt key %llu: published "
+                       "sum is %zu bytes but params hold %zu elements; "
+                       "update skipped (param_version stalls)\n",
+                       static_cast<unsigned long long>(key),
+                       ks.out.size(), ne);
+        }
+        return;
+      }
+      std::memcpy(g.data(), ks.out.data(), ne * 4);
+    }
+    if (ks.opt_gscale != 1.0) {
+      // The baseline scales the pulled sum before its optax step
+      // (grad = gscale * sum, one weak-f32 scalar multiply) — and only
+      // when the scale is not exactly 1, so the unscaled path stays
+      // op-identical on both sides.
+      const float gs = static_cast<float>(ks.opt_gscale);
+      for (size_t i = 0; i < ne; ++i) g[i] = gs * g[i];
+    }
+    float* p = ks.params.data();
+    // optax scale_by_learning_rate: step_size = -1 * lr in f64, rounded
+    // weak-f32 at the multiply.
+    const float nlr = static_cast<float>(-1.0 * ks.opt_lr);
+    switch (ks.opt_kind) {
+      case 1: {  // sgd: u = -lr * g; p = p + u
+        for (size_t i = 0; i < ne; ++i) p[i] = p[i] + nlr * g[i];
+        break;
+      }
+      case 2: {  // sgd+momentum (optax trace): t = g + mu*t; u = -lr*t
+        if (ks.opt_m.size() != ne) ks.opt_m.assign(ne, 0.0f);
+        const float mu = static_cast<float>(ks.opt_mu);
+        for (size_t i = 0; i < ne; ++i) {
+          const float m = g[i] + mu * ks.opt_m[i];
+          ks.opt_m[i] = m;
+          p[i] = p[i] + nlr * m;
+        }
+        break;
+      }
+      case 3: {  // adam (optax scale_by_adam, eps_root=0)
+        if (ks.opt_m.size() != ne) ks.opt_m.assign(ne, 0.0f);
+        if (ks.opt_v.size() != ne) ks.opt_v.assign(ne, 0.0f);
+        const float b1f = static_cast<float>(ks.opt_b1);
+        const float b2f = static_cast<float>(ks.opt_b2);
+        const float onemb1 = static_cast<float>(1.0 - ks.opt_b1);
+        const float onemb2 = static_cast<float>(1.0 - ks.opt_b2);
+        const float epsf = static_cast<float>(ks.opt_eps);
+        // safe_int32_increment: the count saturates at INT32_MAX.
+        const uint64_t step = ks.opt_step >= 2147483647ULL
+                                  ? 2147483647ULL : ks.opt_step + 1;
+        const float bc1 = 1.0f - IntPowF32(b1f, step);
+        const float bc2 = 1.0f - IntPowF32(b2f, step);
+        for (size_t i = 0; i < ne; ++i) {
+          const float gi = g[i];
+          const float mi = onemb1 * gi + b1f * ks.opt_m[i];
+          const float vi = onemb2 * (gi * gi) + b2f * ks.opt_v[i];
+          ks.opt_m[i] = mi;
+          ks.opt_v[i] = vi;
+          const float mh = mi / bc1;
+          const float vh = vi / bc2;
+          const float u = nlr * (mh / (std::sqrt(vh) + epsf));
+          p[i] = p[i] + u;
+        }
+        break;
+      }
+      default:
+        return;
+    }
+    if (ks.opt_step < 2147483647ULL) ks.opt_step++;
+    ks.param_version++;
+    ks.out.assign(reinterpret_cast<const char*>(p),
+                  reinterpret_cast<const char*>(p) + ne * 4);
+    OptSlotAccount(ks);
+    opt_updates_.fetch_add(1, std::memory_order_relaxed);
+    StatOpt(key, ks.param_version, ks.opt_kind);
+    DebugLog("opt_update", key, 0, ks.completed_round, ks.out);
+  }
+
   void HandleInit(Task& t) {
     // Init allocates the merged store; like the reference's init push it is
     // idempotent and sized by the declared length (reference:
@@ -3865,6 +4370,13 @@ class Server {
     // the same gradient and replays, so the round stays format-uniform
     // and no contribution is lost.  Epoch 0 (no renegotiation ever) pays
     // one integer compare and behaves exactly as before.
+    // Pending optimizer-mode switch (CMD_OPT) lands at the same round
+    // boundary law as the codec table below: the round's FIRST push,
+    // once completed_round reached the declared effective round — so no
+    // round ever mixes update modes.  Epoch 0 pays one integer compare.
+    if (!async_ && ks.opt_epoch != 0 && ks.opt_pending &&
+        ks.seen.empty() && ks.completed_round >= ks.opt_effective)
+      ApplyPendingOpt(ks);
     if (!async_ && ks.codec_epoch != 0) {
       if (ks.codec_pending && ks.seen.empty() &&
           ks.completed_round >= ks.codec_effective)
@@ -4031,6 +4543,10 @@ class Server {
       ks.ef_err.shrink_to_fit();
       ks.ef_fold_pending = false;
     }
+    // Captured before the flags reset below: did this round's publish
+    // leg produce a recompressed blob (what the opt stage must decode)
+    // or the raw f32 sum?
+    const bool served_compressed = ks.round_compressed && ks.bidirectional;
     if (ks.round_compressed && ks.bidirectional) {
       size_t ne = ks.store.size() / 4;
       float* s = reinterpret_cast<float*>(ks.store.data());
@@ -4077,6 +4593,14 @@ class Server {
       // full-buffer memcpy per partition per round on the serve path.
       std::swap(ks.out, ks.store);
     }
+    // --- server-resident optimizer update stage (CMD_OPT) ---------------
+    // Merge -> update -> publish *parameters*: with an active optimizer
+    // mode, the round's served bytes become the post-step params instead
+    // of the sum.  Unarmed keys (opt_kind 0 — every pre-subsystem run)
+    // skip on one compare; raw last-write-wins keys are not gradient
+    // streams and never update.
+    if (!async_ && ks.opt_kind != 0 && ks.dtype == kF32)
+      OptUpdateStage(ks, key, served_compressed);
     ks.completed_round++;
     ks.seen.clear();
     ks.round_compressed = false;
@@ -4324,6 +4848,16 @@ class Server {
   // renegotiation race backstop firing) — CMD_STATS observability.
   std::atomic<uint64_t> codec_sets_{0};
   std::atomic<uint64_t> codec_stale_{0};
+  // Server-resident optimizer plane (CMD_OPT) — CMD_STATS observability:
+  // accepted declarations, idempotent param seeds, published optimizer
+  // updates, and the live bytes held in server-owned optimizer slots
+  // (params + m + v across keys; the bench's "per-worker optimizer-state
+  // bytes ~0" claim is this gauge living HERE instead of N times on the
+  // workers).
+  std::atomic<uint64_t> opt_sets_{0};
+  std::atomic<uint64_t> opt_seeds_{0};
+  std::atomic<uint64_t> opt_updates_{0};
+  std::atomic<uint64_t> opt_slot_bytes_{0};
   std::mutex peer_mu_;
   std::map<uint32_t, int> peer_fds_;
   std::map<uint32_t, int64_t> peer_down_until_us_;  // negative cache

@@ -32,6 +32,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from ..common.compat import axis_size as _axis_size
+
 from ..common.config import get_config
 
 PyTree = Any
@@ -60,7 +62,7 @@ def is_local() -> bool:
 
 
 def axis_size(axis_name: str) -> int:
-    return 1 if is_local() else lax.axis_size(axis_name)
+    return 1 if is_local() else _axis_size(axis_name)
 
 
 # ---------------------------------------------------------------------------
@@ -86,7 +88,7 @@ def reduce_scatter(x: jax.Array, axis_name: str = "dp",
 
 def ring_permute(x: jax.Array, axis_name: str, shift: int = 1) -> jax.Array:
     """Neighbor exchange on the ring — building block for ring attention."""
-    n = lax.axis_size(axis_name)
+    n = _axis_size(axis_name)
     perm = [(i, (i + shift) % n) for i in range(n)]
     return lax.ppermute(x, axis_name, perm)
 

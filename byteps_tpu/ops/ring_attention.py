@@ -23,6 +23,9 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
+from ..common.compat import axis_size as _axis_size
+from ..common.compat import shard_map as _shard_map
+
 NEG_INF = float(jnp.finfo(jnp.float32).min)
 
 
@@ -53,7 +56,7 @@ def ring_attention_shard(q, k, v, causal: bool, axis_name: str = "sp"):
     q,k,v: [B, H, S_local, D] — this device's sequence block along a ring of
     `axis_size(axis_name)` devices.  Returns [B, H, S_local, D].
     """
-    n = lax.axis_size(axis_name)
+    n = _axis_size(axis_name)
     my = lax.axis_index(axis_name)
     B, H, S, D = q.shape
 
@@ -102,8 +105,8 @@ def make_ring_attn_fn(mesh: Mesh, axis_name: str = "sp"):
     def attn_fn(q, k, v, causal):
         f = functools.partial(ring_attention_shard, causal=causal,
                               axis_name=axis_name)
-        return jax.shard_map(f, mesh=mesh, in_specs=(spec, spec, spec),
-                             out_specs=spec, check_vma=False)(q, k, v)
+        return _shard_map(f, mesh=mesh, in_specs=(spec, spec, spec),
+                          out_specs=spec, check_vma=False)(q, k, v)
     return attn_fn
 
 
@@ -121,7 +124,7 @@ def ulysses_attention_shard(q, k, v, causal: bool, axis_name: str = "sp",
     better for moderate n on all-to-all-capable fabrics; requires
     num_heads % n == 0.
     """
-    n = lax.axis_size(axis_name)
+    n = _axis_size(axis_name)
 
     def seq_to_heads(x):
         # [B, H, S/n, D] -> [B, H/n, S, D]
@@ -171,6 +174,6 @@ def make_ulysses_attn_fn(mesh: Mesh, axis_name: str = "sp", attn="dense"):
     def attn_fn(q, k, v, causal):
         f = functools.partial(ulysses_attention_shard, causal=causal,
                               axis_name=axis_name, attn=inner)
-        return jax.shard_map(f, mesh=mesh, in_specs=(spec, spec, spec),
-                             out_specs=spec, check_vma=False)(q, k, v)
+        return _shard_map(f, mesh=mesh, in_specs=(spec, spec, spec),
+                          out_specs=spec, check_vma=False)(q, k, v)
     return attn_fn

@@ -20,6 +20,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from ..common.compat import axis_size as _axis_size
+
 PyTree = Any
 
 
@@ -46,7 +48,7 @@ def gpipe_spmd(
     stage 0's embedding feed; summing across ranks happens in the caller's
     final loss psum.
     """
-    P = lax.axis_size(axis_name)
+    P = _axis_size(axis_name)
     idx = lax.axis_index(axis_name)
     M = num_microbatches
     B = x.shape[0]

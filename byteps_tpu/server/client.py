@@ -80,7 +80,7 @@ _RESP = struct.Struct("<BIQQ")     # status req_id key len
 CMD_HELLO, CMD_INIT, CMD_PUSH, CMD_PULL, CMD_BARRIER, CMD_SHUTDOWN, \
     CMD_PING, CMD_LR_SCALE, CMD_STATS, CMD_TRACE, CMD_LEAVE, \
     CMD_MEMBERS, CMD_RING, CMD_RING_SET, CMD_DRAIN, CMD_MIGRATE, \
-    CMD_AUDIT, CMD_CODEC = range(18)
+    CMD_AUDIT, CMD_CODEC, CMD_OPT = range(19)
 
 # Response status bytes (server.cc Status).  MOVED carries the server's
 # current ring table as JSON: the addressed server is not (or no longer)
@@ -1123,6 +1123,8 @@ class PSSession:
         "ring_redirects": 0,      # partitions re-routed by status MOVED
         "codec_switches": 0,      # per-key codec renegotiations applied
         "codec_stale_retries": 0,  # pushes re-encoded after CODEC_STALE
+        "opt_reseeds": 0,         # server-opt configs+params re-seeded
+        #                           onto a fresh owner during a rebase
         "server_failovers": 0,    # dead servers this worker failed over
         "pool_hits": 0,           # recv buffers served from the pool
         "pool_misses": 0,         # recv buffers freshly allocated
@@ -1332,6 +1334,14 @@ class PSSession:
         self._ef_fold: Dict[int, np.ndarray] = {}
         self._codec_retry_queue: List[tuple] = []
         self._codec_retry_thread: Optional[threading.Thread] = None
+        # Server-resident optimizer plane (CMD_OPT): per declared key the
+        # armed config {"epoch", "kwargs_str", "params_fn", "nbytes"} —
+        # params_fn is the rebase re-seed source after a failover hands
+        # the key's range to a fresh owner.  Empty until arm_server_opt()
+        # — an unarmed session never emits a CMD_OPT frame and the wire
+        # stays byte-identical (shares _codec_lock: both tables are tiny
+        # control-plane state touched off the hot path).
+        self._opt_armed: Dict[int, dict] = {}
         self._server_load = [0] * len(self.conns)
         self._plans: Dict[Tuple[int, int], list] = {}
         # _plan's read-modify-write of _plans/_server_load must be atomic:
@@ -1950,6 +1960,260 @@ class PSSession:
             part.bidirectional = False
         part.phase = "push"
         part.ready = None   # payload is materialized; dispatcher sends it
+
+    # -- server-resident optimizer plane (CMD_OPT) --------------------------
+    @staticmethod
+    def _opt_kwargs_to_str(kwargs: Optional[dict]) -> str:
+        """Canonical kwargs string for an optimizer declaration ("" =
+        off): ``opt`` leads, the remaining hyperparams follow sorted,
+        float values ride ``repr()`` — the shortest decimal that
+        round-trips, which the server's strtod parses back to the
+        IDENTICAL f64 the worker-local optax baseline holds.  The
+        f32-exact equivalence law starts at this string."""
+        if not kwargs:
+            return ""
+        kw = {str(k): v for k, v in kwargs.items()}
+        name = str(kw.pop("opt", "sgd"))
+        parts = [f"opt={name}"]
+        for k in sorted(kw):
+            v = kw[k]
+            parts.append(
+                f"{k}={repr(float(v)) if isinstance(v, float) else v}")
+        return ",".join(parts)
+
+    def _opt_pkeys(self, declared_key: int) -> list:
+        """ALL of this key's partition keys — unlike the codec table,
+        the optimizer plane covers every partition (a sub-floor raw
+        partition's slice of the params updates server-side exactly
+        like a compressed one's).  Once armed, derived from the plan
+        rather than `_inited`: a ring transition invalidates the moved
+        partitions' `_inited` rows until their next push, and the doc
+        surface must keep covering them (the drain test reads slots_crc
+        on BOTH sides of the handoff)."""
+        with self._codec_lock:
+            rec = self._opt_armed.get(declared_key)
+        if rec and rec.get("nbytes"):
+            return sorted(pk for pk, _, _, _ in
+                          self._plan(declared_key, rec["nbytes"]))
+        return sorted(pk for pk in self._inited
+                      if pk >> 16 == declared_key)
+
+    def propose_opt(self, declared_key: int, kwargs,
+                    effective_round: int = 0) -> dict:
+        """Declare (or switch) ``declared_key``'s server-resident
+        optimizer, atomically at a round boundary.
+
+        Sends an epoch-versioned CMD_OPT SET for each declared partition
+        to its owner ("applied only if newer" — the CMD_CODEC law, so
+        every worker declaring the same trainer config is idempotent and
+        racing proposers converge on one winner).  The mode takes effect
+        at the first round boundary at/after ``effective_round``; from
+        that round on the key publishes post-update *parameters* instead
+        of sums.  ``kwargs`` is a dict like ``{"opt": "adam", "lr":
+        1e-3, ...}`` (or a pre-canonicalized string); None/"" switches
+        the update stage off.  Returns {"accepted", "epoch", "doc"}."""
+        import json as _json
+        kwstr = (kwargs if isinstance(kwargs, str)
+                 else self._opt_kwargs_to_str(kwargs))
+        pkeys = self._opt_pkeys(declared_key)
+        if not pkeys:
+            raise RuntimeError(
+                f"propose_opt: key {declared_key} has no declared "
+                f"partitions yet — arm_server_opt() declares them first")
+        with self._codec_lock:
+            rec = self._opt_armed.get(declared_key) or {}
+            epoch = int(rec.get("epoch", 0)) + 1
+        kb = kwstr.encode()
+        payload = struct.pack("<IQI", epoch, int(effective_round),
+                              len(kb)) + kb
+        best: Optional[dict] = None
+        for pk in pkeys:
+            srv = self._pkey_srv.get(pk, 0)
+            doc = None
+            for _attempt in range(3):
+                conn = self.conns[srv]
+                try:
+                    resp = conn.request(CMD_OPT, pk, payload,
+                                        worker_id=self.worker_id,
+                                        flags=1, timeout=30.0)
+                except _KeyMoved as e:
+                    self._safe_adopt_ring(e.doc)
+                    srv = self._pkey_srv.get(pk, srv)
+                    continue
+                except RuntimeError as e:
+                    raise RuntimeError(
+                        "CMD_OPT failed — server too old for the "
+                        "server-resident optimizer plane (rebuild "
+                        "libbyteps_core.so)") from e
+                doc = _json.loads(bytes(resp).decode())
+                if best is None or int(doc.get("epoch", 0)) > int(
+                        best.get("epoch", 0)):
+                    best = doc
+                break
+            if doc is None:
+                # A half-armed key is silent corruption (some partitions
+                # would keep publishing sums the trainer adopts as
+                # params, and their opt_mode 0 keeps the doctor quiet) —
+                # every partition must take the declaration, or nobody
+                # trains on it.
+                raise RuntimeError(
+                    f"ring kept moving while declaring the server "
+                    f"optimizer for partition {pk} of key "
+                    f"{declared_key}; declaration aborted (retry once "
+                    f"the ring settles)")
+        accepted = bool(best) and int(best.get("epoch", -1)) == epoch and (
+            (int(best.get("pending", 0)) == 1
+             and best.get("kwargs_next", "") == kwstr)
+            or (int(best.get("pending", 0)) == 0
+                and best.get("kwargs", "") == kwstr))
+        with self._codec_lock:
+            rec = self._opt_armed.setdefault(declared_key, {})
+            rec["epoch"] = max(int(rec.get("epoch", 0)),
+                               int(best.get("epoch", epoch))
+                               if best else epoch)
+            if best is not None:
+                rec["kwargs_str"] = (best.get("kwargs_next")
+                                     or best.get("kwargs") or kwstr)
+            else:
+                rec["kwargs_str"] = kwstr
+        get_logger().info(
+            "server-opt proposal for key %d (%s): %s -> %r at round >= "
+            "%d (epoch %d)", declared_key, self._label(declared_key),
+            "accepted" if accepted else "superseded", kwstr or "off",
+            int(effective_round), epoch)
+        return {"accepted": accepted, "epoch": epoch, "doc": best}
+
+    def seed_params(self, declared_key: int, flat) -> None:
+        """Bootstrap the key's initial parameters to each partition's
+        owner (CMD_OPT flags bit1): raw f32, applied only while the
+        server holds none — idempotent across workers shipping the same
+        broadcast weights, a no-op against migrated-in state."""
+        flat = np.ascontiguousarray(np.asarray(flat), np.float32).ravel()
+        plan = self._plan(declared_key, flat.nbytes)
+        mv = memoryview(flat).cast("B")
+        for pkey, off, ln, srv in plan:
+            payload = bytes(mv[off:off + ln])
+            srv_i = self._pkey_srv.get(pkey, srv)
+            for _attempt in range(3):
+                try:
+                    self.conns[srv_i].request(
+                        CMD_OPT, pkey, payload,
+                        worker_id=self.worker_id, flags=2, timeout=60.0)
+                    break
+                except _KeyMoved as e:
+                    self._safe_adopt_ring(e.doc)
+                    srv_i = self._pkey_srv.get(pkey, srv_i)
+            else:
+                # An unseeded partition never updates (param_version
+                # stalls while its siblings train) — fail the bootstrap
+                # loudly instead.
+                raise RuntimeError(
+                    f"ring kept moving while seeding params for "
+                    f"partition {pkey} of key {declared_key}; seed "
+                    f"aborted (retry once the ring settles)")
+
+    def arm_server_opt(self, declared_key: int, params, opt_kwargs,
+                       params_fn=None, effective_round: int = 0) -> dict:
+        """One-call bootstrap for the parameter-pull session mode:
+        declare the key's partitions (idempotent CMD_INIT, carrying the
+        key's current codec kwargs so the push-leg compression contract
+        is untouched), send the epoch-versioned optimizer declaration to
+        each partition's owner, and seed the initial parameters.
+
+        ``params_fn`` (optional but recommended) returns the caller's
+        CURRENT flat f32 params — the re-seed source when a
+        post-failover fresh owner answers round 0 for this key
+        (ServerOptTrainer wires its adopted view in here)."""
+        flat = np.ascontiguousarray(np.asarray(params), np.float32).ravel()
+        comp = self._compressors.get(declared_key)
+        kw_bytes = comp.kwargs_string().encode() if comp else b""
+        plan = self._plan(declared_key, flat.nbytes)
+        self._init_parts(plan, kw_bytes)
+        res = self.propose_opt(declared_key, opt_kwargs,
+                               effective_round=effective_round)
+        self.seed_params(declared_key, flat)
+        with self._codec_lock:
+            rec = self._opt_armed.setdefault(declared_key, {})
+            rec["params_fn"] = params_fn
+            rec["nbytes"] = flat.nbytes
+        return res
+
+    def fetch_opt_docs(self, declared_key: int,
+                       timeout: float = 10.0) -> dict:
+        """{pkey: authoritative opt doc} via CMD_OPT GET on each of the
+        key's partitions — param_version / opt_step / slots_crc, the
+        exactly-one-update audit surface tests and tooling read."""
+        import json as _json
+        out = {}
+        for pk in self._opt_pkeys(declared_key):
+            srv = self._pkey_srv.get(pk, 0)
+            for _attempt in range(3):
+                try:
+                    resp = self.conns[srv].request(
+                        CMD_OPT, pk, b"", worker_id=self.worker_id,
+                        timeout=timeout)
+                except _KeyMoved as e:
+                    self._safe_adopt_ring(e.doc)
+                    srv = self._pkey_srv.get(pk, srv)
+                    continue
+                out[pk] = _json.loads(bytes(resp).decode())
+                break
+        return out
+
+    def opt_table(self) -> dict:
+        """Local view of the armed server-opt keys (the codec_table()
+        analog for tooling): {label: {"declared_key", "epoch",
+        "kwargs"}}."""
+        out = {}
+        with self._codec_lock:
+            for dk, rec in self._opt_armed.items():
+                out[self._label(dk)] = {
+                    "declared_key": dk,
+                    "epoch": int(rec.get("epoch", 0)),
+                    "kwargs": rec.get("kwargs_str", ""),
+                }
+        return out
+
+    def _opt_rebase_reseed(self, conn: "_ServerConn",
+                           part: "_PartTask") -> None:
+        """A server answered a round BEHIND ours for an opt-armed key (a
+        restart, or a SIGKILL failover handed the range to a fresh owner
+        with no migrated state): re-declare the optimizer config and
+        re-seed this partition's params slice from the trainer's adopted
+        view, so the rebased rounds continue the trajectory.  Stateless
+        modes (sgd) recover bit-identically — the params after round r
+        are exactly what every worker pulled; stateful slots
+        (momentum/adam m, v) cannot be rebuilt from the workers and
+        restart zeroed (docs/server-optimizer.md "Failover"; drain and
+        scale-up migrate them byte-equal instead)."""
+        dk = part.pkey >> 16
+        with self._codec_lock:
+            rec = dict(self._opt_armed.get(dk) or {})
+        if not rec or rec.get("params_fn") is None:
+            return
+        try:
+            kwstr = rec.get("kwargs_str", "")
+            kb = kwstr.encode()
+            payload = struct.pack("<IQI", int(rec.get("epoch", 1)), 0,
+                                  len(kb)) + kb
+            conn.request(CMD_OPT, part.pkey, payload,
+                         worker_id=self.worker_id, flags=1, timeout=30.0)
+            flat = np.ascontiguousarray(
+                np.asarray(rec["params_fn"]()), np.float32).ravel()
+            mv = memoryview(flat).cast("B")
+            conn.request(CMD_OPT, part.pkey,
+                         bytes(mv[part.off:part.off + part.ln]),
+                         worker_id=self.worker_id, flags=2, timeout=60.0)
+            with self._transport_lock:
+                self._tstats["opt_reseeds"] += 1
+            get_logger().warning(
+                "server-opt key %d: re-seeded optimizer config + params "
+                "onto %s:%d after rebase", part.pkey, conn.host,
+                conn.port)
+        except Exception:
+            get_logger().exception(
+                "server-opt re-seed for key %d failed (rounds will "
+                "publish sums and param_version will stall)", part.pkey)
 
     # -- partition planning -------------------------------------------------
     def _plan(self, declared_key: int, nbytes: int) -> list:
@@ -2641,6 +2905,10 @@ class PSSession:
                     self._round[part.pkey] = completed
                 replay_push = True
                 part.phase = "push"
+                # Opt-armed key on a state-less owner: re-declare the
+                # optimizer + re-seed params BEFORE the push replays, so
+                # the rebased round publishes parameters, not sums.
+                self._opt_rebase_reseed(conn, part)
             elif completed > part.round + 1:
                 raise RuntimeError(
                     f"PS server round state for key {part.pkey} is ahead "
@@ -3469,7 +3737,8 @@ class PSSession:
                   "num_workers": 0, "scatter_frames": 0, "keys": {},
                   "workers": {}, "epoch": 0, "deferred_joins": 0,
                   "members": {}, "ring_epoch": 0, "servers": {},
-                  "codec_sets": 0, "codec_stale_frames": 0}
+                  "codec_sets": 0, "codec_stale_frames": 0,
+                  "opt_sets": 0, "opt_updates": 0, "opt_slot_bytes": 0}
         import json as _json
         for slot, c in enumerate(self.conns):
             sid = self._slot_srv.get(slot, slot)
@@ -3534,6 +3803,14 @@ class PSSession:
             merged["codec_sets"] += int(st.get("codec_sets", 0))
             merged["codec_stale_frames"] += int(
                 st.get("codec_stale_frames", 0))
+            # Server-resident optimizer plane; old servers omit these
+            # (and per-key param_version/opt_mode rows flow through the
+            # wholesale key-row copy below).
+            merged["opt_sets"] += int(st.get("opt_sets", 0))
+            merged["opt_updates"] += int(st.get("opt_updates", 0))
+            merged["opt_slot_bytes"] += int(st.get("opt_slot_bytes", 0))
+            merged["servers"][row_id]["opt_slot_bytes"] = int(
+                st.get("opt_slot_bytes", 0))
             for w, rec in (st.get("members") or {}).items():
                 _merge_member_rec(merged["members"], int(w), rec)
             for k, v in (st.get("keys") or {}).items():

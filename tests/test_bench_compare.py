@@ -83,6 +83,21 @@ def test_autotune_family_direction():
     assert bench_compare.check(recs)["regressions"] == []
 
 
+def test_serveropt_family_direction():
+    """BENCH_SERVEROPT records (ISSUE 14): the headline is the step-time
+    gap between the server-resident update stage and the worker-local
+    optax baseline — same gap family as BENCH_AUTOTUNE, lower is
+    better (negative = the server mode is outright faster)."""
+    assert bench_compare._lower_is_better(
+        "serveropt_step_time_gap_pct", "pct_gap")
+    recs = [R(1, "serveropt_step_time_gap_pct", -20.0, unit="pct_gap"),
+            R(2, "serveropt_step_time_gap_pct", 15.0, unit="pct_gap")]
+    rep = bench_compare.check(recs, threshold=0.10)
+    assert len(rep["regressions"]) == 1      # server mode got slower
+    recs[-1] = R(2, "serveropt_step_time_gap_pct", -30.0, unit="pct_gap")
+    assert bench_compare.check(recs)["regressions"] == []
+
+
 def test_platforms_compared_separately():
     recs = [R(1, "eff", 1.0, platform="tpu"),
             R(2, "eff", 0.2, platform="cpu"),   # different hardware

@@ -14,6 +14,7 @@ from jax.sharding import PartitionSpec as P
 
 import byteps_tpu as bps
 from byteps_tpu.ops import collectives
+from byteps_tpu.common.compat import shard_map as _compat_shard_map
 
 BENCH = os.path.join(os.path.dirname(__file__), os.pardir, "bench.py")
 
@@ -26,7 +27,7 @@ def test_bucketed_issues_far_fewer_collectives():
     tree = {f"g{i}": jnp.ones((1000,), jnp.float32) for i in range(500)}
 
     def lower(fn):
-        sm = jax.jit(jax.shard_map(fn, mesh=mesh, in_specs=(P(),),
+        sm = jax.jit(_compat_shard_map(fn, mesh=mesh, in_specs=(P(),),
                                    out_specs=P(), check_vma=False))
         return sm.lower(tree).compiler_ir(dialect="stablehlo")
 

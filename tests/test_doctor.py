@@ -231,6 +231,38 @@ def test_tuner_thrash_boundary():
     assert "tuner_thrash" not in rules_fired(reset)
 
 
+def test_param_version_stall_boundary():
+    def srv(completed, pv, opt_mode=3):
+        return {"server": {"keys": {"7": {
+            "completed_round": completed, "param_version": pv,
+            "opt_mode": opt_mode}}}}
+
+    # Fires: rounds complete for 2 consecutive windows, param_version
+    # frozen — the update stage is wedged.
+    stall = [W(0, **srv(4, 4)), W(1, **srv(6, 4)), W(2, **srv(8, 4))]
+    fired = rules_fired(stall)
+    assert "param_version_stall" in fired
+    diag = doctor.evaluate_stream(stall)
+    f = next(x for x in diag["open"]
+             if x["rule"] == "param_version_stall")
+    assert f["subject"] == "key=7"
+    assert f["playbook"].endswith("#rule-param_version_stall")
+    # Healthy: param_version advances with the rounds.
+    ok = [W(0, **srv(4, 4)), W(1, **srv(6, 6)), W(2, **srv(8, 8))]
+    assert "param_version_stall" not in rules_fired(ok)
+    # One stalled window is not enough (threshold = 2).
+    assert "param_version_stall" not in rules_fired(
+        [W(0, **srv(4, 4)), W(1, **srv(6, 4))])
+    # Idle key (rounds not advancing either): quiet — nothing is wedged,
+    # the job just is not training.
+    idle = [W(0, **srv(4, 4)), W(1, **srv(4, 4)), W(2, **srv(4, 4))]
+    assert "param_version_stall" not in rules_fired(idle)
+    # Sum-only keys (opt_mode 0) never fire.
+    off = [W(0, **srv(4, 0, 0)), W(1, **srv(6, 0, 0)),
+           W(2, **srv(8, 0, 0))]
+    assert "param_version_stall" not in rules_fired(off)
+
+
 def test_every_rule_has_a_boundary_test():
     """The fire/no-fire coverage above must track the rule set: a new
     rule without a test here is exactly the drift this file pins."""
@@ -238,7 +270,7 @@ def test_every_rule_has_a_boundary_test():
                "lane_credit_imbalance", "recv_pool_miss_rate",
                "fusion_dilution", "server_hot_shard",
                "nonfinite_gradients", "audit_mismatch", "barrier_stall",
-               "tuner_thrash"}
+               "tuner_thrash", "param_version_stall"}
     assert set(doctor.RULE_IDS) == covered
 
 

@@ -9,6 +9,7 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+from byteps_tpu.common.compat import shard_map as _compat_shard_map
 from byteps_tpu.models.transformer import dense_attention, \
     flash_attention_fn
 from byteps_tpu.ops.flash_attention import flash_attention
@@ -187,7 +188,7 @@ def test_flash_under_shard_map():
     def f(q, k, v):
         return flash_attention(q, k, v, True, None, 64, 64, True)
 
-    sm = jax.jit(jax.shard_map(f, mesh=mesh,
+    sm = jax.jit(_compat_shard_map(f, mesh=mesh,
                                in_specs=(P("dp"), P("dp"), P("dp")),
                                out_specs=P("dp"), check_vma=False))
     out = sm(q, k, v)

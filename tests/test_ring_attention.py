@@ -11,6 +11,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 from byteps_tpu.models.transformer import dense_attention
 from byteps_tpu.ops import ring_attention as ra
 
+from byteps_tpu.common.compat import shard_map as _compat_shard_map
 
 def _mesh_sp(n=8):
     return Mesh(np.array(jax.devices()[:n]), ("sp",))
@@ -28,7 +29,7 @@ def test_ring_attention_matches_dense(causal):
     expect = dense_attention(q, k, v, causal)
     spec = P(None, None, "sp", None)
     f = functools.partial(ra.ring_attention_shard, causal=causal)
-    out = jax.jit(jax.shard_map(f, mesh=mesh, in_specs=(spec,) * 3,
+    out = jax.jit(_compat_shard_map(f, mesh=mesh, in_specs=(spec,) * 3,
                                 out_specs=spec, check_vma=False))(q, k, v)
     np.testing.assert_allclose(np.asarray(out), np.asarray(expect),
                                rtol=2e-5, atol=2e-5)
@@ -41,7 +42,7 @@ def test_ulysses_attention_matches_dense(causal):
     expect = dense_attention(q, k, v, causal)
     spec = P(None, None, "sp", None)
     f = functools.partial(ra.ulysses_attention_shard, causal=causal)
-    out = jax.jit(jax.shard_map(f, mesh=mesh, in_specs=(spec,) * 3,
+    out = jax.jit(_compat_shard_map(f, mesh=mesh, in_specs=(spec,) * 3,
                                 out_specs=spec, check_vma=False))(q, k, v)
     np.testing.assert_allclose(np.asarray(out), np.asarray(expect),
                                rtol=2e-5, atol=2e-5)
@@ -70,7 +71,7 @@ def test_ring_attention_grads_flow():
 
     def loss(q, k, v):
         f = functools.partial(ra.ring_attention_shard, causal=True)
-        out = jax.shard_map(f, mesh=mesh, in_specs=(spec,) * 3,
+        out = _compat_shard_map(f, mesh=mesh, in_specs=(spec,) * 3,
                             out_specs=spec, check_vma=False)(q, k, v)
         return (out ** 2).sum()
 
@@ -90,7 +91,7 @@ def test_ulysses_rejects_bad_head_count():
     spec = P(None, None, "sp", None)
     with pytest.raises(ValueError, match="divisible"):
         f = functools.partial(ra.ulysses_attention_shard, causal=False)
-        jax.shard_map(f, mesh=mesh, in_specs=(spec,) * 3,
+        _compat_shard_map(f, mesh=mesh, in_specs=(spec,) * 3,
                       out_specs=spec, check_vma=False)(q, k, v)
 
 

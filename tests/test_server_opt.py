@@ -1,0 +1,566 @@
+"""Server-resident optimizer plane (ISSUE 14): f32-exact equivalence
+with the worker-local optax baseline (SGD / momentum / Adam, including
+a mid-run raw->onebit codec switch under EF), exactly-one-update under
+replay, byte-equal optimizer-slot migration across a drain, SIGKILL
+failover re-seed, and the unarmed/local-mode wire byte-identity.
+"""
+
+import os
+import socket
+import struct
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from byteps_tpu.server.client import (CMD_HELLO, CMD_INIT, CMD_OPT,
+                                      CMD_PULL, CMD_PUSH, PSSession)
+from byteps_tpu.parallel.server_opt import ServerOptTrainer
+
+from testutil import StubPSServer, cpu_env
+
+
+def _wait_up(port, procs, deadline_s=30):
+    deadline = time.time() + deadline_s
+    while True:
+        try:
+            socket.create_connection(("127.0.0.1", port), 0.5).close()
+            return
+        except OSError:
+            for p in procs:
+                if p.poll() is not None:
+                    raise RuntimeError(f"server died rc={p.returncode}")
+            if time.time() > deadline:
+                raise TimeoutError("PS server did not come up")
+            time.sleep(0.1)
+
+
+@pytest.fixture
+def ps_server():
+    made = []
+
+    def start(num_workers=1, extra_env=None):
+        last = None
+        for _ in range(3):
+            with socket.socket() as sk:
+                sk.bind(("127.0.0.1", 0))
+                port = sk.getsockname()[1]
+            env = cpu_env({
+                "DMLC_PS_ROOT_PORT": str(port - 1),
+                "DMLC_NUM_WORKER": str(num_workers),
+                "BYTEPS_SERVER_ENGINE_THREAD": "2",
+                "JAX_PLATFORMS": "cpu",
+                **(extra_env or {}),
+            })
+            proc = subprocess.Popen(
+                [sys.executable, "-m", "byteps_tpu.server"], env=env,
+                stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+            made.append(proc)
+            try:
+                _wait_up(port, [proc])
+                return port
+            except (RuntimeError, TimeoutError) as e:
+                last = e
+        raise last
+
+    yield start
+    for p in made:
+        p.kill()
+        p.wait()
+
+
+@pytest.fixture
+def ring_servers():
+    made = []
+
+    def start(n, num_workers=1):
+        last = None
+        for _ in range(4):
+            try:
+                return _start_group(n, num_workers)
+            except (RuntimeError, TimeoutError) as e:
+                last = e
+        raise last
+
+    def _start_group(n, num_workers):
+        with socket.socket() as sk:
+            sk.bind(("127.0.0.1", 0))
+            base = sk.getsockname()[1]
+        ports = [base + i for i in range(n)]
+        procs = []
+        for i in range(n):
+            env = cpu_env({
+                "DMLC_PS_ROOT_PORT": str(base - 1),
+                "DMLC_NUM_WORKER": str(num_workers),
+                "DMLC_NUM_SERVER": str(n),
+                "DMLC_SERVER_ID": str(i),
+                "BYTEPS_TPU_RING": "1",
+                "BYTEPS_SERVER_ENGINE_THREAD": "2",
+                "JAX_PLATFORMS": "cpu",
+            })
+            procs.append(subprocess.Popen(
+                [sys.executable, "-m", "byteps_tpu.server"], env=env,
+                stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL))
+        made.extend(procs)
+        for p in ports:
+            _wait_up(p, procs)
+        return ports, base
+
+    yield start
+    for p in made:
+        p.kill()
+        p.wait()
+
+
+def _ring_session(ports, wid=0, srv_evict=0.0, **kw):
+    kw.setdefault("wire_conns", 1)
+    kw.setdefault("partition_bytes", 1 << 16)
+    return PSSession(["127.0.0.1"] * len(ports), list(ports),
+                     worker_id=wid, num_servers=len(ports), ring=True,
+                     server_evict_timeout_s=srv_evict, **kw)
+
+
+# ---------------------------------------------------------------------------
+# fast: single-worker equivalence — sgd and momentum
+# ---------------------------------------------------------------------------
+def test_sgd_and_momentum_match_optax(ps_server):
+    """Server-resident SGD and momentum trajectories match the
+    worker-local optax baseline f32-exactly, round by round (baseline
+    eager under disable_jit — the op-for-op reference)."""
+    import jax
+
+    rng = np.random.RandomState(0)
+    params0 = {"w": rng.randn(257, 9).astype(np.float32),
+               "b": rng.randn(33).astype(np.float32)}
+    grads = [{"w": rng.randn(257, 9).astype(np.float32) * 3,
+              "b": rng.randn(33).astype(np.float32) * 3}
+             for _ in range(6)]
+    for key, kw in ((31, {"opt": "sgd", "lr": 0.05}),
+                    (32, {"opt": "momentum", "lr": 0.01, "mu": 0.9})):
+        trajs = {}
+        for mode in ("server", "local"):
+            s = PSSession(["127.0.0.1"], [ps_server()], worker_id=0,
+                          num_servers=1)
+            try:
+                tr = ServerOptTrainer(s, params0, kw, mode=mode,
+                                      declared_key=key + (0 if mode ==
+                                                          "server"
+                                                          else 40))
+                out = []
+                with jax.disable_jit():
+                    for g in grads:
+                        p = tr.step(g, timeout=60.0)
+                        out.append(np.concatenate(
+                            [np.asarray(p["w"]).ravel(),
+                             np.asarray(p["b"]).ravel()]))
+                trajs[mode] = out
+                if mode == "server":
+                    docs = tr.server_docs()
+                    assert docs
+                    for d in docs.values():
+                        assert d["param_version"] == len(grads)
+                        assert d["opt_step"] == len(grads)
+            finally:
+                s.close()
+        for r, (a, b) in enumerate(zip(trajs["server"],
+                                       trajs["local"])):
+            np.testing.assert_array_equal(
+                a, b, err_msg=f"opt {kw['opt']} round {r}")
+
+
+# ---------------------------------------------------------------------------
+# fast: the ISSUE acceptance — 2-worker Adam with a mid-run codec switch
+# ---------------------------------------------------------------------------
+def _both_step(tr0, tr1, g0, g1):
+    out = [None, None]
+    err = []
+
+    def run1():
+        import jax
+
+        try:
+            # disable_jit is thread-local: the worker-1 baseline must
+            # run the same eager op sequence as worker 0 on the main
+            # thread (harmless in server mode — no jax ops in step).
+            with jax.disable_jit():
+                out[1] = tr1.step(g1, timeout=60.0)
+        except Exception as e:       # surface on the main thread
+            err.append(e)
+
+    t = threading.Thread(target=run1)
+    t.start()
+    out[0] = tr0.step(g0, timeout=60.0)
+    t.join(60)
+    assert not t.is_alive()
+    if err:
+        raise err[0]
+    return out
+
+
+def _flatcat(p):
+    return np.concatenate([np.asarray(p["w"]).ravel(),
+                           np.asarray(p["b"]).ravel()])
+
+
+def test_adam_two_workers_codec_switch_equivalence(ps_server):
+    """The acceptance scenario: 2 workers with server-resident Adam
+    match the worker-local optax baseline f32-exactly round-by-round,
+    INCLUDING a raw->onebit(+EF) renegotiation at a declared round
+    boundary mid-run — the codec/EF law runs untouched under the server
+    update stage, and worker 1 (never told about the switch) recovers
+    through the CODEC_STALE replay exactly as in sum mode.
+    param_version == rounds is the exactly-one-update proof."""
+    import jax
+
+    n = 1 << 14                    # 64 KiB >= the compress floor
+    rng = np.random.RandomState(1)
+    params0 = {"w": rng.randn(n - 16).astype(np.float32),
+               "b": rng.randn(16).astype(np.float32)}
+    g0s = [{"w": rng.randn(n - 16).astype(np.float32),
+            "b": rng.randn(16).astype(np.float32)} for _ in range(8)]
+    g1s = [{"w": rng.randn(n - 16).astype(np.float32),
+            "b": rng.randn(16).astype(np.float32)} for _ in range(8)]
+    kw = {"opt": "adam", "lr": 1e-3}
+
+    def run(mode, dk):
+        port = ps_server(num_workers=2)
+        s0 = PSSession(["127.0.0.1"], [port], worker_id=0,
+                       num_servers=1)
+        s1 = PSSession(["127.0.0.1"], [port], worker_id=1,
+                       num_servers=1)
+        try:
+            tr0 = ServerOptTrainer(s0, params0, kw, mode=mode,
+                                   declared_key=dk, grad_scale=0.5)
+            tr1 = ServerOptTrainer(s1, params0, kw, mode=mode,
+                                   declared_key=dk, grad_scale=0.5)
+            traj = []
+            with jax.disable_jit():
+                for r in range(8):
+                    if r == 3:
+                        # Worker 0 renegotiates; worker 1 discovers via
+                        # CODEC_STALE at the boundary.
+                        res = s0.propose_codec(
+                            dk, {"compressor": "onebit",
+                                 "ef": "vanilla"}, effective_round=4)
+                        assert res["accepted"]
+                    p0, p1 = _both_step(tr0, tr1, g0s[r], g1s[r])
+                    a, b = _flatcat(p0), _flatcat(p1)
+                    # Both workers adopt identical bytes every round —
+                    # params in server mode, sums->optax in local mode.
+                    np.testing.assert_array_equal(
+                        a, b, err_msg=f"{mode} round {r} w0 vs w1")
+                    traj.append(a)
+            stale = s1.transport_stats()["codec_stale_retries"]
+            docs = (tr0.server_docs() if mode == "server" else {})
+            return traj, stale, docs
+        finally:
+            s0.close()
+            s1.close()
+
+    srv_traj, srv_stale, docs = run("server", 61)
+    loc_traj, loc_stale, _ = run("local", 62)
+    for r, (a, b) in enumerate(zip(srv_traj, loc_traj)):
+        np.testing.assert_array_equal(
+            a, b, err_msg=f"server vs local round {r}")
+    # The switch really happened the hard way on worker 1, both modes.
+    assert srv_stale >= 1
+    assert loc_stale >= 1
+    # Exactly one optimizer update per round, per partition.
+    assert docs
+    for d in docs.values():
+        assert d["param_version"] == 8
+        assert d["opt_mode"] == 3
+
+
+# ---------------------------------------------------------------------------
+# fast: replay can never double-step (the PR 3 stale guard, audited)
+# ---------------------------------------------------------------------------
+def test_replay_never_double_steps(ps_server):
+    """A mid-payload connection reset during server-opt training
+    replays through reconnect + re-declare: the trajectory stays
+    bit-identical to the unfaulted run and param_version == rounds —
+    the stale-round guard keeps the replayed push out of the update
+    stage."""
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))), "tools"))
+    from chaos_proxy import ChaosProxy
+
+    rng = np.random.RandomState(5)
+    params0 = {"w": rng.randn(1 << 12).astype(np.float32)}
+    grads = [{"w": rng.randn(1 << 12).astype(np.float32)}
+             for _ in range(7)]
+    kw = {"opt": "adam", "lr": 1e-3}
+
+    def run(port, dk, proxy=None):
+        s = PSSession(["127.0.0.1"], [port], worker_id=0, num_servers=1,
+                      wire_conns=1, reconnect_attempts=8,
+                      reconnect_backoff_ms=20.0)
+        try:
+            tr = ServerOptTrainer(s, params0, kw, mode="server",
+                                  declared_key=dk)
+            outs = []
+            for i, g in enumerate(grads):
+                if proxy is not None and i == 3:
+                    proxy.reset_after(1024)      # mid-blob, one-shot
+                outs.append(_flat(tr.step(g, timeout=60.0)))
+            docs = tr.server_docs()
+            st = s.transport_stats()
+            return outs, docs, st
+        finally:
+            s.close()
+
+    def _flat(p):
+        return np.asarray(p["w"]).ravel()
+
+    ref, ref_docs, _ = run(ps_server(), 71)
+    with ChaosProxy("127.0.0.1", ps_server()) as proxy:
+        got, docs, st = run(proxy.port, 72, proxy=proxy)
+        assert st["reconnects"] >= 1, st
+    for i, (r, g) in enumerate(zip(ref, got)):
+        np.testing.assert_array_equal(r, g, err_msg=f"round {i}")
+    for d in docs.values():
+        assert d["param_version"] == len(grads)
+        assert d["opt_step"] == len(grads)
+
+
+# ---------------------------------------------------------------------------
+# fast: drain — optimizer slots follow the key, byte-equal
+# ---------------------------------------------------------------------------
+def test_drain_migrates_optimizer_slots_byte_equal(ring_servers):
+    """Draining 1-of-2 ring servers mid-training with server-resident
+    Adam: the weight trajectory stays bit-identical to the unfaulted
+    run, and every migrated partition's optimizer slots (params, m, v,
+    step, param_version) land byte-equal on the new owner — slots_crc
+    is the proof (the CMD_MIGRATE opt trailer)."""
+    rng = np.random.RandomState(7)
+    nel = 6 * (1 << 14)            # 384 KiB -> 6 partitions at 64 KiB
+    params0 = {"w": rng.randn(nel).astype(np.float32)}
+    grads = [{"w": rng.randn(nel).astype(np.float32)}
+             for _ in range(10)]
+    kw = {"opt": "adam", "lr": 1e-3}
+
+    def run(ports, dk, drain_at=None):
+        s = _ring_session(ports)
+        try:
+            tr = ServerOptTrainer(s, params0, kw, mode="server",
+                                  declared_key=dk)
+            traj = []
+            pre_docs = post_docs = None
+            target = None
+            for i, g in enumerate(grads):
+                if drain_at is not None and i == drain_at:
+                    by_slot = {}
+                    for pk in s._opt_pkeys(dk):
+                        slot = s._pkey_srv.get(pk, 0)
+                        by_slot[slot] = by_slot.get(slot, 0) + 1
+                    # Drain whichever non-0 slot owns partitions (server
+                    # 0 holds the startup barrier).
+                    target = max((sl for sl in by_slot if sl != 0),
+                                 key=lambda sl: by_slot[sl], default=None)
+                    assert target is not None and by_slot[target] > 0
+                    pre_docs = s.fetch_opt_docs(dk)
+                    doc = s.drain_server(target)
+                    assert doc["keys_owned"] == 0
+                    post_docs = s.fetch_opt_docs(dk)
+                traj.append(np.asarray(tr.step(g, timeout=60.0)["w"]))
+            return traj, pre_docs, post_docs
+        finally:
+            s.close()
+
+    ports_a, _ = ring_servers(2)
+    ref, _, _ = run(ports_a, 81)
+    ports_b, _ = ring_servers(2)
+    got, pre, post = run(ports_b, 81, drain_at=4)
+
+    for i, (r, g) in enumerate(zip(ref, got)):
+        np.testing.assert_array_equal(r, g, err_msg=f"round {i}")
+    # Every partition's slots crossed the boundary byte-equal.
+    assert pre and post and set(pre) == set(post)
+    moved = 0
+    for pk in pre:
+        assert post[pk]["param_version"] == pre[pk]["param_version"], pk
+        assert post[pk]["opt_step"] == pre[pk]["opt_step"], pk
+        assert post[pk]["slots_crc"] == pre[pk]["slots_crc"], pk
+        assert post[pk]["kwargs"] == pre[pk]["kwargs"], pk
+        moved += 1
+    assert moved >= 1
+
+
+# ---------------------------------------------------------------------------
+# fast: SIGKILL failover — stateless mode recovers bit-identically
+# ---------------------------------------------------------------------------
+def _kill_listener(port: int) -> None:
+    """SIGKILL the process listening on 127.0.0.1:`port` (the crash
+    fault — no FIN, no drain; same discovery as test_server_elastic)."""
+    import signal
+    out = subprocess.run(
+        ["python", "-c", (
+            "import glob,os\n"
+            f"port={port}\n"
+            "hexp = '%04X' % port\n"
+            "inode = None\n"
+            "for line in open('/proc/net/tcp'):\n"
+            "    f = line.split()\n"
+            "    if len(f) > 9 and f[1].endswith(':' + hexp) "
+            "and f[3] == '0A':\n"
+            "        inode = f[9]\n"
+            "if inode:\n"
+            "    for fd in glob.glob('/proc/[0-9]*/fd/*'):\n"
+            "        try:\n"
+            "            if os.readlink(fd) == 'socket:[' + inode + ']':\n"
+            "                print(fd.split('/')[2]); break\n"
+            "        except OSError: pass\n")],
+        capture_output=True, text=True)
+    pid = out.stdout.strip()
+    assert pid, f"no listener found on port {port}"
+    os.kill(int(pid), signal.SIGKILL)
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        try:
+            socket.create_connection(("127.0.0.1", port), 0.3).close()
+            time.sleep(0.1)
+        except OSError:
+            return
+
+
+def test_sigkill_failover_reseeds_params(ring_servers):
+    """1-of-2 ring servers SIGKILLed mid-training with server-resident
+    SGD: the survivor claims the dead ranges, the session re-declares
+    the optimizer and re-seeds each claimed partition's params from the
+    trainer's adopted view, and the weight trajectory stays
+    bit-identical to the unfaulted closed-form run (SGD carries no m/v,
+    so nothing is lost — the documented stateful-mode caveat does not
+    apply)."""
+    lr = 0.05
+    rng = np.random.RandomState(9)
+    nel = 8 * (1 << 14)
+    params0 = {"w": rng.randn(nel).astype(np.float32)}
+    grads = [{"w": rng.randn(nel).astype(np.float32)}
+             for _ in range(8)]
+
+    ports, _ = ring_servers(2)
+    s = _ring_session(ports, srv_evict=0.8)
+    try:
+        tr = ServerOptTrainer(s, params0, {"opt": "sgd", "lr": lr},
+                              mode="server", declared_key=91)
+        # Some partitions must actually live on the doomed server.
+        doomed = [pk for pk, srv in s._pkey_srv.items()
+                  if pk >> 16 == 91 and srv == 1]
+        assert doomed, "ring placed nothing on server 1; test vacuous"
+        traj = []
+        for i, g in enumerate(grads):
+            if i == 3:
+                _kill_listener(ports[1])
+            traj.append(np.asarray(tr.step(g, timeout=120.0)["w"]))
+        st = s.transport_stats()
+        assert st["server_failovers"] >= 1
+        assert st["opt_reseeds"] >= 1
+        docs = tr.server_docs()
+        assert docs
+    finally:
+        s.close()
+
+    # Closed-form SGD (bit-exact: p = p + (-lr) * g, optax op order).
+    p = params0["w"].copy()
+    nlr = np.float32(-1.0 * lr)
+    for i, g in enumerate(grads):
+        p = p + nlr * g["w"]
+        np.testing.assert_array_equal(traj[i], p, err_msg=f"round {i}")
+
+
+# ---------------------------------------------------------------------------
+# fast: unarmed wire byte-identity + local mode adds nothing
+# ---------------------------------------------------------------------------
+def _stub_roundtrip(use_trainer):
+    store = {}
+
+    def handler(cmd, dt, fl, req_id, wid, key, payload):
+        if cmd == CMD_HELLO:
+            return 0, b"\x00\x00"
+        if cmd == CMD_INIT:
+            return 0, struct.pack("<Q", 0)
+        if cmd == CMD_PUSH:
+            store[key] = bytes(payload)
+            return 0, b""
+        if cmd == CMD_PULL:
+            return 0, store[key]
+        return 1, b""
+
+    srv = StubPSServer(handler, record=True)
+    try:
+        s = PSSession(["127.0.0.1"], [srv.port], worker_id=0,
+                      num_servers=1, wire_conns=1)
+        rng = np.random.RandomState(3)
+        params0 = {"w": rng.randn(256).astype(np.float32)}
+        grads = [{"w": rng.randn(256).astype(np.float32)}
+                 for _ in range(3)]
+        if use_trainer:
+            tr = ServerOptTrainer(s, params0, {"opt": "sgd", "lr": 0.1},
+                                  mode="local", declared_key=3)
+            for g in grads:
+                tr.step(g)
+        else:
+            for g in grads:
+                s.push_pull(3, np.asarray(g["w"], np.float32).ravel())
+        s.close()
+        with srv.lock:
+            return list(srv.frames)
+    finally:
+        srv.close()
+
+
+def test_signal_window_carries_opt_keys_slice(ps_server):
+    """The live half of the param_version_stall plumbing: an armed run's
+    window summaries carry the minimal `opt_keys` slice (completed_round
+    / param_version / opt_mode per armed key) inside the server section
+    — what the doctor rule evaluates — while the full per-key CMD_STATS
+    map stays stripped."""
+    from byteps_tpu.common import doctor, signals
+
+    s = PSSession(["127.0.0.1"], [ps_server()], worker_id=0,
+                  num_servers=1)
+    plane = signals.arm(window_s=60.0, start_thread=False,
+                        refresh=lambda: s.server_stats())
+    try:
+        rng = np.random.RandomState(11)
+        params0 = {"w": rng.randn(1 << 10).astype(np.float32)}
+        tr = ServerOptTrainer(s, params0, {"opt": "adam", "lr": 1e-3},
+                              mode="server", declared_key=95)
+        tr.step({"w": rng.randn(1 << 10).astype(np.float32)})
+        w = plane.roll()
+        sec = w.get("server") or {}
+        assert "keys" not in sec                 # still stripped
+        opt = sec.get("opt_keys") or {}
+        assert opt, sec.keys()
+        row = next(iter(opt.values()))
+        assert row["opt_mode"] == 3
+        assert row["param_version"] == 1
+        # And the rule consumes exactly this shape: freeze
+        # param_version while rounds advance -> it fires.
+        frozen = [
+            {"window": i, "metrics": {}, "events": {}, "keys": {},
+             "server": {"opt_keys": {"9": {
+                 "completed_round": 2 + i, "param_version": 1,
+                 "opt_mode": 3}}}}
+            for i in range(3)]
+        fired = {f["rule"] for f in
+                 doctor.evaluate_stream(frozen)["history"]}
+        assert "param_version_stall" in fired
+    finally:
+        signals.disarm()
+        s.close()
+
+
+def test_local_mode_wire_identity_no_opt_frames():
+    """A worker-local ServerOptTrainer is wire-byte-identical to the
+    plain push_pull loop it wraps — the optimizer plane is fully
+    off-wire until armed, and NO CMD_OPT frame is ever sent (the
+    recording-stub law every prior plane obeys)."""
+    off = _stub_roundtrip(use_trainer=False)
+    on = _stub_roundtrip(use_trainer=True)
+    assert [h for h, _, _ in off] == [h for h, _, _ in on]
+    assert [b for _, _, b in off] == [b for _, _, b in on]
+    assert all(c != CMD_OPT for _, c, _ in on)

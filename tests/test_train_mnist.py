@@ -16,6 +16,7 @@ from jax.sharding import PartitionSpec as P
 
 import byteps_tpu as bps
 
+from byteps_tpu.common.compat import shard_map as _compat_shard_map
 
 def _mlp_init(key, sizes=(784, 64, 10)):
     params = []
@@ -178,7 +179,7 @@ def test_hierarchical_optimizer_trains():
 
     import functools
     @functools.partial(
-        jax.shard_map, mesh=mesh,
+        _compat_shard_map, mesh=mesh,
         in_specs=(P(), P(), P(("dcn_dp", "ici_dp"))),
         out_specs=(P(), P(), P()), check_vma=False)
     def _step(params, opt_state, batch):

@@ -260,6 +260,8 @@ def render(metrics: dict, prev: dict, dt: float,
         for k, v in (metrics.get("bps_server_migrations") or {}).items():
             d = dict(k)
             mig.setdefault(d.get("server"), {})[d.get("direction")] = int(v)
+        slot_bytes = {dict(k).get("server"): int(v) for k, v in
+                      (metrics.get("bps_opt_slot_bytes") or {}).items()}
         total_owned = sum(owned.values()) or 1
         lines.append(f"PS servers (ring epoch {ring_epoch})")
         for key, alive in sorted(srv_alive.items(),
@@ -272,8 +274,23 @@ def render(metrics: dict, prev: dict, dt: float,
             migtxt = (f"  mig in/out {m.get('in', 0)}/{m.get('out', 0)}"
                       if m.get("in") or m.get("out") else "")
             flag = "" if alive else "  <-- dead/retired"
+            ob = slot_bytes.get(sid)
+            opttxt = f"  opt slots {_fmt_bytes(ob)}" if ob else ""
             lines.append(f"  server {sid:>3}  keys {n:5d}  {bar}"
-                         f"{migtxt}{flag}")
+                         f"{migtxt}{opttxt}{flag}")
+        lines.append("")
+
+    # Server-resident optimizer plane: per-key published update counts
+    # (bps_param_version advances exactly one per completed round — a
+    # frozen row under advancing rounds is the param_version_stall
+    # doctor rule in the making).
+    pv = metrics.get("bps_param_version") or {}
+    if pv:
+        lines.append("server-resident optimizer (param_version per key)")
+        for key, v in sorted(pv.items(),
+                             key=lambda kv: dict(kv[0]).get("key", "")):
+            name = dict(key).get("key", "?")
+            lines.append(f"  key {name:<24} updates {int(v):8d}")
         lines.append("")
 
     lag = metrics.get("bps_worker_round_lag") or {}
