@@ -58,6 +58,12 @@ Modes:
                      emits serveropt_step_time_gap_pct plus the
                      structural detail (worker optimizer-state bytes ->
                      0 in server mode, param_version == rounds)
+  BENCH_HIER=1       hierarchical-reduction bench: the same 4-worker
+                     sync workload flat vs 2-slice x 2-chip (in-graph
+                     psum intra-slice, leaders-only on the wire;
+                     BENCH_HIER_SLICE overrides the slice size); emits
+                     hier_wire_bytes_saved_pct plus the per-worker wire
+                     bytes and step-time deltas
   BENCH_TELEMETRY=1  telemetry-overhead bench: sync-round time with the
                      metrics endpoint scraped at 20Hz vs export plane off
                      (emits telemetry_overhead_ms; expected within noise)
@@ -1481,6 +1487,117 @@ def bench_autotune():
         proc.wait()
 
 
+def bench_hier():
+    """Hierarchical-reduction benchmark (BENCH_HIER=1): the ISSUE-15
+    headline — the same 4-worker synchronous workload run FLAT (every
+    chip pushes/pulls the full gradient) and HIERARCHICAL (2 slices x 2
+    chips: in-graph psum intra-slice, one leader per slice on the wire,
+    broadcast back), against the real native server over loopback.
+
+    Headline ``hier_wire_bytes_saved_pct`` = (1 - hier_bytes /
+    flat_bytes) * 100 — structurally ~(1 - 1/S) for slice size S, read
+    from the transport lane counters (payload bytes actually sent), with
+    the step-time delta in the detail.  Host-only honesty: on a small
+    loopback container the in-graph psum and the wire round trip share
+    cores, so step time can land anywhere within noise — the number
+    being measured is the wire traffic removed, which is what DCN-bound
+    pods buy with this mode.
+    """
+    import threading
+
+    import numpy as np
+
+    from byteps_tpu.parallel.hierarchy import (HierarchicalReducer,
+                                               reset_slice_groups)
+    from byteps_tpu.server.client import PSSession
+
+    reps = int(os.environ.get("BENCH_HIER_REPS", "30"))
+    slice_size = max(1, int(os.environ.get("BENCH_HIER_SLICE", "2")))
+    world = 4
+    n = 1 << 18                       # 1 MiB f32 per worker per round
+    rng = np.random.default_rng(0)
+    grads = [rng.standard_normal(n).astype(np.float32)
+             for _ in range(world)]
+
+    def run(hier: bool) -> dict:
+        reset_slice_groups()
+        extra = ({"BYTEPS_TPU_SLICE_SIZE": str(slice_size)}
+                 if hier else None)
+        proc, port = _boot_ps_server(engine_threads=2, num_workers=world,
+                                     extra_env=extra)
+        try:
+            sessions = [PSSession(["127.0.0.1"], [port], worker_id=w,
+                                  num_servers=1, wire_conns=1,
+                                  slice_size=slice_size if hier else 1)
+                        for w in range(world)]
+            reducers = ([HierarchicalReducer(s, w, slice_size,
+                                             world=world)
+                         for w, s in enumerate(sessions)]
+                        if hier else None)
+            times = []
+
+            def worker(w, barrier):
+                for r in range(reps + 3):
+                    barrier.wait()
+                    t0 = time.perf_counter()
+                    if hier:
+                        reducers[w].push_pull_flat(1, grads[w])
+                    else:
+                        sessions[w].push_pull_async(
+                            1, grads[w]).wait(60)
+                    if w == 0 and r >= 3:          # settle 3 rounds
+                        times.append(time.perf_counter() - t0)
+
+            barrier = threading.Barrier(world)
+            ts = [threading.Thread(target=worker, args=(w, barrier))
+                  for w in range(world)]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join(timeout=300)
+            if any(t.is_alive() for t in ts):
+                raise RuntimeError("bench worker hung")
+            per_worker = [s.transport_stats()["lane_bytes_total"]
+                          for s in sessions]
+            for s in sessions:
+                s.close()
+            return {"step_ms": sorted(times)[len(times) // 2] * 1e3,
+                    "bytes_per_worker": per_worker,
+                    "bytes_total": int(sum(per_worker))}
+        finally:
+            proc.kill()
+            proc.wait()
+
+    flat = run(False)
+    hier = run(True)
+    saved_pct = (1.0 - hier["bytes_total"] / flat["bytes_total"]) * 100.0
+    print(json.dumps({
+        "metric": "hier_wire_bytes_saved_pct",
+        "value": round(saved_pct, 2),
+        "unit": "pct",
+        "detail": {
+            "slice_size": slice_size,
+            "workers": world,
+            "flat_bytes_total": flat["bytes_total"],
+            "hier_bytes_total": hier["bytes_total"],
+            "flat_bytes_per_worker": flat["bytes_per_worker"],
+            "hier_bytes_per_worker": hier["bytes_per_worker"],
+            "flat_step_ms": round(flat["step_ms"], 3),
+            "hier_step_ms": round(hier["step_ms"], 3),
+            "step_time_delta_pct": round(
+                (hier["step_ms"] - flat["step_ms"])
+                / flat["step_ms"] * 100.0, 2),
+            "reps": reps,
+            "note": "value = wire payload bytes removed by leaders-only "
+                    "push_pull, ~(1 - 1/slice_size) by construction; "
+                    "step-time delta on a loopback container shares "
+                    "cores between the psum and the wire and is "
+                    "reported as detail, not headline",
+            **_note(),
+        },
+    }))
+
+
 def bench_serveropt():
     """Server-resident-optimizer benchmark (BENCH_SERVEROPT=1): step
     time and per-worker optimizer-state bytes, server-side update stage
@@ -2030,6 +2147,8 @@ def main():
         bench_doctor()       # host-only: no device backend involved
     elif os.environ.get("BENCH_SERVEROPT", "0") == "1":
         bench_serveropt()    # host-only: no device backend involved
+    elif os.environ.get("BENCH_HIER", "0") == "1":
+        bench_hier()         # host-only: no device backend involved
     elif os.environ.get("BENCH_AUTOTUNE", "0") == "1":
         bench_autotune()     # host-only: no device backend involved
     elif os.environ.get("BENCH_CNN", ""):

@@ -23,10 +23,11 @@ from .common.api import (
     get_pushpull_speed, get_codec_stats, get_fusion_stats,
     get_transport_stats, get_metrics, get_server_stats,
     get_health, get_audit, get_key_signals, get_diagnosis,
-    get_tuner,
+    get_tuner, get_hierarchy,
     mark_step, current_step,
 )
 from .parallel.async_ps import AsyncPSTrainer
+from .parallel.hierarchy import HierarchicalReducer, SliceGroup
 from .parallel.server_opt import ServerOptTrainer
 from .ops.compression import Compression
 from .ops import collectives
@@ -35,7 +36,8 @@ from .parallel.data_parallel import (
     distributed_gradient_transform, build_train_step,
 )
 from .parallel.mesh import (
-    make_mesh, make_hierarchical_mesh, get_mesh, set_mesh, reset_mesh,
+    make_mesh, make_hierarchical_mesh, make_slice_mesh, get_mesh,
+    set_mesh, reset_mesh,
 )
 from .parallel.cross_barrier import CrossBarrierDriver, run_cross_barrier
 from .parallel.sharded import (
@@ -70,13 +72,14 @@ __all__ = [
     "get_pushpull_speed", "get_codec_stats", "get_fusion_stats",
     "get_transport_stats", "get_metrics", "get_server_stats",
     "get_health", "get_audit", "get_key_signals", "get_diagnosis",
-    "get_tuner",
+    "get_tuner", "get_hierarchy",
+    "HierarchicalReducer", "SliceGroup",
     "mark_step", "current_step",
     "Compression", "collectives",
     "DistributedOptimizer", "DistributedGradientTransformation",
     "distributed_gradient_transform", "build_train_step",
-    "make_mesh", "make_hierarchical_mesh", "get_mesh", "set_mesh",
-    "reset_mesh",
+    "make_mesh", "make_hierarchical_mesh", "make_slice_mesh",
+    "get_mesh", "set_mesh", "reset_mesh",
     "CrossBarrierDriver", "run_cross_barrier",
     "build_sharded_train_step", "shard_params", "init_sharded",
     "zero1_opt_specs", "zero1_init", "fsdp_param_specs", "fsdp_init",

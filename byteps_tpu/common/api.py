@@ -74,6 +74,11 @@ class _State:
     # same window stream as the doctor; worker 0 proposes CMD_CODEC
     # switches, everyone else observes/adopts.
     tuner: Optional[Any] = None
+    # Hierarchical reduction (BYTEPS_TPU_HIERARCHY=1, PS mode): the
+    # HierarchicalReducer push_pull_tree/push_pull_async route through —
+    # slice-reduce in-graph, leader-only wire round, broadcast back.
+    # None (default) keeps the flat path byte-identical.
+    hierarchy: Optional[Any] = None
 
 
 _state = _State()
@@ -187,6 +192,34 @@ def init(lazy: bool = True) -> None:
                 get_logger().warning(
                     "server clock sync unavailable (%s); trace will "
                     "carry worker spans only", e)
+    if cfg.hierarchy:
+        # Hierarchical reduction (docs/architecture.md "Hierarchical
+        # reduction"): slice-reduce in-graph, one leader per slice on
+        # the wire.  PS mode only — the in-graph collective plane
+        # already composes its own hierarchy through the mesh axes.
+        if _state.ps_session is None:
+            get_logger().warning(
+                "BYTEPS_TPU_HIERARCHY=1 outside PS mode is a no-op: "
+                "the collective plane reduces intra-slice in-graph "
+                "already (dp/ici_dp mesh axes) — the knob arms the PS "
+                "tier's leader-aware push_pull only")
+        else:
+            from ..parallel import hierarchy as hierarchy_mod
+            _state.hierarchy = hierarchy_mod.maybe_reducer(
+                _state.ps_session)
+            if _state.hierarchy is not None:
+                h = _state.hierarchy
+                get_logger().info(
+                    "hierarchical reduction armed: slice=%d size=%d "
+                    "members=%s leader=%s", h.slice_id, h.slice_size,
+                    h.group.members, h.leader())
+                # Misconfig check while it is still cheap to name: a
+                # flat server under leader-only pushes would otherwise
+                # just hang every round until the wait timeout.
+                mismatch = h.verify_topology()
+                if mismatch:
+                    get_logger().error(
+                        "hierarchical topology mismatch: %s", mismatch)
     _state.initialized = True
     # Black-box flight recorder: lifecycle events always record (bounded
     # in-memory ring, no I/O); postmortem bundles + the faulthandler
@@ -259,6 +292,14 @@ def shutdown() -> None:
     # Dump BEFORE the session teardown: the merged export drains the
     # server-side span ring over the live connections.
     _maybe_dump_trace(final=True)
+    if _state.hierarchy is not None:
+        # Retire this session's SliceGroup from the process registry: a
+        # re-init must meet fresh rendezvous counters (a failed round
+        # can leave them desynced), while groups other in-process
+        # workers hold stay untouched.
+        from ..parallel.hierarchy import drop_slice_group
+        drop_slice_group(_state.hierarchy.group)
+    _state.hierarchy = None
     if _state.ps_session is not None:
         _state.ps_session.close()
         _state.ps_session = None
@@ -787,6 +828,36 @@ def _fused_tree_push_pull(name, leaves, metas, sep_idx, batch_idx,
     sess = _state.ps_session
     if sess is not None:
         from ..ops.compression import Compression
+        hier = _state.hierarchy
+        rkey = None
+        if hier is not None:
+            # Hierarchical reduction: slice-reduce every unit's RAW f32
+            # payload in one in-graph psum BEFORE any wire compression
+            # (the leader's codec then encodes the slice sum once).
+            # The rendezvous key is the unit key tuple — deterministic
+            # across workers regardless of unrelated traffic.  The f32
+            # cast here is NOT a new precision loss for the forced-solo
+            # non-float units: the PS wire is f32 for every payload
+            # (PSSession._stage casts), so flat PS mode already sums
+            # them in f32 — the slice psum is the same precision class.
+            rkey = tuple(declare(nm) for nm, _, _, _, _ in units)
+            reduced = hier.reduce_payloads(
+                rkey, [np.asarray(p, np.float32).ravel()
+                       for _, p, _, _, _ in units])
+            units = [(nm, jnp.asarray(red), prio, comp, members)
+                     for (nm, _p, prio, comp, members), red
+                     in zip(units, reduced)]
+            if not hier.is_leader:
+                # Followers never touch the data plane: the leader's
+                # broadcast delivers the round's averaged unit outputs.
+                skipped = sum(int(np.size(r)) * 4 for r in reduced)
+                for (nm, p, _, _, _) in units:
+                    _debug_sample("push", nm, p)
+                outs_vecs = hier.await_outs(rkey, skipped_bytes=skipped)
+                for (nm, _, _, _, members), vec in zip(units, outs_vecs):
+                    scatter(members, jnp.asarray(vec))
+                    _debug_sample("pull", nm, vec)
+                return outs
         items, ctxs = [], []
         for nm, payload, prio, comp, members in units:
             _debug_sample("push", nm, payload)
@@ -803,14 +874,28 @@ def _fused_tree_push_pull(name, leaves, metas, sep_idx, batch_idx,
                     dk, [leaf_name(li) for li, _ in members])
             items.append((dk, wire, prio))
             ctxs.append((comp, ctx))
-        handles = sess.push_pull_group(items)
-        for (nm, _, _, _, members), h, (comp, ctx) in zip(
-                units, handles, ctxs):
-            out = comp.decompress(jnp.asarray(h.wait()), ctx)
-            if average:
-                out = out / size()
-            scatter(members, out)
-            _debug_sample("pull", nm, out)
+        pulled_vecs = []
+        try:
+            handles = sess.push_pull_group(items)
+            for (nm, _, _, _, members), h, (comp, ctx) in zip(
+                    units, handles, ctxs):
+                out = comp.decompress(jnp.asarray(h.wait()), ctx)
+                if average:
+                    out = out / size()
+                scatter(members, out)
+                _debug_sample("pull", nm, out)
+                if hier is not None:
+                    pulled_vecs.append(
+                        np.asarray(out, np.float32).ravel())
+        except Exception as e:
+            if hier is not None:
+                # Slice followers are blocked on the broadcast — a
+                # leader-side wire failure must fail the whole slice's
+                # round loudly, not strand it.
+                hier.publish_failure(rkey, e)
+            raise
+        if hier is not None:
+            hier.publish_outs(rkey, pulled_vecs)
         cfg = _state.config or get_config()
         if cfg.telemetry_on:
             telemetry.record_pushpull(
@@ -862,6 +947,51 @@ def push_pull_async(tensor: jax.Array, name: Optional[str] = None,
     core = get_core()
     handle = core.handle_allocate()
     t0 = core.trace_now_us()
+    hier = _state.hierarchy
+    if _state.ps_session is not None and hier is not None:
+        # Hierarchical reduction: slice-reduce the RAW tensor in-graph
+        # first; only the slice leader compresses and rides the wire,
+        # and the decompressed pull broadcasts back — so a follower's
+        # push_pull costs zero wire bytes.  The intra-slice reduce is
+        # f32 (in-graph psum); the wire codec then applies to the slice
+        # sum once instead of S per-chip gradients.
+        shape, dt = tensor.shape, tensor.dtype
+
+        def _leader_dispatch(reduced, comp=compression, prio=priority):
+            wire, cctx = comp.compress(jnp.asarray(reduced))
+            inner = _state.ps_session.push_pull_async(
+                dk, wire, priority=prio)
+
+            class _Decomp:
+                def done(self):
+                    return inner.done()
+
+                def wait(self, timeout=300.0):
+                    return np.asarray(
+                        comp.decompress(jnp.asarray(inner.wait(timeout)),
+                                        cctx), np.float32)
+
+            return _Decomp()
+
+        ph = hier.dispatch_round(
+            dk, np.asarray(tensor, np.float32).ravel(),
+            priority=priority, leader_dispatch=_leader_dispatch)
+
+        def _resolve(ph=ph, shape=shape, dt=dt, avg=average):
+            out = jnp.asarray(ph.wait()).reshape(shape)
+            return (out / size() if avg else out).astype(dt)
+
+        _resolve.ps_handle = ph
+        cfg = _state.config or get_config()
+        if cfg.telemetry_on and getattr(ph, "carried_wire", True):
+            # Followers sent nothing: recording their tensor bytes would
+            # make the push/pull counters deny the very traffic
+            # reduction the saved-bytes counter reports (the fused path
+            # skips follower recording the same way).
+            telemetry.record_pushpull(tensor.size * tensor.dtype.itemsize)
+        with _state.lock:
+            _state.handles[handle] = (_resolve, name, t0)
+        return handle
     wire, ctx = compression.compress(tensor)
     if _state.ps_session is not None:
         # True async: partitions go through the session's priority-scheduled
@@ -1240,6 +1370,21 @@ def get_tuner() -> dict:
     return _state.tuner.state()
 
 
+def get_hierarchy() -> dict:
+    """The hierarchical-reduction plane's state (``BYTEPS_TPU_HIERARCHY=1``,
+    PS mode): slice topology (id/size/members), the CURRENT leader under
+    the membership epoch, whether this worker is it, and the counters —
+    leader vs follower wire rounds, in-graph slice reductions, and
+    ``wire_bytes_saved`` (push+pull payload bytes followers never sent,
+    the ``bps_hierarchy_wire_bytes_saved_total`` counter's source).
+    ``{"armed": False}`` in flat mode."""
+    if _state.hierarchy is None:
+        return {"armed": False, "slice_size": 1, "is_leader": True,
+                "leader_rounds": 0, "follower_rounds": 0,
+                "intra_reduces": 0, "wire_bytes_saved": 0}
+    return _state.hierarchy.snapshot()
+
+
 def get_health() -> dict:
     """The gradient-health monitor's last per-key samples
     (``BYTEPS_TPU_HEALTH_SAMPLE_ROUNDS`` > 0, PS mode): ``{"sample_rounds",
@@ -1414,8 +1559,16 @@ def _merge_server_trace(path: str, exiting: bool = False) -> None:
         events = doc.get("traceEvents", [])
         # tid present on metadata too: older consumers iterate e["tid"]
         # over the whole file.
+        hier = _state.hierarchy
+        wname = f"worker{rank()}"
+        if hier is not None:
+            # Per-slice lanes: the worker's process lane names its slice
+            # and role, so a hierarchical trace reads as slices (leader
+            # lanes carrying wire spans, follower lanes without them).
+            wname += (f" slice{hier.slice_id}"
+                      + (" leader" if hier.is_leader else ""))
         meta = [{"name": "process_name", "ph": "M", "pid": rank(),
-                 "tid": 0, "args": {"name": f"worker{rank()}"}}]
+                 "tid": 0, "args": {"name": wname}}]
         if sess is not None:
             core = get_core()
             try:
@@ -1440,13 +1593,18 @@ def _merge_server_trace(path: str, exiting: bool = False) -> None:
                 dk, pidx = s["key"] >> 16, s["key"] & 0xFFFF
                 nm = core.declared_name(dk) or f"key_{dk}"
                 seen_servers.add(s["server"])
+                args = {"key": s["key"], "round": s["round"],
+                        "worker": s["worker"], "bytes": s["bytes"]}
+                if hier is not None:
+                    # Slice attribution on server spans: which slice's
+                    # leader pushed this partition.
+                    args["slice"] = s["worker"] // hier.slice_size
                 events.append({
                     "name": f"{nm}.part{pidx}", "cat": "comm", "ph": "X",
                     "ts": s["ts_us"], "dur": s["dur_us"],
                     "pid": trace_analysis.SERVER_PID_BASE + s["server"],
                     "tid": s["stage"],
-                    "args": {"key": s["key"], "round": s["round"],
-                             "worker": s["worker"], "bytes": s["bytes"]}})
+                    "args": args})
             for i in sorted(seen_servers):
                 meta.append({"name": "process_name", "ph": "M",
                              "pid": trace_analysis.SERVER_PID_BASE + i,

@@ -233,6 +233,16 @@ class Config:
     # PS parity mode: route push_pull through the host KV server tier
     # instead of XLA collectives (reference default path).
     ps_mode: bool = False                # BYTEPS_TPU_PS_MODE
+    # Hierarchical reduction over the PS tier (parallel/hierarchy.py):
+    # workers slice-reduce in-graph (psum/shard_map), one leader per
+    # slice runs the wire push_pull, the pulled value broadcasts back —
+    # per-slice wire bytes drop by the slice size.  hierarchy arms the
+    # plane on workers; slice_size (chips per slice, contiguous worker
+    # ids) must be set identically on workers AND servers — the server
+    # counts round completion in slices under it.  Defaults off/1: flat
+    # mode, wire byte-identical to pre-hierarchy.
+    hierarchy: bool = False              # BYTEPS_TPU_HIERARCHY
+    slice_size: int = 1                  # BYTEPS_TPU_SLICE_SIZE
 
     @classmethod
     def from_env(cls) -> "Config":
@@ -314,6 +324,8 @@ class Config:
             mesh_ep=_env_int("BYTEPS_TPU_MESH_EP", 1),
             ici_size=_env_int("BYTEPS_TPU_ICI_SIZE", 0),
             ps_mode=_env_bool("BYTEPS_TPU_PS_MODE"),
+            hierarchy=_env_bool("BYTEPS_TPU_HIERARCHY"),
+            slice_size=max(1, _env_int("BYTEPS_TPU_SLICE_SIZE", 1)),
         )
 
 

@@ -395,6 +395,50 @@ def pushpull_speed_mbps() -> float:
 
 
 # ---------------------------------------------------------------------------
+# Hierarchical reduction (parallel/hierarchy.py; BYTEPS_TPU_HIERARCHY=1)
+# ---------------------------------------------------------------------------
+def record_hierarchy_saved(nbytes: int,
+                           registry: Optional[MetricsRegistry] = None
+                           ) -> None:
+    """Count push+pull payload bytes a follower did NOT send because its
+    slice leader carried the round — the hierarchical plane's headline
+    counter (``bps_hierarchy_wire_bytes_saved_total``).  Bytes are the
+    LOGICAL f32 payload size (what the PS wire carries uncompressed);
+    with a wire codec registered the on-wire saving is the codec's
+    encoded size instead — smaller, same ratio."""
+    (registry or get_registry()).counter(
+        "bps_hierarchy_wire_bytes_saved_total",
+        help="logical (uncompressed f32) push+pull payload bytes "
+             "skipped by followers whose slice leader carried the "
+             "wire round").inc(int(nbytes))
+
+
+def update_hierarchy(slice_id: int, slice_size: int, is_leader: bool,
+                     members: int,
+                     registry: Optional[MetricsRegistry] = None) -> None:
+    """Fold this worker's hierarchical-reduction role into the registry.
+
+    ``bps_hierarchy_slice_size`` / ``bps_hierarchy_slice_id`` pin the
+    topology; ``bps_hierarchy_is_leader`` is the 0/1 leadership gauge —
+    a leadership move after an eviction is visible as the gauge flipping
+    on the follower that took over.  Quiet (never registered) for flat
+    runs: only an armed reducer calls this."""
+    reg = registry or get_registry()
+    reg.gauge("bps_hierarchy_slice_size",
+              help="chips per slice (BYTEPS_TPU_SLICE_SIZE; 1 = flat)"
+              ).set(int(slice_size))
+    reg.gauge("bps_hierarchy_slice_id",
+              help="this worker's slice id (worker_id // slice_size)"
+              ).set(int(slice_id))
+    reg.gauge("bps_hierarchy_slice_members",
+              help="members of this worker's slice").set(int(members))
+    reg.gauge("bps_hierarchy_is_leader",
+              help="1 = this worker runs its slice's wire push_pull "
+                   "under the current membership epoch"
+              ).set(1 if is_leader else 0)
+
+
+# ---------------------------------------------------------------------------
 # Straggler detection (per-worker round lag from CMD_STATS)
 # ---------------------------------------------------------------------------
 def update_membership(membership: dict, registry: Optional[MetricsRegistry]

@@ -1516,6 +1516,24 @@ class Server {
     const int64_t now = NowUs();
     for (int i = 0; i < num_workers_; ++i)
       members_[static_cast<uint32_t>(i)] = MemberRec{now, true};
+    // Hierarchical reduction (BYTEPS_TPU_SLICE_SIZE, parallel/
+    // hierarchy.py): workers are grouped into slices of this many
+    // contiguous ids, only one leader per slice pushes/pulls, and
+    // RoundComplete counts SLICES covered, not chips — a slice whose
+    // every member departed stops being expected through the same
+    // epoch/round_members machinery elastic membership already uses.
+    // 1 (default) keeps the historical per-worker completion exactly.
+    const char* ss = std::getenv("BYTEPS_TPU_SLICE_SIZE");
+    if (ss && ss[0]) {
+      char* end = nullptr;
+      uint64_t v = std::strtoull(ss, &end, 10);
+      if (end && *end == '\0' && v >= 1)
+        slice_size_ = static_cast<int>(v);
+      else
+        std::fprintf(stderr,
+                     "[byteps server] ignoring invalid "
+                     "BYTEPS_TPU_SLICE_SIZE=%s (want >= 1)\n", ss);
+    }
     // Elastic PS tier (consistent-hash ring).  BYTEPS_TPU_RING=1 arms
     // ring placement + ownership enforcement; BYTEPS_TPU_RING_JOIN=1
     // additionally makes this a JOINING server (it announces itself to
@@ -1982,7 +2000,7 @@ class Server {
                   "\"moved_frames\":%llu,\"codec_sets\":%llu,"
                   "\"codec_stale_frames\":%llu,\"opt_sets\":%llu,"
                   "\"opt_updates\":%llu,\"opt_slot_bytes\":%llu,"
-                  "\"keys\":{",
+                  "\"slice_size\":%d,\"keys\":{",
                   static_cast<unsigned long long>(
                       bytes_in_.load(std::memory_order_relaxed)),
                   static_cast<unsigned long long>(
@@ -2014,7 +2032,8 @@ class Server {
                   static_cast<unsigned long long>(
                       opt_updates_.load(std::memory_order_relaxed)),
                   static_cast<unsigned long long>(
-                      opt_slot_bytes_.load(std::memory_order_relaxed)));
+                      opt_slot_bytes_.load(std::memory_order_relaxed)),
+                  slice_size_);
     js += buf;
     std::lock_guard<std::mutex> lk(stats_mu_);
     bool first = true;
@@ -3682,11 +3701,33 @@ class Server {
   // the set by the transition fan-out, so a survivor-complete round
   // re-finalizes instead of waiting on the dead.
   bool RoundComplete(const KeyState& ks) const {
-    if (ks.round_members.empty())
-      return static_cast<int>(ks.seen.size()) >= num_workers_;
-    for (uint32_t w : ks.round_members)
-      if (!ks.seen.count(w)) return false;
-    return true;
+    if (slice_size_ <= 1) {
+      if (ks.round_members.empty())
+        return static_cast<int>(ks.seen.size()) >= num_workers_;
+      for (uint32_t w : ks.round_members)
+        if (!ks.seen.count(w)) return false;
+      return true;
+    }
+    // Hierarchical mode: completion counts SLICES, not chips.  The
+    // expected set is the slices the round's contributor set spans
+    // (round_members, or the dense launch world at epoch 0); a slice
+    // is covered once ANY of its members merged — normally its leader,
+    // or the follower that took leadership over mid-round.  A slice
+    // whose members were all erased by a membership transition simply
+    // stops being expected — "a slice leaving = that many chips
+    // leaving", expressed through the same round_members machinery.
+    std::set<uint32_t> want;
+    if (ks.round_members.empty()) {
+      for (int w = 0; w < num_workers_; ++w)
+        want.insert(static_cast<uint32_t>(w) /
+                    static_cast<uint32_t>(slice_size_));
+    } else {
+      for (uint32_t w : ks.round_members)
+        want.insert(w / static_cast<uint32_t>(slice_size_));
+    }
+    for (uint32_t w : ks.seen)
+      want.erase(w / static_cast<uint32_t>(slice_size_));
+    return want.empty();
   }
 
   // Membership transition, engine side (see FanOutMembership for the
@@ -4773,6 +4814,10 @@ class Server {
 
   int port_;
   int num_workers_;
+  // Hierarchical reduction (BYTEPS_TPU_SLICE_SIZE): chips per slice;
+  // RoundComplete counts slice coverage when > 1.  1 = flat (exact
+  // historical per-worker completion).
+  int slice_size_ = 1;
   int engine_threads_;
   bool schedule_;
   bool async_;

@@ -64,7 +64,7 @@ class AsyncPSTrainer:
 
     def __init__(self, session, params: PyTree, name: str = "async_param",
                  declared_key: Optional[int] = None, pipeline: bool = True,
-                 fusion_bytes: Optional[int] = None):
+                 fusion_bytes: Optional[int] = None, hierarchy=None):
         import jax
 
         if getattr(session, "server_async", True) is False:
@@ -74,6 +74,15 @@ class AsyncPSTrainer:
                 "delta protocol would silently train on deltas")
         self._session = session
         self._pipeline = pipeline
+        # Hierarchical reduction (BYTEPS_TPU_HIERARCHY=1, parallel/
+        # hierarchy.py): slice-reduce each round's delta in-graph, only
+        # the slice leader rides the wire, the pulled global weights
+        # broadcast back.  None reads the env opt-in; pass an explicit
+        # HierarchicalReducer to share a custom topology.
+        if hierarchy is None:
+            from .hierarchy import maybe_reducer
+            hierarchy = maybe_reducer(session)
+        self._hier = hierarchy
         self._treedef = jax.tree.structure(params)
         leaves = jax.tree.leaves(params)
         self._shapes = [np.shape(l) for l in leaves]
@@ -144,6 +153,20 @@ class AsyncPSTrainer:
     def _dispatch(self, flat: np.ndarray, seed: bool = False):
         """Push one round's flat payload; returns an object whose
         .wait(timeout) yields the assembled global flat vector."""
+        if self._hier is not None:
+            # Hierarchical round: the slice's deltas sum in-graph, the
+            # LEADER runs the wire leg below (same chunked layout), and
+            # followers' handles resolve from the leader's broadcast.
+            # Seeds skip the reduce — the initial weights are identical
+            # on every member, and summing S copies would corrupt the
+            # store (hierarchy.dispatch_round owns that law).
+            return self._hier.dispatch_round(
+                self._key, flat, seed=seed,
+                leader_dispatch=lambda reduced: self._wire_dispatch(
+                    reduced, seed))
+        return self._wire_dispatch(flat, seed)
+
+    def _wire_dispatch(self, flat: np.ndarray, seed: bool = False):
         if self._chunks is None:
             return self._session.push_pull_async(self._key, flat, seed=seed)
         items = [(key, _gather(flat, ranges), prio)
@@ -210,6 +233,61 @@ class AsyncPSTrainer:
             self._pending = None
             self._flat = handle.wait(timeout).astype(np.float32)
         return self.params
+
+    # -- elastic input-pipeline re-sharding (docs/elasticity.md) ----------
+    def data_shard(self, membership: Optional[dict] = None) -> tuple:
+        """``(shard_index, shard_count)`` for this worker's input
+        pipeline.  The index is this worker's position among the SORTED
+        alive ids, so shards stay dense after a join or eviction even
+        when worker ids have gaps; with no membership view (or a fixed
+        epoch-0 job) it is the launch ``(worker_id, num_worker)``."""
+        wid = int(getattr(self._session, "worker_id", 0))
+        if membership is None or int(membership.get("epoch", 0)) == 0:
+            from ..common.config import get_config
+            return wid, max(1, int(get_config().num_worker))
+        alive = sorted(int(w) for w in membership.get("alive", ()))
+        if not alive:
+            return 0, 1
+        if wid not in alive:
+            # Evicted self: the value is moot (this worker's pushes no
+            # longer count) but must stay well-formed for shutdown paths.
+            return 0, len(alive)
+        return alive.index(wid), len(alive)
+
+    def membership_callback(self, on_reshard):
+        """A ``callback(membership)`` for :func:`bps.on_membership_change`
+        that re-derives this worker's data shard on every epoch change
+        and calls ``on_reshard(shard_index, shard_count, membership)``
+        exactly when the shard actually moved — epoch bumps that leave
+        the shard unchanged (e.g. an unrelated slice departing) stay
+        quiet, so the input pipeline never reshuffles needlessly."""
+        state = {"shard": self.data_shard()}
+
+        def _cb(membership):
+            shard = self.data_shard(membership)
+            if shard != state["shard"]:
+                state["shard"] = shard
+                on_reshard(shard[0], shard[1], membership)
+
+        return _cb
+
+    def enable_reshard(self, on_reshard, poll_s: Optional[float] = None):
+        """Wire :func:`bps.on_membership_change` into this trainer so the
+        input pipeline re-shards itself on worker join/evict (ROADMAP
+        autoscaling item (b)).
+
+        ``on_reshard(shard_index, shard_count, membership)`` fires when —
+        and only when — this worker's dense shard assignment changes;
+        size()/rank() already follow the new epoch by the time it runs,
+        so the handler can rebuild its data iterator directly.  Returns
+        the registered callback (also usable standalone when the caller
+        drives its own membership polling).  Requires an initialized PS
+        session (``bps.init()``) — the api poller owns the CMD_MEMBERS
+        traffic."""
+        from ..common import api
+        cb = self.membership_callback(on_reshard)
+        api.on_membership_change(cb, poll_s)
+        return cb
 
 
 def _gather(flat: np.ndarray, ranges) -> np.ndarray:

@@ -84,6 +84,26 @@ def make_hierarchical_mesh(ici_size: Optional[int] = None,
     return Mesh(arr, ("dcn_dp", "ici_dp"))
 
 
+def make_slice_mesh(num_members: int,
+                    devices: Optional[Sequence] = None) -> Optional[Mesh]:
+    """One-axis ``('ici_dp',)`` mesh for a slice's in-graph reduction
+    (parallel/hierarchy.py): one device per slice member, so the
+    intra-slice ``psum`` under ``shard_map`` runs on real device lanes.
+
+    Returns None when the process has fewer addressable devices than
+    members — the caller then falls back to a host-side sum (same
+    values, different engine).  The device list is stable (jax.devices()
+    order), so every member of a colocated slice builds the same mesh.
+    """
+    import jax
+
+    n = max(1, int(num_members))
+    devs = list(devices) if devices is not None else jax.devices()
+    if len(devs) < n:
+        return None
+    return Mesh(np.asarray(devs[:n]), ("ici_dp",))
+
+
 def get_mesh(refresh: bool = False) -> Mesh:
     """Process-wide default mesh built from config (BYTEPS_TPU_MESH_*)."""
     global _mesh

@@ -102,7 +102,8 @@ class ServerOptTrainer:
                  name: str = "serveropt",
                  declared_key: Optional[int] = None,
                  mode: Optional[str] = None,
-                 grad_scale: float = 1.0):
+                 grad_scale: float = 1.0,
+                 hierarchy=None):
         import jax
 
         if getattr(session, "server_async", False):
@@ -119,6 +120,16 @@ class ServerOptTrainer:
                              f"got {mode!r}")
         self._session = session
         self.mode = mode
+        # Hierarchical reduction (BYTEPS_TPU_HIERARCHY=1): gradients
+        # slice-reduce in-graph, the slice leader pushes the slice sum,
+        # and the pulled value — post-update PARAMETERS in server mode —
+        # broadcasts back to the slice.  grad_scale semantics are
+        # untouched: the server scales the total sum (sum of slice
+        # sums == sum over every chip).
+        if hierarchy is None:
+            from .hierarchy import maybe_reducer
+            hierarchy = maybe_reducer(session)
+        self._hier = hierarchy
         self._grad_scale = float(grad_scale)
         self._kw = _canonical_opt_kwargs(opt_kwargs, grad_scale)
         self._treedef = jax.tree.structure(params)
@@ -208,8 +219,14 @@ class ServerOptTrainer:
         the step once, on the key's owner).  Local mode: the pull is the
         gradient sum and the identical optax step runs here."""
         flat_g = self._flatten(grads)
-        handle = self._session.push_pull_async(self._key, flat_g)
-        pulled = np.asarray(handle.wait(timeout), np.float32).ravel()
+        if self._hier is not None:
+            pulled = np.asarray(
+                self._hier.push_pull_flat(self._key, flat_g,
+                                          timeout=timeout),
+                np.float32).ravel()
+        else:
+            handle = self._session.push_pull_async(self._key, flat_g)
+            pulled = np.asarray(handle.wait(timeout), np.float32).ravel()
         if self.mode == "server":
             self._flat = pulled
         else:

@@ -1156,9 +1156,15 @@ class PSSession:
                  server_evict_timeout_s: float = 0.0,
                  audit: bool = False,
                  audit_window: int = 16,
-                 health_sample_rounds: int = 0):
+                 health_sample_rounds: int = 0,
+                 slice_size: int = 1):
         self.worker_id = worker_id
         self.num_servers = max(1, num_servers)
+        # Hierarchical reduction (parallel/hierarchy.py;
+        # BYTEPS_TPU_SLICE_SIZE): chips per slice for leader election.
+        # 1 (default) = flat mode — every worker is its own slice and
+        # always its own leader; nothing else in the session changes.
+        self.slice_size = max(1, int(slice_size))
         self.hash_fn = hash_fn
         self.partition_bytes = max(1, partition_bytes)
         # Partitions below this size skip compression — the
@@ -1456,6 +1462,10 @@ class PSSession:
         # fetches and audit trailers both update it) — attribution
         # context for health/audit verdicts without a wire fetch.
         self._last_epoch = 0
+        # Last merged CMD_MEMBERS view — what slice_leader() elects
+        # from, so leadership rides the same epoch rounds are pinned
+        # to.  None until the first fetch (launch set semantics).
+        self._members_cache: Optional[dict] = None
         # Postmortem bundles dumped anywhere in this process carry this
         # session's local sections (transport/audit/ring/health) via the
         # provider registry — computed once per dump, unregistered at
@@ -1573,7 +1583,8 @@ class PSSession:
                    server_evict_timeout_s=cfg.server_evict_timeout_s,
                    audit=cfg.audit,
                    audit_window=cfg.audit_window,
-                   health_sample_rounds=cfg.health_sample_rounds)
+                   health_sample_rounds=cfg.health_sample_rounds,
+                   slice_size=cfg.slice_size)
 
     def set_lr_scale(self, scale: float) -> None:
         """One-shot EF-error rescale after a learning-rate change;
@@ -3128,7 +3139,36 @@ class PSSession:
         merged = merge_membership(views)
         if int(merged.get("epoch", 0)) > self._last_epoch:
             self._last_epoch = int(merged["epoch"])
+        self._members_cache = merged
         return merged
+
+    def cached_alive(self) -> Optional[list]:
+        """Worker ids alive per the last CMD_MEMBERS fetch, or None when
+        nothing has been fetched (or the epoch never advanced) — the
+        launch set is then authoritative, matching size()'s law."""
+        m = self._members_cache
+        if m is None or int(m.get("epoch", 0)) == 0:
+            return None
+        return list(m.get("alive", ()))
+
+    def slice_leader(self, slice_size: Optional[int] = None,
+                     world: Optional[int] = None) -> Optional[int]:
+        """The leader of THIS worker's slice: the lowest ALIVE member
+        under the last observed membership epoch (docs/architecture.md
+        "Hierarchical reduction" — the leader law).
+
+        Before any membership fetch — or while the epoch has never
+        advanced — the launch set is the electorate, so the leader is
+        simply the slice's lowest id.  After an eviction the next
+        membership refresh moves leadership to the lowest survivor;
+        None means the whole slice has departed."""
+        from ..parallel.hierarchy import elect_leader, slice_members, \
+            slice_of
+        s = self.slice_size if slice_size is None else max(1,
+                                                           int(slice_size))
+        members = slice_members(slice_of(self.worker_id, s), s,
+                                world=world)
+        return elect_leader(members, self.cached_alive())
 
     def _barrier_diag_text(self, generation: int) -> str:
         """One line naming who the barrier is waiting on: live epoch
@@ -3738,7 +3778,8 @@ class PSSession:
                   "workers": {}, "epoch": 0, "deferred_joins": 0,
                   "members": {}, "ring_epoch": 0, "servers": {},
                   "codec_sets": 0, "codec_stale_frames": 0,
-                  "opt_sets": 0, "opt_updates": 0, "opt_slot_bytes": 0}
+                  "opt_sets": 0, "opt_updates": 0, "opt_slot_bytes": 0,
+                  "slice_size": 1}
         import json as _json
         for slot, c in enumerate(self.conns):
             sid = self._slot_srv.get(slot, slot)
@@ -3793,6 +3834,10 @@ class PSSession:
             merged["async"] = merged["async"] or bool(st.get("async"))
             merged["num_workers"] = max(merged["num_workers"],
                                         int(st.get("num_workers", 0)))
+            # Hierarchical reduction: the slice size the server counts
+            # round completion in (1 = flat; old servers omit it).
+            merged["slice_size"] = max(merged["slice_size"],
+                                       int(st.get("slice_size", 1)))
             # Elastic membership — the one merge law (_merge_member_rec):
             # freshest epoch wins, alive = AND across servers, age = max.
             # Old servers omit these keys entirely.

@@ -27,7 +27,70 @@ if os.environ.get("BYTEPS_TEST_TPU", "0") != "1":
     import jax
     jax.config.update("jax_platforms", "cpu")
 
+import json  # noqa: E402
+
 import pytest  # noqa: E402
+
+# ---------------------------------------------------------------------------
+# Tier-1 duration budget (tools/check_test_budget.py): record every
+# test's call-phase duration (+ its slow marker) to a JSON file at
+# session end, so the budget check can flag any non-slow test creeping
+# toward the tier-1 timeout.  The file is the pytest --durations data,
+# just machine-readable and complete (the CLI flag truncates to top-N).
+# ---------------------------------------------------------------------------
+DURATIONS_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                              ".last_durations.json")
+_durations: dict = {}
+
+
+def pytest_runtest_logreport(report):
+    if report.when == "call":
+        _durations[report.nodeid] = {
+            "duration": round(float(report.duration), 3),
+            "slow": "slow" in getattr(report, "keywords", {}),
+            "outcome": report.outcome,
+        }
+
+
+def pytest_sessionfinish(session, exitstatus):
+    if not _durations:
+        return
+    # MERGE into the existing recording: a single-test invocation must
+    # not clobber the last full-suite data — each nodeid keeps its most
+    # recent observation.  Two pruning rules keep ghosts out of the
+    # budget gate: an old entry is dropped when its FILE ran this
+    # session without re-producing the nodeid (renamed/deleted test),
+    # or when the file itself is gone from disk (deleted module) — a
+    # stale over-budget entry would otherwise fail the gate by a name
+    # that no longer exists.
+    here = os.path.dirname(os.path.abspath(__file__))
+    roots = (os.path.dirname(here), here)
+
+    def _file_exists(nodeid: str) -> bool:
+        rel = nodeid.split("::", 1)[0]
+        return any(os.path.exists(os.path.join(r, rel)) for r in roots)
+
+    ran_files = {n.split("::", 1)[0] for n in _durations}
+    merged = {}
+    try:
+        with open(DURATIONS_PATH) as f:
+            prev = json.load(f).get("durations")
+        if isinstance(prev, dict):
+            for nodeid, rec in prev.items():
+                if nodeid.split("::", 1)[0] in ran_files \
+                        and nodeid not in _durations:
+                    continue
+                if not _file_exists(nodeid):
+                    continue
+                merged[nodeid] = rec
+    except (OSError, ValueError):
+        pass
+    merged.update(_durations)
+    try:
+        with open(DURATIONS_PATH, "w") as f:
+            json.dump({"durations": merged}, f)
+    except OSError:
+        pass    # a read-only checkout must not fail the suite
 
 
 @pytest.fixture

@@ -136,3 +136,89 @@ def test_rejects_sync_server():
 
     with pytest.raises(RuntimeError):
         AsyncPSTrainer(S(), {"w": np.zeros(2, np.float32)})
+
+
+# ---------------------------------------------------------------------------
+# Elastic input-pipeline re-sharding (ROADMAP autoscaling item (b)):
+# on_membership_change() wired into the trainer so data shards follow
+# the live worker set.
+# ---------------------------------------------------------------------------
+def _trainer(wid=1):
+    sess = _FakeAsyncServerSession()
+    sess.worker_id = wid
+    return AsyncPSTrainer(sess, {"w": np.zeros(2, np.float32)})
+
+
+def test_data_shard_follows_membership(monkeypatch):
+    from byteps_tpu.common import config as config_mod
+
+    monkeypatch.setattr(config_mod, "_config",
+                        config_mod.Config(num_worker=4))
+    tr = _trainer(wid=2)
+    # Fixed world (no view / epoch 0): the launch (worker_id, N).
+    assert tr.data_shard() == (2, 4)
+    assert tr.data_shard({"epoch": 0, "alive": [0, 1]}) == (2, 4)
+    # Live epoch: dense position among SORTED alive ids — id gaps from
+    # evictions never leave shard holes.
+    assert tr.data_shard({"epoch": 3, "alive": [0, 2, 5]}) == (1, 3)
+    assert tr.data_shard({"epoch": 4, "alive": [2]}) == (0, 1)
+    # Evicted self: well-formed degenerate, not a crash.
+    assert tr.data_shard({"epoch": 5, "alive": [0, 1]}) == (0, 2)
+
+
+def test_membership_callback_fires_only_on_shard_change(monkeypatch):
+    from byteps_tpu.common import config as config_mod
+
+    monkeypatch.setattr(config_mod, "_config",
+                        config_mod.Config(num_worker=3))
+    tr = _trainer(wid=1)
+    fired = []
+    cb = tr.membership_callback(
+        lambda idx, n, m: fired.append((idx, n, m["epoch"])))
+    # Epoch bump that leaves this worker's dense shard unchanged: quiet.
+    cb({"epoch": 1, "alive": [0, 1, 2]})
+    assert fired == []
+    # A peer evicted: the shard moves, the pipeline re-shards once.
+    cb({"epoch": 2, "alive": [1, 2]})
+    assert fired == [(0, 2, 2)]
+    # Same view again: no duplicate reshuffle.
+    cb({"epoch": 2, "alive": [1, 2]})
+    assert fired == [(0, 2, 2)]
+    # A join: back to three shards.
+    cb({"epoch": 3, "alive": [0, 1, 2]})
+    assert fired == [(0, 2, 2), (1, 3, 3)]
+
+
+def test_enable_reshard_registers_with_api(monkeypatch):
+    """enable_reshard() wires the callback through
+    bps.on_membership_change: the api poller's epoch-change delivery
+    drives the trainer's reshard hook."""
+    from byteps_tpu.common import api
+    from byteps_tpu.common import config as config_mod
+
+    class _Sess:
+        worker_id = 0
+
+        def membership(self, timeout=5.0):
+            return {"epoch": 0, "workers": {}, "alive": [0], "barrier": {}}
+
+    monkeypatch.setattr(config_mod, "_config",
+                        config_mod.Config(num_worker=2))
+    monkeypatch.setattr(api._state, "initialized", True)
+    monkeypatch.setattr(api._state, "config", config_mod.Config(
+        num_worker=2))
+    monkeypatch.setattr(api._state, "ps_session", _Sess())
+    monkeypatch.setattr(api._state, "membership", None)
+    monkeypatch.setattr(api._state, "membership_cb", None)
+    tr = _trainer(wid=0)
+    fired = []
+    try:
+        tr.enable_reshard(
+            lambda idx, n, m: fired.append((idx, n)), poll_s=30.0)
+        cb = api._state.membership_cb
+        assert cb is not None
+        # What the api poller delivers on an epoch change:
+        cb({"epoch": 2, "alive": [0, 1, 2], "workers": {}})
+        assert fired == [(0, 3)]
+    finally:
+        api.on_membership_change(None)      # unregister + stop poller

@@ -98,6 +98,49 @@ def test_serveropt_family_direction():
     assert bench_compare.check(recs)["regressions"] == []
 
 
+def test_throughput_units_are_higher_is_better():
+    """The unit-direction law (ISSUE 15 satellite): *_mbps / *_goodput /
+    throughput-ish units are explicitly HIGHER-is-better — including
+    rate names ending in "_s" that the time-suffix rule would otherwise
+    misread as latencies — and a throughput DROP flags as the
+    regression, not a rise."""
+    for metric, unit in [
+        ("wire_goodput_mbps", "mbps"),
+        ("transport_goodput", "pct_of_floor"),
+        ("embedding_rows_per_s", "per_s"),      # "_s" suffix trap
+        ("pull_qps", "qps"),
+        ("bert_large_mfu", "mfu"),
+        ("dp_scaling_efficiency", "ratio"),
+        ("hier_wire_bytes_saved_pct", "pct"),
+        ("some_metric", "MB/s"),                # unit alone decides
+    ]:
+        assert not bench_compare._lower_is_better(metric, unit), \
+            (metric, unit)
+    # ...and the time family still reads lower-is-better, including
+    # under the cpu_fallback_ unit prefix.
+    for metric, unit in [
+        ("fault_recovery_ms", "ms"),
+        ("bert_step_time_s", "s"),
+        ("join_catchup_ms", "cpu_fallback_ms"),
+        ("autotune_step_time_gap_pct", "pct_gap"),
+    ]:
+        assert bench_compare._lower_is_better(metric, unit), (metric, unit)
+
+    # End to end: goodput falling 9 -> 5 mbps is the regression...
+    recs = [R(1, "wire_goodput_mbps", 9.0, unit="mbps"),
+            R(2, "wire_goodput_mbps", 5.0, unit="mbps")]
+    rep = bench_compare.check(recs, threshold=0.10)
+    assert len(rep["regressions"]) == 1
+    assert rep["groups"][0]["direction"] == "higher"
+    # ...and rising throughput never is.
+    recs[-1] = R(2, "wire_goodput_mbps", 20.0, unit="mbps")
+    assert bench_compare.check(recs)["regressions"] == []
+    # The "_s" trap, end to end: rows/s DOUBLING must not flag.
+    recs = [R(1, "embedding_rows_per_s", 1000.0, unit="per_s"),
+            R(2, "embedding_rows_per_s", 2000.0, unit="per_s")]
+    assert bench_compare.check(recs)["regressions"] == []
+
+
 def test_platforms_compared_separately():
     recs = [R(1, "eff", 1.0, platform="tpu"),
             R(2, "eff", 0.2, platform="cpu"),   # different hardware

@@ -1,0 +1,155 @@
+"""common/compat.py resolver tests (ISSUE 15 satellite).
+
+The shims own every "where does JAX keep this today" decision; these
+tests exercise BOTH sides of each decision — the new-JAX public
+bindings and the 0.4.x fallbacks — by reloading the module against a
+monkeypatched ``jax``, so the next JAX API move fails loudly in tier-1
+instead of at import time on whatever host upgrades first.
+"""
+
+import importlib
+
+import jax
+import numpy as np
+import pytest
+
+from byteps_tpu.common import compat
+
+
+@pytest.fixture
+def jax_sandbox():
+    """A private MonkeyPatch whose undo runs BEFORE the restoring
+    reload: patch jax through this, call ``reload()``, and teardown
+    first un-patches jax, then reloads compat so the real resolution is
+    back for every later test (importlib.reload mutates the module in
+    place — the function-scoped ``monkeypatch`` fixture would undo
+    AFTER our finalizer and leave a recorder-stub binding live)."""
+    mp = pytest.MonkeyPatch()
+    yield mp
+    mp.undo()
+    importlib.reload(compat)
+
+
+class _Recorder:
+    """Stands in for a shard_map implementation: records the kwargs the
+    shim forwarded and returns a sentinel."""
+
+    def __init__(self):
+        self.calls = []
+
+    def __call__(self, f, *, mesh, in_specs, out_specs, **kwargs):
+        self.calls.append({"mesh": mesh, "in_specs": in_specs,
+                           "out_specs": out_specs, **kwargs})
+        return "wrapped"
+
+
+# ---------------------------------------------------------------------------
+# shard_map resolver: branch selection + kwarg translation
+# ---------------------------------------------------------------------------
+def test_new_jax_branch_uses_public_binding(jax_sandbox):
+    """With ``jax.shard_map`` present (new JAX), the shim must use it
+    and forward ``check_vma`` VERBATIM (no translation)."""
+    rec = _Recorder()
+    jax_sandbox.setattr(jax, "shard_map", rec, raising=False)
+    importlib.reload(compat)
+    out = compat.shard_map(lambda x: x, mesh="m", in_specs="i",
+                          out_specs="o", check_vma=False)
+    assert out == "wrapped"
+    assert rec.calls == [{"mesh": "m", "in_specs": "i", "out_specs": "o",
+                          "check_vma": False}]
+    # check_vma=None leaves the implementation default in place.
+    compat.shard_map(lambda x: x, mesh="m", in_specs="i", out_specs="o")
+    assert "check_vma" not in rec.calls[-1]
+
+
+def test_old_jax_branch_translates_check_rep(jax_sandbox):
+    """Without ``jax.shard_map`` (0.4.x), the shim must fall back to
+    ``jax.experimental.shard_map.shard_map`` and translate
+    ``check_vma`` -> the old ``check_rep`` spelling."""
+    import jax.experimental.shard_map as exp
+
+    rec = _Recorder()
+    jax_sandbox.delattr(jax, "shard_map", raising=False)
+    jax_sandbox.setattr(exp, "shard_map", rec)
+    importlib.reload(compat)
+    out = compat.shard_map(lambda x: x, mesh="m", in_specs="i",
+                          out_specs="o", check_vma=False)
+    assert out == "wrapped"
+    assert rec.calls == [{"mesh": "m", "in_specs": "i", "out_specs": "o",
+                          "check_rep": False}]
+    compat.shard_map(lambda x: x, mesh="m", in_specs="i", out_specs="o")
+    assert "check_rep" not in rec.calls[-1]
+    assert "check_vma" not in rec.calls[-1]
+
+
+def test_shard_map_executes_on_live_branch():
+    """Whichever branch this host's JAX resolves to must actually RUN: a
+    psum under compat.shard_map over a 2-device mesh (conftest forces 8
+    CPU devices) produces the cross-device sum."""
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    mesh = Mesh(np.array(jax.devices()[:2]), ("x",))
+    f = jax.jit(compat.shard_map(
+        lambda a: jax.lax.psum(a, "x"), mesh=mesh,
+        in_specs=P("x"), out_specs=P()))
+    x = np.arange(8, dtype=np.float32).reshape(2, 4)
+    np.testing.assert_array_equal(np.asarray(f(x))[0], x[0] + x[1])
+
+
+# ---------------------------------------------------------------------------
+# axis_size: public binding vs the psum(1, axis) constant-fold fallback
+# ---------------------------------------------------------------------------
+def _run_axis_size_under_shard_map():
+    """compat.axis_size inside a mapped context, via compat.shard_map —
+    the composition the hierarchy plane actually uses."""
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    mesh = Mesh(np.array(jax.devices()[:2]), ("x",))
+    f = jax.jit(compat.shard_map(
+        lambda a: a * 0 + compat.axis_size("x"), mesh=mesh,
+        in_specs=P("x"), out_specs=P("x")))
+    return np.asarray(f(np.zeros((2, 3), np.float32)))
+
+
+def test_axis_size_psum_fallback(monkeypatch):
+    """Force the 0.4.x path: with ``jax.lax.axis_size`` absent the shim
+    must constant-fold ``psum(1, axis)`` to the mapped axis size."""
+    monkeypatch.delattr(jax.lax, "axis_size", raising=False)
+    np.testing.assert_array_equal(_run_axis_size_under_shard_map(),
+                                  np.full((2, 3), 2.0, np.float32))
+
+
+def test_axis_size_public_binding(monkeypatch):
+    """Force (or fake) the new-JAX path: a present ``jax.lax.axis_size``
+    must be what the shim calls."""
+    if not hasattr(jax.lax, "axis_size"):
+        calls = []
+
+        def fake_axis_size(name):
+            calls.append(name)
+            return 2
+
+        monkeypatch.setattr(jax.lax, "axis_size", fake_axis_size,
+                            raising=False)
+        assert compat.axis_size("x") == 2
+        assert calls == ["x"]
+    else:
+        np.testing.assert_array_equal(_run_axis_size_under_shard_map(),
+                                      np.full((2, 3), 2.0, np.float32))
+
+
+# ---------------------------------------------------------------------------
+# tree_flatten_with_path: both spellings
+# ---------------------------------------------------------------------------
+def test_tree_flatten_with_path_both_spellings(monkeypatch):
+    tree = {"a": 1, "b": [2, 3]}
+    want = jax.tree_util.tree_flatten_with_path(tree)
+    # Whatever this JAX resolves to:
+    paths, treedef = compat.tree_flatten_with_path(tree)
+    assert [l for _, l in paths] == [l for _, l in want[0]]
+    assert treedef == want[1]
+    # Forced old spelling: jax.tree.flatten_with_path absent.
+    monkeypatch.delattr(jax.tree, "flatten_with_path", raising=False)
+    paths2, treedef2 = compat.tree_flatten_with_path(tree)
+    assert [l for _, l in paths2] == [l for _, l in want[0]]
+    assert treedef2 == want[1]

@@ -1,0 +1,93 @@
+"""Tier-1 duration budget gate (tools/check_test_budget.py + the
+conftest recorder): any non-slow test exceeding the per-test budget
+fails BY NAME, so the growing e2e suite can't silently blow the 870s
+tier-1 timeout one slow test at a time (ISSUE 15 satellite)."""
+
+import json
+import os
+import subprocess
+import sys
+
+import conftest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TOOLS = os.path.join(ROOT, "tools")
+if TOOLS not in sys.path:
+    sys.path.insert(0, TOOLS)
+
+import check_test_budget  # noqa: E402
+
+
+def test_check_flags_only_nonslow_over_budget():
+    durations = {
+        "tests/test_a.py::fast": {"duration": 1.2, "slow": False},
+        "tests/test_a.py::creeping": {"duration": 75.0, "slow": False},
+        "tests/test_b.py::worse": {"duration": 120.0, "slow": False},
+        "tests/test_b.py::chaos": {"duration": 300.0, "slow": True},
+    }
+    rep = check_test_budget.check(durations, budget_s=60.0)
+    assert rep["slow_exempt"] == 1
+    # Slowest first, slow-marked exempt, fast ones absent.
+    assert [o["nodeid"] for o in rep["offenders"]] == [
+        "tests/test_b.py::worse", "tests/test_a.py::creeping"]
+    assert check_test_budget.check(durations, budget_s=500.0) \
+        ["offenders"] == []
+
+
+def test_parse_pytest_durations_log():
+    text = """
+============================= slowest durations ==============================
+12.34s call     tests/test_x.py::test_y
+0.50s setup    tests/test_x.py::test_y
+70.10s call     tests/test_z.py::test_big
+0.01s teardown tests/test_z.py::test_big
+=========================== short test summary info ===========================
+"""
+    got = check_test_budget.parse_durations_log(text)
+    assert got == {
+        "tests/test_x.py::test_y": {"duration": 12.34, "slow": False},
+        "tests/test_z.py::test_big": {"duration": 70.1, "slow": False},
+    }
+    rep = check_test_budget.check(got, budget_s=60.0)
+    assert [o["nodeid"] for o in rep["offenders"]] \
+        == ["tests/test_z.py::test_big"]
+
+
+def test_cli_paths(tmp_path):
+    """No recording -> exit 0 (first run); a breaching recording ->
+    exit 1 naming the test; a clean one -> exit 0."""
+    tool = os.path.join(TOOLS, "check_test_budget.py")
+    missing = str(tmp_path / "nope.json")
+    r = subprocess.run([sys.executable, tool, missing],
+                       capture_output=True, text=True, timeout=60)
+    assert r.returncode == 0 and "nothing to check" in r.stdout
+
+    rec = tmp_path / "durations.json"
+    rec.write_text(json.dumps({"durations": {
+        "tests/test_q.py::huge": {"duration": 200.0, "slow": False}}}))
+    r = subprocess.run([sys.executable, tool, str(rec), "--json"],
+                       capture_output=True, text=True, timeout=60)
+    assert r.returncode == 1
+    assert json.loads(r.stdout)["offenders"][0]["nodeid"] \
+        == "tests/test_q.py::huge"
+
+    rec.write_text(json.dumps({"durations": {
+        "tests/test_q.py::ok": {"duration": 2.0, "slow": False}}}))
+    r = subprocess.run([sys.executable, tool, str(rec)],
+                       capture_output=True, text=True, timeout=60)
+    assert r.returncode == 0 and "within budget" in r.stdout
+
+
+def test_previous_tier1_run_within_budget():
+    """THE wired gate: the conftest recorder's last session must hold no
+    non-slow test over the budget.  A breach introduced by a PR fails
+    here on the next tier-1 run, naming the culprit — before the global
+    870s timeout ever fires.  First run on a clean checkout: vacuously
+    green (no recording yet)."""
+    durations = check_test_budget.load_recorded(conftest.DURATIONS_PATH)
+    if durations is None:
+        return      # nothing recorded yet — the next run is covered
+    budget = float(os.environ.get("BYTEPS_TPU_TEST_BUDGET_S") or
+                   check_test_budget.DEFAULT_BUDGET_S)
+    rep = check_test_budget.check(durations, budget_s=budget)
+    assert not rep["offenders"], check_test_budget.render(rep)

@@ -30,15 +30,20 @@ class StubPSServer:
 
     With ``record=True`` every raw request header is kept in
     ``self.frames`` as ``(raw_header_bytes, cmd, flags)`` under
-    ``self.lock``.
+    ``self.lock``; ``record_payload=True`` additionally keeps the raw
+    payload bytes in ``self.payloads`` (index-aligned with
+    ``self.frames``) — the wire byte-identity tests' surface.
     """
 
-    def __init__(self, handler, record: bool = False):
+    def __init__(self, handler, record: bool = False,
+                 record_payload: bool = False):
         import socket as _socket
         import threading as _threading
         self.handler = handler
-        self.record = record
+        self.record = record or record_payload
+        self.record_payload = record_payload
         self.frames = []
+        self.payloads = []
         self.lock = _threading.Lock()
         self._srv = _socket.socket()
         self._srv.setsockopt(_socket.SOL_SOCKET, _socket.SO_REUSEADDR, 1)
@@ -87,6 +92,8 @@ class StubPSServer:
                 if self.record:
                     with self.lock:
                         self.frames.append((hdr, cmd, fl))
+                        if self.record_payload:
+                            self.payloads.append(bytes(payload))
                 status, resp = self.handler(cmd, dt, fl, req_id, wid, key,
                                             payload)
                 c.sendall(_RESP.pack(status, req_id, key, len(resp))

@@ -64,9 +64,32 @@ def _platform(parsed: dict) -> str:
     return "cpu" if _is_fallback(parsed) else "unknown"
 
 
+# Throughput-ish shapes that are HIGHER-is-better and must be
+# recognized explicitly: a rate metric named "*_per_s" / "*_rows_s"
+# would otherwise match the "_s" time suffix below and read as
+# lower-is-better — a goodput IMPROVEMENT would then flag as a
+# regression.  Checked before the time-suffix rules for exactly that
+# reason.
+_HIGHER_METRIC_SUFFIXES = (
+    "_mbps", "_gbps", "_mb_s", "_gb_s", "_goodput", "_throughput",
+    "_per_s", "_per_sec", "_rows_s", "_tokens_s", "_items_s", "_qps",
+    "_mfu", "_efficiency", "_pct_of_floor", "_saved_pct", "_hit_rate",
+)
+_HIGHER_UNITS = {
+    "mbps", "gbps", "mb/s", "gb/s", "mb_s", "gb_s", "goodput_mbps",
+    "per_s", "per_sec", "qps", "rows_s", "tokens_s", "items_per_s",
+    "steps_per_s", "pct_of_floor", "mfu", "ratio", "x",
+}
+
+
 def _lower_is_better(metric: str, unit: str) -> bool:
     unit = unit[len("cpu_fallback_"):] if unit.startswith(
         "cpu_fallback_") else unit
+    # Explicit higher-is-better first: throughput/goodput/efficiency
+    # shapes, including rate names that also end in "_s".
+    if metric.endswith(_HIGHER_METRIC_SUFFIXES) \
+            or unit.lower() in _HIGHER_UNITS:
+        return False
     if metric.endswith(("_ms", "_ns", "_s", "_seconds", "_latency")):
         return True
     # BENCH_AUTOTUNE family: the headline is the step-time GAP between

@@ -183,6 +183,26 @@ def render(metrics: dict, prev: dict, dt: float,
                 f" switches {switches.get(name, 0):3d}")
         lines.append("")
 
+    # Hierarchical-reduction panel (BYTEPS_TPU_HIERARCHY=1): this
+    # worker's slice role and the wire bytes its followers never sent.
+    # Absent in flat runs — the gauges are only registered by an armed
+    # reducer.
+    ss = metrics.get("bps_hierarchy_slice_size")
+    if ss is not None:
+        saved = _get(metrics, "bps_hierarchy_wire_bytes_saved_total")
+        saved_rate = ((saved - _get(prev,
+                                    "bps_hierarchy_wire_bytes_saved_total"))
+                      / dt if prev and dt > 0 else 0.0)
+        role = ("leader" if _get(metrics, "bps_hierarchy_is_leader")
+                else "follower")
+        lines.append(
+            f"hierarchy: slice {int(_get(metrics, 'bps_hierarchy_slice_id'))}"
+            f" ({int(_get(metrics, 'bps_hierarchy_slice_members'))} chips,"
+            f" slice_size {int(_get(metrics, 'bps_hierarchy_slice_size'))})"
+            f"   role {role}   wire saved {_fmt_bytes(saved)}"
+            f"   {_fmt_bytes(saved_rate)}/s")
+        lines.append("")
+
     lines.append("latency                 p50      p95      count")
     for label, hist in (("push RTT", "bps_push_rtt_seconds"),
                         ("queue wait", "bps_dispatch_queue_wait_seconds"),

@@ -36,7 +36,10 @@ IGNORE = {
     "BYTEPS_TPU_",           # bare prefix in prose
 }
 
-CODE_DIRS = ("byteps_tpu",)
+# tools/ counts as code too: developer-facing knobs like
+# BYTEPS_TPU_TEST_BUDGET_S live only there, and an env.md row for a
+# name no code reads is exactly the drift this check exists to catch.
+CODE_DIRS = ("byteps_tpu", "tools")
 CODE_EXTS = (".py", ".cc", ".h")
 DOC_FILE = os.path.join("docs", "env.md")
 

@@ -1,0 +1,163 @@
+#!/usr/bin/env python
+"""check_test_budget — per-test duration budget for the tier-1 suite.
+
+The tier-1 verify runs the whole non-slow suite under one hard timeout
+(870s, ROADMAP).  Nothing has historically capped an INDIVIDUAL test,
+so the growing e2e set can blow the global timeout one slow test at a
+time, and the failure mode is the worst one — a timeout kill with no
+culprit named.  This gate closes that: any non-``slow``-marked test
+whose call phase exceeds ``--budget`` seconds (default 60) fails the
+check BY NAME.
+
+Data source, in order of preference:
+
+1. ``tests/.last_durations.json`` — written by the conftest recorder at
+   every pytest session end: the complete ``pytest --durations`` data
+   (call-phase seconds + slow-marker flag per nodeid), machine-readable
+   and untruncated.
+2. ``--log FILE`` — a pytest output log produced WITH ``--durations=0``;
+   the classic ``12.34s call path::test`` rows are parsed instead
+   (slow-marker information is absent there, so pass ``--log`` only for
+   runs that already deselected slow tests, e.g. the tier-1 command).
+
+Wired as a fast tier-1 test (tests/test_test_budget.py) over the
+PREVIOUS run's recording — a budget breach lands on the next run, which
+is exactly when a reviewer is still looking at the PR that caused it.
+Also runnable standalone:
+
+    python tools/check_test_budget.py [--budget 60] [--json]
+    python tools/check_test_budget.py --log /tmp/_t1.log
+
+Exit codes: 0 = within budget (or no data yet), 1 = budget exceeded,
+2 = usage error.  ``BYTEPS_TPU_TEST_BUDGET_S`` overrides the default
+budget (documented in docs/env.md).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import sys
+from typing import Dict, Optional
+
+DEFAULT_BUDGET_S = 60.0
+
+#: pytest --durations row: "  12.34s call     tests/test_x.py::test_y"
+_DURATION_ROW = re.compile(
+    r"^\s*(\d+(?:\.\d+)?)s\s+(call|setup|teardown)\s+(\S+)\s*$")
+
+
+def default_data_path() -> str:
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    return os.path.join(root, "tests", ".last_durations.json")
+
+
+def load_recorded(path: str) -> Optional[Dict[str, dict]]:
+    """The conftest recorder's {nodeid: {"duration", "slow"}} map, or
+    None when no recording exists yet (first run / clean checkout)."""
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except OSError:
+        return None
+    except ValueError:
+        print(f"check_test_budget: unreadable recording {path}; "
+              f"treating as no data", file=sys.stderr)
+        return None
+    d = doc.get("durations")
+    return d if isinstance(d, dict) else None
+
+
+def parse_durations_log(text: str) -> Dict[str, dict]:
+    """{nodeid: {"duration", "slow": False}} from a pytest log produced
+    with ``--durations=0`` — call-phase rows only (setup/teardown waits
+    are fixture costs, budgeted with the test that pays them in the
+    recorder path but unattributable here)."""
+    out: Dict[str, dict] = {}
+    for line in text.splitlines():
+        m = _DURATION_ROW.match(line)
+        if m and m.group(2) == "call":
+            nodeid = m.group(3)
+            dur = float(m.group(1))
+            if dur > out.get(nodeid, {}).get("duration", -1.0):
+                out[nodeid] = {"duration": dur, "slow": False}
+    return out
+
+
+def check(durations: Dict[str, dict],
+          budget_s: float = DEFAULT_BUDGET_S) -> dict:
+    """The gate as a pure function (the self-test's entry point):
+    non-slow tests over budget, slowest first."""
+    offenders = []
+    slow_exempt = 0
+    for nodeid, rec in durations.items():
+        dur = float(rec.get("duration", 0.0))
+        if rec.get("slow"):
+            slow_exempt += 1
+            continue
+        if dur > budget_s:
+            offenders.append({"nodeid": nodeid,
+                              "duration": round(dur, 3)})
+    offenders.sort(key=lambda r: -r["duration"])
+    return {"budget_s": budget_s, "tests": len(durations),
+            "slow_exempt": slow_exempt, "offenders": offenders}
+
+
+def render(report: dict) -> str:
+    lines = [f"check_test_budget: {report['tests']} test(s), budget "
+             f"{report['budget_s']:g}s per non-slow test "
+             f"({report['slow_exempt']} slow-marked exempt)"]
+    for o in report["offenders"]:
+        lines.append(f"  {o['duration']:8.1f}s  {o['nodeid']}  "
+                     f"<-- OVER BUDGET (mark it slow, split it, or "
+                     f"speed it up)")
+    lines.append(f"{len(report['offenders'])} test(s) over budget"
+                 if report["offenders"] else "all tests within budget")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("path", nargs="?", default=default_data_path(),
+                    help="durations recording (default: "
+                         "tests/.last_durations.json)")
+    ap.add_argument("--log", default=None,
+                    help="parse a pytest --durations=0 output log "
+                         "instead of the recording")
+    ap.add_argument("--budget", type=float,
+                    default=float(os.environ.get(
+                        "BYTEPS_TPU_TEST_BUDGET_S") or DEFAULT_BUDGET_S),
+                    help="per-test seconds allowed (default 60; env "
+                         "BYTEPS_TPU_TEST_BUDGET_S overrides)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the report as one JSON object")
+    args = ap.parse_args(argv)
+    if args.budget <= 0:
+        print("check_test_budget: --budget must be > 0", file=sys.stderr)
+        return 2
+    if args.log:
+        try:
+            with open(args.log) as f:
+                durations = parse_durations_log(f.read())
+        except OSError as e:
+            print(f"check_test_budget: cannot read {args.log}: {e}",
+                  file=sys.stderr)
+            return 2
+    else:
+        durations = load_recorded(args.path)
+        if durations is None:
+            print("check_test_budget: no durations recorded yet "
+                  f"({args.path}) — nothing to check")
+            return 0
+    report = check(durations, budget_s=args.budget)
+    if args.json:
+        print(json.dumps(report))
+    else:
+        print(render(report))
+    return 1 if report["offenders"] else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
