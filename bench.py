@@ -52,6 +52,12 @@ Modes:
                      autotune_step_time_gap_pct (target: within a few %)
                      plus switch counts and the per-key final codec
                      assignments
+  BENCH_KNOB=1       knob-plane bench: cold-start job whose predictive
+                     tuner must discover FUSION_BYTES + codecs live
+                     (cost-model jumps + actuated CMD_KNOB sets at
+                     round boundaries) vs the hand-tuned expert config;
+                     emits knob_step_time_gap_pct (target: <= 0) with
+                     the cost-model seed and final knob assignments
   BENCH_SERVEROPT=1  server-resident-optimizer bench: the same Adam
                      workload with the update stage on the PS tier
                      (push grads, pull params) vs worker-local optax;
@@ -1487,6 +1493,186 @@ def bench_autotune():
         proc.wait()
 
 
+_KNOB_WORKER_CODE = """
+import json, os, time
+import numpy as np
+import jax.numpy as jnp
+import byteps_tpu as bps
+
+reps = int(os.environ["KB_REPS"])
+warm_s = float(os.environ["KB_WARM_S"])
+expert = os.environ.get("KB_EXPERT", "0") == "1"
+bps.init()
+rng = np.random.default_rng(0)
+tree = {}
+# Two FC-sized gradients + a sheaf of layernorm-sized leaves: the
+# mixed shape both the fusion planner and the codec dial care about.
+tree["fc1.w"] = jnp.asarray(rng.standard_normal(1 << 19).astype(np.float32))
+tree["fc2.w"] = jnp.asarray(rng.standard_normal(1 << 19).astype(np.float32))
+for i in range(48):
+    tree[f"ln{i:02d}.g"] = jnp.asarray(
+        rng.standard_normal(1 << 10).astype(np.float32))
+names = sorted(tree)
+if expert:
+    bps.register_compressor("fc1.w", {"compressor": "onebit",
+                                      "ef": "vanilla"})
+    bps.register_compressor("fc2.w", {"compressor": "onebit",
+                                      "ef": "vanilla"})
+
+def step():
+    out = bps.push_pull_tree(tree, name="knobwl", average=False,
+                             leaf_names=names)
+    jnp.asarray(out["fc1.w"]).block_until_ready()
+
+deadline = time.time() + warm_s
+warm_steps = 0
+while time.time() < deadline or warm_steps < 8:
+    step()
+    warm_steps += 1
+times = []
+for _ in range(reps):
+    t0 = time.perf_counter()
+    step()
+    times.append(time.perf_counter() - t0)
+med = sorted(times)[len(times) // 2]
+tstate = {}
+try:
+    tstate = bps.get_tuner() or {}
+except Exception:
+    pass
+print("KB_RESULT " + json.dumps({
+    "step_ms": med * 1e3,
+    "warm_steps": warm_steps,
+    "knob_table": tstate.get("knob_table"),
+    "predict_jumps_total": tstate.get("predict_jumps_total", 0),
+    "switches_total": tstate.get("switches_total", 0),
+    "cost_model": tstate.get("cost_model"),
+    "final_codecs": {k: v.get("codec")
+                     for k, v in (tstate.get("keys") or {}).items()},
+}))
+bps.shutdown()
+"""
+
+
+def bench_knob():
+    """Knob-plane benchmark (BENCH_KNOB=1): a cold-start job whose
+    predictive tuner must DISCOVER the global knobs live vs the same
+    workload hand-tuned by an expert up front — the CMD_KNOB headline.
+
+    Both arms launch the same mixed-key workload (two 2 MB FC gradients
+    + 48 layernorm-sized 4 KiB leaves through push_pull_tree) with a
+    deliberately naive launch config (64 KiB fusion buckets, raw
+    codecs).  EXPERT overrides up front: 256 KiB fusion (one bucket
+    holds the whole layernorm sheaf) and onebit+EF on the FC keys.
+    COLD keeps the naive launch but arms the tuner with a persisted
+    codec cost model (seeded here by an in-tree
+    ``wire_bench --codec-sweep --quick --json`` run): it must
+    predict-jump the FC codecs from the model and actuate
+    FUSION_BYTES doublings through epoch-versioned CMD_KNOB sets at
+    round boundaries, mid-job, no restart.
+    ``knob_step_time_gap_pct`` = (cold - expert) / expert * 100; <= 0
+    means the cold-start tuner matched or beat the expert.  The
+    cost-model seed and COLD's final knob assignments ride the detail.
+    Host-only loopback on a small container: both arms can land within
+    noise (same honesty clause as BENCH_AUTOTUNE) — the number
+    measures knob-plane convergence, not the knobs' absolute win.
+    """
+    import subprocess
+    import sys
+    import tempfile
+
+    from byteps_tpu.utils.hermetic import cpu_subprocess_env
+
+    reps = int(os.environ.get("BENCH_KNOB_REPS", "30"))
+    warm_s = float(os.environ.get("BENCH_KNOB_WARM_S", "8.0"))
+
+    # Seed the cost model at a bench-private path — never the operator's
+    # real ~/.cache table.
+    tmpdir = tempfile.mkdtemp(prefix="bench_knob_")
+    model_path = os.path.join(tmpdir, "codec_cost_model.json")
+    sweep = subprocess.run(
+        [sys.executable, os.path.join(os.path.dirname(
+            os.path.abspath(__file__)), "tools", "wire_bench.py"),
+         "--codec-sweep", "--quick", "--json"],
+        env=cpu_subprocess_env(
+            {"BYTEPS_TPU_KNOB_COST_MODEL": model_path}),
+        capture_output=True, text=True, timeout=600)
+    if sweep.returncode != 0 or not os.path.exists(model_path):
+        raise RuntimeError(f"cost-model seed sweep failed: "
+                           f"{sweep.stderr[-500:]}")
+    with open(model_path) as f:
+        model_rows = len(json.load(f).get("codec_sweep") or [])
+
+    def run_arm(extra_env: dict) -> dict:
+        proc, port = _boot_ps_server(engine_threads=2)
+        try:
+            env = cpu_subprocess_env({
+                "BYTEPS_TPU_PS_MODE": "1",
+                "DMLC_NUM_WORKER": "1",
+                "DMLC_NUM_SERVER": "1",
+                "DMLC_PS_ROOT_PORT": str(port - 1),
+                # The naive launch config both arms start from.
+                "BYTEPS_TPU_FUSION_BYTES": str(64 << 10),
+                "KB_REPS": str(reps),
+                "KB_WARM_S": str(warm_s),
+                **extra_env,
+            })
+            r = subprocess.run([sys.executable, "-c", _KNOB_WORKER_CODE],
+                               env=env, capture_output=True, text=True,
+                               timeout=900)
+            if r.returncode != 0:
+                raise RuntimeError(f"knob bench arm failed: "
+                                   f"{r.stderr[-1500:]}")
+            for line in r.stdout.splitlines():
+                if line.startswith("KB_RESULT "):
+                    return json.loads(line[len("KB_RESULT "):])
+            raise RuntimeError(f"knob bench arm emitted no result: "
+                               f"{r.stdout[-500:]}")
+        finally:
+            proc.kill()
+            proc.wait()
+
+    expert = run_arm({"KB_EXPERT": "1",
+                      "BYTEPS_TPU_FUSION_BYTES": str(256 << 10)})
+    cold = run_arm({"BYTEPS_TPU_TUNER": "1",
+                    "BYTEPS_TPU_SIGNAL_WINDOW_S": "0.4",
+                    "BYTEPS_TPU_TUNER_HOLD": "1",
+                    "BYTEPS_TPU_KNOB_ACTUATE": "1",
+                    "BYTEPS_TPU_KNOB_COST_MODEL": model_path})
+
+    gap_pct = ((cold["step_ms"] - expert["step_ms"])
+               / expert["step_ms"] * 100.0)
+    print(json.dumps({
+        "metric": "knob_step_time_gap_pct",
+        "value": round(gap_pct, 2),
+        "unit": "pct_gap",
+        "vs_baseline": round(cold["step_ms"] / expert["step_ms"], 3),
+        "detail": {
+            "expert_step_ms": round(expert["step_ms"], 3),
+            "cold_with_tuner_step_ms": round(cold["step_ms"], 3),
+            "cost_model_path": model_path,
+            "cost_model_rows": model_rows,
+            "predict_jumps_total": cold.get("predict_jumps_total", 0),
+            "tuner_switches_total": cold.get("switches_total", 0),
+            "final_knob_table": cold.get("knob_table"),
+            "final_codecs": cold.get("final_codecs"),
+            "launch_fusion_bytes": 64 << 10,
+            "expert_fusion_bytes": 256 << 10,
+            "warm_steps": cold.get("warm_steps"),
+            "reps": reps,
+            "note": "value = (cold-start-with-predictive-tuner - "
+                    "hand-tuned expert) / expert step time in %, "
+                    f"medians over {reps} steps after {warm_s:.0f}s of "
+                    "live convergence; <= 0 = the knob plane found the "
+                    "expert config mid-job.  Loopback on a small host "
+                    "can put both arms within noise — the number "
+                    "measures knob-plane convergence, not the knobs' "
+                    "absolute win",
+            **_note(),
+        },
+    }))
+
+
 def bench_hier():
     """Hierarchical-reduction benchmark (BENCH_HIER=1): the ISSUE-15
     headline — the same 4-worker synchronous workload run FLAT (every
@@ -2151,6 +2337,8 @@ def main():
         bench_hier()         # host-only: no device backend involved
     elif os.environ.get("BENCH_AUTOTUNE", "0") == "1":
         bench_autotune()     # host-only: no device backend involved
+    elif os.environ.get("BENCH_KNOB", "0") == "1":
+        bench_knob()         # host-only: no device backend involved
     elif os.environ.get("BENCH_CNN", ""):
         # Validate the name BEFORE the (possibly minutes-long) backend
         # probe so a typo still honors the one-JSON-line contract.

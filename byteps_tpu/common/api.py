@@ -706,7 +706,18 @@ def push_pull_tree(tree: PyTree, name: Optional[str] = None,
     leaves = [jnp.asarray(l) for _, l in paths_leaves]
     metas = [(l.shape, l.dtype, int(l.size)) for l in leaves]
     cfg = _state.config or get_config()
-    fb = cfg.fusion_bytes if fusion_bytes is None else int(fusion_bytes)
+    if fusion_bytes is not None:
+        fb = int(fusion_bytes)
+    else:
+        # Knob plane: an actuated FUSION_BYTES (CMD_KNOB) overrides the
+        # launch config — live_fusion_bytes() applies any staged switch
+        # whose round boundary this session has reached, so every worker
+        # flips to the new threshold at the same round and the
+        # composition-derived bucket keys line up fleet-wide.
+        fb = (_state.ps_session.live_fusion_bytes()
+              if _state.ps_session is not None else None)
+        if fb is None:
+            fb = cfg.fusion_bytes
 
     compressed_keys = (set(_state.ps_session._compressors)
                        if _state.ps_session is not None else set())
@@ -858,49 +869,114 @@ def _fused_tree_push_pull(name, leaves, metas, sep_idx, batch_idx,
                     scatter(members, jnp.asarray(vec))
                     _debug_sample("pull", nm, vec)
                 return outs
-        items, ctxs = [], []
-        for nm, payload, prio, comp, members in units:
-            _debug_sample("push", nm, payload)
-            comp = comp or Compression.none
-            wire, ctx = comp.compress(payload)
-            dk = declare(nm)
-            if len(members) > 1 and get_core().trace_on:
-                # Fused bucket inside a trace window: record its
-                # member-leaf names so trace spans carry the real
-                # parameters in args.members (the analyzer's slow-bucket
-                # attribution).  Gated like every other trace feed — an
-                # untraced run must not build name lists per step.
-                sess.set_trace_members(
-                    dk, [leaf_name(li) for li, _ in members])
-            items.append((dk, wire, prio))
-            ctxs.append((comp, ctx))
+        from ..server.client import KnobReplan
+        # Units whose KEY IDENTITY derives from the fusion plan (buckets
+        # and plan solos — a different FUSION_BYTES re-composes them).
+        # Registered with the session so a mid-flight FUSION_BYTES
+        # switch withdraws their pushes with KnobReplan instead of
+        # merging old-layout bytes into orphaned keys; forced-solo units
+        # keep layout-independent keys and replay in place.
+        plan_unit_names = ({f"{name}.{b.tag}" for b in plan.buckets}
+                           | {leaf_name(li) for li, _ in plan.solo})
         pulled_vecs = []
-        try:
-            handles = sess.push_pull_group(items)
-            for (nm, _, _, _, members), h, (comp, ctx) in zip(
-                    units, handles, ctxs):
-                out = comp.decompress(jnp.asarray(h.wait()), ctx)
-                if average:
-                    out = out / size()
-                scatter(members, out)
-                _debug_sample("pull", nm, out)
+        unit_bytes = sum(int(p.size * p.dtype.itemsize)
+                         for _, p, _, _, _ in units)
+        for attempt in range(3):
+            items, ctxs, fusion_dks = [], [], []
+            for nm, payload, prio, comp, members in units:
+                _debug_sample("push", nm, payload)
+                comp = comp or Compression.none
+                wire, ctx = comp.compress(payload)
+                dk = declare(nm)
+                if nm in plan_unit_names:
+                    fusion_dks.append(dk)
+                if len(members) > 1 and get_core().trace_on:
+                    # Fused bucket inside a trace window: record its
+                    # member-leaf names so trace spans carry the real
+                    # parameters in args.members (the analyzer's
+                    # slow-bucket attribution).  Gated like every other
+                    # trace feed — an untraced run must not build name
+                    # lists per step.
+                    sess.set_trace_members(
+                        dk, [leaf_name(li) for li, _ in members])
+                items.append((dk, wire, prio))
+                ctxs.append((comp, ctx))
+            if fusion_dks:
+                sess.note_fusion_keys(fusion_dks)
+            failed: set = set()
+            replan_err = None
+            try:
+                handles = sess.push_pull_group(items)
+                for (nm, _, _, _, members), h, (comp, ctx) in zip(
+                        units, handles, ctxs):
+                    try:
+                        out = comp.decompress(jnp.asarray(h.wait()), ctx)
+                    except KnobReplan as kr:
+                        if hier is not None:
+                            # The slice broadcast can't re-plan under a
+                            # follower's feet — surface it like any
+                            # other wire failure.
+                            raise
+                        failed.update(li for li, _ in members)
+                        replan_err = kr
+                        continue
+                    if average:
+                        out = out / size()
+                    scatter(members, out)
+                    _debug_sample("pull", nm, out)
+                    if hier is not None:
+                        pulled_vecs.append(
+                            np.asarray(out, np.float32).ravel())
+            except Exception as e:
                 if hier is not None:
-                    pulled_vecs.append(
-                        np.asarray(out, np.float32).ravel())
-        except Exception as e:
-            if hier is not None:
-                # Slice followers are blocked on the broadcast — a
-                # leader-side wire failure must fail the whole slice's
-                # round loudly, not strand it.
-                hier.publish_failure(rkey, e)
-            raise
+                    # Slice followers are blocked on the broadcast — a
+                    # leader-side wire failure must fail the whole
+                    # slice's round loudly, not strand it.
+                    hier.publish_failure(rkey, e)
+                raise
+            if not failed:
+                break
+            if attempt == 2:
+                raise replan_err
+            # A FUSION_BYTES switch withdrew some units mid-flight:
+            # re-plan the FULL fusable set under the live threshold
+            # (every worker re-plans identically — the switch is global
+            # and boundary-synchronized, so the new composition-derived
+            # bucket keys line up fleet-wide), then re-dispatch only the
+            # units carrying a withdrawn leaf.  Idempotent CMD_INIT
+            # declares the new bucket keys; withdrawn handles never
+            # advanced their round, so the replay stages the same round.
+            live_fb = sess.live_fusion_bytes()
+            if live_fb is not None:
+                fb = live_fb
+            plan = plan_buckets(
+                tuple((i, metas[i][2], str(metas[i][1]),
+                       jnp.dtype(metas[i][1]).itemsize)
+                      for i in batch_idx), fb)
+            plan.record_use()
+            units = []
+            for b in plan.buckets:
+                members = [(li, n) for li, n in b.members]
+                if not any(li in failed for li, _ in members):
+                    continue
+                packed = (jnp.concatenate(
+                    [leaves[li].ravel() for li, _ in members])
+                    if len(members) > 1
+                    else leaves[members[0][0]].ravel())
+                units.append((f"{name}.{b.tag}", packed, b.priority,
+                              compression, members))
+            for li, prio in plan.solo:
+                if li in failed:
+                    units.append((leaf_name(li), leaves[li].ravel(),
+                                  prio, compression,
+                                  [(li, metas[li][2])]))
+            units.sort(key=lambda u: -u[2])
+            plan_unit_names = {u[0] for u in units}
         if hier is not None:
             hier.publish_outs(rkey, pulled_vecs)
         cfg = _state.config or get_config()
         if cfg.telemetry_on:
-            telemetry.record_pushpull(
-                sum(int(p.size * p.dtype.itemsize)
-                    for _, p, _, _, _ in units))
+            telemetry.record_pushpull(unit_bytes)
     else:
         handles = [push_pull_async(payload, name=nm, average=average,
                                    priority=prio, compression=comp)

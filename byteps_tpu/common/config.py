@@ -217,6 +217,19 @@ class Config:
     # Fractional per-push round-time regression (vs the pre-switch
     # baseline) that reverts a switch and blacklists the key.
     tuner_regress_frac: float = 0.2      # BYTEPS_TPU_TUNER_REGRESS_FRAC
+    # Knob plane (CMD_KNOB): whether the tuner's global knob proposals
+    # (fusion_bytes / compress_threads / wire_conns) ACTUATE as
+    # epoch-versioned CMD_KNOB sets that land at a round boundary, or
+    # stay advisory log lines (the pre-knob-plane behavior).  Only
+    # worker 0's tuner proposes either way.
+    knob_actuate: bool = True            # BYTEPS_TPU_KNOB_ACTUATE
+    # Machine-readable per-codec cost-model table (wire_bench.py
+    # --codec-sweep --json writes it; the predictive tuner seeds from
+    # it).  Empty = the per-user default cache path.
+    knob_cost_model: str = ""            # BYTEPS_TPU_KNOB_COST_MODEL
+    # Rounds ahead a knob switch's boundary is placed (same headroom
+    # law as tuner_margin_rounds; KNOB_STALE covers whoever misses it).
+    knob_margin_rounds: int = 2          # BYTEPS_TPU_KNOB_MARGIN_ROUNDS
 
     # ---- logging ----
     log_level: str = "WARNING"           # BYTEPS_LOG_LEVEL
@@ -316,6 +329,10 @@ class Config:
                 "BYTEPS_TPU_TUNER_MARGIN_ROUNDS", 2),
             tuner_regress_frac=float(
                 os.environ.get("BYTEPS_TPU_TUNER_REGRESS_FRAC") or 0.2),
+            knob_actuate=_env_bool("BYTEPS_TPU_KNOB_ACTUATE", True),
+            knob_cost_model=_env_str("BYTEPS_TPU_KNOB_COST_MODEL", ""),
+            knob_margin_rounds=_env_int(
+                "BYTEPS_TPU_KNOB_MARGIN_ROUNDS", 2),
             log_level=_env_str("BYTEPS_LOG_LEVEL", "WARNING"),
             mesh_dp=_env_int("BYTEPS_TPU_MESH_DP", 0),
             mesh_tp=_env_int("BYTEPS_TPU_MESH_TP", 1),

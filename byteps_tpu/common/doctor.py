@@ -81,6 +81,15 @@ DEFAULT_THRESHOLDS = {
     # wire/compute boundary).
     "tuner_thrash_windows": 6,
     "tuner_thrash_switches": 2,
+    # knob_thrash: the GLOBAL knob table (CMD_KNOB: fusion_bytes /
+    # compress_threads / wire_conns) switched in MORE THAN
+    # knob_thrash_switches of the last knob_thrash_windows windows —
+    # every switch re-plans fusion layouts / resizes pools / redials
+    # lanes fleet-wide, so an oscillating knob loop is far costlier
+    # than a thrashing per-key codec (raise the tuner's knob cooldown,
+    # or pin the knobs with BYTEPS_TPU_KNOB_ACTUATE=0).
+    "knob_thrash_windows": 6,
+    "knob_thrash_switches": 2,
     # param_version_stall: an opt-armed key's completed_round grew while
     # its param_version did not, for this many consecutive windows — the
     # server-resident update stage is wedged or misconfigured (params
@@ -455,6 +464,56 @@ def _r_tuner_thrash(ctx: RuleCtx) -> List[dict]:
     return out
 
 
+def _r_knob_thrash(ctx: RuleCtx) -> List[dict]:
+    m = int(ctx.th["knob_thrash_windows"])
+    n = int(ctx.th["knob_thrash_switches"])
+    if len(ctx.windows) < 2:
+        return []
+    wins = ctx.windows[-(m + 1):]
+    # A "switch window" = bps_knob_switches_total grew across it (the
+    # counter delta law; the counter increments once per applied global
+    # knob-table epoch on this worker).
+    switch_windows = 0
+    history = []
+    for prev, cur in zip(wins, wins[1:]):
+        pv = parse_series(prev.get("metrics") or {},
+                          "bps_knob_switches_total").get((), 0.0)
+        cv = parse_series(cur.get("metrics") or {},
+                          "bps_knob_switches_total").get((), 0.0)
+        switched = cv - pv > 0
+        if switched:
+            switch_windows += 1
+        entry = {"window": int(cur.get("window", -1)),
+                 "switched": switched,
+                 "epoch": int(parse_series(
+                     cur.get("metrics") or {},
+                     "bps_knob_epoch").get((), 0.0))}
+        values = {}
+        for lbl, v in parse_series(cur.get("metrics") or {},
+                                   "bps_knob_value").items():
+            knob = dict(lbl).get("knob")
+            if knob:
+                values[knob] = int(v)
+        if values:
+            entry["knobs"] = values
+        history.append(entry)
+    if switch_windows <= n:
+        return []
+    return [{
+        "subject": "knob_table",
+        "message": (f"the global knob table switched in "
+                    f"{switch_windows} of the last {len(wins) - 1} "
+                    f"windows: every CMD_KNOB epoch re-plans fusion "
+                    f"layouts / resizes pools / redials lanes "
+                    f"fleet-wide — the knob loop is oscillating "
+                    f"instead of converging; raise the tuner's knob "
+                    f"cooldown or pin the knobs with "
+                    f"BYTEPS_TPU_KNOB_ACTUATE=0"),
+        "evidence": {"switch_windows": switch_windows,
+                     "windows": len(wins) - 1,
+                     "knob_history": history}}]
+
+
 def _r_param_version_stall(ctx: RuleCtx) -> List[dict]:
     """Server-resident optimizer wedge: a key whose rounds keep
     completing (completed_round grows) while its param_version does not
@@ -570,6 +629,9 @@ RULES: List[Rule] = [
     Rule("tuner_thrash", SEV_WARN,
          "the adaptive-compression tuner keeps flipping a key's codec",
          _r_tuner_thrash),
+    Rule("knob_thrash", SEV_WARN,
+         "the global knob table keeps switching instead of converging",
+         _r_knob_thrash),
     Rule("param_version_stall", SEV_ERROR,
          "a server-resident optimizer key stopped publishing updates",
          _r_param_version_stall),

@@ -37,15 +37,28 @@ residuals carried across the switch.  Only ONE worker proposes
 polling the codec table and relying on the server's CODEC_STALE
 backstop, so racing proposers can't fight.
 
-The same loop also inspects the global knobs —
+The same loop also inspects the global knobs.  Three of them —
 ``BYTEPS_TPU_FUSION_BYTES``, ``BYTEPS_TPU_COMPRESS_THREADS``,
-``BYTEPS_PARTITION_BYTES``, ``BYTEPS_TPU_WIRE_CONNS`` — and PROPOSES
-adjustments where the evidence supports them.  None of these are
-safely re-appliable mid-job in this codebase (fusion bytes change
-bucket key identity, the codec pool's width and the lane pools are
-fixed at session init, partition size changes the key space), so
-proposals are logged once and surfaced through ``bps.get_tuner()``,
-never silently applied — restart with the suggested values.
+``BYTEPS_TPU_WIRE_CONNS`` — are ACTUATED through the knob plane
+(``PSSession.propose_knobs``, CMD_KNOB): an epoch-versioned global
+table applied at a declared round boundary on the server and every
+worker atomically, with the KNOB_STALE replay as the backstop (the
+CMD_CODEC law, generalized).  Gate with ``BYTEPS_TPU_KNOB_ACTUATE=0``
+to fall back to advisory-only.  ``BYTEPS_PARTITION_BYTES`` remains
+advisory — partition size changes the pkey space itself, which no
+boundary handshake can re-map mid-job — logged once and surfaced
+through ``bps.get_tuner()``; restart with the suggested value.
+
+When a machine-readable cost model is present (``wire_bench.py
+--codec-sweep --json`` persists one to ``BYTEPS_TPU_KNOB_COST_MODEL``,
+default ``~/.cache/byteps_tpu/codec_cost_model.json``), the tuner is
+PREDICTIVE from a cold start: for each key's first window it computes
+per-dial predicted push time — encode at the measured encode MB/s +
+(payload / ratio) over the key's measured wire MB/s + decode — and
+jumps straight to the predicted-best codec instead of stepping the
+dial one notch per window.  The hysteretic react/revert/blacklist loop
+stays armed as the safety net: a predictive jump is judged on the next
+window like any other switch and reverted if it regressed.
 
 Armed by ``BYTEPS_TPU_TUNER=1`` (requires the signal plane,
 ``BYTEPS_TPU_SIGNAL_WINDOW_S`` > 0).  Off by default: nothing is
@@ -81,6 +94,12 @@ DIAL_KWARGS = {
 # Wire comp ids for the bps_codec_active gauge / bps_top column.
 DIAL_COMP_ID = {"raw": 0, "onebit": 1, "elias": 4, "qblock": 5}
 
+# Dial position -> wire_bench --codec-sweep codec name (the cost-model
+# table's row key).  The sweep benches the EF-carrying variants — the
+# same kwargs DIAL_KWARGS actuates.
+DIAL_SWEEP_NAME = {"raw": "raw", "onebit": "onebit+ef",
+                   "elias": "elias+ef", "qblock": "qblock4+ef"}
+
 DEFAULT_HOLD = 2          # windows a class must persist before a switch
 DEFAULT_BLACKLIST = 8     # windows a reverted key stays frozen
 DEFAULT_MARGIN_ROUNDS = 2  # switch takes effect this many rounds ahead
@@ -102,12 +121,115 @@ def dial_of(comp) -> Optional[int]:
     return None
 
 
+def cost_model_path() -> str:
+    """The stable cost-model path shared by the producer (wire_bench.py
+    --codec-sweep --json persists here) and the consumer (the predictive
+    tuner seeds from here): BYTEPS_TPU_KNOB_COST_MODEL, else the
+    per-user cache default."""
+    import os
+    p = os.environ.get("BYTEPS_TPU_KNOB_COST_MODEL", "")
+    if not p:
+        try:
+            from .config import get_config
+            p = get_config().knob_cost_model
+        except Exception:
+            p = ""
+    return p or os.path.expanduser(
+        "~/.cache/byteps_tpu/codec_cost_model.json")
+
+
+class CostModel:
+    """Per-codec encode/decode throughput + ratio table, seeded from the
+    ``wire_bench.py --codec-sweep`` ground truth.
+
+    ``predict_push_s(dial_name, size_bytes, wire_mbps)`` models one
+    push's wire-visible cost: encode the payload at the benched encode
+    MB/s, ship ``size/ratio`` bytes at the key's MEASURED wire MB/s
+    (the signal plane's per-key number — the model supplies the codec
+    half, the live window supplies the network half), decode at the
+    benched decode MB/s.  Rows are matched by nearest benched size."""
+
+    def __init__(self, rows: List[dict], path: str = ""):
+        self.path = path
+        self._by_codec: Dict[str, List[dict]] = {}
+        for r in rows or []:
+            try:
+                self._by_codec.setdefault(str(r["codec"]), []).append({
+                    "size_bytes": int(r["size_bytes"]),
+                    "encode_MBps": (float(r["encode_MBps"])
+                                    if r.get("encode_MBps") else None),
+                    "decode_MBps": (float(r["decode_MBps"])
+                                    if r.get("decode_MBps") else None),
+                    "ratio": float(r.get("ratio") or 1.0),
+                })
+            except (KeyError, TypeError, ValueError):
+                continue
+        for rows_ in self._by_codec.values():
+            rows_.sort(key=lambda r: r["size_bytes"])
+
+    def __len__(self) -> int:
+        return sum(len(v) for v in self._by_codec.values())
+
+    @classmethod
+    def load(cls, path: Optional[str] = None) -> Optional["CostModel"]:
+        """Best-effort load; None when the table is absent/unreadable
+        (the tuner then runs purely hysteretic — never an error)."""
+        import json
+        import os
+        p = path or cost_model_path()
+        try:
+            with open(p) as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            return None
+        rows = doc.get("codec_sweep") if isinstance(doc, dict) else doc
+        cm = cls(rows or [], path=p)
+        return cm if len(cm) else None
+
+    def _row(self, codec: str, size_bytes: int) -> Optional[dict]:
+        rows = self._by_codec.get(codec)
+        if not rows:
+            return None
+        return min(rows, key=lambda r: abs(r["size_bytes"] - size_bytes))
+
+    def predict_push_s(self, dial_name: str, size_bytes: int,
+                      wire_mbps: float) -> Optional[float]:
+        if size_bytes <= 0 or wire_mbps <= 0:
+            return None
+        wire_bps = wire_mbps * 1e6
+        if dial_name == "raw":
+            return size_bytes / wire_bps
+        row = self._row(DIAL_SWEEP_NAME.get(dial_name, dial_name),
+                        size_bytes)
+        if row is None:
+            return None
+        t = (size_bytes / max(1.0, row["ratio"])) / wire_bps
+        if row["encode_MBps"]:
+            t += size_bytes / (row["encode_MBps"] * 1e6)
+        if row["decode_MBps"]:
+            t += size_bytes / (row["decode_MBps"] * 1e6)
+        return t
+
+    def best_dial(self, size_bytes: int, wire_mbps: float,
+                  max_dial: int) -> Optional[int]:
+        """argmin of predicted push time over the dial — None when the
+        table can't price this point (missing rows, no wire measure)."""
+        best, best_t = None, None
+        for d in range(0, max(0, int(max_dial)) + 1):
+            t = self.predict_push_s(DIAL[d], size_bytes, wire_mbps)
+            if t is None:
+                continue
+            if best_t is None or t < best_t:
+                best, best_t = d, t
+        return best
+
+
 class _KeyTune:
     """One key's controller state."""
 
     __slots__ = ("dial", "classes", "blacklist_until", "pinned",
                  "baseline_ms", "eval_window", "prev_dial", "switches",
-                 "declared_key", "off_dial_warned")
+                 "declared_key", "off_dial_warned", "predicted")
 
     def __init__(self, dial: int, declared_key: Optional[int]):
         self.dial = dial                 # current DIAL index
@@ -121,6 +243,7 @@ class _KeyTune:
         self.switches = 0
         self.declared_key = declared_key
         self.off_dial_warned = False
+        self.predicted = False           # cold-start jump spent (one-shot)
 
 
 class Tuner:
@@ -133,7 +256,8 @@ class Tuner:
                  blacklist: int = DEFAULT_BLACKLIST,
                  margin_rounds: int = DEFAULT_MARGIN_ROUNDS,
                  regress_frac: float = DEFAULT_REGRESS_FRAC,
-                 max_dial: int = len(DIAL) - 1):
+                 max_dial: int = len(DIAL) - 1,
+                 cost_model: Optional[CostModel] = None):
         self._session = session
         self.propose = bool(propose)
         self.hold = max(1, int(hold))
@@ -146,8 +270,15 @@ class Tuner:
         self._window = -1
         self.switches_total = 0
         self.reverts_total = 0
+        self.predict_jumps_total = 0
         self._proposals: List[dict] = []
         self._proposed_knobs: set = set()
+        self._knob_last: Dict[str, int] = {}   # env name -> window actuated
+        # Predictive seed: the wire_bench --codec-sweep table, when one
+        # has been persisted.  Absent -> purely hysteretic (the pre-
+        # cost-model behavior, byte-identical decisions).
+        self._cost_model = (cost_model if cost_model is not None
+                            else CostModel.load())
         from . import telemetry as _tm
         reg = _tm.get_registry()
         self._m_switches = reg.counter(
@@ -171,6 +302,19 @@ class Tuner:
             self._session.poll_codec()
         except Exception:
             get_logger().debug("tuner codec poll failed", exc_info=True)
+        try:
+            # Same law for the GLOBAL knob table: observers learn a
+            # pending CMD_KNOB switch before their round crosses its
+            # boundary (KNOB_STALE remains the correctness backstop).
+            # Gated on knob_actuate so BYTEPS_TPU_KNOB_ACTUATE=0
+            # restores the pre-knob-plane wire byte stream exactly —
+            # advisory proposals read only the session-local mirror.
+            from .config import get_config
+            poll_knobs = getattr(self._session, "poll_knobs", None)
+            if poll_knobs is not None and get_config().knob_actuate:
+                poll_knobs()
+        except Exception:
+            get_logger().debug("tuner knob poll failed", exc_info=True)
         with self._lock:
             self._window = int(summary.get("window", self._window + 1))
             for label, rec in (summary.get("keys") or {}).items():
@@ -291,6 +435,31 @@ class Tuner:
                 or self._window <= kt.blacklist_until \
                 or kt.eval_window >= 0:
             return
+        # Predictive cold start: with a cost model present, this key's
+        # FIRST observed window prices every dial position — benched
+        # enc/dec throughput + (payload/ratio) over the key's measured
+        # wire MB/s — and jumps straight to the predicted minimum
+        # instead of stepping one notch per hold period.  One-shot per
+        # key; the jump is judged next window like any switch (the
+        # hysteretic revert/blacklist loop is the safety net), and the
+        # ambient loop keeps adapting from wherever the jump landed.
+        if (self._cost_model is not None and not kt.predicted
+                and cls not in ("unhealthy", "straggler_bound")):
+            kt.predicted = True
+            size = int(rec.get("push_bytes", 0)
+                       / max(1, int(rec.get("pushes", 1))))
+            best = self._cost_model.best_dial(
+                size, float(rec.get("wire_mbps", 0.0)), self.max_dial)
+            if best is not None and best != kt.dial:
+                self.predict_jumps_total += 1
+                kt.baseline_ms = per_push
+                get_logger().info(
+                    "tuner: cost model predicts %s for key %s "
+                    "(%d B payload @ %.1f wire MB/s) — jumping from %s",
+                    DIAL[best], label, size, rec.get("wire_mbps", 0.0),
+                    DIAL[kt.dial])
+                self._switch(label, kt, best, "predict")
+                return
         # Hysteresis: the class must have held for `hold` windows.
         recent = list(kt.classes)[-self.hold:]
         if len(recent) < self.hold or len(set(recent)) != 1:
@@ -344,7 +513,20 @@ class Tuner:
             res.get("effective_round"),
             "accepted" if res.get("accepted") else "superseded")
 
-    # -- advisory knob proposals --------------------------------------------
+    # -- knob proposals (actuated via CMD_KNOB where safe) ------------------
+
+    # env name -> knob-plane name for the three knobs CMD_KNOB actuates.
+    # BYTEPS_PARTITION_BYTES is deliberately absent: partition size
+    # changes the pkey space itself, which no boundary handshake can
+    # re-map mid-job — it stays advisory.
+    _ACTUATED = {"BYTEPS_TPU_FUSION_BYTES": "fusion_bytes",
+                 "BYTEPS_TPU_COMPRESS_THREADS": "compress_threads",
+                 "BYTEPS_TPU_WIRE_CONNS": "wire_conns"}
+    # Windows between actuated sets of the same knob — the knob-plane
+    # hysteresis (the doctor's knob_thrash rule fires at >2 switches in
+    # 6 windows; the cooldown keeps a healthy loop well under it).
+    KNOB_COOLDOWN = 8
+
     def _propose_knobs(self, summary: dict) -> None:
         keys = summary.get("keys") or {}
         if not keys:
@@ -356,33 +538,74 @@ class Tuner:
             counts[rec.get("class", "?")] = counts.get(
                 rec.get("class", "?"), 0) + 1
         total = sum(counts.values())
+        # Live knob values win over launch config once a switch landed —
+        # doubling from the LAUNCH value after an actuation would propose
+        # a stale target forever.
+        live: Dict[str, int] = {}
+        can_actuate = (self.propose and cfg.knob_actuate
+                       and hasattr(self._session, "propose_knobs"))
+        if hasattr(self._session, "knob_table"):
+            try:
+                live = self._session.knob_table().get("live", {}) or {}
+            except Exception:
+                live = {}
+        cur_fb = int(live.get("fusion_bytes", cfg.fusion_bytes))
+        cur_ct = int(live.get("compress_threads", cfg.compress_threads))
+        cur_wc = int(live.get("wire_conns", cfg.wire_conns))
 
-        def propose(knob: str, current, suggested, reason: str,
-                    appliable: bool = False) -> None:
-            if knob in self._proposed_knobs:
+        def propose(knob: str, current, suggested, reason: str) -> None:
+            plane_name = self._ACTUATED.get(knob)
+            actuate = can_actuate and plane_name is not None
+            if actuate:
+                last = self._knob_last.get(knob)
+                if (last is not None
+                        and self._window - last < self.KNOB_COOLDOWN):
+                    return
+                if int(suggested) == int(current):
+                    return
+            elif knob in self._proposed_knobs:
                 return
-            self._proposed_knobs.add(knob)
             row = {"knob": knob, "current": current,
                    "proposed": suggested, "reason": reason,
                    "applied": False, "window": self._window}
+            if actuate:
+                # Graduated from advisory: ride the knob plane — an
+                # epoch-versioned CMD_KNOB set, applied at a round
+                # boundary on every participant atomically.
+                self._knob_last[knob] = self._window
+                try:
+                    res = self._session.propose_knobs(
+                        {plane_name: int(suggested)},
+                        margin_rounds=cfg.knob_margin_rounds)
+                except Exception as e:
+                    get_logger().warning(
+                        "tuner: knob actuation %s=%s failed: %s",
+                        knob, suggested, e)
+                    row["error"] = str(e)
+                else:
+                    row["applied"] = bool(res.get("accepted"))
+                    row["epoch"] = res.get("epoch")
+                    row["effective_round"] = res.get("effective_round")
+                    get_logger().info(
+                        "tuner knob actuation: %s=%s (was %s) at round "
+                        ">= %s: %s", knob, suggested, current,
+                        res.get("effective_round"), reason)
+            else:
+                self._proposed_knobs.add(knob)
+                get_logger().info(
+                    "tuner proposal (advisory, NOT auto-applied — "
+                    "restart with it): %s=%s (now %s): %s", knob,
+                    suggested, current, reason)
             self._proposals.append(row)
-            # None of these knobs are safely re-appliable mid-job here
-            # (bucket identity / fixed pools / key space) — log, never
-            # silently apply.
-            get_logger().info(
-                "tuner proposal (advisory, NOT auto-applied — restart "
-                "with it): %s=%s (now %s): %s", knob, suggested, current,
-                reason)
 
-        if counts.get("tiny", 0) > total / 2 and cfg.fusion_bytes > 0:
-            propose("BYTEPS_TPU_FUSION_BYTES", cfg.fusion_bytes,
-                    cfg.fusion_bytes * 2,
+        if counts.get("tiny", 0) > total / 2 and cur_fb > 0:
+            propose("BYTEPS_TPU_FUSION_BYTES", cur_fb, cur_fb * 2,
                     f"{counts['tiny']}/{total} keys are tiny (<64KiB "
                     f"mean payload): per-message overhead dominates — "
                     f"bigger fusion buckets amortize it")
         if counts.get("compute_bound", 0) > total / 2:
-            propose("BYTEPS_TPU_COMPRESS_THREADS", cfg.compress_threads,
-                    max(4, cfg.compress_threads * 2),
+            propose("BYTEPS_TPU_COMPRESS_THREADS", cur_ct,
+                    max(4, cur_ct * 2),
                     f"{counts['compute_bound']}/{total} keys are "
                     f"compute-bound: codec work dominates their round "
                     f"time — widen the codec pool")
@@ -391,8 +614,7 @@ class Tuner:
                 kt.dial >= self.max_dial for kt in self._keys.values()
                 if kt.dial >= 0)
             if at_max and self._keys:
-                propose("BYTEPS_TPU_WIRE_CONNS", cfg.wire_conns,
-                        cfg.wire_conns * 2,
+                propose("BYTEPS_TPU_WIRE_CONNS", cur_wc, cur_wc * 2,
                         f"{counts['wire_bound']}/{total} keys stay "
                         f"wire-bound at the hardest codec: more data "
                         f"lanes per server is the next dial")
@@ -416,6 +638,12 @@ class Tuner:
                     "baseline_per_push_ms": kt.baseline_ms,
                     "switches": kt.switches,
                 }
+            knob_table = None
+            if hasattr(self._session, "knob_table"):
+                try:
+                    knob_table = self._session.knob_table()
+                except Exception:
+                    knob_table = None
             return {
                 "armed": True,
                 "proposer": self.propose,
@@ -423,6 +651,11 @@ class Tuner:
                 "dial": list(DIAL),
                 "switches_total": self.switches_total,
                 "reverts_total": self.reverts_total,
+                "predict_jumps_total": self.predict_jumps_total,
+                "cost_model": ({"path": self._cost_model.path,
+                                "rows": len(self._cost_model)}
+                               if self._cost_model is not None else None),
+                "knob_table": knob_table,
                 "keys": keys,
                 "knob_proposals": [dict(p) for p in self._proposals],
             }

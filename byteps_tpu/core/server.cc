@@ -185,6 +185,28 @@ enum Cmd : uint8_t {
                  // engine-owned state, exactly like the codec table).
                  // Old servers answer kError via the engine default arm —
                  // "server too old".
+  kKnob = 19,    // GLOBAL knob plane (CMD_KNOB): the CMD_CODEC epoch law
+                 // generalized from one key's wire format to the job's
+                 // global performance knobs (fusion_bytes /
+                 // compress_threads / wire_conns).  ONE epoch-versioned
+                 // table per server, not per key.  flags bit0 = SET
+                 // (payload: u32 epoch | u64 effective_round | u32 klen |
+                 // kwargs "k=v,k=v"): applied only when the proposed
+                 // epoch is NEWER than the current one (the CMD_RING_SET
+                 // idempotency law — racing proposers converge), taking
+                 // effect at the first round boundary with
+                 // completed_round >= effective_round, so no round ever
+                 // mixes fusion layouts, pool sizes, or lane sets.
+                 // flags bit1 = ACK (payload: u32 epoch): the sending
+                 // worker reports it has ADOPTED that epoch — the
+                 // per-worker acked map is what the push-path backstop
+                 // checks (kKnobStale below).  GET (no flag bits), SET
+                 // and ACK all answer the authoritative knob JSON doc.
+                 // Reader thread, like kStats: the table is global
+                 // control-plane state, never engine-owned, and a SET
+                 // must land even when an engine is wedged mid-round.
+                 // Old servers answer kError via the engine default arm —
+                 // "server too old".
 };
 
 // Request `dtype` marker on PULL frames: the worker asks for the 24-byte
@@ -221,7 +243,19 @@ enum : uint8_t { kRingTask = 201 };
 // no contribution is lost.  Emitted only for keys whose codec epoch has
 // advanced past 0 — a job that never renegotiates (and any pre-codec
 // client) never sees status 3.
-enum Status : uint8_t { kOk = 0, kError = 1, kMoved = 2, kCodecStale = 3 };
+// kKnobStale: a sync-round push arrived from a worker that has not acked
+// the CURRENT global knob epoch while the key's round is already at/past
+// the switch's effective round — the sender missed a CMD_KNOB
+// renegotiation and its staged work may ride a stale fusion layout, pool
+// size, or lane set.  The response payload is the authoritative knob
+// JSON; the worker adopts the table, re-applies its half of the switch,
+// ACKs the epoch, and replays (re-planning its fusion buckets when the
+// layout changed), so no round mixes knob configurations and no
+// contribution is lost.  Emitted only once the knob epoch has advanced
+// past 0 — a job that never renegotiates (and any pre-knob client) never
+// sees status 4.
+enum Status : uint8_t { kOk = 0, kError = 1, kMoved = 2, kCodecStale = 3,
+                        kKnobStale = 4 };
 
 // Header `flags` bit 15: this frame is inside the sending worker's trace
 // window.  PUSH/PULL frames carry their round in the LOW 15 BITS always;
@@ -1986,7 +2020,7 @@ class Server {
     // Worst-case row: the header now carries ~13 numeric fields at up
     // to 20 digits + ~270 chars of labels — keep comfortable headroom
     // (snprintf truncation would silently corrupt the JSON).
-    char buf[832];
+    char buf[1024];
     std::string js;
     js.reserve(4096);
     const uint64_t keys_owned = ring_armed_ ? KeysOwned() : 0;
@@ -2000,6 +2034,8 @@ class Server {
                   "\"moved_frames\":%llu,\"codec_sets\":%llu,"
                   "\"codec_stale_frames\":%llu,\"opt_sets\":%llu,"
                   "\"opt_updates\":%llu,\"opt_slot_bytes\":%llu,"
+                  "\"knob_epoch\":%llu,\"knob_sets\":%llu,"
+                  "\"knob_stale_frames\":%llu,"
                   "\"slice_size\":%d,\"keys\":{",
                   static_cast<unsigned long long>(
                       bytes_in_.load(std::memory_order_relaxed)),
@@ -2033,6 +2069,12 @@ class Server {
                       opt_updates_.load(std::memory_order_relaxed)),
                   static_cast<unsigned long long>(
                       opt_slot_bytes_.load(std::memory_order_relaxed)),
+                  static_cast<unsigned long long>(
+                      knob_epoch_atomic_.load(std::memory_order_acquire)),
+                  static_cast<unsigned long long>(
+                      knob_sets_.load(std::memory_order_relaxed)),
+                  static_cast<unsigned long long>(
+                      knob_stale_.load(std::memory_order_relaxed)),
                   slice_size_);
     js += buf;
     std::lock_guard<std::mutex> lk(stats_mu_);
@@ -2801,6 +2843,30 @@ class Server {
     fn = ks.opt_v.size();
     put(&fn, 8);
     put(ks.opt_v.data(), fn * 4);
+    // Global knob-table trailer (the CMD_MIGRATE-adjacent seam of the
+    // knob plane): the table is SERVER-global, but a ring drain hands
+    // keys to a peer that may predate the switch — so every migrated
+    // key carries the sender's table and the receiver adopts it IF
+    // NEWER, idempotent across the N keys of a drain exactly like a
+    // racing CMD_KNOB SET.  The acked map deliberately does NOT ride:
+    // workers re-ack the new owner via the kKnobStale backstop (one
+    // adopt-and-replay round trip, self-healing).  Absent from pre-knob
+    // senders — the receiver's remaining()-based parse then leaves its
+    // table untouched, version-tolerant like the codec/opt trailers.
+    {
+      std::lock_guard<std::mutex> lk(knob_mu_);
+      put(&knob_epoch_, 4);
+      put(&knob_applied_, 4);
+      uint8_t kpend = knob_pending_ ? 1 : 0;
+      put(&kpend, 1);
+      put(&knob_effective_, 8);
+      uint32_t kl = static_cast<uint32_t>(knob_kwargs_.size());
+      put(&kl, 4);
+      put(knob_kwargs_.data(), kl);
+      kl = static_cast<uint32_t>(knob_next_.size());
+      put(&kl, 4);
+      put(knob_next_.data(), kl);
+    }
     return out;
   }
 
@@ -3171,6 +3237,37 @@ class Server {
         }
       }
     }
+    // Global knob-table trailer (absent from pre-knob senders: the
+    // remaining()-based parse then leaves the local table untouched).
+    // Adopted IF NEWER under the same idempotency law as a racing
+    // CMD_KNOB SET, so the N per-key migrations of a drain converge on
+    // the sender's table and a post-switch drain CARRIES the knob epoch
+    // to the surviving owner.  The acked map intentionally resets:
+    // workers re-introduce themselves via the kKnobStale backstop.
+    {
+      uint32_t kep = 0, kaep = 0, kwl = 0, knl = 0;
+      uint8_t kpend = 0;
+      uint64_t keff = 0;
+      if (take(&kep, 4) && take(&kaep, 4) && take(&kpend, 1) &&
+          take(&keff, 8) && take(&kwl, 4) && kwl <= remaining()) {
+        std::string kkw(p.data() + pos, kwl);
+        pos += kwl;
+        if (take(&knl, 4) && knl <= remaining()) {
+          std::string knext(p.data() + pos, knl);
+          pos += knl;
+          std::lock_guard<std::mutex> lk(knob_mu_);
+          if (kep > knob_epoch_) {
+            knob_epoch_ = kep;
+            knob_applied_ = kaep;
+            knob_pending_ = kpend != 0;
+            knob_effective_ = keff;
+            knob_kwargs_ = std::move(kkw);
+            knob_next_ = std::move(knext);
+            knob_epoch_atomic_.store(kep, std::memory_order_release);
+          }
+        }
+      }
+    }
     OptSlotAccount(ks);
     StatOpt(t.key, ks.param_version, ks.opt_kind);
     ks.merge_ts.clear();
@@ -3496,6 +3593,13 @@ class Server {
           Respond(conn, kOk, h.req_id, h.key, js.data(), js.size());
           break;
         }
+        case kKnob:
+          // Reader-thread knob plane, like kStats: the table is global
+          // control-plane state and a SET/GET must answer even when an
+          // engine is wedged mid-round.
+          HandleKnobFrame(conn, h.req_id, key, h.flags, h.worker_id,
+                          payload);
+          break;
         case kAudit: {
           // Reader-thread digest-window read, same rationale as kStats:
           // the auditor's cross-check must answer even when an engine is
@@ -3919,6 +4023,120 @@ class Server {
     }
     std::string js = CodecJson(t.key, ks);
     Respond(t.conn, kOk, t.req_id, t.key, js.data(), js.size());
+  }
+
+  // -- global knob plane (CMD_KNOB) ---------------------------------------
+  // The authoritative knob doc — the SET/GET/ACK response and the
+  // kKnobStale payload.  `kwargs` is always the ACTIVE table (what the
+  // rounds currently merging were planned under); `kwargs_next` /
+  // `effective_round` describe the staged switch while one is pending.
+  // The acked map is included so a proposer can observe fleet adoption.
+  std::string KnobJsonLocked() {
+    std::string js = "{\"epoch\":" + std::to_string(knob_epoch_) +
+        ",\"applied_epoch\":" + std::to_string(knob_applied_) +
+        ",\"pending\":" + (knob_pending_ ? "1" : "0") +
+        ",\"effective_round\":" + std::to_string(knob_effective_) +
+        ",\"kwargs\":\"";
+    JsonEscapeInto(&js, knob_kwargs_);
+    js += "\",\"kwargs_next\":\"";
+    JsonEscapeInto(&js, knob_next_);
+    js += "\",\"acked\":{";
+    bool first = true;
+    for (auto& kv : knob_acked_) {
+      js += (first ? "\"" : ",\"") + std::to_string(kv.first) + "\":" +
+            std::to_string(kv.second);
+      first = false;
+    }
+    js += "}}";
+    return js;
+  }
+
+  // The server half of the boundary apply: flip the staged table to
+  // ACTIVE once any key's completed_round reaches the effective round.
+  // Observational only (the enforcement is the per-push acked check) —
+  // but it keeps the doc's `kwargs` field truthful for GET/stale
+  // replies.  Caller holds knob_mu_.
+  void MaybeApplyKnobLocked(uint64_t completed_round) {
+    if (knob_pending_ && completed_round >= knob_effective_) {
+      knob_kwargs_ = knob_next_;
+      knob_applied_ = knob_epoch_;
+      knob_pending_ = false;
+      knob_next_.clear();
+    }
+  }
+
+  // Reader-thread CMD_KNOB handler (kStats rationale: global
+  // control-plane state, must answer even when an engine is wedged).
+  // flags bit0 = SET, bit1 = ACK, neither = GET; every path answers the
+  // authoritative doc so racing proposers and pollers all converge.
+  void HandleKnobFrame(Conn* conn, uint32_t req_id, uint64_t key,
+                       uint16_t flags, uint32_t worker_id,
+                       const std::vector<char>& payload) {
+    std::unique_lock<std::mutex> lk(knob_mu_);
+    if (flags & 1) {   // SET: u32 epoch | u64 effective | u32 klen | kw
+      if (payload.size() < 16) {
+        lk.unlock();
+        Respond(conn, kError, req_id, key, nullptr, 0);
+        return;
+      }
+      uint32_t epoch = 0, klen = 0;
+      uint64_t eff = 0;
+      std::memcpy(&epoch, payload.data(), 4);
+      std::memcpy(&eff, payload.data() + 4, 8);
+      std::memcpy(&klen, payload.data() + 12, 4);
+      if (payload.size() < 16ull + klen) {
+        lk.unlock();
+        Respond(conn, kError, req_id, key, nullptr, 0);
+        return;
+      }
+      // Applied only if newer — racing proposers are idempotent, and a
+      // losing proposer reads the winner's doc from the response.
+      if (epoch > knob_epoch_) {
+        knob_epoch_ = epoch;
+        knob_next_.assign(payload.data() + 16, klen);
+        knob_effective_ = eff;
+        knob_pending_ = true;
+        knob_sets_.fetch_add(1, std::memory_order_relaxed);
+        knob_epoch_atomic_.store(epoch, std::memory_order_release);
+        // Async mode has no rounds to hold the boundary for: the table
+        // applies immediately, exactly like the codec law's async arm.
+        if (async_) MaybeApplyKnobLocked(eff);
+        // The proposer adopted what it proposed — its SET doubles as
+        // its ACK, so a 1-worker job never needs the stale backstop.
+        uint32_t& acked = knob_acked_[worker_id];
+        if (epoch > acked) acked = epoch;
+      }
+    } else if (flags & 2) {   // ACK: u32 epoch this worker has adopted
+      if (payload.size() >= 4) {
+        uint32_t epoch = 0;
+        std::memcpy(&epoch, payload.data(), 4);
+        uint32_t& acked = knob_acked_[worker_id];
+        if (epoch > acked) acked = epoch;
+      }
+    }
+    std::string js = KnobJsonLocked();
+    lk.unlock();
+    Respond(conn, kOk, req_id, key, js.data(), js.size());
+  }
+
+  // Engine-thread push-path backstop (called only once the fast atomic
+  // gate saw a nonzero epoch): a current-round push from a worker that
+  // has not acked the newest knob epoch, for a key already at/past the
+  // switch boundary, is rejected with the doc — its staged work may ride
+  // a stale fusion layout / pool size / lane set.  Returns true when the
+  // push was answered (caller returns without mutating state).
+  bool KnobStaleCheck(Task& t, KeyState& ks) {
+    std::unique_lock<std::mutex> lk(knob_mu_);
+    MaybeApplyKnobLocked(ks.completed_round);
+    auto it = knob_acked_.find(t.worker_id);
+    const uint32_t acked = it == knob_acked_.end() ? 0 : it->second;
+    if (acked >= knob_epoch_ || ks.completed_round < knob_effective_)
+      return false;
+    knob_stale_.fetch_add(1, std::memory_order_relaxed);
+    std::string js = KnobJsonLocked();
+    lk.unlock();
+    Respond(t.conn, kKnobStale, t.req_id, t.key, js.data(), js.size());
+    return true;
   }
 
   // -- server-resident optimizer plane (CMD_OPT) --------------------------
@@ -4415,6 +4633,18 @@ class Server {
     // boundary law as the codec table below: the round's FIRST push,
     // once completed_round reached the declared effective round — so no
     // round ever mixes update modes.  Epoch 0 pays one integer compare.
+    // Global knob plane (CMD_KNOB): once the knob epoch has advanced, a
+    // current-round push from a worker that has not acked the newest
+    // epoch — for a key already at/past the switch's effective round —
+    // draws kKnobStale carrying the authoritative table BEFORE any state
+    // mutates: the worker adopts, re-applies its half of the switch
+    // (re-planning fusion buckets when the layout changed), ACKs, and
+    // replays.  Epoch 0 (no knob switch ever) pays one atomic load and
+    // behaves exactly as before — wire byte-identical.
+    if (!async_ &&
+        knob_epoch_atomic_.load(std::memory_order_acquire) != 0 &&
+        KnobStaleCheck(t, ks))
+      return;
     if (!async_ && ks.opt_epoch != 0 && ks.opt_pending &&
         ks.seen.empty() && ks.completed_round >= ks.opt_effective)
       ApplyPendingOpt(ks);
@@ -4893,6 +5123,24 @@ class Server {
   // renegotiation race backstop firing) — CMD_STATS observability.
   std::atomic<uint64_t> codec_sets_{0};
   std::atomic<uint64_t> codec_stale_{0};
+  // CMD_KNOB global knob plane: ONE epoch-versioned kwargs table per
+  // server ("fusion_bytes=..,compress_threads=..,wire_conns=..") plus
+  // the per-worker acked-epoch map the push-path backstop consults.
+  // Guarded by knob_mu_ (reader threads write it, engine threads read it
+  // on the push path); knob_epoch_atomic_ mirrors knob_epoch_ so an
+  // unarmed run's pushes pay ONE relaxed load and never take the mutex —
+  // wire behavior byte-identical until the first SET.
+  std::mutex knob_mu_;
+  uint32_t knob_epoch_ = 0;          // newest accepted epoch (0 = launch)
+  uint32_t knob_applied_ = 0;        // epoch of the ACTIVE kwargs
+  bool knob_pending_ = false;        // a staged switch awaits its boundary
+  uint64_t knob_effective_ = 0;      // round boundary of the newest SET
+  std::string knob_kwargs_;          // ACTIVE table ("" = launch config)
+  std::string knob_next_;            // staged table while pending
+  std::map<uint32_t, uint32_t> knob_acked_;  // worker -> last acked epoch
+  std::atomic<uint32_t> knob_epoch_atomic_{0};
+  std::atomic<uint64_t> knob_sets_{0};
+  std::atomic<uint64_t> knob_stale_{0};
   // Server-resident optimizer plane (CMD_OPT) — CMD_STATS observability:
   // accepted declarations, idempotent param seeds, published optimizer
   // updates, and the live bytes held in server-owned optimizer slots

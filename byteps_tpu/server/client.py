@@ -80,7 +80,7 @@ _RESP = struct.Struct("<BIQQ")     # status req_id key len
 CMD_HELLO, CMD_INIT, CMD_PUSH, CMD_PULL, CMD_BARRIER, CMD_SHUTDOWN, \
     CMD_PING, CMD_LR_SCALE, CMD_STATS, CMD_TRACE, CMD_LEAVE, \
     CMD_MEMBERS, CMD_RING, CMD_RING_SET, CMD_DRAIN, CMD_MIGRATE, \
-    CMD_AUDIT, CMD_CODEC, CMD_OPT = range(19)
+    CMD_AUDIT, CMD_CODEC, CMD_OPT, CMD_KNOB = range(20)
 
 # Response status bytes (server.cc Status).  MOVED carries the server's
 # current ring table as JSON: the addressed server is not (or no longer)
@@ -92,7 +92,15 @@ CMD_HELLO, CMD_INIT, CMD_PUSH, CMD_PULL, CMD_BARRIER, CMD_SHUTDOWN, \
 # CMD_CODEC renegotiation); the session re-encodes the SAME gradient
 # with the right codec and replays.  Emitted only once the key's codec
 # epoch has advanced, so a job that never renegotiates never sees it.
-STATUS_OK, STATUS_ERROR, STATUS_MOVED, STATUS_CODEC_STALE = 0, 1, 2, 3
+# KNOB_STALE carries the server's GLOBAL knob doc (the CMD_KNOB table):
+# this push came from a worker that has not acked the newest knob epoch
+# while the key's round is already at/past the switch boundary — the
+# session adopts the table, re-applies its half of the switch (fusion
+# re-plan / pool resize / lane resize), ACKs, and replays.  Emitted only
+# once the knob epoch has advanced, so a job that never renegotiates a
+# knob never sees it.
+STATUS_OK, STATUS_ERROR, STATUS_MOVED, STATUS_CODEC_STALE, \
+    STATUS_KNOB_STALE = 0, 1, 2, 3, 4
 
 # dtype byte on the wire (server.cc WireDtype)
 DT_F32, DT_RAW, DT_COMPRESSED, DT_SEED = 0, 1, 2, 3
@@ -285,6 +293,39 @@ class _CodecStale(Exception):
         super().__init__(f"key {key} codec stale (epoch "
                          f"{doc.get('epoch', '?')})")
         self.key = key
+        self.doc = doc
+
+
+class _KnobStale(Exception):
+    """A push drew status KNOB_STALE: this session has not acked the
+    server's newest GLOBAL knob epoch and the key's round is already
+    at/past the switch boundary.  ``doc`` is the authoritative knob doc
+    (the KNOB_STALE payload) — the session adopts the table, applies its
+    half of the switch, ACKs the epoch, and either replays the partition
+    in place (pool/lane knobs, payload unchanged) or fails its handle
+    with :class:`KnobReplan` (the fusion layout changed, so the staged
+    bucket keys no longer exist fleet-wide and the caller must re-plan)."""
+
+    def __init__(self, key: int, doc: dict):
+        super().__init__(f"key {key} knob stale (epoch "
+                         f"{doc.get('epoch', '?')})")
+        self.key = key
+        self.doc = doc
+
+
+class KnobReplan(RuntimeError):
+    """A staged push was withdrawn because a FUSION_BYTES knob switch
+    re-partitioned the tree under it: the bucket keys it was planned
+    against are no longer what the fleet pushes from the effective round
+    on.  Raised out of the affected handles' ``wait()``; the fusion
+    dispatch layer (common/api.py) catches it, re-plans the tree under
+    the live fusion_bytes, and re-dispatches exactly the failed units —
+    idempotent against the server's seen-dedup and stale-round guards,
+    so nothing double-merges.  ``doc`` is the knob doc that triggered
+    the withdrawal (None when the switch was applied locally)."""
+
+    def __init__(self, msg: str, doc: Optional[dict] = None):
+        super().__init__(msg)
         self.doc = doc
 
 
@@ -496,6 +537,10 @@ class _ServerConn:
         self.outstanding_bytes = 0
         self.lane_bytes_total = 0
         self.lane_sends = 0
+        # WIRE_CONNS knob: a retiring lane takes no NEW dispatches
+        # (excluded from _pick_lane) while its outstanding bytes drain;
+        # the resize worker closes it once quiet (_resize_lanes).
+        self.retiring = False
         self.sock = self._dial()
         self.lock = threading.Lock()          # send serialization
         self.replay_lock = threading.Lock()   # serializes on_reconnect runs
@@ -797,6 +842,16 @@ class _ServerConn:
                 except Exception:
                     doc = {}
                 err = _CodecStale(rkey, doc)
+            elif status == STATUS_KNOB_STALE:
+                # Global knob renegotiation race: the payload is the
+                # server's authoritative knob doc — tiny, parsed like
+                # MOVED/CODEC_STALE above.
+                import json as _json
+                try:
+                    doc = _json.loads(bytes(data).decode())
+                except Exception:
+                    doc = {}
+                err = _KnobStale(rkey, doc)
             elif status != 0:
                 err = RuntimeError(f"PS server error for key {rkey}")
             try:
@@ -1028,7 +1083,7 @@ class _PartTask:
                  "label", "priority", "enq_ts", "push_ts", "pull_ts",
                  "ready", "enc_err", "credit_ln", "phase", "parked",
                  "enq_mono", "send_mono", "ack_mono", "lane_debt",
-                 "audit", "seg", "stale_retries")
+                 "audit", "seg", "stale_retries", "knob_gen")
 
     def __init__(self, pkey, payload, off, ln, rnd, srv, handle,
                  dtype=DT_F32, bidirectional=False, label=""):
@@ -1087,6 +1142,12 @@ class _PartTask:
         # completion) so a mid-flight audit downgrade can never make the
         # completion path mis-split a trailerless payload.
         self.audit = False
+        # Knob plane: the session's fusion-layout generation this part was
+        # staged under (_stage stamps it).  A FUSION_BYTES switch bumps
+        # the generation; stale-generation parts at/past the switch round
+        # are withdrawn with KnobReplan instead of pushed/replayed — their
+        # bucket keys no longer exist fleet-wide.
+        self.knob_gen = 0
         # The staged f32 view this partition was encoded from (None for
         # raw parts, whose payload IS the f32 bytes).  Held so a
         # CODEC_STALE rejection can re-encode the same gradient with the
@@ -1123,6 +1184,8 @@ class PSSession:
         "ring_redirects": 0,      # partitions re-routed by status MOVED
         "codec_switches": 0,      # per-key codec renegotiations applied
         "codec_stale_retries": 0,  # pushes re-encoded after CODEC_STALE
+        "knob_switches": 0,       # global knob-table applications
+        "knob_stale_retries": 0,  # pushes replayed/withdrawn, KNOB_STALE
         "opt_reseeds": 0,         # server-opt configs+params re-seeded
         #                           onto a fresh owner during a rebase
         "server_failovers": 0,    # dead servers this worker failed over
@@ -1340,6 +1403,46 @@ class PSSession:
         self._ef_fold: Dict[int, np.ndarray] = {}
         self._codec_retry_queue: List[tuple] = []
         self._codec_retry_thread: Optional[threading.Thread] = None
+        # Global knob plane (CMD_KNOB): the session half of the
+        # epoch-versioned GLOBAL knob table — the CMD_CODEC law lifted
+        # from one key's wire format to the job's performance knobs.
+        # `_knob_live` holds the actuated values (fusion_bytes /
+        # compress_threads / wire_conns; a missing knob means launch
+        # config rules), `_knob_next` a staged switch applied at stage
+        # time once any key's round reaches effective_round — the same
+        # boundary the server applies its half, so no round mixes fusion
+        # layouts, pool sizes, or lane sets (KNOB_STALE is the race
+        # backstop).  `_knob_gen` is the fusion-LAYOUT generation: a
+        # FUSION_BYTES value change bumps it, and parts staged under an
+        # older generation at/past `_knob_fusion_eff` are withdrawn with
+        # KnobReplan instead of pushed (their bucket keys no longer exist
+        # fleet-wide).  All empty/zero until a proposal — an unarmed
+        # session never emits a CMD_KNOB frame and the wire stays
+        # byte-identical.
+        self._knob_lock = threading.Lock()
+        self._knob_epoch = 0          # newest epoch seen accepted
+        self._knob_applied = 0        # epoch of the values in _knob_live
+        self._knob_next: Optional[dict] = None
+        self._knob_live: Dict[str, int] = {}
+        self._knob_gen = 0            # fusion-layout generation
+        self._knob_fusion_eff = 0     # boundary of the last fusion bump
+        self._knob_acked = 0          # newest epoch ACKed to the servers
+        # ACK deferral: after a fusion-layout switch the ACK is held until
+        # every stale-generation push has left the wire — once the server
+        # sees the ACK it stops rejecting this worker, so a still-in-
+        # flight old-layout push could otherwise merge into an orphaned
+        # bucket key (see _knob_retry_loop).
+        self._knob_ack_due: Optional[int] = None
+        self._knob_history: List[dict] = []
+        self._knob_retry_queue: List[tuple] = []
+        self._knob_retry_thread: Optional[threading.Thread] = None
+        # Declared keys whose identity depends on the fusion plan (bucket
+        # and solo-leaf units registered by the fusion dispatch layer via
+        # note_fusion_keys) — the only keys a FUSION_BYTES switch may
+        # withdraw with KnobReplan.  Caller-owned keys (plain
+        # push_pull_async) are layout-independent and always replay in
+        # place.
+        self._fusion_keys: set = set()
         # Server-resident optimizer plane (CMD_OPT): per declared key the
         # armed config {"epoch", "kwargs_str", "params_fn", "nbytes"} —
         # params_fn is the rebase re-seed source after a failover hands
@@ -1972,6 +2075,517 @@ class PSSession:
         part.phase = "push"
         part.ready = None   # payload is materialized; dispatcher sends it
 
+    # -- global knob plane (CMD_KNOB) ---------------------------------------
+    # The CMD_CODEC epoch law generalized to the job's GLOBAL performance
+    # knobs: one epoch-versioned kwargs table per fleet, three actuated
+    # knobs (fusion_bytes / compress_threads / wire_conns), applied on
+    # every participant at the first round boundary at/after the declared
+    # effective round — so no round ever mixes fusion layouts, pool
+    # sizes, or lane sets — with the KNOB_STALE push rejection as the
+    # backstop for workers that miss the memo.
+
+    ACTUATED_KNOBS = ("fusion_bytes", "compress_threads", "wire_conns")
+
+    @staticmethod
+    def _knob_kwargs_to_str(kwargs: Optional[dict]) -> str:
+        """Canonical "k=v,k=v" string for a knob proposal: sorted keys,
+        integer values — every worker proposing the same config emits
+        the same bytes (the server compares epochs, not strings, but the
+        doc round-trips through this form)."""
+        if not kwargs:
+            return ""
+        return ",".join(f"{k}={int(kwargs[k])}" for k in sorted(kwargs))
+
+    @staticmethod
+    def _knob_kwargs_from_str(kwstr: str) -> Dict[str, int]:
+        out: Dict[str, int] = {}
+        for kv in (kwstr or "").split(","):
+            if "=" in kv:
+                k, v = kv.split("=", 1)
+                try:
+                    out[k.strip()] = int(v)
+                except ValueError:
+                    pass
+        return out
+
+    def current_round(self) -> int:
+        """This session's round high-water mark — the boundary proxy the
+        knob plane compares against effective_round (all keys advance in
+        lockstep under sync rounds)."""
+        return max(self._round.values(), default=0)
+
+    def note_fusion_keys(self, declared_keys) -> None:
+        """Register declared keys whose IDENTITY derives from the fusion
+        plan (bucket/solo units).  Only these may be withdrawn with
+        KnobReplan when FUSION_BYTES changes; everything else replays in
+        place (its key is layout-independent)."""
+        self._fusion_keys.update(int(dk) for dk in declared_keys)
+
+    def propose_knobs(self, kwargs: dict, margin_rounds: int = 2,
+                      effective_round: Optional[int] = None) -> dict:
+        """Propose new values for the GLOBAL actuated knobs, atomically
+        at a future round boundary.
+
+        Sends one epoch-versioned CMD_KNOB SET to EVERY server (the
+        table is global — a ring drain must find the same epoch on every
+        owner): "applied only if newer", the CMD_RING_SET idempotency
+        law, so racing proposers converge and the losers adopt the
+        winner's doc from the response.  The switch takes effect at the
+        first round boundary at/after ``effective_round`` (default: the
+        session's current round + ``margin_rounds``) on the servers and
+        on every worker; workers that miss the memo are caught by the
+        per-worker acked check and recover via KNOB_STALE.  Returns
+        {"accepted", "epoch", "effective_round", "doc"}."""
+        import json as _json
+        unknown = set(kwargs) - set(self.ACTUATED_KNOBS)
+        if unknown:
+            raise ValueError(
+                f"not actuated knob(s) {sorted(unknown)}: the knob plane "
+                f"actuates {list(self.ACTUATED_KNOBS)} only (everything "
+                f"else is launch-only; see docs/performance.md)")
+        kwstr = self._knob_kwargs_to_str(kwargs)
+        with self._knob_lock:
+            epoch = self._knob_epoch + 1
+        eff = (int(effective_round) if effective_round is not None
+               else self.current_round() + max(1, int(margin_rounds)))
+        kb = kwstr.encode()
+        payload = struct.pack("<IQI", epoch, eff, len(kb)) + kb
+        best: Optional[dict] = None
+        for conn in self.conns:
+            try:
+                resp = conn.request(CMD_KNOB, 0, payload,
+                                    worker_id=self.worker_id,
+                                    flags=1, timeout=30.0)
+            except RuntimeError as e:
+                raise RuntimeError(
+                    "CMD_KNOB failed — server too old for the knob "
+                    "plane (rebuild libbyteps_core.so)") from e
+            doc = _json.loads(bytes(resp).decode())
+            if best is None or int(doc.get("epoch", 0)) > int(
+                    best.get("epoch", 0)):
+                best = doc
+        accepted = bool(best) and int(best.get("epoch", -1)) == epoch and (
+            (int(best.get("pending", 0)) == 1
+             and best.get("kwargs_next", "") == kwstr)
+            or (int(best.get("pending", 0)) == 0
+                and best.get("kwargs", "") == kwstr))
+        if accepted:
+            # The SET doubled as this worker's ACK server-side; mirror
+            # that locally so the boundary apply won't re-ack.
+            with self._knob_lock:
+                if epoch > self._knob_acked:
+                    self._knob_acked = epoch
+        if best is not None:
+            self._adopt_knob_doc(best)
+        get_logger().info(
+            "knob proposal %r: %s at round >= %d (epoch %d)",
+            kwstr, "accepted" if accepted else "superseded", eff, epoch)
+        return {"accepted": accepted, "epoch": epoch,
+                "effective_round": eff, "doc": best}
+
+    def poll_knobs(self) -> Optional[dict]:
+        """Refresh this session's view of the global knob table (CMD_KNOB
+        GET against server 0) — how a non-proposing worker learns of a
+        pending switch BEFORE its round crosses the boundary; KNOB_STALE
+        remains the correctness backstop either way.  Returns the doc
+        (None on transport trouble — the backstop covers it)."""
+        import json as _json
+        if not self.conns:
+            return None
+        try:
+            resp = self.conns[0].request(CMD_KNOB, 0, b"",
+                                         worker_id=self.worker_id,
+                                         timeout=10.0)
+            doc = _json.loads(bytes(resp).decode())
+        except Exception:
+            return None
+        self._adopt_knob_doc(doc)
+        return doc
+
+    def knob_table(self) -> dict:
+        """This session's live view of the knob plane (the bps_top /
+        tuner introspection surface)."""
+        with self._knob_lock:
+            return {
+                "epoch": self._knob_epoch,
+                "applied_epoch": self._knob_applied,
+                "acked_epoch": self._knob_acked,
+                "live": dict(self._knob_live),
+                "pending": (dict(self._knob_next)
+                            if self._knob_next else None),
+                "fusion_gen": self._knob_gen,
+                "history": [dict(h) for h in self._knob_history[-8:]],
+            }
+
+    def live_fusion_bytes(self) -> Optional[int]:
+        """The actuated FUSION_BYTES value, or None while launch config
+        rules.  Applies a staged switch whose boundary this call's round
+        has reached — the fusion planner reads this per dispatch, which
+        is exactly the re-plan actuation point (bucket identity is
+        composition-derived, so a new value re-declares new keys via
+        idempotent CMD_INIT)."""
+        self._maybe_apply_knobs()
+        with self._knob_lock:
+            v = self._knob_live.get("fusion_bytes")
+            return None if v is None else int(v)
+
+    def _maybe_apply_knobs(self, rnd: Optional[int] = None) -> None:
+        """Worker half of the boundary apply: install the staged knob
+        table once this session's round reaches its effective round —
+        the same boundary the server applies its half, so no round mixes
+        configurations.  Called at stage time (every _stage) and from
+        live_fusion_bytes; a session with no staged switch pays one
+        attribute read."""
+        if self._knob_next is None:
+            return
+        ack = None
+        with self._knob_lock:
+            pend = self._knob_next
+            if pend is None:
+                return
+            if rnd is None:
+                rnd = self.current_round()
+            if rnd < pend["effective_round"]:
+                return
+            self._apply_knobs_locked(pend["kwargs_str"], pend["epoch"],
+                                     pend["effective_round"])
+            self._knob_next = None
+            if pend["epoch"] > self._knob_acked:
+                ack = pend["epoch"]
+        if ack is not None:
+            self._ack_knobs(ack)
+
+    def _apply_knobs_locked(self, kwstr: str, epoch: int,
+                            eff: int) -> bool:
+        """Install one knob kwargs string as the ACTIVE table (caller
+        holds _knob_lock).  Returns True when the fusion LAYOUT changed
+        (the generation bumped) — the caller then defers the ACK until
+        stale-generation pushes have left the wire."""
+        kv = self._knob_kwargs_from_str(kwstr)
+        applied: Dict[str, int] = {}
+        fusion_changed = False
+        if "fusion_bytes" in kv:
+            val = max(0, int(kv["fusion_bytes"]))
+            if self._knob_live.get("fusion_bytes") != val:
+                self._knob_gen += 1
+                self._knob_fusion_eff = max(1, int(eff))
+                fusion_changed = True
+            self._knob_live["fusion_bytes"] = val
+            applied["fusion_bytes"] = val
+        if "compress_threads" in kv:
+            val = max(1, int(kv["compress_threads"]))
+            if self._codec_pool is not None:
+                # Resize without dropping staged work (grow = start
+                # threads now; shrink = surplus threads exit between
+                # jobs).  threads=0 sessions have no pool: 0 <-> N stays
+                # launch-only, documented in docs/performance.md.
+                self._codec_pool.resize(val)
+                self.compress_threads = val
+                self._knob_live["compress_threads"] = val
+                applied["compress_threads"] = val
+        if "wire_conns" in kv:
+            val = max(1, int(kv["wire_conns"]))
+            self._resize_lanes(val)
+            self._knob_live["wire_conns"] = val
+            applied["wire_conns"] = val
+        self._knob_applied = max(self._knob_applied, int(epoch))
+        self._knob_history.append({"epoch": int(epoch),
+                                   "effective_round": int(eff),
+                                   "kwargs": kwstr,
+                                   "ts": time.time()})
+        del self._knob_history[:-32]
+        with self._transport_lock:
+            self._tstats["knob_switches"] += 1
+        try:
+            from ..common import telemetry as _tm
+            reg = _tm.get_registry()
+            reg.gauge("bps_knob_epoch",
+                      help="newest applied global knob epoch"
+                      ).set(int(epoch))
+            for name, val in applied.items():
+                reg.gauge("bps_knob_value", labels={"knob": name},
+                          help="live value of an actuated global knob"
+                          ).set(val)
+            reg.counter("bps_knob_switches_total",
+                        help="global knob-table applications"
+                        ).inc()
+        except Exception:
+            pass
+        _flightrec.record("knob_switch", epoch=int(epoch),
+                          kwargs=kwstr, effective_round=int(eff),
+                          fusion_gen=self._knob_gen,
+                          worker=self.worker_id)
+        get_logger().info(
+            "knob switch applied (epoch %d, round >= %d): %r%s",
+            epoch, eff, kwstr,
+            " [fusion re-plan]" if fusion_changed else "")
+        return fusion_changed
+
+    def _resize_lanes(self, n: int) -> None:
+        """WIRE_CONNS actuation: dial every server's data-lane pool to
+        `n` sockets.  Growing dials new lanes immediately (the
+        _apply_ring joiner path's move); shrinking marks surplus lanes
+        RETIRING — excluded from _pick_lane, so no new dispatch lands on
+        them — and a drain worker closes each once its outstanding bytes
+        and pending requests hit zero.  The primary conn (control
+        traffic) never retires."""
+        n = max(1, int(n))
+        self._wire_conns = n
+        to_drain: List[tuple] = []
+        for srv, pool in enumerate(self._data_conns):
+            if srv in self._dead_slots:
+                continue
+            primary = (self.conns[srv] if srv < len(self.conns)
+                       else pool[0] if pool else None)
+            live = [c for c in pool if not c.retiring]
+            if len(live) < n:
+                # Reactivate retiring lanes first (a shrink->grow bounce
+                # must not leak half-drained sockets), then dial fresh.
+                for c in pool:
+                    if len(live) >= n:
+                        break
+                    if c.retiring:
+                        c.retiring = False
+                        live.append(c)
+                anchor = live[0] if live else primary
+                while len(live) < n and anchor is not None:
+                    c = self._make_conn(anchor.host, anchor.port)
+                    pool.append(c)
+                    live.append(c)
+            elif len(live) > n:
+                for c in reversed(pool):
+                    if len(live) <= n:
+                        break
+                    if c.retiring or c is primary:
+                        continue
+                    c.retiring = True
+                    live.remove(c)
+                    to_drain.append((pool, c))
+        if to_drain:
+            threading.Thread(target=self._drain_retired_lanes,
+                             args=(to_drain,), daemon=True,
+                             name="bps-ps-lane-drain").start()
+
+    def _drain_retired_lanes(self, to_drain: List[tuple]) -> None:
+        """Close retiring lanes once quiet: outstanding byte credit
+        returned AND no response outstanding — a lane is never cut with
+        a round trip in flight, so a WIRE_CONNS shrink can never lose a
+        push ack or a pull payload."""
+        deadline = time.monotonic() + 60.0
+        for pool, c in to_drain:
+            while time.monotonic() < deadline:
+                with c._pending_lock:
+                    busy = bool(c._pending)
+                if c.outstanding_bytes <= 0 and not busy:
+                    break
+                time.sleep(0.02)
+            else:
+                get_logger().warning(
+                    "retiring lane %s:%d still busy after drain window; "
+                    "closing anyway", c.host, c.port)
+            try:
+                pool.remove(c)
+            except ValueError:
+                pass
+            try:
+                c.close()
+            except Exception:
+                pass
+
+    def _ack_knobs(self, epoch: int) -> None:
+        """Report adoption of knob epoch `epoch` to every server (the
+        per-worker acked map is what the push-path backstop checks).
+        Best effort: a lost ACK just means one more KNOB_STALE round
+        trip — the backstop is idempotent."""
+        payload = struct.pack("<I", int(epoch))
+        for conn in self.conns:
+            try:
+                conn.request(CMD_KNOB, 0, payload,
+                             worker_id=self.worker_id, flags=2,
+                             timeout=10.0)
+            except Exception as e:
+                get_logger().warning(
+                    "knob ACK (epoch %d) to %s:%d failed: %s — the "
+                    "KNOB_STALE backstop will retry", epoch,
+                    conn.host, conn.port, e)
+        with self._knob_lock:
+            if int(epoch) > self._knob_acked:
+                self._knob_acked = int(epoch)
+
+    def _adopt_knob_doc(self, doc: dict, defer_ack: bool = False) -> None:
+        """Adopt the authoritative knob doc (SET/GET response or a
+        KNOB_STALE payload): record the newest epoch, apply the ACTIVE
+        table when the server already crossed the boundary, stage the
+        pending one otherwise.  With defer_ack (the stale path), a
+        fusion-layout change holds the ACK until the stale-generation
+        flight drains (see _knob_retry_loop)."""
+        ack = None
+        with self._knob_lock:
+            ep = int(doc.get("epoch", 0))
+            if ep > self._knob_epoch:
+                self._knob_epoch = ep
+            applied = int(doc.get("applied_epoch", 0))
+            if applied > self._knob_applied:
+                fusion_changed = self._apply_knobs_locked(
+                    doc.get("kwargs", ""), applied,
+                    int(doc.get("effective_round", 0)))
+                if self._knob_next is not None and \
+                        self._knob_next["epoch"] <= applied:
+                    self._knob_next = None
+                if applied > self._knob_acked:
+                    if defer_ack and fusion_changed:
+                        self._knob_ack_due = applied
+                        self._knob_ack_deadline = \
+                            time.monotonic() + 30.0
+                    else:
+                        ack = applied
+            if int(doc.get("pending", 0)) and ep > self._knob_applied:
+                self._knob_next = {
+                    "epoch": ep,
+                    "effective_round": int(doc.get("effective_round", 0)),
+                    "kwargs_str": doc.get("kwargs_next", ""),
+                }
+        if ack is not None:
+            self._ack_knobs(ack)
+
+    # -- KNOB_STALE replay (the knob renegotiation race backstop) -----------
+    def _on_knob_stale(self, pkey: int, phase: str,
+                       err: "_KnobStale") -> None:
+        """A push was rejected because this worker missed a knob switch:
+        park the partition and hand it — with the authoritative doc — to
+        the retry worker.  Runs on a receiver-callback thread, so it
+        must never block."""
+        claimed = self._park_for_remap(pkey, phase)
+        with self._transport_lock:
+            self._tstats["knob_stale_retries"] += 1
+        with self._knob_lock:
+            self._knob_retry_queue.append((pkey if claimed else None,
+                                           err.doc))
+            if self._knob_retry_thread is None:
+                self._knob_retry_thread = threading.Thread(
+                    target=self._knob_retry_loop, daemon=True,
+                    name="bps-ps-knob-retry")
+                self._knob_retry_thread.start()
+
+    def _knob_retry_loop(self) -> None:
+        """Adopt-and-recover worker for KNOB_STALE rejections.
+
+        Order matters: (1) adopt the doc and APPLY the switch (the
+        server already crossed the boundary — that is why it rejected
+        us); (2) while a fusion-layout change holds the ACK, withdraw
+        every stale-generation part that is parked or queued (the
+        dispatcher gate catches queued ones too) and WAIT for the ones
+        already on the wire to resolve — the server keeps rejecting them
+        until the ACK lands, which is exactly the guarantee that no
+        old-layout push can merge into an orphaned bucket key AFTER the
+        ACK re-admits this worker; (3) send the ACK; (4) replay the
+        rejected parts whose keys are layout-independent in place."""
+        pending_parts: List[int] = []
+        while True:
+            with self._knob_lock:
+                item = (self._knob_retry_queue.pop(0)
+                        if self._knob_retry_queue else None)
+                if (item is None and self._knob_ack_due is None
+                        and not pending_parts):
+                    self._knob_retry_thread = None
+                    return
+            if item is not None:
+                pkey, doc = item
+                try:
+                    if doc:
+                        self._adopt_knob_doc(doc, defer_ack=True)
+                except Exception:
+                    get_logger().exception("knob doc adoption failed")
+                if pkey is not None:
+                    pending_parts.append(pkey)
+            # ACK gate: a deferred ACK goes out only once no stale-
+            # generation push can still reach the server.
+            with self._knob_lock:
+                due = self._knob_ack_due
+                deadline = getattr(self, "_knob_ack_deadline", 0.0)
+            if due is not None:
+                parked_stale: List[_PartTask] = []
+                busy = False
+                with self._inflight_lock:
+                    for p in self._inflight.values():
+                        if (p.knob_gen != self._knob_gen
+                                and p.phase == "push"
+                                and p.round >= self._knob_fusion_eff):
+                            if p.parked:
+                                parked_stale.append(p)
+                            elif p.conn is not None:
+                                busy = True   # on the wire: rejection due
+                for p in parked_stale:
+                    if self._unpark(p):
+                        pending_parts = [k for k in pending_parts
+                                         if k != p.pkey]
+                        self._finish_part(p.pkey, KnobReplan(
+                            f"push for key {p.pkey} withdrawn: a "
+                            f"FUSION_BYTES switch re-partitioned the "
+                            f"tree (generation {p.knob_gen} -> "
+                            f"{self._knob_gen}) — re-plan and "
+                            f"re-dispatch"))
+                if not busy or time.monotonic() > deadline:
+                    if busy:
+                        get_logger().warning(
+                            "knob ACK (epoch %d) released with stale-"
+                            "generation pushes still in flight after "
+                            "the drain window", due)
+                    with self._knob_lock:
+                        if self._knob_ack_due == due:
+                            self._knob_ack_due = None
+                    self._ack_knobs(due)
+                else:
+                    time.sleep(0.005)
+                    continue
+            # Replay/withdraw the rejected parts now that the ACK (if
+            # any) is out — an in-place replay sent before the ACK would
+            # only be rejected again.
+            if pending_parts:
+                todo, pending_parts = pending_parts, []
+                for pkey in todo:
+                    self._knob_retry_part(pkey)
+
+    def _knob_retry_part(self, pkey: int) -> None:
+        """Replay one KNOB_STALE-rejected partition in place, or fail it
+        with KnobReplan when its key's identity died with the old
+        fusion plan."""
+        with self._inflight_lock:
+            part = self._inflight.get(pkey)
+        if part is None or not self._unpark(part):
+            return
+        if (part.knob_gen != self._knob_gen
+                and part.round >= self._knob_fusion_eff
+                and (pkey >> 16) in self._fusion_keys):
+            self._finish_part(pkey, KnobReplan(
+                f"push for key {pkey} withdrawn: a FUSION_BYTES switch "
+                f"re-partitioned the tree (generation {part.knob_gen} "
+                f"-> {self._knob_gen}) — re-plan and re-dispatch"))
+            return
+        part.stale_retries += 1
+        if part.stale_retries > 4:
+            # Bounded like the CODEC_STALE replay: a push still rejected
+            # after several adopt-and-ack cycles means the acked epoch
+            # keeps moving under us (knob thrash) or a server/worker
+            # disagreement — fail the handle loudly instead of replaying
+            # forever while the round wedges.
+            self._finish_part(pkey, RuntimeError(
+                f"push for key {pkey} was rejected KNOB_STALE "
+                f"{part.stale_retries} times in a row despite adopting "
+                f"the server's knob doc each time — check for knob "
+                f"thrash (bps doctor: knob_thrash)"))
+            return
+        part.phase = "push"
+        # Stamp the current generation: the part survives THIS switch
+        # (its key is layout-independent), so the dispatcher gate must
+        # not withdraw it.
+        part.knob_gen = self._knob_gen
+        with self._transport_lock:
+            self._tstats["replayed_pushes"] += 1
+        with self._cv:
+            self._queue.add(part.pkey, part.priority, part.credit_ln)
+            self._cv.notify_all()
+
     # -- server-resident optimizer plane (CMD_OPT) --------------------------
     @staticmethod
     def _opt_kwargs_to_str(kwargs: Optional[dict]) -> str:
@@ -2285,10 +2899,15 @@ class PSSession:
     @staticmethod
     def _pick_lane_from(pool) -> _ServerConn:
         """Least-loaded pick among the "up" lanes of one server's pool
-        (static so the scheduler policy is unit-testable on stub conns)."""
+        (static so the scheduler policy is unit-testable on stub conns).
+        Retiring lanes (a WIRE_CONNS shrink draining outstanding bytes
+        before close) never take new work unless they are ALL that's
+        left mid-transition."""
         if len(pool) == 1:
             return pool[0]
-        up = [c for c in pool if c.state() == "up"] or pool
+        live = [c for c in pool
+                if not getattr(c, "retiring", False)] or pool
+        up = [c for c in live if c.state() == "up"] or live
         return min(up, key=lambda c: (c.outstanding_bytes, c.lane_sends))
 
     def _lane_settle(self, part: "_PartTask") -> None:
@@ -2326,6 +2945,25 @@ class PSSession:
                 self._queue.report_finish(nbytes)
                 with self._cv:
                     self._cv.notify_all()
+                continue
+            if (part.knob_gen != self._knob_gen
+                    and part.phase == "push"
+                    and part.round >= self._knob_fusion_eff
+                    and (pkey >> 16) in self._fusion_keys):
+                # A FUSION_BYTES switch landed between staging and
+                # dispatch: this part's bucket key no longer exists in
+                # the fleet's layout at/past the switch round.  Sending
+                # it would merge old-layout bytes into an orphaned key
+                # (or leave a solo key one contributor short forever) —
+                # withdraw it and let the fusion layer re-plan.
+                self._queue.report_finish(nbytes)
+                with self._cv:
+                    self._cv.notify_all()
+                self._finish_part(pkey, KnobReplan(
+                    f"push for key {pkey} withdrawn before dispatch: a "
+                    f"FUSION_BYTES switch re-partitioned the tree "
+                    f"(generation {part.knob_gen} -> {self._knob_gen}) "
+                    f"— re-plan and re-dispatch"))
                 continue
             if self.record_push_order:
                 self.push_order.append(pkey)
@@ -2392,6 +3030,12 @@ class PSSession:
             # gradient under the authoritative codec and replay.
             if isinstance(error, _CodecStale):
                 self._on_codec_stale(pkey, "push", error)
+                return
+            # Global knob renegotiation race: this worker missed a knob
+            # switch — adopt the table, apply, ACK, then replay in place
+            # (pool/lane knobs) or withdraw for re-plan (fusion layout).
+            if isinstance(error, _KnobStale):
+                self._on_knob_stale(pkey, "push", error)
                 return
             # A reconnect-tagged loss parks the partition for replay (the
             # ack never arrived, so the push phase must be re-run — the
@@ -4433,7 +5077,11 @@ class PSSession:
         mv = memoryview(payload).cast("B")
         # Pending codec renegotiation whose round boundary this push
         # reaches applies HERE, before the kwargs/INIT and any encode —
-        # the worker half of the atomic switch.
+        # the worker half of the atomic switch.  The GLOBAL knob table
+        # applies at the same boundary (staged CMD_KNOB switch whose
+        # effective round this session has reached): pool resize and
+        # lane dial happen before any of this round's parts stage.
+        self._maybe_apply_knobs(self._round.get(plan[0][0], 0))
         comp = self._current_compressor(declared_key, plan)
         kw_bytes = comp.kwargs_string().encode() if comp else b""
         label = self._label(declared_key)
@@ -4454,6 +5102,13 @@ class PSSession:
                 self._stage_parts(plan, payload, mv, comp, kw_bytes,
                                   handle, parts, raw, seed, label,
                                   priority, consumed_folds)
+                # Stamp the fusion-layout generation these parts were
+                # staged under — the dispatcher gate and the KNOB_STALE
+                # replay use it to withdraw layout-dependent pushes that
+                # a later FUSION_BYTES switch orphans.
+                gen = self._knob_gen
+                for p in parts:
+                    p.knob_gen = gen
                 return handle, parts
             except _KeyMoved as e:
                 # A staging INIT hit a ring transition: roll back, adopt

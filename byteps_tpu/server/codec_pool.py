@@ -74,12 +74,51 @@ class CompressionPool:
                 help="per-partition wire-compressor decode latency"),
         }
         self.num_threads = threads
+        self._name = name
+        self._spawned = threads   # lifetime thread counter (names only)
+        self._retire = 0         # threads asked to exit at their next pick
         self._threads: List[threading.Thread] = [
             threading.Thread(target=self._loop, daemon=True,
                              name=f"{name}-{i}")
             for i in range(threads)]
         for t in self._threads:
             t.start()
+
+    def resize(self, threads: int) -> int:
+        """Grow/shrink the pool to `threads` workers WITHOUT dropping
+        staged work — the COMPRESS_THREADS knob's actuation point.
+
+        Growing starts fresh threads immediately.  Shrinking marks the
+        surplus for retirement: each retiring thread exits at its next
+        queue pick, never mid-job, and queued jobs stay in the heap for
+        the survivors — so a switch can never lose an encode (whose
+        partition's ready event the dispatcher waits on) or a decode
+        (whose handle nothing else would resolve).  Clamped to >= 1: the
+        pool always owns a thread (0 <-> N is a launch-only transition,
+        documented in docs/performance.md "Knob plane").  Returns the
+        applied size."""
+        threads = max(1, int(threads))
+        with self._cv:
+            if self._closed:
+                return self.num_threads
+            # Outstanding retirements still count against the live total:
+            # resize(1) -> resize(4) on a pool that hasn't drained its
+            # retiring threads yet must only top up the difference.
+            live = len([t for t in self._threads if t.is_alive()]) \
+                - self._retire
+            if threads > live:
+                for _ in range(threads - live):
+                    t = threading.Thread(
+                        target=self._loop, daemon=True,
+                        name=f"{self._name}-{self._spawned}")
+                    self._spawned += 1
+                    self._threads.append(t)
+                    t.start()
+            elif threads < live:
+                self._retire += live - threads
+                self._cv.notify_all()
+            self.num_threads = threads
+        return threads
 
     def submit(self, priority: int, key: int, job: Callable[[], None]) -> None:
         """Queue `job`; higher priority first, then ascending key, then
@@ -122,8 +161,19 @@ class CompressionPool:
     def _loop(self) -> None:
         while True:
             with self._cv:
-                while not self._heap and not self._closed:
+                while (not self._heap and not self._closed
+                       and not self._retire):
                     self._cv.wait()
+                if self._retire:
+                    # A resize() shrink claimed this thread: exit between
+                    # jobs.  Queued work stays in the heap for the
+                    # survivors — nothing staged is ever dropped.
+                    self._retire -= 1
+                    try:
+                        self._threads.remove(threading.current_thread())
+                    except ValueError:
+                        pass
+                    return
                 if not self._heap:          # closed and drained
                     return
                 _, _, _, job = heapq.heappop(self._heap)
@@ -143,7 +193,7 @@ class CompressionPool:
         with self._cv:
             self._closed = True
             self._cv.notify_all()
-        for t in self._threads:
+        for t in list(self._threads):
             t.join(timeout=10)
 
 

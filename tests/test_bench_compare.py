@@ -98,6 +98,22 @@ def test_serveropt_family_direction():
     assert bench_compare.check(recs)["regressions"] == []
 
 
+def test_knob_family_direction():
+    """BENCH_KNOB records (ISSUE 16): the headline is the step-time gap
+    between a cold-start job whose predictive tuner discovers the
+    global knobs live (actuated CMD_KNOB sets + cost-model codec
+    jumps) and the hand-tuned expert config — same gap family, lower
+    is better (<= 0 = the knob plane matched/beat the expert)."""
+    assert bench_compare._lower_is_better(
+        "knob_step_time_gap_pct", "pct_gap")
+    recs = [R(1, "knob_step_time_gap_pct", -2.0, unit="pct_gap"),
+            R(2, "knob_step_time_gap_pct", 12.0, unit="pct_gap")]
+    rep = bench_compare.check(recs, threshold=0.10)
+    assert len(rep["regressions"]) == 1      # cold start stopped converging
+    recs[-1] = R(2, "knob_step_time_gap_pct", -6.0, unit="pct_gap")
+    assert bench_compare.check(recs)["regressions"] == []
+
+
 def test_throughput_units_are_higher_is_better():
     """The unit-direction law (ISSUE 15 satellite): *_mbps / *_goodput /
     throughput-ish units are explicitly HIGHER-is-better — including

@@ -231,6 +231,46 @@ def test_tuner_thrash_boundary():
     assert "tuner_thrash" not in rules_fired(reset)
 
 
+def test_knob_thrash_boundary():
+    """Fires when the GLOBAL knob table's switch counter grew in > N of
+    the last M windows; evidence carries the per-window knob history
+    (epoch + live values)."""
+    def kn(v, epoch=None, fb=None):
+        m = {"bps_knob_switches_total": v}
+        if epoch is not None:
+            m["bps_knob_epoch"] = epoch
+        if fb is not None:
+            m['bps_knob_value{knob="fusion_bytes"}'] = fb
+        return {"metrics": m}
+
+    # 3 switch windows out of 6 (> default 2): fires, with history.
+    hot = [W(i, **kn(v, epoch=v, fb=(1 << 20) * (v + 1)))
+           for i, v in enumerate([0, 1, 2, 3, 3, 3, 3])]
+    fired = rules_fired(hot)
+    assert "knob_thrash" in fired
+    diag = doctor.evaluate_stream(hot)
+    # The still-open finding carries evidence refreshed to the newest
+    # window — the full 6-pair knob history.
+    f = next(x for x in diag["open"] if x["rule"] == "knob_thrash")
+    assert f["subject"] == "knob_table"
+    assert f["evidence"]["switch_windows"] == 3
+    assert f["playbook"].endswith("#rule-knob_thrash")
+    hist = f["evidence"]["knob_history"]
+    assert len(hist) == 6
+    assert hist[0]["switched"] is True and hist[-1]["switched"] is False
+    assert hist[2]["epoch"] == 3
+    assert hist[2]["knobs"]["fusion_bytes"] == (1 << 20) * 4
+    # Exactly N switch windows: quiet (boundary is strict >).
+    warm = [W(i, **kn(v)) for i, v in enumerate([0, 1, 2, 2, 2, 2, 2])]
+    assert "knob_thrash" not in rules_fired(warm)
+    # A converged knob plane (counter flat): quiet.
+    cold = [W(i, **kn(3)) for i in range(7)]
+    assert "knob_thrash" not in rules_fired(cold)
+    # Counter restart (delta clamps at 0): quiet.
+    reset = [W(0, **kn(5))] + [W(i + 1, **kn(0)) for i in range(6)]
+    assert "knob_thrash" not in rules_fired(reset)
+
+
 def test_param_version_stall_boundary():
     def srv(completed, pv, opt_mode=3):
         return {"server": {"keys": {"7": {
@@ -270,7 +310,7 @@ def test_every_rule_has_a_boundary_test():
                "lane_credit_imbalance", "recv_pool_miss_rate",
                "fusion_dilution", "server_hot_shard",
                "nonfinite_gradients", "audit_mismatch", "barrier_stall",
-               "tuner_thrash", "param_version_stall"}
+               "tuner_thrash", "knob_thrash", "param_version_stall"}
     assert set(doctor.RULE_IDS) == covered
 
 

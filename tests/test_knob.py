@@ -1,0 +1,886 @@
+"""Global knob plane (CMD_KNOB) tests — the epoch-versioned GLOBAL knob
+table that lets the tuner actuate FUSION_BYTES / COMPRESS_THREADS /
+WIRE_CONNS live.
+
+Covers the protocol law (SET newer-wins/idempotent, GET doc, ACK merge,
+KNOB_STALE only at/past the declared round boundary), the three
+actuation mechanisms (fusion re-plan via KnobReplan withdrawal, codec
+pool resize without dropping staged work, lane drain-before-retire),
+the mid-job two-worker switch acceptance (pulls identical every round,
+lagging worker recovered via one KNOB_STALE round trip), the unarmed
+wire byte-identity guarantee, the chaos/migration regressions, and the
+predictive tuner (CostModel units + actuated knob proposals).
+"""
+
+import json
+import os
+import socket
+import struct
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from byteps_tpu.server.client import (
+    PSSession, _ServerConn, CMD_HELLO, CMD_INIT, CMD_PUSH, CMD_PULL,
+    CMD_KNOB, STATUS_KNOB_STALE)
+from byteps_tpu.server.codec_pool import CompressionPool
+
+from testutil import cpu_env, StubPSServer
+
+TOOLS = os.path.join(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))), "tools")
+if TOOLS not in sys.path:
+    sys.path.insert(0, TOOLS)
+from chaos_proxy import ChaosProxy  # noqa: E402
+
+
+# ---------------------------------------------------------------------------
+# server fixture (the test_ps_server pattern)
+# ---------------------------------------------------------------------------
+def _wait_up(port, procs, deadline_s=30):
+    deadline = time.time() + deadline_s
+    while True:
+        try:
+            socket.create_connection(("127.0.0.1", port), 0.5).close()
+            return
+        except OSError:
+            for p in procs:
+                if p.poll() is not None:
+                    raise RuntimeError(f"server died rc={p.returncode}")
+            if time.time() > deadline:
+                raise TimeoutError("PS server did not come up")
+            time.sleep(0.1)
+
+
+@pytest.fixture
+def ps_server():
+    made = []
+
+    def start(num_workers=1, extra_env=None):
+        last = None
+        for _ in range(3):
+            with socket.socket() as sk:
+                sk.bind(("127.0.0.1", 0))
+                port = sk.getsockname()[1]
+            env = cpu_env({
+                "DMLC_PS_ROOT_PORT": str(port - 1),
+                "DMLC_NUM_WORKER": str(num_workers),
+                "BYTEPS_SERVER_ENGINE_THREAD": "2",
+                "JAX_PLATFORMS": "cpu",
+                **(extra_env or {}),
+            })
+            proc = subprocess.Popen(
+                [sys.executable, "-m", "byteps_tpu.server"], env=env,
+                stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+            made.append(proc)
+            try:
+                _wait_up(port, [proc])
+                return port
+            except (RuntimeError, TimeoutError) as e:
+                last = e
+        raise last
+
+    yield start
+    for p in made:
+        p.kill()
+        p.wait()
+
+
+@pytest.fixture
+def ring_servers():
+    """N ring-armed servers on consecutive ports (root+1+id convention),
+    for the knob-trailer migration regression."""
+    made = []
+
+    def start(n, num_workers=1):
+        last = None
+        for _ in range(4):
+            try:
+                return _start_group(n, num_workers)
+            except (RuntimeError, TimeoutError) as e:
+                last = e
+        raise last
+
+    def _start_group(n, num_workers):
+        with socket.socket() as sk:
+            sk.bind(("127.0.0.1", 0))
+            base = sk.getsockname()[1]
+        ports = [base + i for i in range(n)]
+        procs = []
+        for i in range(n):
+            env = cpu_env({
+                "DMLC_PS_ROOT_PORT": str(base - 1),
+                "DMLC_NUM_WORKER": str(num_workers),
+                "DMLC_NUM_SERVER": str(n),
+                "DMLC_SERVER_ID": str(i),
+                "BYTEPS_TPU_RING": "1",
+                "BYTEPS_SERVER_ENGINE_THREAD": "2",
+                "JAX_PLATFORMS": "cpu",
+            })
+            procs.append(subprocess.Popen(
+                [sys.executable, "-m", "byteps_tpu.server"], env=env,
+                stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL))
+        made.extend(procs)
+        for p in ports:
+            _wait_up(p, procs)
+        return ports, base
+
+    yield start
+    for p in made:
+        p.kill()
+        p.wait()
+
+
+def _session(port, wid=0, **kw):
+    return PSSession(["127.0.0.1"], [port], worker_id=wid,
+                     num_servers=1, **kw)
+
+
+def _knob_frame(port, flags, payload, worker_id=9):
+    """One raw CMD_KNOB round trip from a rogue worker (the session
+    under test stays unaware — exactly a racing/external proposer)."""
+    conn = _ServerConn("127.0.0.1", port)
+    try:
+        resp = conn.request(CMD_KNOB, 0, payload, worker_id=worker_id,
+                            flags=flags, timeout=20.0)
+        return json.loads(bytes(resp).decode())
+    finally:
+        conn.close()
+
+
+def _knob_set(port, epoch, eff, kwstr, worker_id=9):
+    kb = kwstr.encode()
+    return _knob_frame(port, 1, struct.pack("<IQI", epoch, eff, len(kb))
+                       + kb, worker_id)
+
+
+def _knob_get(port, worker_id=9):
+    return _knob_frame(port, 0, b"", worker_id)
+
+
+def _knob_ack(port, epoch, worker_id=9):
+    return _knob_frame(port, 2, struct.pack("<I", epoch), worker_id)
+
+
+# ---------------------------------------------------------------------------
+# fast: the CMD_KNOB protocol law — SET newer-wins, GET doc, ACK merge
+# ---------------------------------------------------------------------------
+def test_cmd_knob_set_get_ack_newer_wins(ps_server):
+    port = ps_server(num_workers=1)
+    doc = _knob_get(port)
+    assert doc["epoch"] == 0 and doc["applied_epoch"] == 0
+    assert doc["pending"] == 0 and doc["kwargs"] == ""
+
+    # SET epoch 1: staged (no round has reached the boundary yet), and
+    # the SET doubles as the proposer's ACK.
+    doc = _knob_set(port, 1, 5, "wire_conns=2", worker_id=3)
+    assert doc["epoch"] == 1 and doc["pending"] == 1
+    assert doc["effective_round"] == 5
+    assert doc["kwargs_next"] == "wire_conns=2"
+    assert doc["kwargs"] == ""          # nothing ACTIVE yet
+    assert doc["acked"].get("3") == 1
+
+    # A racing SET at the SAME epoch is ignored — applied only if newer
+    # (the CMD_RING_SET idempotency law); the loser reads the winner's
+    # doc from the response.
+    doc = _knob_set(port, 1, 9, "wire_conns=8", worker_id=4)
+    assert doc["kwargs_next"] == "wire_conns=2"
+    assert doc["effective_round"] == 5
+
+    # A NEWER epoch supersedes the staged switch.
+    doc = _knob_set(port, 2, 6, "fusion_bytes=131072,wire_conns=4",
+                    worker_id=3)
+    assert doc["epoch"] == 2 and doc["pending"] == 1
+    assert doc["kwargs_next"] == "fusion_bytes=131072,wire_conns=4"
+
+    # ACK from another worker merges into the adoption map.
+    doc = _knob_ack(port, 2, worker_id=7)
+    assert doc["acked"].get("7") == 2
+    # A stale ACK never regresses the map.
+    doc = _knob_ack(port, 1, worker_id=7)
+    assert doc["acked"].get("7") == 2
+
+
+def test_propose_knobs_rejects_unactuated_knobs(ps_server):
+    port = ps_server(num_workers=1)
+    s = _session(port)
+    try:
+        with pytest.raises(ValueError, match="launch-only"):
+            s.propose_knobs({"partition_bytes": 1 << 20})
+    finally:
+        s.close()
+
+
+# ---------------------------------------------------------------------------
+# fast: KNOB_STALE backstop — rejected only at/past the boundary,
+# adopt-and-replay recovers transparently
+# ---------------------------------------------------------------------------
+def test_knob_stale_backstop_fires_only_past_boundary(ps_server):
+    port = ps_server(num_workers=1)
+    s = _session(port, wid=0, wire_conns=4)
+    try:
+        x = np.arange(1 << 10, dtype=np.float32)
+        # Rounds 1-2: establish the key, no knob set anywhere.
+        for r in (1.0, 2.0):
+            np.testing.assert_array_equal(s.push_pull(5, x * r), x * r)
+        # A rogue proposer (worker 9 — never pushes) stages a lane
+        # shrink at round boundary 4; this session is UNAWARE.
+        doc = _knob_set(port, 1, 4, "wire_conns=2")
+        assert doc["pending"] == 1
+        # Round 3 + 4 complete BELOW the boundary: no rejection.
+        for r in (3.0, 4.0):
+            np.testing.assert_array_equal(s.push_pull(5, x * r), x * r)
+        assert s.transport_stats()["knob_stale_retries"] == 0
+        # Round 5 crosses it (completed_round 4 >= 4): the push is
+        # rejected KNOB_STALE with the doc, the session adopts, ACKs,
+        # and replays — the caller sees nothing but the right answer.
+        for r in (5.0, 6.0):
+            np.testing.assert_array_equal(s.push_pull(5, x * r), x * r)
+        st = s.transport_stats()
+        assert st["knob_stale_retries"] >= 1
+        assert st["knob_switches"] >= 1
+        kt = s.knob_table()
+        assert kt["epoch"] == 1 and kt["applied_epoch"] == 1
+        assert kt["live"] == {"wire_conns": 2}
+        # The shrink 4 -> 2 drains: retired lanes close once quiet.
+        deadline = time.time() + 20
+        while time.time() < deadline and len(s._data_conns[0]) > 2:
+            time.sleep(0.05)
+        assert len(s._data_conns[0]) == 2
+        assert not any(c.retiring for c in s._data_conns[0])
+        # And the server saw this worker's ACK.
+        assert _knob_get(port)["acked"].get("0") == 1
+    finally:
+        s.close()
+
+
+# ---------------------------------------------------------------------------
+# fast: mid-job switch, two workers — the tentpole acceptance
+# ---------------------------------------------------------------------------
+def test_mid_job_switch_two_workers_atomic_at_boundary(ps_server):
+    """Worker 0 proposes a WIRE_CONNS (+COMPRESS_THREADS no-op) switch
+    mid-job; worker 1 learns only via the KNOB_STALE backstop.  Every
+    round's pulls are identical across workers and equal to the exact
+    two-worker sum — and equal to a fresh run LAUNCHED with the final
+    config (trajectory bit-identity)."""
+    port = ps_server(num_workers=2)
+    keys = [11, 12]
+    x = np.arange(1 << 11, dtype=np.float32)
+    rounds = 10
+    switch_after = 4
+
+    def run(sessions, propose_at=None):
+        """rounds x keys pull trajectory per worker, lockstep rounds."""
+        barrier = threading.Barrier(len(sessions))
+        out = [[] for _ in sessions]
+        errs = []
+
+        def worker(i, s):
+            try:
+                for r in range(1, rounds + 1):
+                    barrier.wait(timeout=60)
+                    if i == 0 and propose_at is not None \
+                            and r == propose_at:
+                        res = s.propose_knobs(
+                            {"wire_conns": 2, "compress_threads": 3},
+                            margin_rounds=2)
+                        assert res["accepted"], res
+                    hs = [s.push_pull_async(k, x * (r * (i + 1)))
+                          for k in keys]
+                    out[i].append([np.asarray(h.wait(60)) for h in hs])
+            except Exception as e:   # noqa: BLE001 - surfaced below
+                errs.append(e)
+                try:
+                    barrier.abort()
+                except Exception:
+                    pass
+
+        ts = [threading.Thread(target=worker, args=(i, s))
+              for i, s in enumerate(sessions)]
+        [t.start() for t in ts]
+        [t.join(timeout=120) for t in ts]
+        assert not errs, errs
+        return out
+
+    s0 = _session(port, wid=0, wire_conns=4)
+    s1 = _session(port, wid=1, wire_conns=4)
+    try:
+        traj = run([s0, s1], propose_at=switch_after)
+        for r in range(rounds):
+            want = [x * ((r + 1) * 1) + x * ((r + 1) * 2)] * len(keys)
+            for i in range(2):
+                for k in range(len(keys)):
+                    np.testing.assert_array_equal(traj[i][r][k], want[k])
+        # Pulls identical across workers every round (incl. the switch).
+        for r in range(rounds):
+            for k in range(len(keys)):
+                assert traj[0][r][k].tobytes() == traj[1][r][k].tobytes()
+        # Both sessions converged on the same applied table.
+        for s in (s0, s1):
+            kt = s.knob_table()
+            assert kt["applied_epoch"] == 1, kt
+            assert kt["live"]["wire_conns"] == 2
+            # compress_threads recorded live even though this session
+            # has no codec pool (0 <-> N is launch-only; no-op apply).
+            assert kt["live"]["compress_threads"] == 3
+        # The lagging worker recovered through the backstop.
+        assert s1.transport_stats()["knob_stale_retries"] >= 1
+        # Lanes drained to 2 on both workers.
+        for s in (s0, s1):
+            deadline = time.time() + 20
+            while time.time() < deadline and len(s._data_conns[0]) > 2:
+                time.sleep(0.05)
+            assert len(s._data_conns[0]) == 2
+    finally:
+        s0.close()
+        s1.close()
+
+    # Trajectory bit-identity vs a run LAUNCHED with the final config.
+    port2 = ps_server(num_workers=2)
+    r0 = _session(port2, wid=0, wire_conns=2)
+    r1 = _session(port2, wid=1, wire_conns=2)
+    try:
+        ref = run([r0, r1])
+        for r in range(rounds):
+            for k in range(len(keys)):
+                assert traj[0][r][k].tobytes() == ref[0][r][k].tobytes()
+    finally:
+        r0.close()
+        r1.close()
+
+
+# ---------------------------------------------------------------------------
+# fast: FUSION_BYTES re-plan end to end (push_pull_tree + KnobReplan)
+# ---------------------------------------------------------------------------
+def test_fusion_replan_actuates_mid_job(ps_server):
+    """A FUSION_BYTES switch staged by an external proposer re-plans the
+    fusion tree mid-job: the session learns via KNOB_STALE at the
+    boundary, withdraws stale-layout bucket pushes (KnobReplan), the
+    fusion layer re-plans under the live threshold and re-dispatches —
+    every round's values stay exact, before, across, and after."""
+    port = ps_server(num_workers=1)
+    code = """
+import json, struct
+import numpy as np, jax.numpy as jnp
+import byteps_tpu as bps
+from byteps_tpu.common import api
+from byteps_tpu.server.client import _ServerConn, CMD_KNOB
+
+bps.init()
+rng = np.random.RandomState(3)
+tree = {f"ln{i:02d}.g": jnp.asarray(
+            rng.randn(1 << 9).astype(np.float32)) for i in range(12)}
+tree["fc.w"] = jnp.asarray(rng.randn(1 << 14).astype(np.float32))
+names = sorted(tree)
+
+def round_exact(r):
+    out = bps.push_pull_tree(tree, name="kt", average=False,
+                             leaf_names=names)
+    for n in names:
+        np.testing.assert_array_equal(np.asarray(out[n]),
+                                      np.asarray(tree[n]))
+
+for r in range(3):
+    round_exact(r)
+sess = api._state.ps_session
+cur = sess.current_round()
+# External proposer stages FUSION_BYTES 8 KiB -> 32 KiB two rounds out.
+kw = b"fusion_bytes=32768"
+conn = _ServerConn("127.0.0.1", %(port)d)
+doc = json.loads(bytes(conn.request(
+    CMD_KNOB, 0, struct.pack("<IQI", 1, cur + 2, len(kw)) + kw,
+    worker_id=9, flags=1, timeout=20)).decode())
+conn.close()
+assert doc["epoch"] == 1 and doc["pending"] == 1, doc
+for r in range(6):
+    round_exact(r)
+assert sess.live_fusion_bytes() == 32768, sess.knob_table()
+st = sess.transport_stats()
+assert st["knob_stale_retries"] >= 1, st
+assert st["knob_switches"] >= 1, st
+kt = sess.knob_table()
+assert kt["applied_epoch"] == 1 and kt["fusion_gen"] >= 1, kt
+bps.shutdown()
+print("KNOB_REPLAN_OK")
+""" % {"port": port}
+    env = cpu_env({
+        "BYTEPS_TPU_PS_MODE": "1",
+        "DMLC_NUM_WORKER": "1",
+        "DMLC_NUM_SERVER": "1",
+        "DMLC_PS_ROOT_PORT": str(port - 1),
+        "BYTEPS_TPU_FUSION_BYTES": str(8 << 10),
+    })
+    proc = subprocess.run([sys.executable, "-c", code], env=env,
+                          capture_output=True, text=True, timeout=240)
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    assert "KNOB_REPLAN_OK" in proc.stdout
+
+
+# ---------------------------------------------------------------------------
+# fast: unarmed wire byte-identity — no knob set, no new frames
+# ---------------------------------------------------------------------------
+def test_unarmed_run_wire_byte_identical():
+    """A job that never proposes a knob emits a byte-identical frame
+    stream to the pre-knob-plane protocol: no CMD_KNOB frames, and two
+    identical runs produce identical (header, payload) sequences."""
+    def run_once():
+        store = {}
+
+        def handler(cmd, dt, fl, req_id, wid, key, payload):
+            if cmd == CMD_HELLO:
+                return 0, b"\x00\x00"
+            if cmd == CMD_INIT:
+                return 0, struct.pack("<Q", 0)
+            if cmd == CMD_PUSH:
+                store[key] = bytes(payload)
+                return 0, b""
+            if cmd == CMD_PULL:
+                return 0, store[key]
+            return 1, b""
+
+        srv = StubPSServer(handler, record_payload=True)
+        try:
+            s = PSSession(["127.0.0.1"], [srv.port], worker_id=0,
+                          num_servers=1, wire_conns=1)
+            x = np.arange(256, dtype=np.float32)
+            for r in (1.0, 2.0, 3.0):
+                np.testing.assert_array_equal(s.push_pull(3, x * r),
+                                              x * r)
+            s.close()
+            with srv.lock:
+                return list(zip([f for f, _c, _fl in srv.frames],
+                                srv.payloads)), \
+                    {c for _f, c, _fl in srv.frames}
+        finally:
+            srv.close()
+
+    frames_a, cmds_a = run_once()
+    frames_b, cmds_b = run_once()
+    assert CMD_KNOB not in cmds_a
+    assert cmds_a <= {CMD_HELLO, CMD_INIT, CMD_PUSH, CMD_PULL}, cmds_a
+    assert frames_a == frames_b
+
+
+# ---------------------------------------------------------------------------
+# fast: codec pool resize — staged work survives
+# ---------------------------------------------------------------------------
+def test_codec_pool_resize_never_drops_staged_work():
+    from byteps_tpu.common import telemetry as tm
+    tm.reset_registry()
+    pool = CompressionPool(2)
+    done = []
+    lock = threading.Lock()
+    total = 120
+
+    def job(i):
+        def run():
+            time.sleep(0.002)
+            with lock:
+                done.append(i)
+        return run
+
+    try:
+        for i in range(total // 3):
+            pool.submit(1, i, job(i))
+        assert pool.resize(6) == 6           # grow mid-backlog
+        for i in range(total // 3, 2 * total // 3):
+            pool.submit(1, i, job(i))
+        assert pool.resize(1) == 1           # shrink mid-backlog
+        for i in range(2 * total // 3, total):
+            pool.submit(1, i, job(i))
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            with lock:
+                if len(done) == total:
+                    break
+            time.sleep(0.02)
+        with lock:
+            assert sorted(done) == list(range(total))   # nothing dropped
+        assert pool.stats()["threads"] == 1
+        # Retiring threads really exit (between jobs, not mid-job).
+        deadline = time.time() + 10
+        while time.time() < deadline and len(
+                [t for t in pool._threads if t.is_alive()]) > 1:
+            time.sleep(0.02)
+        assert len([t for t in pool._threads if t.is_alive()]) == 1
+        # 0 threads is a launch-only transition: resize clamps to 1.
+        assert pool.resize(0) == 1
+    finally:
+        pool.close()
+
+
+# ---------------------------------------------------------------------------
+# chaos: a mid-payload reset at the switch boundary is survivable and
+# bit-identical (WIRE_CONNS resize under fault)
+# ---------------------------------------------------------------------------
+def test_chaos_reset_at_wire_conns_switch_bit_identical(ps_server):
+    """tools/chaos_proxy.py cuts a connection mid-payload right around
+    the WIRE_CONNS switch boundary; with reconnect armed the session
+    replays and the whole pull trajectory is bit-identical to an
+    unfaulted run (single worker: every pull equals its own push)."""
+    port = ps_server(num_workers=1)
+    with ChaosProxy("127.0.0.1", port) as proxy:
+        s = PSSession(["127.0.0.1"], [proxy.port], worker_id=0,
+                      num_servers=1, wire_conns=1,
+                      reconnect_attempts=8)
+        try:
+            x = np.arange(1 << 12, dtype=np.float32)
+            for r in (1.0, 2.0):
+                np.testing.assert_array_equal(s.push_pull(4, x * r),
+                                              x * r)
+            res = s.propose_knobs({"wire_conns": 3}, margin_rounds=1)
+            assert res["accepted"]
+            # One-shot fault: the next frame's payload dies mid-flight —
+            # i.e. during the boundary-crossing round.
+            proxy.reset_after(1024)
+            for r in (3.0, 4.0, 5.0, 6.0):
+                np.testing.assert_array_equal(s.push_pull(4, x * r),
+                                              x * r)
+            assert proxy.stats()["faults_fired"] >= 1
+            assert s.transport_stats()["reconnects"] >= 1
+            kt = s.knob_table()
+            assert kt["applied_epoch"] == 1
+            assert kt["live"]["wire_conns"] == 3
+            deadline = time.time() + 20
+            while time.time() < deadline and len(s._data_conns[0]) < 3:
+                time.sleep(0.05)
+            assert len(
+                [c for c in s._data_conns[0] if not c.retiring]) == 3
+        finally:
+            s.close()
+
+
+@pytest.mark.slow
+def test_chaos_reset_at_fusion_switch_bit_identical(ps_server):
+    """Same law for FUSION_BYTES: the re-plan (KnobReplan withdrawal +
+    re-dispatch) composes with a mid-payload connection reset at the
+    switch boundary — the tree trajectory stays exact throughout."""
+    port = ps_server(num_workers=1)
+    with ChaosProxy("127.0.0.1", port) as proxy:
+        code = """
+import json, struct
+import numpy as np, jax.numpy as jnp
+import byteps_tpu as bps
+from byteps_tpu.common import api
+from byteps_tpu.server.client import _ServerConn, CMD_KNOB
+
+bps.init()
+rng = np.random.RandomState(7)
+tree = {f"ln{i:02d}.g": jnp.asarray(
+            rng.randn(1 << 9).astype(np.float32)) for i in range(10)}
+names = sorted(tree)
+
+def round_exact():
+    out = bps.push_pull_tree(tree, name="ck", average=False,
+                             leaf_names=names)
+    for n in names:
+        np.testing.assert_array_equal(np.asarray(out[n]),
+                                      np.asarray(tree[n]))
+
+for _ in range(3):
+    round_exact()
+sess = api._state.ps_session
+cur = sess.current_round()
+kw = b"fusion_bytes=16384"
+conn = _ServerConn("127.0.0.1", %(proxy_port)d)
+doc = json.loads(bytes(conn.request(
+    CMD_KNOB, 0, struct.pack("<IQI", 1, cur + 1, len(kw)) + kw,
+    worker_id=9, flags=1, timeout=20)).decode())
+conn.close()
+assert doc["epoch"] == 1, doc
+print("ARM_FAULT", flush=True)
+for _ in range(6):
+    round_exact()
+assert sess.live_fusion_bytes() == 16384, sess.knob_table()
+assert sess.transport_stats()["reconnects"] >= 1
+bps.shutdown()
+print("CHAOS_FUSION_OK")
+""" % {"proxy_port": proxy.port}
+        env = cpu_env({
+            "BYTEPS_TPU_PS_MODE": "1",
+            "DMLC_NUM_WORKER": "1",
+            "DMLC_NUM_SERVER": "1",
+            "DMLC_PS_ROOT_PORT": str(proxy.port - 1),
+            "BYTEPS_TPU_FUSION_BYTES": str(4 << 10),
+            "BYTEPS_TPU_RECONNECT_ATTEMPTS": "8",
+        })
+        proc = subprocess.Popen([sys.executable, "-c", code], env=env,
+                                stdout=subprocess.PIPE,
+                                stderr=subprocess.PIPE, text=True)
+        # Fire the one-shot mid-payload reset once the switch is staged.
+        fired = False
+        deadline = time.time() + 180
+        out_lines = []
+        while time.time() < deadline:
+            line = proc.stdout.readline()
+            if not line:
+                break
+            out_lines.append(line)
+            if not fired and "ARM_FAULT" in line:
+                proxy.reset_after(512)
+                fired = True
+        proc.wait(timeout=180)
+        err = proc.stderr.read()
+        assert proc.returncode == 0, err[-3000:]
+        assert any("CHAOS_FUSION_OK" in ln for ln in out_lines)
+        assert fired and proxy.stats()["faults_fired"] >= 1
+
+
+# ---------------------------------------------------------------------------
+# ring: a drain after a knob switch carries the epoch (migrate trailer)
+# ---------------------------------------------------------------------------
+def test_ring_drain_carries_knob_epoch(ring_servers):
+    """The knob table is server-global but must survive re-ownership: a
+    drain streams it as a CMD_MIGRATE trailer, so a server that never
+    saw the SET still answers the authoritative epoch afterwards."""
+    ports, _ = ring_servers(2, num_workers=1)
+    s = PSSession(["127.0.0.1"] * 2, list(ports), worker_id=0,
+                  num_servers=2, ring=True, wire_conns=1,
+                  partition_bytes=1 << 16)
+    try:
+        keys = list(range(1, 9))
+        x = np.arange(1 << 10, dtype=np.float32)
+
+        def round_all(mult):
+            hs = [s.push_pull_async(k, x * mult) for k in keys]
+            for h in hs:
+                np.testing.assert_array_equal(h.wait(30), x * mult)
+
+        round_all(1.0)
+        # Epoch 1 lands everywhere through the session (fleet-wide SET),
+        # applies at its boundary...
+        res = s.propose_knobs({"fusion_bytes": 1 << 20},
+                              margin_rounds=1)
+        assert res["accepted"]
+        round_all(2.0)
+        round_all(3.0)
+        assert s.knob_table()["applied_epoch"] == 1
+        # ...then epoch 2 is SET on server 0 ONLY (rogue proposer with a
+        # far boundary — stays pending): the survivor can only learn it
+        # from the drain trailer.
+        doc = _knob_set(ports[0], 2, 10_000, "fusion_bytes=2097152")
+        assert doc["epoch"] == 2
+        assert _knob_get(ports[1])["epoch"] == 1
+        drained = s.drain_server(0)
+        assert drained["keys_owned"] == 0
+        surv = _knob_get(ports[1])
+        assert surv["epoch"] == 2, surv
+        assert surv["pending"] == 1
+        assert surv["kwargs_next"] == "fusion_bytes=2097152"
+        assert surv["kwargs"] == "fusion_bytes=1048576"
+        # And the post-drain rounds stay exact on the survivor.
+        round_all(4.0)
+    finally:
+        s.close()
+
+
+# ---------------------------------------------------------------------------
+# fast: predictive tuner — CostModel units + actuated knob proposals
+# ---------------------------------------------------------------------------
+def _model_rows():
+    # Synthetic but shaped like the wire_bench sweep: onebit crushes
+    # 32x at healthy codec throughput; elias/qblock slower but tighter.
+    rows = []
+    for size in (64 << 10, 1 << 20):
+        rows += [
+            {"codec": "raw", "size_bytes": size,
+             "encode_MBps": None, "decode_MBps": None, "ratio": 1.0},
+            {"codec": "onebit+ef", "size_bytes": size,
+             "encode_MBps": 2000.0, "decode_MBps": 2000.0,
+             "ratio": 32.0},
+            {"codec": "elias+ef", "size_bytes": size,
+             "encode_MBps": 300.0, "decode_MBps": 300.0, "ratio": 20.0},
+            {"codec": "qblock4+ef", "size_bytes": size,
+             "encode_MBps": 800.0, "decode_MBps": 800.0, "ratio": 8.0},
+        ]
+    return rows
+
+
+def test_cost_model_predicts_and_loads(tmp_path):
+    from byteps_tpu.common.tuner import CostModel, DIAL
+
+    cm = CostModel(_model_rows())
+    # Slow wire (10 MB/s), 1 MB payload: raw pays 0.1 s of pure wire;
+    # onebit pays ~1 ms codec + ~3 ms wire — compression wins.
+    raw_s = cm.predict_push_s("raw", 1 << 20, 10.0)
+    ob_s = cm.predict_push_s("onebit", 1 << 20, 10.0)
+    assert raw_s == pytest.approx((1 << 20) / 10e6)
+    assert ob_s < raw_s / 5
+    assert DIAL[cm.best_dial(1 << 20, 10.0, len(DIAL) - 1)] == "onebit"
+    # Blazing wire (100 GB/s): codec time dominates — raw wins.
+    assert DIAL[cm.best_dial(1 << 20, 100_000.0, len(DIAL) - 1)] == "raw"
+    # max_dial caps the search space.
+    assert cm.best_dial(1 << 20, 10.0, 0) == 0
+    # Degenerate inputs answer None, never raise.
+    assert cm.predict_push_s("onebit", 0, 10.0) is None
+    assert cm.best_dial(1 << 20, 0.0, 3) is None
+
+    # load(): the wire_bench doc shape round-trips; missing/garbage
+    # paths answer None (the tuner falls back to pure hysteresis).
+    p = tmp_path / "model.json"
+    p.write_text(json.dumps({"codec_sweep": _model_rows()}))
+    cm2 = CostModel.load(str(p))
+    assert cm2 is not None and len(cm2) == len(_model_rows())
+    assert CostModel.load(str(tmp_path / "absent.json")) is None
+    bad = tmp_path / "bad.json"
+    bad.write_text("{not json")
+    assert CostModel.load(str(bad)) is None
+
+
+class _KnobStubSession:
+    """A _StubSession (test_tuner.py) extended with the knob plane —
+    what a real PSSession exposes once CMD_KNOB exists."""
+
+    def __init__(self, live=None):
+        self._compressors = {}
+        self.proposals = []
+        self.knob_sets = []
+        self.live = dict(live or {})
+
+    def poll_codec(self):
+        pass
+
+    def poll_knobs(self):
+        pass
+
+    def propose_codec(self, dk, kwargs, margin_rounds=2,
+                      effective_round=None):
+        from byteps_tpu.server import wire
+        self.proposals.append((dk, None if kwargs is None
+                               else dict(kwargs)))
+        if kwargs is None:
+            self._compressors.pop(dk, None)
+        else:
+            self._compressors[dk] = wire.WireCompressor(
+                {str(k): str(v) for k, v in kwargs.items()})
+        return {"accepted": True, "epoch": len(self.proposals),
+                "effective_round": 100, "doc": {}}
+
+    def propose_knobs(self, kwargs, margin_rounds=2,
+                      effective_round=None):
+        self.knob_sets.append(dict(kwargs))
+        self.live.update(kwargs)     # boundary apply, compressed in time
+        return {"accepted": True, "epoch": len(self.knob_sets),
+                "effective_round": 7, "doc": {}}
+
+    def knob_table(self):
+        return {"epoch": len(self.knob_sets),
+                "applied_epoch": len(self.knob_sets),
+                "live": dict(self.live), "pending": None}
+
+
+def _tiny_window(idx, n_keys=4):
+    return {"window": idx, "keys": {
+        f"k{i}": {"pushes": 5, "push_bytes": 5 * 1024,
+                  "components": {"queue": 0.01}, "class": "tiny"}
+        for i in range(n_keys)}}
+
+
+def test_tuner_actuates_fusion_bytes_with_cooldown(monkeypatch):
+    """Tiny-dominant windows graduate the FUSION_BYTES proposal from
+    advisory to an actuated CMD_KNOB set — once per cooldown, doubling
+    from the LIVE value (not the stale launch config)."""
+    from byteps_tpu.common import telemetry as tm
+    from byteps_tpu.common.tuner import Tuner
+
+    from byteps_tpu.common.config import get_config
+
+    tm.reset_registry()
+    monkeypatch.delenv("BYTEPS_TPU_KNOB_ACTUATE", raising=False)
+    get_config(refresh=True)          # the singleton may hold a stale env
+    sess = _KnobStubSession()
+    t = Tuner(sess, propose=True, hold=1, cost_model=None)
+    t.observe(_tiny_window(0))
+    assert len(sess.knob_sets) == 1
+    assert sess.knob_sets[0] == {
+        "fusion_bytes": 2 * get_config().fusion_bytes}
+    props = [p for p in t.state()["knob_proposals"]
+             if p["knob"] == "BYTEPS_TPU_FUSION_BYTES"]
+    assert props and props[0]["applied"] is True
+    assert props[0]["epoch"] == 1
+    # Within the cooldown: no re-actuation however tiny the keys stay.
+    for i in range(1, Tuner.KNOB_COOLDOWN):
+        t.observe(_tiny_window(i))
+    assert len(sess.knob_sets) == 1
+    # Past the cooldown it doubles again — from the LIVE value.
+    t.observe(_tiny_window(Tuner.KNOB_COOLDOWN))
+    assert len(sess.knob_sets) == 2
+    assert sess.knob_sets[1] == {
+        "fusion_bytes": 4 * get_config().fusion_bytes}
+
+
+def test_tuner_actuation_opt_out_and_stub_fallback(monkeypatch):
+    """BYTEPS_TPU_KNOB_ACTUATE=0 reverts to the advisory behavior, and
+    a session without the knob plane (old stub) falls back the same
+    way — old integrations keep working unchanged."""
+    from byteps_tpu.common import telemetry as tm
+    from byteps_tpu.common.tuner import Tuner
+
+    from byteps_tpu.common.config import get_config
+
+    tm.reset_registry()
+    monkeypatch.setenv("BYTEPS_TPU_KNOB_ACTUATE", "0")
+    get_config(refresh=True)
+    try:
+        sess = _KnobStubSession()
+        t = Tuner(sess, propose=True, hold=1, cost_model=None)
+        t.observe(_tiny_window(0))
+        t.observe(_tiny_window(1))
+        assert sess.knob_sets == []
+        props = t.state()["knob_proposals"]
+        assert [p["knob"] for p in props] == ["BYTEPS_TPU_FUSION_BYTES"]
+        assert props[0]["applied"] is False
+    finally:
+        monkeypatch.undo()
+        get_config(refresh=True)      # don't leak the opt-out to others
+
+
+def test_tuner_predictive_jump_from_cost_model():
+    """With a cost model present, a key's FIRST window prices every dial
+    and jumps straight to the predicted best — one-shot per key, judged
+    by the ordinary revert loop afterwards."""
+    from byteps_tpu.common import telemetry as tm
+    from byteps_tpu.common.tuner import CostModel, Tuner, DIAL_KWARGS
+
+    tm.reset_registry()
+    sess = _KnobStubSession()
+    t = Tuner(sess, propose=True, hold=3,
+              cost_model=CostModel(_model_rows()))
+    win = {"window": 0, "keys": {"key_42": {
+        "pushes": 10, "push_bytes": 10 << 20, "wire_mbps": 10.0,
+        "components": {"push_wire": 0.1}, "class": "wire_bound"}}}
+    t.observe(win)
+    # No hold wait: the model predicted onebit immediately.
+    assert sess.proposals == [(42, DIAL_KWARGS["onebit"])]
+    assert t.predict_jumps_total == 1
+    # One-shot: later windows never re-jump (hysteresis owns it now).
+    win["window"] = 1
+    t.observe(win)
+    assert len(sess.proposals) == 1
+    st = t.state()
+    assert st["predict_jumps_total"] == 1
+    assert st["cost_model"]["rows"] == len(_model_rows())
+
+
+def test_tuner_without_cost_model_stays_hysteretic():
+    """No model on disk: behavior is exactly the pre-predictive loop
+    (the CostModel.load(None) path the Tuner defaults through)."""
+    from byteps_tpu.common import telemetry as tm
+    from byteps_tpu.common.tuner import Tuner, DIAL_KWARGS
+
+    tm.reset_registry()
+    sess = _KnobStubSession()
+    t = Tuner(sess, propose=True, hold=2, cost_model=None)
+    win = {"window": 0, "keys": {"key_42": {
+        "pushes": 10, "push_bytes": 10 << 20, "wire_mbps": 10.0,
+        "components": {"push_wire": 0.1}, "class": "wire_bound"}}}
+    t.observe(win)
+    assert sess.proposals == []            # hold=2: hysteresis gates
+    win["window"] = 1
+    t.observe(win)
+    assert sess.proposals == [(42, DIAL_KWARGS["onebit"])]
+    assert t.predict_jumps_total == 0

@@ -702,20 +702,32 @@ def _stub_roundtrip(with_tuner):
         srv.close()
 
 
-def test_tuner_unarmed_and_idle_wire_byte_identity():
+def test_tuner_unarmed_and_idle_wire_byte_identity(monkeypatch):
     """BYTEPS_TPU_TUNER unset => the wire is byte-identical to PR 12
     (nothing here even constructs a tuner); and an ARMED tuner whose
-    keys never warrant a switch (tiny) sends no CMD_CODEC frame either
-    — same frames, same bytes, against a recording stub."""
-    signals.arm(window_s=60.0, start_thread=False)
+    keys never warrant a CODEC switch (tiny) sends no CMD_CODEC frame
+    either — same frames, same bytes, against a recording stub.  Tiny
+    keys DO warrant a knob-plane actuation since ISSUE 16 (the
+    FUSION_BYTES proposal graduated from advisory to a CMD_KNOB set —
+    tests/test_knob.py owns that wire), so the armed arm runs under the
+    documented BYTEPS_TPU_KNOB_ACTUATE=0 opt-out, which restores the
+    pre-knob-plane byte stream exactly."""
+    from byteps_tpu.common.config import get_config
+    monkeypatch.setenv("BYTEPS_TPU_KNOB_ACTUATE", "0")
+    get_config(refresh=True)
     try:
-        off = _stub_roundtrip(with_tuner=False)
+        signals.arm(window_s=60.0, start_thread=False)
+        try:
+            off = _stub_roundtrip(with_tuner=False)
+        finally:
+            signals.disarm()
+        signals.arm(window_s=60.0, start_thread=False)
+        try:
+            on = _stub_roundtrip(with_tuner=True)
+        finally:
+            signals.disarm()
     finally:
-        signals.disarm()
-    signals.arm(window_s=60.0, start_thread=False)
-    try:
-        on = _stub_roundtrip(with_tuner=True)
-    finally:
-        signals.disarm()
+        monkeypatch.undo()
+        get_config(refresh=True)
     assert [h for h, _, _ in off] == [h for h, _, _ in on]
     assert all(c != CMD_CODEC for _, c, _ in on)

@@ -65,16 +65,25 @@ def test_wire_bench_quick_smoke():
 
 
 @pytest.mark.slow
-def test_wire_bench_codec_sweep_smoke():
+def test_wire_bench_codec_sweep_smoke(tmp_path):
     """--codec-sweep structural smoke (ISSUE 13 satellite): every dial
     codec reports throughput + ratio at every swept size, and the
     ratios land where the dial's documentation claims (onebit ~32x,
-    qblock8 ~4x, qblock4 ~8x)."""
+    qblock8 ~4x, qblock4 ~8x).  With --json the table is also
+    PERSISTED machine-readable at the cost-model path (ISSUE 16: the
+    predictive tuner's seed) — pinned to a tmp path here so the test
+    never writes the operator's real ~/.cache table."""
+    model = tmp_path / "cost_model.json"
     r = subprocess.run(
         [sys.executable, _TOOL, "--codec-sweep", "--quick", "--json"],
-        env=cpu_env(), capture_output=True, text=True, timeout=600)
+        env=cpu_env({"BYTEPS_TPU_KNOB_COST_MODEL": str(model)}),
+        capture_output=True, text=True, timeout=600)
     assert r.returncode == 0, r.stderr[-3000:]
     doc = json.loads(r.stdout)
+    assert doc["cost_model_path"] == str(model)
+    assert model.exists()
+    persisted = json.loads(model.read_text())
+    assert persisted["codec_sweep"] == doc["codec_sweep"]
     rows = doc["codec_sweep"]
     sizes = {row["size_bytes"] for row in rows}
     assert len(sizes) >= 2

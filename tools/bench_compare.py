@@ -92,12 +92,12 @@ def _lower_is_better(metric: str, unit: str) -> bool:
         return False
     if metric.endswith(("_ms", "_ns", "_s", "_seconds", "_latency")):
         return True
-    # BENCH_AUTOTUNE family: the headline is the step-time GAP between
-    # the untuned-with-tuner run and the hand-tuned config — a
-    # percentage where smaller means the tuner closed more of the gap
-    # (0 = converged to hand-tuned).  Without this, "pct" would read as
-    # higher-is-better and a converging tuner would flag as a
-    # regression.
+    # The gap family (BENCH_AUTOTUNE / BENCH_SERVEROPT / BENCH_KNOB):
+    # the headline is the step-time GAP between the adaptive run and
+    # its hand-tuned/baseline config — a percentage where smaller means
+    # more of the gap closed (0 = converged, negative = outright
+    # faster).  Without this, "pct" would read as higher-is-better and
+    # a converging tuner would flag as a regression.
     if metric.endswith("_gap_pct") or unit == "pct_gap":
         return True
     return unit in ("ms", "ns", "s", "seconds", "us")

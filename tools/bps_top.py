@@ -183,6 +183,23 @@ def render(metrics: dict, prev: dict, dt: float,
                 f" switches {switches.get(name, 0):3d}")
         lines.append("")
 
+    # Knob-plane panel (CMD_KNOB): the live epoch and per-knob values
+    # the fleet is actually running under, plus the switch count (which
+    # feeds the doctor's knob_thrash rule).  Absent until a knob set
+    # lands — unarmed runs keep the gauges unregistered.
+    epoch = _get(metrics, "bps_knob_epoch")
+    if epoch:
+        sw = int(_get(metrics, "bps_knob_switches_total"))
+        sw_rate = ((sw - _get(prev, "bps_knob_switches_total")) / dt
+                   if prev and dt > 0 else 0.0)
+        vals = {dict(k).get("knob"): v for k, v in
+                (metrics.get("bps_knob_value") or {}).items()}
+        kv = "  ".join(f"{k}={int(v)}" for k, v in sorted(vals.items()))
+        flag = "  <-- thrashing?" if sw_rate > 0.5 else ""
+        lines.append(f"knob plane: epoch {int(epoch)}   {kv}   "
+                     f"switches {sw}{flag}")
+        lines.append("")
+
     # Hierarchical-reduction panel (BYTEPS_TPU_HIERARCHY=1): this
     # worker's slice role and the wire bytes its followers never sent.
     # Absent in flat runs — the gauges are only registered by an armed

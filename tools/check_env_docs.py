@@ -43,6 +43,18 @@ CODE_DIRS = ("byteps_tpu", "tools")
 CODE_EXTS = (".py", ".cc", ".h")
 DOC_FILE = os.path.join("docs", "env.md")
 
+# Global knobs the CMD_KNOB plane actuates mid-job.  Each must be
+# documented in docs/performance.md WITH its apply-boundary semantics
+# ("round boundary" in the same paragraph): an actuated knob documented
+# without "when does it land" reads as instant — and instant is exactly
+# what the epoch law exists to prevent.  A knob added to the actuated
+# set without boundary docs is the drift this check pins.
+ACTUATED_KNOBS = ("BYTEPS_TPU_FUSION_BYTES",
+                  "BYTEPS_TPU_COMPRESS_THREADS",
+                  "BYTEPS_TPU_WIRE_CONNS")
+PERF_DOC = os.path.join("docs", "performance.md")
+BOUNDARY_RE = re.compile(r"round\s+boundary", re.IGNORECASE)
+
 
 def _names_in_file(path: str) -> Set[str]:
     try:
@@ -88,6 +100,33 @@ def check(root: str) -> List[str]:
         problems.append(
             f"STALE DOC: {name} appears in {DOC_FILE} but nothing under "
             f"{CODE_DIRS[0]}/ reads it")
+    problems += check_knob_boundaries(root)
+    return problems
+
+
+def check_knob_boundaries(root: str) -> List[str]:
+    """Every actuated global knob must state its apply-boundary
+    semantics ("round boundary") in the docs/performance.md paragraph
+    that mentions it."""
+    try:
+        with open(os.path.join(root, PERF_DOC), errors="replace") as f:
+            text = f.read()
+    except OSError:
+        return [f"MISSING: {PERF_DOC} (actuated-knob boundary docs "
+                f"live there)"]
+    problems = []
+    for knob in ACTUATED_KNOBS:
+        paras = [p for p in text.split("\n\n") if knob in p]
+        if not paras:
+            problems.append(
+                f"KNOB UNDOCUMENTED: actuated knob {knob} is never "
+                f"mentioned in {PERF_DOC} — the knob plane applies it "
+                f"mid-job, so its docs must say when it lands")
+        elif not any(BOUNDARY_RE.search(p) for p in paras):
+            problems.append(
+                f"KNOB BOUNDARY UNDOCUMENTED: {knob} is mentioned in "
+                f"{PERF_DOC} but no paragraph naming it states its "
+                f"apply-boundary ('round boundary') semantics")
     return problems
 
 

@@ -616,6 +616,23 @@ def main(argv=None) -> int:
                "config": {"quick": quick, "cpus": os.cpu_count(),
                           "native": wire._c_wire() is not None}}
         if args.json:
+            # Persist the table machine-readable at the STABLE path the
+            # predictive tuner seeds from (BYTEPS_TPU_KNOB_COST_MODEL,
+            # default ~/.cache/byteps_tpu/codec_cost_model.json) — the
+            # producer half of the cost-model contract.  Atomic rename
+            # so a tuner loading mid-write never sees a torn file.
+            from byteps_tpu.common.tuner import cost_model_path
+            path = cost_model_path()
+            try:
+                os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+                tmp = path + ".tmp"
+                with open(tmp, "w") as f:
+                    json.dump(doc, f, indent=1)
+                os.replace(tmp, path)
+                doc["cost_model_path"] = path
+                _log(f"wire_bench: cost model written to {path}")
+            except OSError as e:
+                _log(f"wire_bench: cost model NOT persisted: {e}")
             print(json.dumps(doc, indent=1))
         return 0
 
