@@ -1884,6 +1884,110 @@ def bench_serveropt():
     }))
 
 
+def bench_sparse():
+    """Row-sparse embedding benchmark (BENCH_SPARSE=1): the PS tier as a
+    recommendation-scale lookup tier — the ISSUE-17 headline.
+
+    Workload: a server-resident rows x width f32 embedding table armed
+    with row-wise Adagrad, driven by a zipfian id stream (the recsys
+    shape: a small hot set absorbs most lookups).  Phase 1 trains
+    sparse rounds (push (indices, rows), server steps exactly the
+    touched rows, pull the post-update rows).  Phase 2 is the serving
+    path: batched ungated row reads through the param_version-keyed
+    hot-row LRU cache, where a warm zipf head costs ZERO wire frames.
+
+    Headline `sparse_lookup_rows_per_s` = rows served per second over
+    the read phase (higher is better); the structural numbers ride in
+    the detail: `cache_hit_rate` (zipf head absorbed client-side),
+    `p99_pull_ms` (tail of a batched read), and the wire-economy ratio
+    `touched_frac` — the fraction of the table a training round
+    actually shipped (dense push_pull would ship 1.0 every round).
+    """
+    import numpy as np
+
+    from byteps_tpu.parallel.embedding import EmbeddingTable
+    from byteps_tpu.server.client import PSSession
+
+    rows = int(os.environ.get("BENCH_SPARSE_ROWS", "200000"))
+    width = int(os.environ.get("BENCH_SPARSE_WIDTH", "64"))
+    batch = int(os.environ.get("BENCH_SPARSE_BATCH", "4096"))
+    rounds = int(os.environ.get("BENCH_SPARSE_ROUNDS", "15"))
+    reads = int(os.environ.get("BENCH_SPARSE_READS", "60"))
+    rng = np.random.default_rng(0)
+
+    def zipf_ids(n):
+        # rank-based zipfian over [0, rows): rejection-free fold of the
+        # unbounded zipf draw onto the table (head stays the head).
+        return (rng.zipf(1.2, n).astype(np.int64) - 1) % rows
+
+    proc, port = _boot_ps_server(engine_threads=2)
+    try:
+        sess = PSSession(["127.0.0.1"], [port], worker_id=0,
+                         num_servers=1)
+        table = EmbeddingTable(
+            sess, rows=rows, width=width, name="bench_emb",
+            opt_kwargs={"opt": "adagrad", "lr": 0.05},
+            init=lambda srows, w, s: np.zeros((srows, w), np.float32))
+
+        touched = set()
+        t0 = time.perf_counter()
+        for _ in range(rounds):
+            ids = zipf_ids(batch)
+            touched.update(np.unique(ids).tolist())
+            g = rng.standard_normal((batch, width)).astype(np.float32)
+            table.push_pull(ids, g)
+        train_s = time.perf_counter() - t0
+
+        read_batches = [zipf_ids(batch) for _ in range(reads)]
+        table.lookup(read_batches[0])               # settle / warm
+        times = []
+        t0 = time.perf_counter()
+        for ids in read_batches:
+            t1 = time.perf_counter()
+            table.lookup(ids)
+            times.append(time.perf_counter() - t1)
+        read_s = time.perf_counter() - t0
+
+        cs = sess.embed_cache_stats()
+        st = sess.server_stats()
+        sess.close()
+    finally:
+        proc.kill()
+        proc.wait()
+
+    total_read_rows = batch * len(read_batches)
+    rows_per_s = total_read_rows / read_s
+    hits, misses = cs.get("hits", 0), cs.get("misses", 0)
+    hit_rate = hits / max(1, hits + misses)
+    times.sort()
+    p99_ms = times[min(len(times) - 1, int(0.99 * len(times)))] * 1e3
+    print(json.dumps({
+        "metric": "sparse_lookup_rows_per_s",
+        "value": round(rows_per_s, 1),
+        "unit": "rows_per_s",
+        "detail": {
+            "rows": rows, "width": width, "batch": batch,
+            "train_rounds": rounds, "read_batches": reads,
+            "cache_hit_rate": round(hit_rate, 4),
+            "cache_hits": int(hits), "cache_misses": int(misses),
+            "rows_cached": int(cs.get("rows_cached", 0)),
+            "p99_pull_ms": round(p99_ms, 3),
+            "p50_pull_ms": round(times[len(times) // 2] * 1e3, 3),
+            "train_round_ms": round(train_s / max(1, rounds) * 1e3, 3),
+            "touched_frac": round(len(touched) / rows, 4),
+            "server_rows_served": int(st.get("embed_rows_served", 0)),
+            "server_table_bytes": int(st.get("embed_table_bytes", 0)),
+            "note": "value = rows served per second over the zipfian "
+                    "read phase; the structural claims are "
+                    "cache_hit_rate (the zipf head served with zero "
+                    "wire frames) and touched_frac (a training round "
+                    "ships that fraction of the table — dense "
+                    "push_pull ships 1.0)",
+            **_note(),
+        },
+    }))
+
+
 def bench_trace():
     """Tracing-overhead benchmark: sync-round time with the distributed
     tracer HOT (worker span recording + traced wire flags + server-side
@@ -2339,6 +2443,8 @@ def main():
         bench_autotune()     # host-only: no device backend involved
     elif os.environ.get("BENCH_KNOB", "0") == "1":
         bench_knob()         # host-only: no device backend involved
+    elif os.environ.get("BENCH_SPARSE", "0") == "1":
+        bench_sparse()       # host-only: no device backend involved
     elif os.environ.get("BENCH_CNN", ""):
         # Validate the name BEFORE the (possibly minutes-long) backend
         # probe so a typo still honors the one-JSON-line contract.

@@ -18,7 +18,8 @@ from .common.api import (
     leave, get_membership, on_membership_change,
     get_ring, drain_ps_server,
     declare, declared_key, register_compressor, get_ps_session,
-    push_pull, push_pull_async, push_pull_tree, synchronize, poll,
+    push_pull, push_pull_async, push_pull_tree, push_pull_sparse,
+    synchronize, poll,
     broadcast_parameters, broadcast_optimizer_state,
     get_pushpull_speed, get_codec_stats, get_fusion_stats,
     get_transport_stats, get_metrics, get_server_stats,
@@ -29,6 +30,7 @@ from .common.api import (
 from .parallel.async_ps import AsyncPSTrainer
 from .parallel.hierarchy import HierarchicalReducer, SliceGroup
 from .parallel.server_opt import ServerOptTrainer
+from .parallel.embedding import EmbeddingTable
 from .ops.compression import Compression
 from .ops import collectives
 from .parallel.data_parallel import (
@@ -66,8 +68,9 @@ __all__ = [
     "leave", "get_membership", "on_membership_change",
     "get_ring", "drain_ps_server",
     "declare", "declared_key", "register_compressor", "get_ps_session",
-    "push_pull", "push_pull_async", "push_pull_tree", "synchronize",
-    "poll", "AsyncPSTrainer", "ServerOptTrainer",
+    "push_pull", "push_pull_async", "push_pull_tree", "push_pull_sparse",
+    "synchronize",
+    "poll", "AsyncPSTrainer", "ServerOptTrainer", "EmbeddingTable",
     "broadcast_parameters", "broadcast_optimizer_state",
     "get_pushpull_speed", "get_codec_stats", "get_fusion_stats",
     "get_transport_stats", "get_metrics", "get_server_stats",

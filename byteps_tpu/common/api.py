@@ -661,6 +661,23 @@ def push_pull(tensor: jax.Array, name: Optional[str] = None,
     return synchronize(h)
 
 
+def push_pull_sparse(name: str, indices, rows) -> "np.ndarray":
+    """Row-sparse push_pull against a declared server-resident embedding
+    key (docs/sparse-embedding.md): merge this worker's ``(indices,
+    rows)`` gradient into the key's open round and return the published
+    rows for the same indices — wire bytes proportional to touched
+    rows, never to table size.  PS mode only; most callers want the
+    sharded :class:`bps.EmbeddingTable` wrapper instead, which also
+    owns declaration and optimizer arming."""
+    _require_init()
+    if _state.ps_session is None:
+        raise RuntimeError(
+            "push_pull_sparse needs PS mode (the row-sparse plane is a "
+            "PS-tier feature; the collective plane has no lookup tier)")
+    return _state.ps_session.push_pull_sparse(declare(name), indices,
+                                              rows)
+
+
 def push_pull_tree(tree: PyTree, name: Optional[str] = None,
                    average: bool = True, compression=None,
                    leaf_names=None, fusion_bytes: Optional[int] = None
@@ -1254,6 +1271,9 @@ def get_server_stats() -> dict:
     # bps_opt_slot_bytes{server=}.  Quiet (no gauges registered) unless
     # some key actually runs a server-side update stage.
     telemetry.update_server_opt(stats)
+    # Row-sparse embedding plane: bps_embed_rows_served_total +
+    # bps_embed_table_bytes{server=}.  Quiet unless a table exists.
+    telemetry.update_embed(stats)
     return stats
 
 

@@ -96,6 +96,17 @@ DEFAULT_THRESHOLDS = {
     # never seeded, a gradient/params length mismatch, or a mode switch
     # that silently reverted to sums).
     "param_stall_windows": 2,
+    # embedding_cache_thrash: the hot-row cache's in-window hit rate sat
+    # below embed_cache_hit_floor for embed_thrash_windows consecutive
+    # windows WHILE sparse pull bytes kept growing — every lookup is
+    # paying wire (working set larger than BYTEPS_TPU_SPARSE_CACHE_ROWS,
+    # or publish cadence churns param_version so fast every version
+    # invalidates the cache before it is re-read).  A window needs at
+    # least embed_min_lookup_rows cache decisions to count (a cold or
+    # idle reader is not thrashing).
+    "embed_thrash_windows": 2,
+    "embed_cache_hit_floor": 0.25,
+    "embed_min_lookup_rows": 64,
 }
 
 _SERIES_RE = re.compile(r'^([A-Za-z_:][A-Za-z0-9_:]*)\{(.*)\}$')
@@ -581,6 +592,60 @@ def _r_param_version_stall(ctx: RuleCtx) -> List[dict]:
     return out
 
 
+def _r_embedding_cache_thrash(ctx: RuleCtx) -> List[dict]:
+    """Row-sparse lookup tier (docs/sparse-embedding.md): the hot-row
+    cache stopped absorbing the zipf head — the hit rate collapsed for
+    consecutive windows while sparse pull bytes kept growing, so every
+    lookup pays a wire round trip the cache exists to eliminate.
+    Counter-delta rule: needs windows+1 snapshots, quiet on idle/cold
+    readers (per-window lookup floor) and when wire traffic is not
+    actually flowing (a low rate with no pull bytes is a version-pinned
+    cache serving nothing — not thrash)."""
+    need = int(ctx.th["embed_thrash_windows"])
+    floor = float(ctx.th["embed_cache_hit_floor"])
+    min_rows = int(ctx.th["embed_min_lookup_rows"])
+    if len(ctx.windows) < need + 1:
+        return []
+    wins = ctx.windows[-(need + 1):]
+
+    def _m(window: dict, name: str) -> float:
+        v = (window.get("metrics") or {}).get(name, 0.0)
+        return float(v) if isinstance(v, (int, float)) else 0.0
+
+    rates: List[float] = []
+    pull_bytes: List[int] = []
+    for prev, cur in zip(wins, wins[1:]):
+        dh = max(0.0, _m(cur, "bps_embed_cache_hits")
+                 - _m(prev, "bps_embed_cache_hits"))
+        dm = max(0.0, _m(cur, "bps_embed_cache_misses")
+                 - _m(prev, "bps_embed_cache_misses"))
+        db = max(0.0, _m(cur, "bps_embed_pull_bytes_total")
+                 - _m(prev, "bps_embed_pull_bytes_total"))
+        if dh + dm < min_rows or db <= 0.0:
+            return []
+        rate = dh / (dh + dm)
+        if rate >= floor:
+            return []
+        rates.append(round(rate, 4))
+        pull_bytes.append(int(db))
+    return [{
+        "subject": "embed-cache",
+        "message": (f"embedding hot-row cache hit rate sat below "
+                    f"{floor:.0%} for {need} consecutive windows "
+                    f"(history {rates}) while sparse pull bytes kept "
+                    f"growing ({pull_bytes}): every lookup is paying "
+                    f"wire — the working set outgrew "
+                    f"BYTEPS_TPU_SPARSE_CACHE_ROWS, or publishes churn "
+                    f"param_version faster than the rows are re-read "
+                    f"(each version drop invalidates the key's whole "
+                    f"cache); raise the cache rows/TTL or batch pushes "
+                    f"into fewer rounds (docs/sparse-embedding.md)"),
+        "evidence": {"hit_rate_history": rates,
+                     "pull_bytes_history": pull_bytes,
+                     "windows": need,
+                     "hit_floor": floor}}]
+
+
 def _r_barrier_stall(ctx: RuleCtx) -> List[dict]:
     trips = ctx.delta("bps_transport_watchdog_trips")
     barrier = ctx.events("barrier_timeout")
@@ -635,6 +700,9 @@ RULES: List[Rule] = [
     Rule("param_version_stall", SEV_ERROR,
          "a server-resident optimizer key stopped publishing updates",
          _r_param_version_stall),
+    Rule("embedding_cache_thrash", SEV_WARN,
+         "the embedding hot-row cache stopped absorbing lookups",
+         _r_embedding_cache_thrash),
 ]
 
 RULE_IDS = tuple(r.id for r in RULES)

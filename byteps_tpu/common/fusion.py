@@ -57,6 +57,8 @@ ZERO_STATS: Dict[str, int] = {
     "drain_flushes": 0,         # streaming buckets closed by flush()/close()
     "ingraph_plans": 0,         # collective-plane BucketPlans built
     "ingraph_buckets": 0,       # buckets in those plans
+    "row_batch_plans": 0,       # sparse row-pull batching plans built
+    "row_batches": 0,           # batched row-pull wire units in them
 }
 
 _stats = dict(ZERO_STATS)
@@ -236,6 +238,31 @@ def plan_segments(sizes: Sequence[int], capacity_elems: int,
         buckets.append(cur)
     _bump(ingraph_plans=1, ingraph_buckets=len(buckets))
     return buckets
+
+
+def plan_row_batches(nrows: int, row_width: int, max_bytes: int,
+                     overhead_bytes: int = 32) -> List[Tuple[int, int]]:
+    """Batching plan for row-sparse embedding pulls: coalesce ``nrows``
+    row lookups (each ``row_width`` f32 elements on the response leg)
+    into the fewest wire units whose response payload stays under
+    ``max_bytes`` — many small per-row round trips become one batched
+    request per slot (docs/sparse-embedding.md).  Returns half-open
+    ``(start, stop)`` slices over the caller's sorted index array.
+
+    ``overhead_bytes`` covers the sparse header + param_version trailer;
+    the index stream itself is elias-coded and strictly smaller than the
+    row payload, so the row leg is the binding term.  A single row wider
+    than the cap still ships alone — a lookup can never be split.
+    """
+    if nrows <= 0:
+        return []
+    row_bytes = max(1, int(row_width) * 4)
+    per_batch = max(1, (max(1, int(max_bytes)) - overhead_bytes)
+                    // row_bytes)
+    batches = [(start, min(nrows, start + per_batch))
+               for start in range(0, nrows, per_batch)]
+    _bump(row_batch_plans=1, row_batches=len(batches))
+    return batches
 
 
 # ---------------------------------------------------------------------------

@@ -540,6 +540,36 @@ def update_server_opt(server_stats: dict,
                       int(rec.get("opt_slot_bytes", 0)))
 
 
+def update_embed(server_stats: dict,
+                 registry: Optional[MetricsRegistry] = None) -> None:
+    """Fold the row-sparse embedding plane from a merged CMD_STATS
+    payload into the registry.
+
+    Exports ``bps_embed_rows_served_total`` (rows the server tier has
+    answered over the sparse pull/read planes) and
+    ``bps_embed_table_bytes{server=}`` (declared embedding-table bytes
+    resident per server — the recommendation-scale state that never fits
+    a worker).  Quiet until some key actually declares an embedding
+    (both numbers zero): no gauge is registered and the snapshot is
+    unchanged — a dense job's metrics surface is untouched."""
+    reg = registry or get_registry()
+    served = int(server_stats.get("embed_rows_served", 0))
+    if served or int(server_stats.get("embed_table_bytes", 0)):
+        reg.gauge("bps_embed_rows_served_total",
+                  help="embedding rows served by the PS tier over the "
+                       "row-sparse pull/read planes").set(served)
+    for sid, rec in (server_stats.get("servers") or {}).items():
+        if not isinstance(rec, dict) or "embed_table_bytes" not in rec:
+            continue
+        if int(rec.get("embed_table_bytes", 0)) == 0 and not served:
+            continue
+        reg.gauge("bps_embed_table_bytes",
+                  help="declared embedding-table bytes resident on this "
+                       "server (rows x width x 4)",
+                  labels={"server": str(sid)}).set(
+                      int(rec.get("embed_table_bytes", 0)))
+
+
 def update_round_lag(server_stats: dict, straggler_rounds: int,
                      registry: Optional[MetricsRegistry] = None
                      ) -> Dict[int, int]:

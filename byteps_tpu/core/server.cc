@@ -284,7 +284,90 @@ enum WireDtype : uint8_t {
   kSeed = 3,       // raw write applied ONLY if the key has never been
                    // pushed — idempotent store seeding that cannot reset a
                    // live training run when a worker joins late / rejoins
+  kSparseRows = 4, // row-sparse embedding traffic: push carries
+                   // (index stream, dense rows), pull carries an index
+                   // stream and is round-gated exactly like a dense pull
+  kSparseRead = 5, // ungated sparse row read: served immediately from the
+                   // current table (inference / pull-only sessions) —
+                   // never parks, never touches round state
 };
+
+// Row-sparse block header, little-endian, 16 bytes.  Shared by push
+// payloads (header | index stream | nrows*width f32 rows) and pull
+// requests (header | index stream).  codec 0 = raw u32 LE indices,
+// codec 1 = elias-delta over gaps of the sorted unique index list
+// (first code = idx[0]+1, then idx[i]-idx[i-1], every code >= 1).
+// Pull/read responses are `u64 param_version | nrows*width f32 rows`
+// in request order.
+struct SparseHdr {
+  uint32_t nrows;
+  uint32_t width;
+  uint8_t codec;
+  uint8_t pad0;
+  uint16_t pad1;
+  uint32_t idx_bytes;
+};
+static_assert(sizeof(SparseHdr) == 16, "sparse header layout");
+
+// Decode a sparse index stream (see SparseHdr) into `out`.  Returns
+// false on any malformed stream: truncated bytes, zero elias gaps, or
+// an index walking past the u32 range.  Codec 1 yields sorted unique
+// indices by construction (gaps >= 1); codec 0 preserves wire order.
+// The bit-loop decoder is fine here — index streams are a few KB next
+// to the row payload they describe, unlike the dithering codec's
+// full-gradient elias streams.
+static bool DecodeSparseIndices(const unsigned char* p, size_t nbytes,
+                                uint32_t nrows, uint8_t codec,
+                                std::vector<uint32_t>* out) {
+  out->clear();
+  out->reserve(nrows);
+  if (codec == 0) {
+    if (nbytes < static_cast<size_t>(nrows) * 4) return false;
+    for (uint32_t i = 0; i < nrows; ++i) {
+      uint32_t v;
+      std::memcpy(&v, p + static_cast<size_t>(i) * 4, 4);
+      out->push_back(v);
+    }
+    return true;
+  }
+  if (codec != 1) return false;
+  size_t nbits = nbytes * 8, pos = 0;
+  auto take = [&]() -> int {
+    int b = (p[pos >> 3] >> (pos & 7)) & 1;
+    ++pos;
+    return b;
+  };
+  // Elias-delta, bit-matched to server/wire.py: bits LSB-first within
+  // bytes, each code MSB-first (LL-1 zeros | L in LL bits | low L-1
+  // bits of v).
+  auto elias = [&](uint64_t* v) -> bool {
+    int zeros = 0;
+    bool one = false;
+    while (pos < nbits) {
+      if (take() == 1) { one = true; break; }
+      ++zeros;
+    }
+    if (!one || zeros > 6) return false;
+    if (zeros == 0) { *v = 1; return true; }
+    if (pos + static_cast<size_t>(zeros) > nbits) return false;
+    uint64_t L = 1;
+    for (int i = 0; i < zeros; ++i) L = (L << 1) | take();
+    if (L < 1 || L > 40 || pos + (L - 1) > nbits) return false;
+    uint64_t x = 1;
+    for (uint64_t i = 1; i < L; ++i) x = (x << 1) | take();
+    *v = x;
+    return true;
+  };
+  uint64_t idx = 0;
+  for (uint32_t i = 0; i < nrows; ++i) {
+    uint64_t gap = 0;
+    if (!elias(&gap) || gap == 0) return false;
+    idx = (i == 0) ? gap - 1 : idx + gap;
+    if (idx > 0xFFFFFFFFULL) return false;
+    out->push_back(static_cast<uint32_t>(idx));
+  }
+  return true;
+}
 
 // ---------------------------------------------------------------------------
 // Compressed-payload codec — the server side of the reference's
@@ -1260,6 +1343,10 @@ struct PendingPull {
   uint32_t worker = 0;      // for the PULL_SEND trace span
   bool traced = false;      // record a span when the pull finally serves
   bool audited = false;     // append the AuditTrailer when it serves
+  // Row-sparse pulls (dtype kSparseRows) park their request payload
+  // (SparseHdr + index stream) here; empty for dense pulls.  Served by
+  // FlushPulls via RespondSparse when the wanted round publishes.
+  std::vector<char> sparse;
 };
 
 // Per-key merge state — the reference's BytePSArray + update buffers
@@ -1380,13 +1467,14 @@ struct KeyState {
   uint64_t opt_effective = 0;
   std::string opt_next;         // pending kwargs
   std::string opt_kwargs;       // active kwargs ("" = off)
-  uint8_t opt_kind = 0;         // 0 off, 1 sgd, 2 momentum, 3 adam
+  uint8_t opt_kind = 0;         // 0 off, 1 sgd, 2 momentum, 3 adam,
+                                // 4 adagrad (opt_v = sum-of-squares)
   // Hyperparams kept as the DOUBLES the kwargs decimals parse to (the
   // same f64 the worker-local optax baseline holds); every update-stage
   // constant derives from them with optax's exact rounding, e.g.
   // (float)(1.0 - b1) — f32-parity depends on this.
   double opt_lr = 0.01, opt_mu = 0.9, opt_b1 = 0.9, opt_b2 = 0.999,
-         opt_eps = 1e-8, opt_gscale = 1.0;
+         opt_eps = 1e-8, opt_gscale = 1.0, opt_acc0 = 0.1;
   std::vector<float> params;    // the authoritative weights
   std::vector<float> opt_m;     // momentum trace / Adam first moment
   std::vector<float> opt_v;     // Adam second moment
@@ -1400,6 +1488,32 @@ struct KeyState {
   // memset on the engine's critical path).  Transient — never rides
   // CMD_MIGRATE.
   std::vector<float> opt_scratch;
+
+  // --- row-sparse embedding plane (dtype kSparseRows) -------------------
+  // A key becomes an embedding key at INIT time via kwargs
+  // `embed_rows=N,embed_width=D` with declared length 0: the dense store
+  // stays empty and all round state lives row-wise in the maps below.
+  // The dense and sparse planes are mutually exclusive per key.
+  uint64_t embed_rows = 0;   // declared table rows (0 = not an embed key)
+  uint32_t embed_width = 0;  // f32 elements per row
+  // Open-round merge: row -> accumulated gradient row.  First touch of a
+  // row COPIES the pushed payload (the dense plane's COPY_FIRST law —
+  // zero-init plus += would turn a pushed -0.0 into +0.0 and break
+  // dense/sparse bit-identity); later touches element-wise += in
+  // arrival order.
+  std::unordered_map<uint64_t, std::vector<float>> embed_merge;
+  // Published round: swapped in from embed_merge at publish.  What
+  // unarmed round-gated pulls serve; rows absent here read as zeros —
+  // sum semantics, exactly what a dense pull over an untouched slice
+  // yields.  When the key is armed (opt_kind != 0) pulls serve `params`
+  // rows instead and this map only tracks which rows the round touched.
+  std::unordered_map<uint64_t, std::vector<float>> embed_out;
+  // Per-row update counts for lazy bias correction (Adam) — only rows a
+  // publish actually touched step, mirroring a worker-local optax
+  // baseline that masks untouched rows out of the update.  Sized
+  // embed_rows lazily when the key arms; params/opt_m/opt_v above are
+  // reused at embed_rows*embed_width.
+  std::vector<uint32_t> embed_row_step;
 };
 
 struct Task {
@@ -2017,10 +2131,10 @@ class Server {
   }
 
   std::string StatsJson() {
-    // Worst-case row: the header now carries ~13 numeric fields at up
-    // to 20 digits + ~270 chars of labels — keep comfortable headroom
+    // Worst-case row: the header now carries ~25 numeric fields at up
+    // to 20 digits + ~330 chars of labels — keep comfortable headroom
     // (snprintf truncation would silently corrupt the JSON).
-    char buf[1024];
+    char buf[1536];
     std::string js;
     js.reserve(4096);
     const uint64_t keys_owned = ring_armed_ ? KeysOwned() : 0;
@@ -2036,6 +2150,8 @@ class Server {
                   "\"opt_updates\":%llu,\"opt_slot_bytes\":%llu,"
                   "\"knob_epoch\":%llu,\"knob_sets\":%llu,"
                   "\"knob_stale_frames\":%llu,"
+                  "\"embed_rows_served\":%llu,"
+                  "\"embed_table_bytes\":%llu,"
                   "\"slice_size\":%d,\"keys\":{",
                   static_cast<unsigned long long>(
                       bytes_in_.load(std::memory_order_relaxed)),
@@ -2075,6 +2191,10 @@ class Server {
                       knob_sets_.load(std::memory_order_relaxed)),
                   static_cast<unsigned long long>(
                       knob_stale_.load(std::memory_order_relaxed)),
+                  static_cast<unsigned long long>(
+                      embed_rows_served_.load(std::memory_order_relaxed)),
+                  static_cast<unsigned long long>(
+                      embed_table_bytes_.load(std::memory_order_relaxed)),
                   slice_size_);
     js += buf;
     std::lock_guard<std::mutex> lk(stats_mu_);
@@ -2867,6 +2987,40 @@ class Server {
       put(&kl, 4);
       put(knob_next_.data(), kl);
     }
+    // Row-sparse embedding trailer (appended AFTER the knob trailer,
+    // same version-tolerance law: absent from pre-sparse senders, and a
+    // pre-sparse receiver's positional parse ignores it).  Carries the
+    // declared table shape, the PUBLISHED round's rows, the OPEN
+    // round's partial merge, and the per-row step counts — params/m/v
+    // already rode the optimizer trailer above, so a drained embedding
+    // key's new owner continues the exact row-wise trajectory.
+    {
+      put(&ks.embed_rows, 8);
+      put(&ks.embed_width, 4);
+      auto put_rows =
+          [&](const std::unordered_map<uint64_t, std::vector<float>>& m) {
+            uint64_t cnt = 0;
+            for (auto& kv : m)
+              if (kv.second.size() == ks.embed_width) ++cnt;
+            put(&cnt, 8);
+            for (auto& kv : m)
+              if (kv.second.size() == ks.embed_width) {
+                put(&kv.first, 8);
+                put(kv.second.data(), kv.second.size() * 4);
+              }
+          };
+      put_rows(ks.embed_out);
+      put_rows(ks.embed_merge);
+      uint64_t nz = 0;
+      for (uint32_t s : ks.embed_row_step)
+        if (s) ++nz;
+      put(&nz, 8);
+      for (uint64_t r = 0; r < ks.embed_row_step.size(); ++r)
+        if (ks.embed_row_step[r]) {
+          put(&r, 8);
+          put(&ks.embed_row_step[r], 4);
+        }
+    }
     return out;
   }
 
@@ -2972,6 +3126,16 @@ class Server {
     ks.opt_step = 0;
     ks.param_version = 0;
     ks.opt_warned = false;
+    // Embedding plane rode the trailer; retire it like the rest and
+    // release the declared-footprint gauge bytes.
+    embed_table_bytes_.fetch_add(
+        0 - ks.embed_rows * ks.embed_width * 4, std::memory_order_relaxed);
+    ks.embed_rows = 0;
+    ks.embed_width = 0;
+    ks.embed_merge.clear();
+    ks.embed_out.clear();
+    ks.embed_row_step.clear();
+    ks.embed_row_step.shrink_to_fit();
     OptSlotAccount(ks);
     StatOpt(key, 0, 0);
     ks.active.store(false, std::memory_order_relaxed);
@@ -3268,6 +3432,64 @@ class Server {
         }
       }
     }
+    // Row-sparse embedding trailer (absent from pre-sparse senders: the
+    // reset defaults below then hold and the key stays dense —
+    // version-tolerant by the same remaining()-based parse).  The shape
+    // is bounded like every other wire length: total table elements
+    // must fit the migration frame cap, so a crafted header can never
+    // drive a giant allocation.
+    embed_table_bytes_.fetch_add(
+        0 - ks.embed_rows * ks.embed_width * 4, std::memory_order_relaxed);
+    ks.embed_rows = 0;
+    ks.embed_width = 0;
+    ks.embed_merge.clear();
+    ks.embed_out.clear();
+    ks.embed_row_step.clear();
+    {
+      uint64_t er = 0;
+      uint32_t ew = 0;
+      if (take(&er, 8) && take(&ew, 4)) {
+        auto take_rows =
+            [&](std::unordered_map<uint64_t, std::vector<float>>* m) {
+              uint64_t cnt = 0;
+              if (!take(&cnt, 8)) return false;
+              const uint64_t rb = 8ull + static_cast<uint64_t>(ew) * 4;
+              if (cnt > remaining() / rb) return false;
+              for (uint64_t i = 0; i < cnt; ++i) {
+                uint64_t row = 0;
+                if (!take(&row, 8)) return false;
+                std::vector<float> v(ew);
+                if (!take(v.data(), static_cast<size_t>(ew) * 4))
+                  return false;
+                (*m)[row] = std::move(v);
+              }
+              return true;
+            };
+        std::unordered_map<uint64_t, std::vector<float>> eo, em;
+        uint64_t nz = 0;
+        bool eok = er != 0 && ew != 0 && er <= (max_msg_ / 4) / ew &&
+                   take_rows(&eo) && take_rows(&em) && take(&nz, 8) &&
+                   nz <= remaining() / 12;
+        if (eok) {
+          std::vector<uint32_t> steps(static_cast<size_t>(er), 0);
+          for (uint64_t i = 0; i < nz && eok; ++i) {
+            uint64_t row = 0;
+            uint32_t s = 0;
+            eok = take(&row, 8) && take(&s, 4) && row < er;
+            if (eok) steps[static_cast<size_t>(row)] = s;
+          }
+          if (eok) {
+            ks.embed_rows = er;
+            ks.embed_width = ew;
+            ks.embed_out = std::move(eo);
+            ks.embed_merge = std::move(em);
+            ks.embed_row_step = std::move(steps);
+            embed_table_bytes_.fetch_add(er * ew * 4,
+                                         std::memory_order_relaxed);
+          }
+        }
+      }
+    }
     OptSlotAccount(ks);
     StatOpt(t.key, ks.param_version, ks.opt_kind);
     ks.merge_ts.clear();
@@ -3512,7 +3734,13 @@ class Server {
           // that is not currently live admits it at the next epoch
           // boundary (a live member's HELLO — every fixed-mode session
           // start — changes nothing, keeping the fixed wire identical).
-          AdmitWorker(h.worker_id);
+          // flags bit 0 = OBSERVER: a pull-only session introducing
+          // itself without joining the worker set — it must never be
+          // admitted into elastic membership (it would stall every
+          // round it never pushes into).  TouchWorker already ignores
+          // non-members, so an observer stays invisible to rounds in
+          // both fixed and elastic modes.
+          if (!(h.flags & 1)) AdmitWorker(h.worker_id);
           char mode[2] = {static_cast<char>(async_ ? 1 : 0),
                           static_cast<char>(schedule_ ? 1 : 0)};
           Respond(conn, kOk, h.req_id, h.key, mode, 2);
@@ -4185,12 +4413,17 @@ class Server {
     if (kw.find("opt=sgd") != std::string::npos) kind = 1;
     else if (kw.find("opt=momentum") != std::string::npos) kind = 2;
     else if (kw.find("opt=adam") != std::string::npos) kind = 3;
+    else if (kw.find("opt=adagrad") != std::string::npos) kind = 4;
     ks.opt_kind = kind;
     ks.opt_lr = KwFloat(kw, "lr", 0.01);
     ks.opt_mu = KwFloat(kw, "mu", 0.9);
     ks.opt_b1 = KwFloat(kw, "b1", 0.9);
     ks.opt_b2 = KwFloat(kw, "b2", 0.999);
-    ks.opt_eps = KwFloat(kw, "eps", 1e-8);
+    // optax.adagrad defaults eps=1e-7 and seeds the sum-of-squares
+    // accumulator at initial_accumulator_value=0.1 (scale_by_rss);
+    // the other optimizers keep their optax defaults.
+    ks.opt_eps = KwFloat(kw, "eps", kind == 4 ? 1e-7 : 1e-8);
+    ks.opt_acc0 = KwFloat(kw, "acc0", 0.1);
     ks.opt_gscale = KwFloat(kw, "gscale", 1.0);
   }
 
@@ -4427,6 +4660,21 @@ class Server {
         }
         break;
       }
+      case 4: {  // adagrad (optax scale_by_rss): s += g*g;
+                 // u = g * (s > 0 ? 1/sqrt(s+eps) : 0); p += -lr*u
+        if (ks.opt_v.size() != ne)
+          ks.opt_v.assign(ne, static_cast<float>(ks.opt_acc0));
+        const float epsf = static_cast<float>(ks.opt_eps);
+        for (size_t i = 0; i < ne; ++i) {
+          const float gi = g[i];
+          const float s = ks.opt_v[i] + gi * gi;
+          ks.opt_v[i] = s;
+          const float scale =
+              s > 0.0f ? 1.0f / std::sqrt(s + epsf) : 0.0f;
+          p[i] = p[i] + nlr * (scale * gi);
+        }
+        break;
+      }
       default:
         return;
     }
@@ -4438,6 +4686,102 @@ class Server {
     opt_updates_.fetch_add(1, std::memory_order_relaxed);
     StatOpt(key, ks.param_version, ks.opt_kind);
     DebugLog("opt_update", key, 0, ks.completed_round, ks.out);
+  }
+
+  // Row-wise update stage for embedding keys: runs inside PublishRound
+  // after embed_out adopted the round's merged rows.  Only touched rows
+  // step — per-row step counts drive Adam's bias correction (lazy
+  // Adam) and the Adagrad accumulator, matching a worker-local optax
+  // baseline that gathers the touched rows, steps them, and scatters
+  // the result back.  param_version increments exactly once per
+  // publish, the same exactly-one-update law as the dense stage.
+  // Every f32 op mirrors the dense arms above element-for-element.
+  void EmbedUpdateStage(KeyState& ks, uint64_t key) {
+    const size_t w = ks.embed_width;
+    const size_t total = static_cast<size_t>(ks.embed_rows) * w;
+    if (total == 0) return;
+    // Zero-init unless CMD_OPT seeded the full table (a wrong-size seed
+    // is discarded — the dense stage's size guard, row-wise).
+    if (ks.params.size() != total) ks.params.assign(total, 0.0f);
+    if (ks.embed_row_step.size() != ks.embed_rows)
+      ks.embed_row_step.assign(ks.embed_rows, 0);
+    if ((ks.opt_kind == 2 || ks.opt_kind == 3) && ks.opt_m.size() != total)
+      ks.opt_m.assign(total, 0.0f);
+    if (ks.opt_kind == 3 && ks.opt_v.size() != total)
+      ks.opt_v.assign(total, 0.0f);
+    if (ks.opt_kind == 4 && ks.opt_v.size() != total)
+      ks.opt_v.assign(total, static_cast<float>(ks.opt_acc0));
+    const float nlr = static_cast<float>(-1.0 * ks.opt_lr);
+    const float gs = static_cast<float>(ks.opt_gscale);
+    const bool scaled = ks.opt_gscale != 1.0;
+    const float muf = static_cast<float>(ks.opt_mu);
+    const float b1f = static_cast<float>(ks.opt_b1);
+    const float b2f = static_cast<float>(ks.opt_b2);
+    const float onemb1 = static_cast<float>(1.0 - ks.opt_b1);
+    const float onemb2 = static_cast<float>(1.0 - ks.opt_b2);
+    const float epsf = static_cast<float>(ks.opt_eps);
+    for (auto& kv : ks.embed_out) {
+      const uint64_t r = kv.first;
+      if (r >= ks.embed_rows || kv.second.size() != w) continue;
+      float* g = kv.second.data();
+      if (scaled)
+        for (size_t i = 0; i < w; ++i) g[i] = gs * g[i];
+      float* p = ks.params.data() + r * w;
+      switch (ks.opt_kind) {
+        case 1: {  // sgd
+          for (size_t i = 0; i < w; ++i) p[i] = p[i] + nlr * g[i];
+          break;
+        }
+        case 2: {  // momentum (optax trace — no step count needed)
+          float* m = ks.opt_m.data() + r * w;
+          for (size_t i = 0; i < w; ++i) {
+            const float mi = g[i] + muf * m[i];
+            m[i] = mi;
+            p[i] = p[i] + nlr * mi;
+          }
+          break;
+        }
+        case 3: {  // adam, bias-corrected by THIS ROW's update count
+          const uint32_t rs = ks.embed_row_step[r];
+          const uint64_t step =
+              rs >= 2147483647u ? 2147483647ULL : rs + 1ULL;
+          const float bc1 = 1.0f - IntPowF32(b1f, step);
+          const float bc2 = 1.0f - IntPowF32(b2f, step);
+          float* m = ks.opt_m.data() + r * w;
+          float* v = ks.opt_v.data() + r * w;
+          for (size_t i = 0; i < w; ++i) {
+            const float gi = g[i];
+            const float mi = onemb1 * gi + b1f * m[i];
+            const float vi = onemb2 * (gi * gi) + b2f * v[i];
+            m[i] = mi;
+            v[i] = vi;
+            const float u = nlr * ((mi / bc1) / (std::sqrt(vi / bc2) + epsf));
+            p[i] = p[i] + u;
+          }
+          break;
+        }
+        case 4: {  // adagrad (optax scale_by_rss)
+          float* v = ks.opt_v.data() + r * w;
+          for (size_t i = 0; i < w; ++i) {
+            const float gi = g[i];
+            const float s = v[i] + gi * gi;
+            v[i] = s;
+            const float scale =
+                s > 0.0f ? 1.0f / std::sqrt(s + epsf) : 0.0f;
+            p[i] = p[i] + nlr * (scale * gi);
+          }
+          break;
+        }
+        default:
+          return;
+      }
+      if (ks.embed_row_step[r] < 2147483647u) ks.embed_row_step[r]++;
+    }
+    if (ks.opt_step < 2147483647ULL) ks.opt_step++;
+    ks.param_version++;
+    OptSlotAccount(ks);
+    opt_updates_.fetch_add(1, std::memory_order_relaxed);
+    StatOpt(key, ks.param_version, ks.opt_kind);
   }
 
   void HandleInit(Task& t) {
@@ -4474,6 +4818,33 @@ class Server {
         // worker learns the live codec from CMD_CODEC / kCodecStale.
         if (ks.codec_epoch == 0)
           ApplyCodecKwargs(ks, std::string(t.payload.data() + 12, klen));
+        // Row-sparse embedding declaration: `embed_rows=N,embed_width=D`
+        // with declared length 0 turns the key into an embedding key —
+        // the dense store stays empty, round state lives row-wise.
+        // Idempotent like the size path below: a re-declare with the
+        // same shape touches nothing; a shape CHANGE resets the sparse
+        // round state (the dense size-change reset, row-wise).
+        const std::string kw(t.payload.data() + 12, klen);
+        const int er = KwInt(kw, "embed_rows", 0);
+        const int ew = KwInt(kw, "embed_width", 0);
+        if (er > 0 && ew > 0 && n == 0) {
+          const uint64_t nr = static_cast<uint64_t>(er);
+          const uint32_t nw = static_cast<uint32_t>(ew);
+          if (ks.embed_rows != nr || ks.embed_width != nw) {
+            // Declared-footprint gauge: signed delta via unsigned
+            // wraparound, the OptSlotAccount discipline.
+            embed_table_bytes_.fetch_add(
+                nr * nw * 4 - ks.embed_rows * ks.embed_width * 4,
+                std::memory_order_relaxed);
+            ks.embed_rows = nr;
+            ks.embed_width = nw;
+            ks.embed_merge.clear();
+            ks.embed_out.clear();
+            ks.embed_row_step.clear();
+            ks.seen.clear();
+            ks.merge_ts.clear();
+          }
+        }
       }
     }
     if (ks.store.size() != n) {
@@ -4535,6 +4906,95 @@ class Server {
       StatPush(t.key, t.worker_id, wire_len, true, 0);
       Respond(t.conn, kOk, t.req_id, t.key, nullptr, 0);
       FlushPulls(ks, t.key);
+      return;
+    }
+    if (t.dtype == kSparseRows) {
+      // Row-sparse embedding push: SparseHdr | index stream | dense f32
+      // rows.  A dedicated branch — the dense guards below reason about
+      // store.size(), which embed keys keep at zero.  The guard order
+      // mirrors the dense path exactly: stale-round ack-and-drop,
+      // in-round dedup, elastic membership, pending-opt arm at the
+      // round boundary.  Async mode has no round boundary for the
+      // row-wise update stage to run at — reject, like CMD_OPT writes.
+      // Knob/codec staleness does not apply: sparse frames carry their
+      // own codec in the header and never ride fusion buckets.
+      if (async_ || ks.embed_rows == 0 || ks.embed_width == 0) {
+        Respond(t.conn, kError, t.req_id, t.key, nullptr, 0);
+        return;
+      }
+      if (!RoundMatch(t.flags, ks.completed_round)) {
+        StatPush(t.key, t.worker_id, wire_len, false, 0);
+        Respond(t.conn, kOk, t.req_id, t.key, nullptr, 0);
+        return;
+      }
+      if (ks.seen.count(t.worker_id)) {
+        ks.push_count.fetch_add(1, std::memory_order_relaxed);
+        StatPush(t.key, t.worker_id, wire_len, false, 0);
+        Respond(t.conn, kOk, t.req_id, t.key, nullptr, 0);
+        return;
+      }
+      if (epoch_atomic_.load(std::memory_order_acquire) != 0) {
+        if (ks.seen.empty()) AdoptRoundMembers(ks);
+        if (!ks.round_members.empty() &&
+            !ks.round_members.count(t.worker_id)) {
+          deferred_joins_.fetch_add(1, std::memory_order_relaxed);
+          StatPush(t.key, t.worker_id, wire_len, false, 0);
+          Respond(t.conn, kOk, t.req_id, t.key, nullptr, 0);
+          return;
+        }
+      }
+      if (ks.opt_epoch != 0 && ks.opt_pending && ks.seen.empty() &&
+          ks.completed_round >= ks.opt_effective)
+        ApplyPendingOpt(ks);
+      // Validate the whole frame BEFORE any state mutates (the dense
+      // path's ordering invariant): a malformed frame must leave the
+      // open merge exactly as it found it.
+      SparseHdr h;
+      const size_t w = ks.embed_width;
+      std::vector<uint32_t> idx;
+      bool ok = data->size() >= sizeof(h);
+      if (ok) {
+        std::memcpy(&h, data->data(), sizeof(h));
+        ok = h.width == w &&
+             data->size() >= sizeof(h) +
+                 static_cast<uint64_t>(h.idx_bytes) +
+                 static_cast<uint64_t>(h.nrows) * w * 4 &&
+             DecodeSparseIndices(
+                 reinterpret_cast<const unsigned char*>(data->data()) +
+                     sizeof(h),
+                 h.idx_bytes, h.nrows, h.codec, &idx);
+      }
+      if (ok)
+        for (uint32_t i = 0; i < h.nrows; ++i)
+          if (idx[i] >= ks.embed_rows) { ok = false; break; }
+      if (!ok) {
+        Respond(t.conn, kError, t.req_id, t.key, nullptr, 0);
+        return;
+      }
+      const char* rows = data->data() + sizeof(h) + h.idx_bytes;
+      std::vector<float> tmp(w);
+      for (uint32_t i = 0; i < h.nrows; ++i) {
+        std::memcpy(tmp.data(), rows + static_cast<size_t>(i) * w * 4,
+                    w * 4);
+        auto it = ks.embed_merge.find(idx[i]);
+        if (it == ks.embed_merge.end()) {
+          // COPY_FIRST, row-wise: the row's first touch adopts the
+          // pushed bytes verbatim (zero-init plus += would fold a
+          // pushed -0.0 into +0.0 and break dense/sparse bit-identity).
+          ks.embed_merge.emplace(idx[i], tmp);
+        } else {
+          float* dst = it->second.data();
+          for (size_t j = 0; j < w; ++j) dst[j] += tmp[j];
+        }
+      }
+      ks.dtype = kSparseRows;
+      ks.push_count.fetch_add(1, std::memory_order_relaxed);
+      ks.seen.insert(t.worker_id);
+      StatPush(t.key, t.worker_id, wire_len, true, ks.completed_round + 1,
+               ks.seen.size());
+      Respond(t.conn, kOk, t.req_id, t.key, nullptr, 0);
+      if (RoundComplete(ks))
+        PublishRound(ks, t.key, t.worker_id);
       return;
     }
     // Compressed pushes are expanded to f32 before the merge — the
@@ -4872,6 +5332,26 @@ class Server {
     // streams and never update.
     if (!async_ && ks.opt_kind != 0 && ks.dtype == kF32)
       OptUpdateStage(ks, key, served_compressed);
+    // --- row-sparse embedding publish -----------------------------------
+    // The round's merged rows become the published set (swap, like the
+    // dense out/store swap above — both maps recycle their node pools
+    // round to round), then the row-wise update stage steps exactly the
+    // touched rows when the key is armed.  The audit digest below covers
+    // ks.out, which embed keys keep empty — sparse rounds are outside
+    // the audit plane (docs/sparse-embedding.md).
+    if (ks.embed_rows != 0) {
+      ks.embed_out.swap(ks.embed_merge);
+      ks.embed_merge.clear();
+      if (!async_ && ks.opt_kind != 0) {
+        EmbedUpdateStage(ks, key);
+      } else {
+        // Unarmed publishes change the served rows too (the swap above)
+        // — param_version identifies PUBLISHED TABLE STATE, so it must
+        // advance either way or worker hot-row caches could serve a
+        // superseded round as current (docs/sparse-embedding.md).
+        ks.param_version++;
+      }
+    }
     ks.completed_round++;
     ks.seen.clear();
     ks.round_compressed = false;
@@ -4970,6 +5450,60 @@ class Server {
     }
   }
 
+  // Serve one batched sparse row pull: parse SparseHdr + index stream
+  // out of `req` and respond `u64 param_version | rows` in request
+  // order.  Armed keys serve the authoritative params table (the table
+  // CMD_OPT seeded / the update stage maintains); unarmed keys serve
+  // the published round's merged rows, absent rows reading as zeros —
+  // sum semantics, what a dense pull of an untouched slice yields.
+  void RespondSparse(Conn* c, uint32_t req_id, uint64_t key, KeyState& ks,
+                     const char* req, size_t req_len) {
+    SparseHdr h;
+    const size_t w = ks.embed_width;
+    std::vector<uint32_t> idx;
+    bool ok = ks.embed_rows != 0 && w != 0 && req_len >= sizeof(h);
+    if (ok) {
+      std::memcpy(&h, req, sizeof(h));
+      ok = h.width == w && req_len >= sizeof(h) + h.idx_bytes &&
+           DecodeSparseIndices(
+               reinterpret_cast<const unsigned char*>(req) + sizeof(h),
+               h.idx_bytes, h.nrows, h.codec, &idx);
+    }
+    if (ok)
+      for (uint32_t i = 0; i < h.nrows; ++i)
+        if (idx[i] >= ks.embed_rows) { ok = false; break; }
+    if (!ok) {
+      Respond(c, kError, req_id, key, nullptr, 0);
+      return;
+    }
+    std::vector<char> resp(8 + static_cast<size_t>(h.nrows) * w * 4);
+    std::memcpy(resp.data(), &ks.param_version, 8);
+    char* dst = resp.data() + 8;
+    // Serving law: a full-size params table IS the live table (seeded
+    // via CMD_OPT or optimizer-stepped) and wins regardless of whether
+    // the pending optimizer config has reached its round boundary yet —
+    // a freshly seeded table must serve its seed before round 1.
+    // Without params (unarmed), serve the last published per-round rows
+    // (absent row = zeros, the dense sum semantics).
+    const bool armed =
+        ks.params.size() == static_cast<size_t>(ks.embed_rows) * w;
+    for (uint32_t i = 0; i < h.nrows; ++i) {
+      const uint64_t r = idx[i];
+      if (armed) {
+        std::memcpy(dst, ks.params.data() + r * w, w * 4);
+      } else {
+        auto it = ks.embed_out.find(r);
+        if (it != ks.embed_out.end() && it->second.size() == w)
+          std::memcpy(dst, it->second.data(), w * 4);
+        else
+          std::memset(dst, 0, w * 4);
+      }
+      dst += w * 4;
+    }
+    embed_rows_served_.fetch_add(h.nrows, std::memory_order_relaxed);
+    Respond(c, kOk, req_id, key, resp.data(), resp.size());
+  }
+
   void HandlePull(Task& t) {
     // Ring ownership gate: a pull for a moved key redirects like a push
     // — the published `out` buffer migrated with the state, so the new
@@ -4979,6 +5513,16 @@ class Server {
       return;
     }
     KeyState& ks = StateFor(t.key);
+    if (t.dtype == kSparseRead) {
+      // Ungated inference read: serves whatever the table holds RIGHT
+      // NOW — no round gate, no parking, no round-state mutation at
+      // all, so a pull-only session can never stall (or be stalled by)
+      // round completion.  Readers order themselves by the returned
+      // param_version, which is monotone per key.
+      RespondSparse(t.conn, t.req_id, t.key, ks, t.payload.data(),
+                    t.payload.size());
+      return;
+    }
     // t.flags = the round (mod 2^15, low bits of the u16; bit 15 is the
     // trace marker) the worker just pushed; its result is ready once that
     // round has been published.  The 15-bit compare aliases only if a
@@ -5002,7 +5546,10 @@ class Server {
     bool ready = async_ || !RoundMatch(t.flags, ks.completed_round);
     if (ready) {
       const int64_t t0 = traced ? NowUs() : 0;
-      if (audited)
+      if (t.dtype == kSparseRows)
+        RespondSparse(t.conn, t.req_id, t.key, ks, t.payload.data(),
+                      t.payload.size());
+      else if (audited)
         RespondAudited(t.conn, t.req_id, t.key, ks);
       else
         Respond(t.conn, kOk, t.req_id, t.key, ks.out.data(),
@@ -5014,6 +5561,11 @@ class Server {
       AddRef(t.conn);   // the stash outlives the task's own hold
       ks.pending.push_back({t.conn, t.req_id, t.key, t.flags,
                             t.worker_id, traced, audited});
+      if (t.dtype == kSparseRows)
+        // Round-gated sparse pull: park the request (header + index
+        // stream) so FlushPulls can serve the rows once the wanted
+        // round publishes.
+        ks.pending.back().sparse = std::move(t.payload);
       StatPendingPulls(t.key, 1);
     }
   }
@@ -5024,7 +5576,10 @@ class Server {
     for (auto& p : ks.pending) {
       if (async_ || !RoundMatch(p.want_round, ks.completed_round)) {
         const int64_t t0 = p.traced ? NowUs() : 0;
-        if (p.audited)
+        if (!p.sparse.empty())
+          RespondSparse(p.conn, p.req_id, key, ks, p.sparse.data(),
+                        p.sparse.size());
+        else if (p.audited)
           RespondAudited(p.conn, p.req_id, key, ks);
         else
           Respond(p.conn, kOk, p.req_id, key, ks.out.data(),
@@ -5151,6 +5706,12 @@ class Server {
   std::atomic<uint64_t> opt_seeds_{0};
   std::atomic<uint64_t> opt_updates_{0};
   std::atomic<uint64_t> opt_slot_bytes_{0};
+  // Row-sparse embedding plane: total rows served by sparse pulls/reads
+  // and the summed DECLARED table footprint (rows * width * 4) across
+  // this server's embed keys — the CMD_STATS "embed_rows_served" /
+  // "embed_table_bytes" fields feeding bps_embed_* telemetry.
+  std::atomic<uint64_t> embed_rows_served_{0};
+  std::atomic<uint64_t> embed_table_bytes_{0};
   std::mutex peer_mu_;
   std::map<uint32_t, int> peer_fds_;
   std::map<uint32_t, int64_t> peer_down_until_us_;  // negative cache

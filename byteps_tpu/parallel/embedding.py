@@ -1,0 +1,175 @@
+"""Row-sparse embedding tables: the PS tier as a lookup tier.
+
+The dense planes move whole tensors, so an embedding table pays wire
+bytes proportional to its FULL size even when a step touches 0.1% of its
+rows — the canonical recsys shape (millions of rows, read-dominated
+pull traffic against sharded state, PAPER.md §1) is exactly what a
+parameter server exists for.  This module is the worker-facing face of
+the row-sparse plane (docs/sparse-embedding.md):
+
+- the table lives SERVER-side, sharded row-wise across the PS tier
+  (``shard = row % shards`` — consecutive hot rows spread instead of
+  clustering on one server), larger than any worker's memory,
+- ``push_pull`` ships ``(indices, rows)`` pairs both ways: wire bytes
+  are proportional to touched rows, never to table size, and the
+  server's row-wise CMD_OPT steps exactly the pushed rows (Adagrad/Adam
+  slots materialize row-by-row server-side — dense optimizer state
+  never exists on any worker),
+- ``lookup`` is the read path: batched row pulls against the last
+  published table state, served through the session's
+  param_version-keyed hot-row LRU cache, so unchanged hot rows cost
+  ZERO wire frames — and it works from pull-only "inference" sessions
+  that are not round members and can never stall training.
+
+Every shard is one wire key, so the ring places, drains, and migrates
+embedding shards with the same laws as any other key (the embed
+trailer on CMD_MIGRATE carries merge state, published rows, and
+per-row step counts byte-equal).
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional
+
+import numpy as np
+
+from ..core.native import get_core
+
+
+class EmbeddingTable:
+    """A server-resident ``rows x width`` f32 embedding table.
+
+    Usage::
+
+        table = EmbeddingTable(session, rows=10_000_000, width=64,
+                               name="user_emb",
+                               opt_kwargs={"opt": "adagrad", "lr": 0.01},
+                               init=init_fn)
+        for batch in data:
+            emb = table.lookup(batch.ids)           # batched, cached
+            grads = grad_fn(emb, batch)
+            table.push_pull(batch.ids, grads)       # sparse round step
+
+    ``opt_kwargs`` arms the row-wise server-resident optimizer (same
+    surface as :class:`~byteps_tpu.parallel.server_opt.ServerOptTrainer`:
+    ``{"opt": "adagrad", "lr": ...}`` / adam / momentum / sgd);
+    ``init`` seeds the initial rows — either a full ``(rows, width)``
+    array or a callable ``init(shard_rows, width, shard_idx)`` so a
+    10M-row table never materializes whole on the worker.  Without
+    ``opt_kwargs`` the table publishes per-round gradient SUMS (the
+    dense unarmed semantics) — useful for tests, not for serving.
+
+    A pull-only session builds the same table (same name / shards /
+    shape — declaration is idempotent) and uses ``lookup`` only.
+    """
+
+    def __init__(self, session, rows: int, width: int,
+                 name: str = "embedding",
+                 shards: Optional[int] = None,
+                 opt_kwargs: Optional[dict] = None,
+                 init: Any = None):
+        if rows <= 0 or width <= 0:
+            raise ValueError(f"embedding shape must be positive, got "
+                             f"{rows}x{width}")
+        self._session = session
+        self.rows, self.width = int(rows), int(width)
+        self.name = name
+        nsrv = max(1, len(getattr(session, "conns", [])) or 1)
+        self.shards = max(1, min(int(shards) if shards else nsrv,
+                                 self.rows))
+        core = get_core()
+        self._keys: List[int] = []
+        self._shard_rows: List[int] = []
+        for s in range(self.shards):
+            key = core.declare_tensor(f"Embed.{name}.{s}")
+            # Shard s holds global rows {r : r % shards == s} at local
+            # index r // shards: ceil((rows - s) / shards) of them.
+            srows = (self.rows - s + self.shards - 1) // self.shards
+            session.declare_embedding(key, srows, self.width)
+            self._keys.append(key)
+            self._shard_rows.append(srows)
+        if opt_kwargs:
+            if getattr(session, "pull_only", False):
+                raise RuntimeError(
+                    "a pull-only session cannot arm the optimizer "
+                    "(it is a reader); arm from a trainer session")
+            for s, key in enumerate(self._keys):
+                seed = self._shard_init(init, s)
+                session.arm_embedding(key, dict(opt_kwargs), table=seed)
+
+    def _shard_init(self, init: Any, s: int) -> Optional[np.ndarray]:
+        if init is None:
+            return None
+        if callable(init):
+            t = np.asarray(init(self._shard_rows[s], self.width, s),
+                           dtype=np.float32)
+        else:
+            full = np.asarray(init, dtype=np.float32)
+            if full.shape != (self.rows, self.width):
+                raise ValueError(f"init shape {full.shape} != "
+                                 f"{(self.rows, self.width)}")
+            t = full[s::self.shards]
+        if t.shape != (self._shard_rows[s], self.width):
+            raise ValueError(f"shard {s} init shape {t.shape} != "
+                             f"{(self._shard_rows[s], self.width)}")
+        return t
+
+    def _split(self, indices):
+        idx = np.ascontiguousarray(np.asarray(indices).ravel(),
+                                   dtype=np.int64)
+        if idx.size and (idx.min() < 0 or idx.max() >= self.rows):
+            raise IndexError(f"row index out of range for {self.rows}"
+                             f"-row table")
+        shard = idx % self.shards
+        local = (idx // self.shards).astype(np.uint32)
+        return idx, shard, local
+
+    def push_pull(self, indices, grads) -> np.ndarray:
+        """One sparse training step: merge this worker's ``(indices,
+        grads)`` into the open round of EVERY shard (an untouched shard
+        receives an EMPTY sparse push — presence without rows — so
+        round completion never waits on a shard this batch missed),
+        wait for the publishes, and return the post-publish rows for
+        ``indices`` in caller order (post-optimizer parameters when
+        armed, per-round sums otherwise).  Duplicate indices accumulate
+        on the push and receive identical rows on the pull."""
+        idx, shard, local = self._split(indices)
+        g = np.ascontiguousarray(np.asarray(grads, dtype=np.float32))
+        g = g.reshape(idx.size, self.width)
+        out = np.empty((idx.size, self.width), dtype=np.float32)
+        for s, key in enumerate(self._keys):
+            mask = shard == s
+            got = self._session.push_pull_sparse(key, local[mask],
+                                                 g[mask])
+            out[mask] = got
+        return out
+
+    def lookup(self, indices) -> np.ndarray:
+        """Batched row read against the last PUBLISHED table state (the
+        recsys serving path): ungated on the wire, cached hot rows cost
+        zero frames, and shards no requested row lands on are not
+        contacted at all.  Works from pull-only sessions."""
+        idx, shard, local = self._split(indices)
+        out = np.empty((idx.size, self.width), dtype=np.float32)
+        for s, key in enumerate(self._keys):
+            mask = shard == s
+            if not mask.any():
+                continue
+            out[mask] = self._session.pull_rows(key, local[mask])
+        return out
+
+    @property
+    def keys(self) -> List[int]:
+        """Declared key per shard (for stats/doctor cross-reference)."""
+        return list(self._keys)
+
+    @property
+    def table_bytes(self) -> int:
+        """Declared f32 bytes resident across the PS tier."""
+        return self.rows * self.width * 4
+
+    def versions(self) -> List[Optional[int]]:
+        """Last observed param_version per shard (None = never read).
+        Monotone non-decreasing per shard — what pull-only readers
+        assert across a ring drain."""
+        return [self._session.embed_version(k) for k in self._keys]

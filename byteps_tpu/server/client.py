@@ -56,12 +56,13 @@ TPU-host redesign of that data path:
 
 from __future__ import annotations
 
+import os
 import random
 import socket
 import struct
 import threading
 import time
-from collections import deque
+from collections import OrderedDict, deque
 from typing import Callable, Dict, List, Optional, Tuple
 
 import numpy as np
@@ -104,6 +105,18 @@ STATUS_OK, STATUS_ERROR, STATUS_MOVED, STATUS_CODEC_STALE, \
 
 # dtype byte on the wire (server.cc WireDtype)
 DT_F32, DT_RAW, DT_COMPRESSED, DT_SEED = 0, 1, 2, 3
+# Row-sparse embedding plane (server.cc kSparseRows / kSparseRead):
+# DT_SPARSE rides the round plane — a push merges (indices, rows) into
+# the key's embed_merge and counts toward round completion; a pull with
+# it parks until the round publishes.  DT_SPARSE_READ is the ungated
+# inference read: served immediately from the last published table,
+# never touching round state — what pull-only sessions use.
+DT_SPARSE, DT_SPARSE_READ = 4, 5
+
+# HELLO flags bit 0 (server.cc kHello observer gate): a pull-only
+# session introduces itself WITHOUT being admitted to the worker
+# membership, so a reader can never stall round completion.
+HELLO_FLAG_OBSERVER = 1
 
 # Request dtype marker on PULL frames (server.cc kAuditPullMark): "append
 # the 24-byte audit trailer to the response payload".  Sent ONLY once the
@@ -1220,9 +1233,17 @@ class PSSession:
                  audit: bool = False,
                  audit_window: int = 16,
                  health_sample_rounds: int = 0,
-                 slice_size: int = 1):
+                 slice_size: int = 1,
+                 pull_only: bool = False):
         self.worker_id = worker_id
         self.num_servers = max(1, num_servers)
+        # Pull-only "inference" session (docs/sparse-embedding.md): the
+        # HELLO carries the observer flag, so the servers never admit
+        # this worker_id to the round membership — a reader that never
+        # pushes cannot stall round completion, and its embedding reads
+        # ride the ungated DT_SPARSE_READ plane.  Pushes from a
+        # pull-only session are a caller bug and raise locally.
+        self.pull_only = bool(pull_only)
         # Hierarchical reduction (parallel/hierarchy.py;
         # BYTEPS_TPU_SLICE_SIZE): chips per slice for leader election.
         # 1 (default) = flat mode — every worker is its own slice and
@@ -1534,6 +1555,33 @@ class PSSession:
             "bps_dispatch_queue_depth",
             help="partitions waiting in the priority scheduler",
             fn=self._queue_depth_fn)
+        # Row-sparse embedding plane (docs/sparse-embedding.md): per
+        # declared key the (rows, width) shape, the accumulating-round
+        # counter, and the param_version-keyed hot-row LRU cache.  A
+        # cached row serves WITHOUT a wire frame iff the key's last-seen
+        # param_version is still fresh (refreshed by any embed response
+        # within BYTEPS_TPU_SPARSE_CACHE_TTL_MS) — a version advance
+        # invalidates the whole key's cache, never serves stale rows.
+        self._embed_lock = threading.Lock()
+        self._embed_meta: Dict[int, Tuple[int, int]] = {}
+        self._embed_cache: Dict[int, OrderedDict] = {}
+        self._embed_ver: Dict[int, int] = {}
+        self._embed_ver_ts: Dict[int, float] = {}
+        self._embed_cache_rows = max(
+            0, int(os.environ.get("BYTEPS_TPU_SPARSE_CACHE_ROWS",
+                                  "65536")))
+        self._embed_cache_ttl = max(
+            0.0, float(os.environ.get("BYTEPS_TPU_SPARSE_CACHE_TTL_MS",
+                                      "50"))) / 1000.0
+        self._m_embed_hits = reg.counter(
+            "bps_embed_cache_hits",
+            help="embedding rows served from the hot-row cache (no wire)")
+        self._m_embed_misses = reg.counter(
+            "bps_embed_cache_misses",
+            help="embedding rows that had to be pulled over the wire")
+        self._m_embed_pull_bytes = reg.counter(
+            "bps_embed_pull_bytes_total",
+            help="wire bytes moved by embedding row pulls (both legs)")
         # Auditor state: this worker's last-K (round, digest, epoch, n)
         # window per partition key — what audit_check() compares against
         # the server's CMD_AUDIT window — plus the armed-wire flag (set
@@ -1642,8 +1690,10 @@ class PSSession:
         # All servers must agree — a mixed fleet silently corrupts training
         # (partitions on a sync server would round-SUM async deltas).
         modes = []
+        hello_flags = HELLO_FLAG_OBSERVER if self.pull_only else 0
         for c in self.conns:
-            mode = c.request(CMD_HELLO, worker_id=worker_id)
+            mode = c.request(CMD_HELLO, worker_id=worker_id,
+                             flags=hello_flags)
             modes.append((bool(mode[0]), bool(mode[1]))
                          if len(mode) >= 2 else (False, False))
         if len(set(modes)) > 1:
@@ -3398,7 +3448,9 @@ class PSSession:
             with self._clock_lock:
                 self._clock_offsets.pop(conn_srv, None)
         try:
-            mode = conn.request(CMD_HELLO, worker_id=self.worker_id)
+            mode = conn.request(
+                CMD_HELLO, worker_id=self.worker_id,
+                flags=HELLO_FLAG_OBSERVER if self.pull_only else 0)
             modes = ((bool(mode[0]), bool(mode[1]))
                      if len(mode) >= 2 else (False, False))
             if modes != (self.server_async, self.server_schedule):
@@ -3717,7 +3769,8 @@ class PSSession:
         for c in self.conns:
             try:
                 c.request(CMD_HELLO, worker_id=self.worker_id,
-                          timeout=10.0)
+                          flags=HELLO_FLAG_OBSERVER if self.pull_only
+                          else 0, timeout=10.0)
             except (ConnectionError, OSError, RuntimeError) as e:
                 get_logger().warning("re-admission HELLO to %s:%d "
                                      "failed: %s", c.host, c.port, e)
@@ -4423,6 +4476,7 @@ class PSSession:
                   "members": {}, "ring_epoch": 0, "servers": {},
                   "codec_sets": 0, "codec_stale_frames": 0,
                   "opt_sets": 0, "opt_updates": 0, "opt_slot_bytes": 0,
+                  "embed_rows_served": 0, "embed_table_bytes": 0,
                   "slice_size": 1}
         import json as _json
         for slot, c in enumerate(self.conns):
@@ -4500,6 +4554,13 @@ class PSSession:
             merged["opt_slot_bytes"] += int(st.get("opt_slot_bytes", 0))
             merged["servers"][row_id]["opt_slot_bytes"] = int(
                 st.get("opt_slot_bytes", 0))
+            # Row-sparse embedding plane (old servers omit both).
+            merged["embed_rows_served"] += int(
+                st.get("embed_rows_served", 0))
+            merged["embed_table_bytes"] += int(
+                st.get("embed_table_bytes", 0))
+            merged["servers"][row_id]["embed_table_bytes"] = int(
+                st.get("embed_table_bytes", 0))
             for w, rec in (st.get("members") or {}).items():
                 _merge_member_rec(merged["members"], int(w), rec)
             for k, v in (st.get("keys") or {}).items():
@@ -5357,6 +5418,286 @@ class PSSession:
                 pool.submit(priority, pkey,
                             lambda part=part, seg=seg:
                                 self._encode_part(part, comp, seg))
+
+    # -- row-sparse embedding plane (docs/sparse-embedding.md) ----------
+    #
+    # Embedding keys bypass the partitioned dispatcher entirely: a table
+    # is ONE wire key (part 0) on ONE server, its payloads are
+    # (indices, rows) pairs — wire bytes proportional to touched rows,
+    # never to table size — and its pulls are batched row lookups.  The
+    # ring still places the key, MOVED still redirects it, and a
+    # reconnecting conn still replays it, all through the same retry
+    # laws the dense path uses; it just never pays partition planning,
+    # fusion, or codec staging built for dense trees.
+
+    def _embed_pkey(self, key: int) -> int:
+        return get_core().encode_key(key, 0)
+
+    def _embed_srv(self, pkey: int) -> int:
+        if self._ring is not None:
+            with self._ring_lock:
+                return self._srv_slot[self._ring.owner(pkey)]
+        return get_core().key_to_server(pkey, len(self.conns),
+                                        self.hash_fn)
+
+    def _embed_request(self, cmd: int, pkey: int, payload: bytes,
+                       dtype: int = 0, flags: int = 0,
+                       timeout: float = 60.0) -> bytes:
+        """One blocking embed-plane round trip that survives the same
+        faults the dense path does: MOVED adopts the attached ring table
+        and re-routes to the new owner (state-before-redirect is the
+        server's contract, so a ring drain mid-request is invisible
+        beyond latency), and a reconnecting conn's `_ConnLost` rides out
+        the re-dial.  Both replays are safe by construction — pushes
+        dedup on the server's per-round `seen` set, reads and INIT are
+        idempotent."""
+        deadline = time.monotonic() + max(0.1, timeout)
+        while True:
+            conn = self.conns[self._embed_srv(pkey)]
+            try:
+                return conn.request(
+                    cmd, pkey, payload, worker_id=self.worker_id,
+                    dtype=dtype, flags=flags,
+                    timeout=max(0.1, deadline - time.monotonic()))
+            except _KeyMoved as e:
+                if time.monotonic() > deadline:
+                    raise
+                self._safe_adopt_ring(e.doc)
+                with self._transport_lock:
+                    self._tstats["ring_redirects"] += 1
+            except _ConnLost as e:
+                if not e.will_reconnect or time.monotonic() > deadline:
+                    raise
+                time.sleep(0.05)
+
+    def declare_embedding(self, key: int, rows: int, width: int,
+                          kwargs: str = "", timeout: float = 60.0) -> None:
+        """Declare a server-resident embedding table of ``rows`` x
+        ``width`` f32 under declared key ``key`` (idempotent: a same-
+        shape re-declare preserves server state, so reconnects and extra
+        sessions are safe).  ``kwargs`` rides the INIT kwargs string —
+        the same surface CMD_OPT arming uses (e.g. ``opt=adagrad,
+        lr=0.01``)."""
+        rows, width = int(rows), int(width)
+        if rows <= 0 or width <= 0:
+            raise ValueError("embedding shape must be positive, got "
+                             f"{rows}x{width}")
+        kw = f"embed_rows={rows},embed_width={width}"
+        if kwargs:
+            kw += "," + kwargs
+        kw_bytes = kw.encode()
+        pkey = self._embed_pkey(key)
+        resp = self._embed_request(
+            CMD_INIT, pkey,
+            struct.pack("<QI", 0, len(kw_bytes)) + kw_bytes,
+            timeout=timeout)
+        # Seed the accumulating round from server state, exactly like
+        # the dense _init_parts law — a re-declaring session can never
+        # push into (or pull from) a stale round.
+        (completed,) = struct.unpack("<Q", resp)
+        self._round[pkey] = completed
+        # Register with the control planes the dense path feeds from
+        # _init_parts/_plan: _inited makes propose_opt()'s pkey
+        # enumeration see the table, _pkey_srv routes its CMD_OPT frames
+        # (refreshed by the MOVED handler like any other key's).
+        self._inited[pkey] = (0, kw_bytes)
+        self._pkey_srv[pkey] = self._embed_srv(pkey)
+        with self._embed_lock:
+            self._embed_meta[key] = (rows, width)
+            self._embed_cache.pop(key, None)
+            self._embed_ver.pop(key, None)
+            self._embed_ver_ts.pop(key, None)
+
+    def arm_embedding(self, key: int, kwargs, table=None,
+                      effective_round: int = 0) -> dict:
+        """Arm the row-wise server-resident optimizer on an embedding
+        key: CMD_OPT SET (the dense propose_opt law — epoch-versioned,
+        idempotent, applied at a round boundary) plus an optional
+        full-table initial-parameter seed.  From the effective round on,
+        publishes serve post-update PARAMETER rows and optimizer slots
+        materialize row-by-row, only for pushed rows — dense optimizer
+        state never exists on any worker."""
+        rows, width = self._embed_shape(key)
+        doc = self.propose_opt(key, kwargs,
+                               effective_round=effective_round)
+        if table is not None:
+            t = np.ascontiguousarray(np.asarray(table, dtype=np.float32))
+            if t.shape != (rows, width):
+                raise ValueError(f"seed table shape {t.shape} != "
+                                 f"declared {(rows, width)}")
+            # Full-table seed in ONE frame to the one owner — the dense
+            # seed_params() partitioner must not split an embed key.
+            self._embed_request(CMD_OPT, self._embed_pkey(key),
+                                t.tobytes(), flags=2)
+        return doc
+
+    def _embed_shape(self, key: int) -> Tuple[int, int]:
+        with self._embed_lock:
+            meta = self._embed_meta.get(key)
+        if meta is None:
+            raise KeyError(f"embedding key {key} not declared "
+                           "(call declare_embedding first)")
+        return meta
+
+    @staticmethod
+    def _embed_coalesce(indices, rows, width: int):
+        """Sort + dedup the caller's (indices, rows) pair into the wire
+        form: unique ascending u32 indices with duplicate rows SUMMED
+        (gradient semantics — two touches of one row in a batch are one
+        accumulated update).  Returns (uniq, acc, inverse)."""
+        idx = np.ascontiguousarray(np.asarray(indices).ravel(),
+                                   dtype=np.uint32)
+        uniq, inv = np.unique(idx, return_inverse=True)
+        if rows is None:
+            return uniq, None, inv
+        dense = np.ascontiguousarray(
+            np.asarray(rows, dtype=np.float32)).reshape(idx.size, width)
+        if uniq.size == idx.size:
+            acc = dense[np.argsort(idx, kind="stable")]
+        else:
+            acc = np.zeros((uniq.size, width), dtype=np.float32)
+            np.add.at(acc, inv, dense)
+        return uniq, acc, inv
+
+    def push_pull_sparse(self, key: int, indices, rows,
+                         timeout: float = 60.0) -> np.ndarray:
+        """Row-sparse push_pull: merge this worker's (indices, rows)
+        gradient into the server-resident table's accumulating round,
+        wait for the round to publish (every member pushed; the server
+        runs the row-wise optimizer step on exactly the touched rows),
+        and return the published rows for the SAME indices, aligned to
+        the caller's index order.  Wire bytes are proportional to
+        touched rows on both legs — never to table size."""
+        if self.pull_only:
+            raise RuntimeError("pull-only session cannot push_pull_sparse"
+                               " (it is not a round member); use "
+                               "pull_rows")
+        trows, width = self._embed_shape(key)
+        uniq, acc, inv = self._embed_coalesce(indices, rows, width)
+        if uniq.size and int(uniq[-1]) >= trows:
+            raise IndexError(f"row index {int(uniq[-1])} out of range "
+                             f"for embedding of {trows} rows")
+        from .wire import (decode_sparse_response, encode_sparse_block)
+        pkey = self._embed_pkey(key)
+        rnd = self._round.get(pkey, 0)
+        flags = rnd & ROUND_MASK
+        push = encode_sparse_block(uniq, acc, width)
+        self._embed_request(CMD_PUSH, pkey, push, dtype=DT_SPARSE,
+                            flags=flags, timeout=timeout)
+        # Round-gated pull: same round tag, parks server-side until the
+        # round publishes, then serves the optimizer-stepped (armed) or
+        # merged-sum (unarmed) rows.
+        req = encode_sparse_block(uniq, None, width)
+        resp = self._embed_request(CMD_PULL, pkey, req, dtype=DT_SPARSE,
+                                   flags=flags, timeout=timeout)
+        ver, out = decode_sparse_response(resp, uniq.size, width)
+        self._round[pkey] = rnd + 1
+        self._m_embed_pull_bytes.inc(len(req) + len(resp))
+        self._embed_note_version(key, ver, uniq, out)
+        return out[inv]
+
+    def pull_rows(self, key: int, indices,
+                  timeout: float = 60.0) -> np.ndarray:
+        """Batched row lookup against the last PUBLISHED table state —
+        the read path recsys serving wants.  Ungated on the wire
+        (DT_SPARSE_READ): served immediately from the server's published
+        rows, never parking on a round and never touching round state,
+        so a pull-only session can hammer it freely.
+
+        Hot rows are served from the param_version-keyed LRU cache: when
+        every requested row is cached at the key's last-seen version and
+        that version is still fresh (refreshed by any embed response
+        within BYTEPS_TPU_SPARSE_CACHE_TTL_MS), the lookup completes
+        with ZERO wire frames.  Misses are coalesced into batched wire
+        units (fusion.plan_row_batches) capped at partition_bytes."""
+        trows, width = self._embed_shape(key)
+        uniq, _, inv = self._embed_coalesce(indices, None, width)
+        if uniq.size and int(uniq[-1]) >= trows:
+            raise IndexError(f"row index {int(uniq[-1])} out of range "
+                             f"for embedding of {trows} rows")
+        out = np.empty((uniq.size, width), dtype=np.float32)
+        missing: List[int] = []
+        hits = 0
+        now = time.monotonic()
+        with self._embed_lock:
+            cache = self._embed_cache.get(key)
+            fresh = (cache is not None
+                     and key in self._embed_ver
+                     and self._embed_cache_ttl > 0
+                     and now - self._embed_ver_ts.get(key, 0.0)
+                     <= self._embed_cache_ttl)
+            for j in range(uniq.size):
+                r = int(uniq[j])
+                row = cache.get(r) if fresh else None
+                if row is None:
+                    missing.append(j)
+                else:
+                    out[j] = row
+                    cache.move_to_end(r)
+                    hits += 1
+        if hits:
+            self._m_embed_hits.inc(hits)
+        if not missing:
+            return out[inv]     # warm path: zero wire frames
+        self._m_embed_misses.inc(len(missing))
+        from ..common.fusion import plan_row_batches
+        from .wire import (decode_sparse_response, encode_sparse_block)
+        pkey = self._embed_pkey(key)
+        miss = np.asarray(missing, dtype=np.int64)
+        miss_idx = uniq[miss]
+        for start, stop in plan_row_batches(miss_idx.size, width,
+                                            self.partition_bytes):
+            sub = miss_idx[start:stop]
+            req = encode_sparse_block(sub, None, width)
+            resp = self._embed_request(CMD_PULL, pkey, req,
+                                       dtype=DT_SPARSE_READ,
+                                       timeout=timeout)
+            ver, got = decode_sparse_response(resp, sub.size, width)
+            self._m_embed_pull_bytes.inc(len(req) + len(resp))
+            out[miss[start:stop]] = got
+            self._embed_note_version(key, ver, sub, got)
+        return out[inv]
+
+    def embed_version(self, key: int) -> Optional[int]:
+        """Last param_version observed for ``key`` (None before any
+        embed response) — what pull-only readers assert monotone."""
+        with self._embed_lock:
+            return self._embed_ver.get(key)
+
+    def embed_cache_stats(self) -> dict:
+        """Hot-row cache counters + occupancy (for bps_top / tests)."""
+        with self._embed_lock:
+            held = sum(len(c) for c in self._embed_cache.values())
+        return {"hits": self._m_embed_hits.value(),
+                "misses": self._m_embed_misses.value(),
+                "rows_cached": held,
+                "capacity_rows": self._embed_cache_rows}
+
+    def _embed_note_version(self, key: int, ver: int, uniq,
+                            got) -> None:
+        """Fold one embed response into the hot-row cache under the
+        invalidation law: a param_version ADVANCE drops every cached row
+        of the key (they are rows of a superseded table state); matching
+        versions insert/refresh.  Any response refreshes the freshness
+        clock — the TTL bounds how long a version is trusted without
+        hearing from the server."""
+        if self._embed_cache_rows <= 0:
+            with self._embed_lock:
+                self._embed_ver[key] = int(ver)
+                self._embed_ver_ts[key] = time.monotonic()
+            return
+        with self._embed_lock:
+            if self._embed_ver.get(key) != int(ver):
+                self._embed_cache[key] = OrderedDict()
+                self._embed_ver[key] = int(ver)
+            self._embed_ver_ts[key] = time.monotonic()
+            cache = self._embed_cache.setdefault(key, OrderedDict())
+            for j in range(len(uniq)):
+                r = int(uniq[j])
+                cache[r] = np.array(got[j], dtype=np.float32, copy=True)
+                cache.move_to_end(r)
+            while len(cache) > self._embed_cache_rows:
+                cache.popitem(last=False)
 
     def push_pull(self, key: int, tensor, priority: int = 0,
                   **kw) -> np.ndarray:

@@ -792,3 +792,110 @@ def _decode_py(data: bytes, n: int) -> np.ndarray:
         sign = 1.0 - 2.0 * signs.astype(np.float32)
         return (sign * mag * norm).astype(np.float32)
     raise ValueError(f"unknown comp_id {comp}")
+
+
+# ---------------------------------------------------------------------------
+# Row-sparse embedding wire format (WireDtype kSparseRows / kSparseRead).
+#
+# Block header, little-endian, 16 bytes (C++ SparseHdr):
+#     u32 nrows | u32 width | u8 codec | u8 pad | u16 pad | u32 idx_bytes
+# codec 0 = raw u32 LE indices; codec 1 = elias-delta over the gaps of
+# the SORTED UNIQUE index list (first code = idx[0]+1, then
+# idx[i]-idx[i-1]; every code >= 1), bit-matched to the dithering
+# codec's elias stream (LSB-first within bytes, MSB-of-code-first).
+#
+# Push payload   = header | index stream | nrows*width f32 rows (in
+#                  index order).
+# Pull request   = header | index stream (width pinned so the server can
+#                  cross-check the declared table).
+# Pull response  = u64 param_version | nrows*width f32 rows in REQUEST
+#                  order.
+# ---------------------------------------------------------------------------
+
+SPARSE_HDR = struct.Struct("<IIBBHI")
+SPARSE_CODEC_RAW = 0
+SPARSE_CODEC_ELIAS = 1
+
+
+def encode_sparse_indices(idx: np.ndarray) -> Tuple[int, bytes]:
+    """Encode a SORTED UNIQUE u32 index vector -> (codec, bytes).
+
+    Picks elias-delta when it is strictly smaller than raw u32 — a
+    deterministic rule, so identical index sets always produce identical
+    wire bytes (the byte-identity tests depend on it)."""
+    idx = np.ascontiguousarray(idx, dtype=np.uint32)
+    if idx.size == 0:
+        return SPARSE_CODEC_RAW, b""
+    gaps = np.empty(idx.size, np.int64)
+    gaps[0] = int(idx[0]) + 1
+    gaps[1:] = np.diff(idx.astype(np.int64))
+    if np.any(gaps[1:] <= 0):
+        raise ValueError("sparse indices must be sorted and unique")
+    codes, lengths = _elias_delta_codes(gaps)
+    stream, _ = _emit_bitstream(codes, lengths)
+    if stream.nbytes < idx.nbytes:
+        return SPARSE_CODEC_ELIAS, stream.tobytes()
+    return SPARSE_CODEC_RAW, idx.tobytes()
+
+
+def decode_sparse_indices(codec: int, data: bytes, nrows: int) -> np.ndarray:
+    """Inverse of encode_sparse_indices (reference decoder; the C++
+    server's DecodeSparseIndices is the production path)."""
+    if codec == SPARSE_CODEC_RAW:
+        if len(data) < 4 * nrows:
+            raise ValueError("truncated raw index stream")
+        return np.frombuffer(data[:4 * nrows], np.uint32).copy()
+    if codec != SPARSE_CODEC_ELIAS:
+        raise ValueError(f"unknown sparse index codec {codec}")
+    cur = _BitCursor(np.frombuffer(data, np.uint8), len(data) * 8)
+    out = np.empty(nrows, np.uint32)
+    pos = -1
+    for i in range(nrows):
+        pos += cur.elias_delta()
+        out[i] = pos
+    return out
+
+
+def encode_sparse_block(idx: np.ndarray, rows: Optional[np.ndarray],
+                        width: int) -> bytes:
+    """Header + index stream (+ f32 rows when `rows` is given — the push
+    form; None gives the pull-request form)."""
+    idx = np.ascontiguousarray(idx, dtype=np.uint32)
+    codec, istream = encode_sparse_indices(idx)
+    hdr = SPARSE_HDR.pack(idx.size, width, codec, 0, 0, len(istream))
+    if rows is None:
+        return hdr + istream
+    rows = np.ascontiguousarray(rows, dtype=np.float32)
+    if rows.size != idx.size * width:
+        raise ValueError(
+            f"rows {rows.size} != nrows {idx.size} * width {width}")
+    return hdr + istream + rows.tobytes()
+
+
+def decode_sparse_block(payload) -> Tuple[np.ndarray, Optional[np.ndarray]]:
+    """Inverse of encode_sparse_block: -> (indices, rows-or-None)."""
+    buf = bytes(payload)
+    nrows, width, codec, _, _, ibytes = SPARSE_HDR.unpack_from(buf, 0)
+    idx = decode_sparse_indices(codec, buf[16:16 + ibytes], nrows)
+    body = buf[16 + ibytes:]
+    if not body:
+        return idx, None
+    want = nrows * width * 4
+    if len(body) < want:
+        raise ValueError("truncated sparse row payload")
+    rows = np.frombuffer(body[:want], np.float32).reshape(nrows, width)
+    return idx, rows.copy()
+
+
+def decode_sparse_response(payload, nrows: int,
+                           width: int) -> Tuple[int, np.ndarray]:
+    """Pull/read response -> (param_version, rows [nrows, width] f32)."""
+    buf = memoryview(payload)
+    if len(buf) < 8 + nrows * width * 4:
+        raise ValueError(
+            f"sparse response {len(buf)}B < {8 + nrows * width * 4}B "
+            f"({nrows} rows x {width})")
+    (version,) = struct.unpack_from("<Q", buf, 0)
+    rows = np.frombuffer(buf[8:8 + nrows * width * 4],
+                         np.float32).reshape(nrows, width).copy()
+    return version, rows

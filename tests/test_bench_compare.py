@@ -114,6 +114,42 @@ def test_knob_family_direction():
     assert bench_compare.check(recs)["regressions"] == []
 
 
+def test_sparse_family_direction():
+    """BENCH_SPARSE records (ISSUE 17): rows/s served and cache hit
+    rate are HIGHER-is-better (including the "rows_per_s" unit, which
+    ends in "_s" and would otherwise read as a latency), and the
+    percentile-tail family (p50_/p90_/p95_/p99_ prefixes) is
+    lower-is-better whatever the name's suffix spells."""
+    for metric, unit in [
+        ("sparse_lookup_rows_per_s", "rows_per_s"),
+        ("embed_cache_hit_rate", "ratio"),
+        ("serving_hit_rate", ""),               # suffix alone decides
+    ]:
+        assert not bench_compare._lower_is_better(metric, unit), \
+            (metric, unit)
+    for metric, unit in [
+        ("p99_pull_ms", "ms"),
+        ("p99_pull", ""),                       # prefix alone decides
+        ("p95_lookup_tail", ""),
+        ("p50_round_ms", "cpu_fallback_ms"),
+    ]:
+        assert bench_compare._lower_is_better(metric, unit), (metric, unit)
+
+    # End to end: rows/s falling 1M -> 0.5M is the regression (not a
+    # "latency improvement")...
+    recs = [R(1, "sparse_lookup_rows_per_s", 1e6, unit="rows_per_s"),
+            R(2, "sparse_lookup_rows_per_s", 5e5, unit="rows_per_s")]
+    rep = bench_compare.check(recs, threshold=0.10)
+    assert len(rep["regressions"]) == 1
+    assert rep["groups"][0]["direction"] == "higher"
+    # ...and a p99 tail growing 25% flags even with a bare name.
+    recs = [R(1, "p99_pull", 2.0, unit=""),
+            R(2, "p99_pull", 2.5, unit="")]
+    rep = bench_compare.check(recs, threshold=0.10)
+    assert len(rep["regressions"]) == 1
+    assert rep["groups"][0]["direction"] == "lower"
+
+
 def test_throughput_units_are_higher_is_better():
     """The unit-direction law (ISSUE 15 satellite): *_mbps / *_goodput /
     throughput-ish units are explicitly HIGHER-is-better — including

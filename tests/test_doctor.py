@@ -303,6 +303,47 @@ def test_param_version_stall_boundary():
     assert "param_version_stall" not in rules_fired(off)
 
 
+def test_embedding_cache_thrash_boundary():
+    """Row-sparse lookup tier (ISSUE 17): fires when the hot-row cache
+    hit rate sits below the floor for 2 consecutive windows WHILE pull
+    bytes grow; quiet on cold/idle readers, healthy hit rates, one bad
+    window, or a low rate with no wire traffic."""
+    def em(hits, misses, pulled):
+        return {"bps_embed_cache_hits": hits,
+                "bps_embed_cache_misses": misses,
+                "bps_embed_pull_bytes_total": pulled}
+
+    # Fires: ~10% hit rate across two windows, pull bytes growing.
+    hot = [W(0, em(10, 90, 1 << 20)), W(1, em(20, 180, 2 << 20)),
+           W(2, em(30, 270, 3 << 20))]
+    assert "embedding_cache_thrash" in rules_fired(hot)
+    diag = doctor.evaluate_stream(hot)
+    f = next(x for x in diag["open"]
+             if x["rule"] == "embedding_cache_thrash")
+    assert f["subject"] == "embed-cache"
+    assert f["evidence"]["hit_rate_history"] == [0.1, 0.1]
+    assert f["playbook"].endswith("#rule-embedding_cache_thrash")
+    # One collapsed window is not thrash (threshold = 2 consecutive).
+    assert "embedding_cache_thrash" not in rules_fired(
+        [W(0, em(10, 90, 1 << 20)), W(1, em(20, 180, 2 << 20))])
+    # Healthy hit rate: quiet (zipf head absorbed client-side).
+    ok = [W(0, em(900, 100, 1 << 20)), W(1, em(1800, 200, 2 << 20)),
+          W(2, em(2700, 300, 3 << 20))]
+    assert "embedding_cache_thrash" not in rules_fired(ok)
+    # Low rate but NO pull-byte growth: not thrash (nothing pays wire).
+    flat = [W(0, em(10, 90, 1 << 20)), W(1, em(20, 180, 1 << 20)),
+            W(2, em(30, 270, 1 << 20))]
+    assert "embedding_cache_thrash" not in rules_fired(flat)
+    # Cold/idle reader below the per-window lookup floor: quiet.
+    idle = [W(0, em(1, 9, 1 << 10)), W(1, em(2, 18, 2 << 10)),
+            W(2, em(3, 27, 3 << 10))]
+    assert "embedding_cache_thrash" not in rules_fired(idle)
+    # Boundary: exactly AT the floor (25%) is not below it.
+    at = [W(0, em(25, 75, 1 << 20)), W(1, em(50, 150, 2 << 20)),
+          W(2, em(75, 225, 3 << 20))]
+    assert "embedding_cache_thrash" not in rules_fired(at)
+
+
 def test_every_rule_has_a_boundary_test():
     """The fire/no-fire coverage above must track the rule set: a new
     rule without a test here is exactly the drift this file pins."""
@@ -310,7 +351,8 @@ def test_every_rule_has_a_boundary_test():
                "lane_credit_imbalance", "recv_pool_miss_rate",
                "fusion_dilution", "server_hot_shard",
                "nonfinite_gradients", "audit_mismatch", "barrier_stall",
-               "tuner_thrash", "knob_thrash", "param_version_stall"}
+               "tuner_thrash", "knob_thrash", "param_version_stall",
+               "embedding_cache_thrash"}
     assert set(doctor.RULE_IDS) == covered
 
 

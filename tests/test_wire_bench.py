@@ -104,6 +104,39 @@ def test_wire_bench_codec_sweep_smoke(tmp_path):
 
 
 @pytest.mark.slow
+def test_wire_bench_sparse_sweep_smoke():
+    """--sparse-sweep structural smoke (ISSUE 17 satellite): every
+    (width, density) cell reports encode/decode rows/s and the
+    index-codec choice, the dense-economy ratio tracks 1/density (a
+    0.1%-touched round ships ~1000x fewer bytes than dense push_pull
+    modulo index overhead), and elias gap coding never reports a ratio
+    below raw (the encoder falls back to raw u32 when gaps don't
+    pay)."""
+    r = subprocess.run([sys.executable, _TOOL, "--sparse-sweep",
+                        "--quick", "--json"],
+                       env=cpu_env(), capture_output=True, text=True,
+                       timeout=600)
+    assert r.returncode == 0, r.stderr[-3000:]
+    doc = json.loads(r.stdout)
+    rows = doc["sparse_sweep"]
+    widths = {row["width"] for row in rows}
+    densities = {row["density"] for row in rows}
+    assert len(rows) == len(widths) * len(densities)
+    assert min(densities) <= 0.001 and max(densities) >= 0.1
+    table_rows = doc["config"]["table_rows"]
+    for row in rows:
+        assert row["encode_rows_per_s"] > 0
+        assert row["decode_rows_per_s"] > 0
+        assert row["idx_codec"] in ("raw", "elias")
+        assert row["idx_codec_ratio"] >= 1.0, row
+        assert row["nrows"] == max(1, int(table_rows * row["density"]))
+        # Wire economy vs a dense round: the f32 rows dominate the
+        # block, so the ratio lands within ~25% of 1/density (header
+        # + index stream is the only overhead).
+        assert row["dense_ratio"] > (1.0 / row["density"]) * 0.75, row
+
+
+@pytest.mark.slow
 @pytest.mark.parametrize("uds", [False, True], ids=["tcp", "uds"])
 def test_wire_bench_echo_floor_smoke(uds):
     """--echo-floor structural smoke on both transports: the bench emits

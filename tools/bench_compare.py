@@ -77,9 +77,15 @@ _HIGHER_METRIC_SUFFIXES = (
 )
 _HIGHER_UNITS = {
     "mbps", "gbps", "mb/s", "gb/s", "mb_s", "gb_s", "goodput_mbps",
-    "per_s", "per_sec", "qps", "rows_s", "tokens_s", "items_per_s",
-    "steps_per_s", "pct_of_floor", "mfu", "ratio", "x",
+    "per_s", "per_sec", "qps", "rows_s", "rows_per_s", "tokens_s",
+    "items_per_s", "steps_per_s", "pct_of_floor", "mfu", "ratio", "x",
 }
+
+# Percentile-tail names (BENCH_SPARSE p99 pull latency and friends):
+# a pNN_ prefix marks a latency-distribution tail, lower-is-better
+# whatever the suffix spells — checked after the explicit-higher rules
+# so a hypothetical "p99_*_hit_rate" still reads as a rate.
+_PCTL_PREFIXES = ("p50_", "p90_", "p95_", "p99_", "p999_")
 
 
 def _lower_is_better(metric: str, unit: str) -> bool:
@@ -90,6 +96,8 @@ def _lower_is_better(metric: str, unit: str) -> bool:
     if metric.endswith(_HIGHER_METRIC_SUFFIXES) \
             or unit.lower() in _HIGHER_UNITS:
         return False
+    if metric.startswith(_PCTL_PREFIXES):
+        return True
     if metric.endswith(("_ms", "_ns", "_s", "_seconds", "_latency")):
         return True
     # The gap family (BENCH_AUTOTUNE / BENCH_SERVEROPT / BENCH_KNOB):

@@ -330,6 +330,34 @@ def render(metrics: dict, prev: dict, dt: float,
             lines.append(f"  key {name:<24} updates {int(v):8d}")
         lines.append("")
 
+    # Row-sparse embedding plane (docs/sparse-embedding.md): rows the PS
+    # tier served, resident table bytes per server, and the worker-side
+    # hot-row cache hit rate over the last interval — a collapsing rate
+    # under growing pull bytes is the embedding_cache_thrash doctor
+    # rule in the making.
+    rows_served = _get(metrics, "bps_embed_rows_served_total")
+    tbl = {dict(k).get("server", "?"): int(v) for k, v in
+           (metrics.get("bps_embed_table_bytes") or {}).items()}
+    hits = _get(metrics, "bps_embed_cache_hits")
+    misses = _get(metrics, "bps_embed_cache_misses")
+    if rows_served or tbl or hits or misses:
+        lines.append("embedding (row-sparse lookup tier)")
+        lines.append(f"  rows served {int(rows_served):>12d}   table "
+                     f"{_fmt_bytes(sum(tbl.values()))} resident")
+        for sid in sorted(tbl):
+            lines.append(f"    server {sid:>3}  {_fmt_bytes(tbl[sid])}")
+        dh = hits - _get(prev, "bps_embed_cache_hits")
+        dm = misses - _get(prev, "bps_embed_cache_misses")
+        if dh + dm > 0:
+            rate = dh / (dh + dm)
+            bar = "#" * int(30 * rate)
+            lines.append(f"  cache hit rate {rate:7.1%}  {bar}")
+        pb = (_get(metrics, "bps_embed_pull_bytes_total")
+              - _get(prev, "bps_embed_pull_bytes_total"))
+        if pb > 0 and dt > 0:
+            lines.append(f"  pull wire {_fmt_bytes(pb / dt)}/s")
+        lines.append("")
+
     lag = metrics.get("bps_worker_round_lag") or {}
     if lag:
         epoch = int(_get(metrics, "bps_membership_epoch"))

@@ -123,6 +123,66 @@ def codec_sweep(sizes_bytes, reps: int) -> list:
     return out
 
 
+def sparse_sweep(table_rows: int, widths, densities, reps: int) -> list:
+    """Per-(width, density) row-sparse block codec table — encode/decode
+    rows/s and the index-codec ratio (``--sparse-sweep``).
+
+    The row-sparse plane ships ``(indices, rows)`` blocks
+    (wire.encode_sparse_block: 16-byte header + index stream + f32
+    rows); the index stream picks elias-delta over gaps when strictly
+    smaller than raw u32 LE.  This sweep answers the sizing questions
+    docs/sparse-embedding.md points at: how many rows/s one core can
+    frame at each embedding width, and how much the gap codec saves at
+    recsys densities (sorted-unique zipfian-ish indices, where dense
+    regions give small gaps)."""
+    out = []
+    rng = np.random.RandomState(7)
+    for width in widths:
+        for density in densities:
+            nrows = max(1, int(table_rows * density))
+            # Sorted-unique draw — the shape push_pull_sparse ships
+            # after client-side coalescing (np.unique output).
+            idx = np.unique(rng.choice(table_rows, size=nrows,
+                                       replace=False).astype(np.uint32))
+            rows = rng.randn(idx.size, width).astype(np.float32)
+            blob = wire.encode_sparse_block(idx, rows, width)   # warm
+            t0 = time.perf_counter()
+            for _ in range(reps):
+                blob = wire.encode_sparse_block(idx, rows, width)
+            enc = (time.perf_counter() - t0) / reps
+            wire.decode_sparse_block(blob)                      # warm
+            t0 = time.perf_counter()
+            for _ in range(reps):
+                wire.decode_sparse_block(blob)
+            dec = (time.perf_counter() - t0) / reps
+            codec, stream = wire.encode_sparse_indices(idx)
+            raw_idx = idx.size * 4
+            row = {
+                "width": width,
+                "density": density,
+                "nrows": int(idx.size),
+                "encode_rows_per_s": round(idx.size / enc, 1),
+                "decode_rows_per_s": round(idx.size / dec, 1),
+                "wire_bytes": len(blob),
+                "idx_codec": ("elias"
+                              if codec == wire.SPARSE_CODEC_ELIAS
+                              else "raw"),
+                "idx_codec_ratio": round(
+                    raw_idx / max(1, len(stream) or raw_idx), 3),
+                "dense_ratio": round(table_rows * width * 4
+                                     / len(blob), 1),
+            }
+            out.append(row)
+            _log(f"  w={width:5d} d={density * 100:5.1f}% "
+                 f"({idx.size:6d} rows)  "
+                 f"enc {row['encode_rows_per_s'] / 1e6:7.2f} Mrow/s  "
+                 f"dec {row['decode_rows_per_s'] / 1e6:7.2f} Mrow/s  "
+                 f"idx={row['idx_codec']:5s} "
+                 f"{row['idx_codec_ratio']:5.2f}x  "
+                 f"vs-dense {row['dense_ratio']:7.1f}x")
+    return out
+
+
 def _log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
 
@@ -596,6 +656,12 @@ def main(argv=None) -> int:
                          "throughput + ratio sweep across partition "
                          "sizes (64 KiB - 16 MiB) — the adaptive-"
                          "compression tuner's cost-model ground truth")
+    ap.add_argument("--sparse-sweep", action="store_true",
+                    help="run only the row-sparse block codec sweep: "
+                         "encode/decode rows/s and index-codec ratio "
+                         "across embedding widths 32-1024 and touched "
+                         "densities 0.1%%-10%% "
+                         "(docs/sparse-embedding.md)")
     args = ap.parse_args(argv)
 
     quick = args.quick
@@ -604,6 +670,23 @@ def main(argv=None) -> int:
     mb = args.mb if args.mb is not None else (8.0 if quick else 32.0)
     part_kb = args.part_kb or (512 if quick else 1024)
     rounds = args.rounds or (9 if quick else 15)
+
+    if args.sparse_sweep:
+        table_rows = 1 << 17 if quick else 1 << 20
+        widths = [32, 256] if quick else [32, 64, 128, 256, 512, 1024]
+        densities = ([0.001, 0.1] if quick
+                     else [0.001, 0.003, 0.01, 0.03, 0.1])
+        sweep_reps = 2 if quick else 5
+        _log(f"wire_bench: sparse sweep ({table_rows} table rows, "
+             f"{len(widths)} widths x {len(densities)} densities, "
+             f"{sweep_reps} reps)")
+        sweep = sparse_sweep(table_rows, widths, densities, sweep_reps)
+        doc = {"sparse_sweep": sweep,
+               "config": {"quick": quick, "table_rows": table_rows,
+                          "cpus": os.cpu_count()}}
+        if args.json:
+            print(json.dumps(doc, indent=1))
+        return 0
 
     if args.codec_sweep:
         sizes = ([64 << 10, 1 << 20] if quick
