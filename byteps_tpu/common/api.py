@@ -74,6 +74,9 @@ class _State:
     # same window stream as the doctor; worker 0 proposes CMD_CODEC
     # switches, everyone else observes/adopts.
     tuner: Optional[Any] = None
+    # PS-tier autoscaler (BYTEPS_TPU_AUTOSCALE=1): chained after the
+    # doctor on the same window stream; worker 0 only.
+    autoscaler: Optional[Any] = None
     # Hierarchical reduction (BYTEPS_TPU_HIERARCHY=1, PS mode): the
     # HierarchicalReducer push_pull_tree/push_pull_async route through —
     # slice-reduce in-graph, leader-only wire round, broadcast back.
@@ -1274,6 +1277,9 @@ def get_server_stats() -> dict:
     # Row-sparse embedding plane: bps_embed_rows_served_total +
     # bps_embed_table_bytes{server=}.  Quiet unless a table exists.
     telemetry.update_embed(stats)
+    # Chain-replication plane: bps_repl_lag_rounds{server=} +
+    # bps_repl_bytes_total.  Quiet unless BYTEPS_TPU_REPL is armed.
+    telemetry.update_repl(stats)
     return stats
 
 
@@ -1337,6 +1343,33 @@ def _start_signal_plane(cfg) -> None:
                 margin_rounds=cfg.tuner_margin_rounds,
                 regress_frac=cfg.tuner_regress_frac)
 
+    autoscaler = None
+    if cfg.autoscale:
+        if sess is None:
+            get_logger().warning(
+                "BYTEPS_TPU_AUTOSCALE=1 outside PS mode: the autoscaler "
+                "drives the PS server ring and has nothing to scale here")
+        elif not sess.ring_armed:
+            get_logger().warning(
+                "BYTEPS_TPU_AUTOSCALE=1 without the elastic ring "
+                "(BYTEPS_TPU_RING=1): drain/join need ring transitions")
+        elif cfg.worker_id == 0:
+            # One scaler per job (worker 0, the tuner law): racing
+            # scalers would propose conflicting ring transitions.
+            from . import autoscaler as autoscaler_mod
+            root_port = int(os.environ.get("DMLC_PS_ROOT_PORT") or 0)
+            autoscaler = autoscaler_mod.Autoscaler(
+                sess,
+                autoscaler_mod.SubprocessExecutor(
+                    root_port, num_workers=cfg.num_worker),
+                min_servers=cfg.autoscale_min,
+                max_servers=cfg.autoscale_max,
+                hold=cfg.autoscale_hold,
+                cooldown=cfg.autoscale_cooldown,
+                up_mb=cfg.autoscale_up_mb,
+                down_mb=cfg.autoscale_down_mb,
+                doctor=eng)
+
     def _on_window(summary):
         eng.observe(summary)
         if tuner is not None:
@@ -1344,6 +1377,11 @@ def _start_signal_plane(cfg) -> None:
                 tuner.observe(summary)
             except Exception:
                 get_logger().exception("tuner window pass failed")
+        if autoscaler is not None:
+            try:
+                autoscaler.observe(summary)
+            except Exception:
+                get_logger().exception("autoscale window pass failed")
 
     plane = signals.arm(window_s=cfg.signal_window_s,
                         history=cfg.signal_history,
@@ -1352,6 +1390,7 @@ def _start_signal_plane(cfg) -> None:
     _state.signal_plane = plane
     _state.doctor = eng
     _state.tuner = tuner
+    _state.autoscaler = autoscaler
     _state.doctor_verdict_done = False
     flightrec.set_extra_provider(
         lambda: {"diagnosis": eng.diagnosis(),
@@ -1464,6 +1503,20 @@ def get_tuner() -> dict:
         return {"armed": False, "switches_total": 0, "keys": {},
                 "knob_proposals": []}
     return _state.tuner.state()
+
+
+def get_autoscaler() -> dict:
+    """The PS-tier autoscaler's state (``BYTEPS_TPU_AUTOSCALE=1``):
+    executed action records (dir/window/server), up/down totals, the
+    live hysteresis streaks and cooldown horizon, and the last
+    pressure-to-action detection latency.  ``{"armed": False}`` when
+    the loop is off (or this worker is not worker 0)."""
+    if _state.autoscaler is None:
+        return {"armed": False, "actions_up": 0, "actions_down": 0,
+                "actions": []}
+    out = _state.autoscaler.stats()
+    out["armed"] = True
+    return out
 
 
 def get_hierarchy() -> dict:

@@ -1,0 +1,286 @@
+"""Self-driving PS-tier elasticity (``BYTEPS_TPU_AUTOSCALE=1``).
+
+The elastic machinery has been operator-driven since PR 9: a human
+watches ``bps_top``, decides the tier is hot (or idle), and calls
+``drain_server()`` / boots a ``BYTEPS_TPU_RING_JOIN=1`` server by hand.
+This module closes that loop.  Each closed signal window (the same
+stream the doctor and tuner consume — never the hot path) the
+autoscaler reads the tier's load from the window's server section and
+the doctor's open findings, and actuates the EXISTING primitives:
+
+* **scale up**   -> ``executor.scale_up(new_id)`` boots a joiner
+  (subprocess in dev/tests; a k8s StatefulSet replica bump in prod —
+  docs/run-on-k8s.md "Autoscaling").  The joiner's CMD_RING_SET
+  announce re-shards ~1/N of the keys to it, state streaming first.
+* **scale down** -> ``session.drain_server(id, shutdown=True)`` — the
+  graceful CMD_DRAIN handoff; zero rounds and zero optimizer slots are
+  lost by construction, replication armed or not.
+
+Hysteresis follows the tuner's shape: a pressure must persist
+``hold`` consecutive windows before an action, every action opens a
+``cooldown`` window freeze, the tier never shrinks below ``min_servers``
+or grows past ``max_servers``, and NOTHING actuates while any ring
+member reports an open drain (two concurrent transitions would race
+migrations against each other).  All decisions flow through one pure
+function, :meth:`Autoscaler.decide`, so tests pin the policy table
+without sockets; ``observe()`` is the live wiring that feeds it real
+windows and executes what it returns.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional
+
+from .logging import get_logger
+
+# Load basis: in-window wire bytes (push + pull) per ALIVE server —
+# the same bytes_in/bytes_out lifetime counters the hot-shard rule
+# weighs, read as per-window deltas.  Scale up when the per-server
+# byte rate stays above `up_bytes`; scale down when it stays below
+# `down_bytes` AND the doctor is quiet.  A hot-shard finding counts as
+# up-pressure on its own: one server pinned at 4x fair share needs more
+# ring points to spread onto even when the MEAN is comfortable.
+DEFAULT_MIN_SERVERS = 1
+DEFAULT_MAX_SERVERS = 4
+DEFAULT_HOLD = 2            # windows a pressure must persist
+DEFAULT_COOLDOWN = 3        # windows frozen after any action
+DEFAULT_UP_MB = 64.0        # MiB/window/server above which the tier grows
+DEFAULT_DOWN_MB = 8.0       # MiB/window/server below which it shrinks
+
+# Doctor rules that read as scale-UP pressure when open.
+_UP_RULES = ("server_hot_shard", "replication_lag")
+
+
+class SubprocessExecutor:
+    """Dev/test executor: boots joiner servers as local subprocesses.
+
+    Mirrors the test fixtures' port convention — the server derives its
+    listen port as ``DMLC_PS_ROOT_PORT + 1 + DMLC_SERVER_ID`` — so the
+    autoscaler only needs the root port the original tier was launched
+    with.  In production this class is replaced by a k8s executor that
+    patches the StatefulSet's ``spec.replicas`` (docs/run-on-k8s.md);
+    the protocol is the one method.
+    """
+
+    def __init__(self, root_port: int, num_workers: int = 1,
+                 extra_env: Optional[dict] = None):
+        self.root_port = int(root_port)
+        self.num_workers = int(num_workers)
+        self.extra_env = dict(extra_env or {})
+        self.procs: Dict[int, object] = {}
+
+    def scale_up(self, server_id: int) -> None:
+        import os
+        import subprocess
+        import sys
+        env = dict(os.environ)
+        env.update({
+            "DMLC_PS_ROOT_PORT": str(self.root_port),
+            "DMLC_NUM_WORKER": str(self.num_workers),
+            "DMLC_NUM_SERVER": str(server_id + 1),
+            "DMLC_SERVER_ID": str(server_id),
+            "BYTEPS_TPU_RING": "1",
+            "BYTEPS_TPU_RING_JOIN": "1",
+            "JAX_PLATFORMS": "cpu",
+        })
+        env.update(self.extra_env)
+        self.procs[server_id] = subprocess.Popen(
+            [sys.executable, "-m", "byteps_tpu.server"], env=env,
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+
+    def reap(self, server_id: int) -> None:
+        """Collect a drained server's exited process (best-effort)."""
+        p = self.procs.pop(server_id, None)
+        if p is not None:
+            try:
+                p.wait(timeout=10)
+            except Exception:
+                p.kill()
+
+    def close(self) -> None:
+        for sid in list(self.procs):
+            p = self.procs.pop(sid)
+            try:
+                p.kill()
+                p.wait()
+            except Exception:
+                pass
+
+
+class Autoscaler:
+    """The control loop.  ``observe(summary)`` chains onto the signal
+    plane's ``on_window`` (after the doctor, whose open findings it
+    reads), so it runs once per closed window on the plane's thread —
+    never on the hot path.  Worker 0 only, like the tuner: racing
+    scalers would propose conflicting ring transitions."""
+
+    def __init__(self, session, executor,
+                 min_servers: int = DEFAULT_MIN_SERVERS,
+                 max_servers: int = DEFAULT_MAX_SERVERS,
+                 hold: int = DEFAULT_HOLD,
+                 cooldown: int = DEFAULT_COOLDOWN,
+                 up_mb: float = DEFAULT_UP_MB,
+                 down_mb: float = DEFAULT_DOWN_MB,
+                 doctor=None):
+        self._session = session
+        self._executor = executor
+        self.min_servers = max(1, int(min_servers))
+        self.max_servers = max(self.min_servers, int(max_servers))
+        self.hold = max(1, int(hold))
+        self.cooldown = max(0, int(cooldown))
+        self.up_bytes = max(0.0, float(up_mb)) * (1 << 20)
+        self.down_bytes = max(0.0, float(down_mb)) * (1 << 20)
+        self._doctor = doctor
+        self._lock = threading.Lock()
+        self._prev_rows: Dict[str, float] = {}
+        self._up_streak = 0
+        self._down_streak = 0
+        self._frozen_until = -1      # window index the cooldown ends at
+        self._window = -1
+        self.actions: List[dict] = []
+        self.actions_up = 0
+        self.actions_down = 0
+        self.last_detect_ms: Optional[float] = None
+        self._pressure_since: Optional[float] = None
+        from . import telemetry as _tm
+        self._reg = _tm.get_registry()
+
+    # -- policy (pure: no sockets, no clocks) -------------------------------
+    def decide(self, n_alive: int, per_server_bytes: Optional[float],
+               hot_finding: bool, doctor_quiet: bool,
+               draining: bool) -> Optional[str]:
+        """One window's verdict: ``"up"``, ``"down"`` or ``None``.
+
+        Mutates only the hysteresis streaks.  ``per_server_bytes`` is
+        the in-window wire-byte delta per alive server (None = unknown,
+        e.g. the first window or a partial stats poll — never a
+        pressure either way).  An open drain resets BOTH streaks: the
+        evidence mid-transition describes the transition, not the
+        steady state."""
+        if draining:
+            self._up_streak = self._down_streak = 0
+            return None
+        up = hot_finding or (per_server_bytes is not None
+                             and per_server_bytes > self.up_bytes)
+        down = (not up and doctor_quiet
+                and per_server_bytes is not None
+                and per_server_bytes < self.down_bytes)
+        self._up_streak = self._up_streak + 1 if up else 0
+        self._down_streak = self._down_streak + 1 if down else 0
+        if self._window <= self._frozen_until:
+            return None
+        if self._up_streak >= self.hold and n_alive < self.max_servers:
+            return "up"
+        if self._down_streak >= self.hold and n_alive > self.min_servers:
+            return "down"
+        return None
+
+    # -- live wiring --------------------------------------------------------
+    def observe(self, summary: dict) -> Optional[dict]:
+        """Fold one closed window in; returns the action record when one
+        actuated (tests read it), else None."""
+        with self._lock:
+            self._window = int(summary.get("window", self._window + 1))
+            sec = summary.get("server") or {}
+            rows = {str(s): r for s, r in (sec.get("servers") or {}).items()
+                    if isinstance(r, dict) and r.get("alive")}
+            if not rows:
+                self._prev_rows = {}
+                return None
+            draining = any(r.get("draining") for r in rows.values())
+            cur = {s: float(r.get("bytes_in", 0)) + float(r.get("bytes_out", 0))
+                   for s, r in rows.items()}
+            per_server = None
+            if self._prev_rows and all(s in self._prev_rows for s in cur):
+                delta = sum(max(0.0, cur[s] - self._prev_rows[s])
+                            for s in cur)
+                per_server = delta / max(1, len(cur))
+            self._prev_rows = cur
+            hot, quiet = self._doctor_pressure()
+            pressured = hot or (per_server is not None
+                                and per_server > self.up_bytes)
+            if pressured and self._pressure_since is None:
+                self._pressure_since = time.monotonic()
+            elif not pressured:
+                self._pressure_since = None
+            verdict = self.decide(len(rows), per_server, hot, quiet,
+                                  draining)
+            if verdict is None:
+                return None
+            return self._actuate(verdict, rows, per_server)
+
+    def _doctor_pressure(self) -> tuple:
+        """(hot_finding, doctor_quiet) from the engine's open set."""
+        if self._doctor is None:
+            return False, True
+        try:
+            open_f = self._doctor.diagnosis().get("open") or []
+        except Exception:
+            return False, True
+        hot = any(f.get("rule") in _UP_RULES for f in open_f)
+        return hot, not open_f
+
+    def _actuate(self, verdict: str, rows: Dict[str, dict],
+                 per_server: Optional[float]) -> Optional[dict]:
+        ids = sorted(int(s) for s in rows)
+        try:
+            if verdict == "up":
+                new_id = ids[-1] + 1
+                self._executor.scale_up(new_id)
+                self.actions_up += 1
+                target = new_id
+            else:
+                # Highest non-zero id leaves: server 0 is the root-port
+                # anchor every launch ring and rejoin dials first.
+                target = ids[-1]
+                if target == 0:
+                    return None
+                self._session.drain_server(target, shutdown=True)
+                reap = getattr(self._executor, "reap", None)
+                if reap is not None:
+                    reap(target)
+                self.actions_down += 1
+        except Exception:
+            get_logger().exception("autoscale %s failed (window %d)",
+                                   verdict, self._window)
+            # The freeze still applies: a failed transition may have
+            # left the tier mid-change, and retrying next window would
+            # pile a second transition onto it.
+            self._freeze()
+            return None
+        if self._pressure_since is not None and verdict == "up":
+            self.last_detect_ms = (time.monotonic()
+                                   - self._pressure_since) * 1e3
+            self._pressure_since = None
+        self._freeze()
+        rec = {"dir": verdict, "window": self._window, "server": target,
+               "n_before": len(rows),
+               "per_server_bytes": per_server}
+        self.actions.append(rec)
+        self._reg.counter(
+            "bps_autoscale_actions_total",
+            help="PS-tier scale actions the autoscaler executed",
+            labels={"dir": verdict}).inc()
+        get_logger().warning(
+            "bps autoscale: %s (server %s, %d member(s) before, "
+            "%.1f MiB/window/server)", verdict, target, len(rows),
+            (per_server or 0.0) / (1 << 20))
+        return rec
+
+    def _freeze(self) -> None:
+        self._frozen_until = self._window + self.cooldown
+        self._up_streak = self._down_streak = 0
+
+    # -- read surface -------------------------------------------------------
+    def stats(self) -> dict:
+        with self._lock:
+            return {"actions_up": self.actions_up,
+                    "actions_down": self.actions_down,
+                    "window": self._window,
+                    "frozen_until": self._frozen_until,
+                    "up_streak": self._up_streak,
+                    "down_streak": self._down_streak,
+                    "last_detect_ms": self.last_detect_ms,
+                    "actions": list(self.actions)}

@@ -230,6 +230,26 @@ class Config:
     # Rounds ahead a knob switch's boundary is placed (same headroom
     # law as tuner_margin_rounds; KNOB_STALE covers whoever misses it).
     knob_margin_rounds: int = 2          # BYTEPS_TPU_KNOB_MARGIN_ROUNDS
+    # PS-tier autoscaler (common/autoscaler.py): each signal window,
+    # worker 0 reads the per-server wire-byte rate + the doctor's open
+    # findings and grows/shrinks the server ring through the existing
+    # RING_JOIN / drain_server primitives.  Off (default): no loop is
+    # constructed and the tier only scales when an operator acts.
+    # Requires the signal plane (BYTEPS_TPU_SIGNAL_WINDOW_S > 0) and
+    # the elastic ring.
+    autoscale: bool = False              # BYTEPS_TPU_AUTOSCALE
+    autoscale_min: int = 1               # BYTEPS_TPU_AUTOSCALE_MIN
+    autoscale_max: int = 4               # BYTEPS_TPU_AUTOSCALE_MAX
+    # Windows a scale pressure must persist before an action, and
+    # windows every action freezes the loop after (tuner-style
+    # hysteresis — one noisy window must not re-shard the tier).
+    autoscale_hold: int = 2              # BYTEPS_TPU_AUTOSCALE_HOLD
+    autoscale_cooldown: int = 3          # BYTEPS_TPU_AUTOSCALE_COOLDOWN
+    # Per-server in-window wire MiB above which the tier grows / below
+    # which it shrinks (the doctor's hot-shard finding is independent
+    # up-pressure; any open finding vetoes a shrink).
+    autoscale_up_mb: float = 64.0        # BYTEPS_TPU_AUTOSCALE_UP_MB
+    autoscale_down_mb: float = 8.0       # BYTEPS_TPU_AUTOSCALE_DOWN_MB
 
     # ---- logging ----
     log_level: str = "WARNING"           # BYTEPS_LOG_LEVEL
@@ -333,6 +353,16 @@ class Config:
             knob_cost_model=_env_str("BYTEPS_TPU_KNOB_COST_MODEL", ""),
             knob_margin_rounds=_env_int(
                 "BYTEPS_TPU_KNOB_MARGIN_ROUNDS", 2),
+            autoscale=_env_bool("BYTEPS_TPU_AUTOSCALE"),
+            autoscale_min=_env_int("BYTEPS_TPU_AUTOSCALE_MIN", 1),
+            autoscale_max=_env_int("BYTEPS_TPU_AUTOSCALE_MAX", 4),
+            autoscale_hold=_env_int("BYTEPS_TPU_AUTOSCALE_HOLD", 2),
+            autoscale_cooldown=_env_int(
+                "BYTEPS_TPU_AUTOSCALE_COOLDOWN", 3),
+            autoscale_up_mb=float(
+                os.environ.get("BYTEPS_TPU_AUTOSCALE_UP_MB") or 64.0),
+            autoscale_down_mb=float(
+                os.environ.get("BYTEPS_TPU_AUTOSCALE_DOWN_MB") or 8.0),
             log_level=_env_str("BYTEPS_LOG_LEVEL", "WARNING"),
             mesh_dp=_env_int("BYTEPS_TPU_MESH_DP", 0),
             mesh_tp=_env_int("BYTEPS_TPU_MESH_TP", 1),

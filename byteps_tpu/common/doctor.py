@@ -107,6 +107,15 @@ DEFAULT_THRESHOLDS = {
     "embed_thrash_windows": 2,
     "embed_cache_hit_floor": 0.25,
     "embed_min_lookup_rows": 64,
+    # replication_lag: a chain-replication owner's publish cursor ran
+    # more than repl_lag_rounds ahead of its successor's ack for
+    # repl_lag_windows consecutive windows — the successor (or the peer
+    # link) cannot keep up, so the zero-loss failover window is growing
+    # (docs/elasticity.md "zero-loss law"): a kill now loses up to that
+    # many rounds of pull availability, and with BYTEPS_TPU_REPL_LAG=0
+    # every pull is parked behind the backlog.
+    "repl_lag_rounds": 3,
+    "repl_lag_windows": 2,
 }
 
 _SERIES_RE = re.compile(r'^([A-Za-z_:][A-Za-z0-9_:]*)\{(.*)\}$')
@@ -394,6 +403,50 @@ def _r_server_hot_shard(ctx: RuleCtx) -> List[dict]:
                           "load": {s: round(v, 1)
                                    for s, v in load.items()},
                           "keys_owned": owned}}]
+
+
+def _r_replication_lag(ctx: RuleCtx) -> List[dict]:
+    """Chain replication (CMD_REPL) can't keep up: a server's newest
+    published round trails its ring successor's ack by more than
+    ``repl_lag_rounds`` for ``repl_lag_windows`` consecutive windows.
+    Reads the per-server rows (lag is a property of one owner→successor
+    edge, not of the tier) straight from the window's server section —
+    the same rows the autoscaler consumes."""
+    need = int(ctx.th["repl_lag_windows"])
+    floor = int(ctx.th["repl_lag_rounds"])
+    if len(ctx.windows) < need:
+        return []
+
+    def _lag_rows(window: dict) -> Dict[str, int]:
+        sec = window.get("server") or {}
+        if not sec.get("repl_armed"):
+            return {}
+        out: Dict[str, int] = {}
+        for sid, row in (sec.get("servers") or {}).items():
+            if isinstance(row, dict) and isinstance(
+                    row.get("repl_lag_rounds"), (int, float)):
+                out[str(sid)] = int(row["repl_lag_rounds"])
+        return out
+
+    recent = [_lag_rows(w) for w in ctx.windows[-need:]]
+    if not all(recent):
+        return []      # replication unarmed or rows missing in a window
+    out: List[dict] = []
+    for sid, lag in recent[-1].items():
+        history = [r.get(sid, 0) for r in recent]
+        if all(v > floor for v in history):
+            out.append({
+                "subject": f"server={sid}",
+                "message": (
+                    f"server {sid}'s replication to its ring successor "
+                    f"trails its publishes by {lag} rounds (> "
+                    f"{floor}) for {need} consecutive windows: the "
+                    f"zero-loss failover window is growing — check the "
+                    f"successor's load / the peer link, or raise "
+                    f"BYTEPS_TPU_REPL_LAG only if pulls are parking"),
+                "evidence": {"server": sid, "lag_history": history,
+                             "floor": floor, "windows": need}})
+    return out
 
 
 def _r_nonfinite_gradients(ctx: RuleCtx) -> List[dict]:
@@ -703,6 +756,9 @@ RULES: List[Rule] = [
     Rule("embedding_cache_thrash", SEV_WARN,
          "the embedding hot-row cache stopped absorbing lookups",
          _r_embedding_cache_thrash),
+    Rule("replication_lag", SEV_WARN,
+         "a server's chain replication trails its publishes",
+         _r_replication_lag),
 ]
 
 RULE_IDS = tuple(r.id for r in RULES)

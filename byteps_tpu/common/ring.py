@@ -82,6 +82,21 @@ def owner_of(key: int, points: List[Tuple[int, int]]) -> int:
     return points[lo % len(points)][1]
 
 
+def successor_of(key: int, points: List[Tuple[int, int]]) -> int:
+    """Replication target for ``key``: the owner of the ring with the
+    key's OWNER's vnodes removed — i.e. the next DISTINCT server along
+    the ring.  This is the Python mirror of the C++ `repl_points_` law
+    (server.cc CMD_REPL): owner and successor must agree from both
+    sides, or a failover would look for the replica on the wrong
+    server.  Raises ValueError on a single-member ring (no distinct
+    successor exists; the owner self-acks there)."""
+    own = owner_of(key, points)
+    rest = [(p, s) for p, s in points if s != own]
+    if not rest:
+        raise ValueError("ring has a single member: no successor")
+    return owner_of(key, rest)
+
+
 class RingTable:
     """One worker's view of the server ring: epoch, members (id ->
     address), and the precomputed point table.
@@ -103,6 +118,10 @@ class RingTable:
     # -- placement ----------------------------------------------------------
     def owner(self, key: int) -> int:
         return owner_of(key, self._points)
+
+    def successor(self, key: int) -> int:
+        """The key's replication target (see ``successor_of``)."""
+        return successor_of(key, self._points)
 
     def ids(self) -> List[int]:
         return [i for i, _, _ in self.servers]

@@ -540,6 +540,37 @@ def update_server_opt(server_stats: dict,
                       int(rec.get("opt_slot_bytes", 0)))
 
 
+def update_repl(server_stats: dict,
+                registry: Optional[MetricsRegistry] = None) -> None:
+    """Fold the chain-replication plane (CMD_REPL) from a merged
+    CMD_STATS payload into the registry.
+
+    Exports ``bps_repl_lag_rounds{server=}`` (how many published rounds
+    the server's ring successor has not yet acked — the width of the
+    would-be loss window a failover closes, and what the doctor's
+    ``replication_lag`` rule watches) and ``bps_repl_bytes_total``
+    (replica bytes shipped tier-wide).  Quiet when replication is
+    unarmed (BYTEPS_TPU_REPL unset): no gauge is registered and the
+    snapshot is unchanged — the zero-overhead-when-off law every plane
+    here follows."""
+    reg = registry or get_registry()
+    if not server_stats.get("repl_armed"):
+        return
+    reg.gauge("bps_repl_bytes_total",
+              help="replica bytes shipped to ring successors "
+                   "(CMD_REPL), tier-wide").set(
+                  int(server_stats.get("repl_bytes_total", 0)))
+    for sid, rec in (server_stats.get("servers") or {}).items():
+        if not isinstance(rec, dict) or "repl_lag_rounds" not in rec:
+            continue
+        reg.gauge("bps_repl_lag_rounds",
+                  help="published rounds this server's ring successor "
+                       "has not yet acked (0 = every published round "
+                       "survives an owner SIGKILL)",
+                  labels={"server": str(sid)}).set(
+                      int(rec.get("repl_lag_rounds", 0)))
+
+
 def update_embed(server_stats: dict,
                  registry: Optional[MetricsRegistry] = None) -> None:
     """Fold the row-sparse embedding plane from a merged CMD_STATS

@@ -207,6 +207,22 @@ enum Cmd : uint8_t {
                  // must land even when an engine is wedged mid-round.
                  // Old servers answer kError via the engine default arm —
                  // "server too old".
+  kRepl = 20,    // Chain replication (CMD_REPL): after every publish the
+                 // ring owner streams the key's FULL serialized state —
+                 // the CMD_MIGRATE blob verbatim (published out +
+                 // completed_round + CMD_OPT slots + embed rows), so the
+                 // format stays version-tolerant by construction — to
+                 // its ring successor over the peer transport.  The
+                 // receiver stores the blob only-if-newer (first 8 bytes
+                 // = completed_round, the CMD_RING_SET idempotency law)
+                 // and installs NOTHING until a failover re-homes the
+                 // key onto it (MaybeAdoptReplica).  Reader thread, like
+                 // kStats: a replica must land even when the receiver's
+                 // engines are wedged, and the blob never touches
+                 // engine-owned state while parked.  Unarmed
+                 // (BYTEPS_TPU_REPL=0, the default) the command is
+                 // rejected and no peer byte is ever sent — the wire is
+                 // byte-identical to the pre-replication server.
 };
 
 // Request `dtype` marker on PULL frames: the worker asks for the 24-byte
@@ -230,6 +246,11 @@ enum : uint8_t { kMembershipTask = 200 };
 // owns whose new ring owner is another server — per-key state mutates
 // only on its owning thread, exactly like kMembershipTask.
 enum : uint8_t { kRingTask = 201 };
+// Engine-internal replication-ack flush (never on the wire): fanned to a
+// key's engine when its ring successor acks a replica, so the pulls the
+// zero-loss gate parked (ReplBlocked) are served on the thread that owns
+// the key's round state — same single-writer law as the other tasks.
+enum : uint8_t { kReplFlushTask = 202 };
 // kMoved: this server is not (or no longer) the ring owner of the frame's
 // key.  The response payload is the CURRENT ring table as JSON, so the
 // client re-plans and re-routes without an extra round trip.  Emitted
@@ -1343,6 +1364,10 @@ struct PendingPull {
   uint32_t worker = 0;      // for the PULL_SEND trace span
   bool traced = false;      // record a span when the pull finally serves
   bool audited = false;     // append the AuditTrailer when it serves
+  bool ungated = false;     // a kSparseRead parked ONLY by the
+                            // replication gate (ReplBlocked): it ignores
+                            // the round match and serves as soon as the
+                            // successor's ack lands
   // Row-sparse pulls (dtype kSparseRows) park their request payload
   // (SparseHdr + index stream) here; empty for dense pulls.  Served by
   // FlushPulls via RespondSparse when the wanted round publishes.
@@ -1412,6 +1437,13 @@ struct KeyState {
   // Atomic because the reader-thread stats path counts it while engines
   // flip it.
   std::atomic<bool> active{false};
+  // Chain replication (CMD_REPL): the newest completed_round the ring
+  // successor has ACKED holding a replica of.  The zero-loss pull gate
+  // (ReplBlocked) parks pulls while completed_round runs ahead of this
+  // by more than the lag window, so no worker can consume a round that
+  // would be lost if this server died right now.  Atomic: written by
+  // the replication thread on ack, read by the key's engine.
+  std::atomic<uint64_t> repl_acked_round{0};
   // --- audit state (engine-owned, like the round state) -----------------
   // Digest of the LAST published `out` buffer + the round/epoch/
   // contributor-count recorded with it — what an audited pull's trailer
@@ -1733,6 +1765,26 @@ class Server {
     }
     ring_join_ = truthy(std::getenv("BYTEPS_TPU_RING_JOIN"));
     ring_armed_ = ring_join_ || truthy(std::getenv("BYTEPS_TPU_RING"));
+    // Chain replication (BYTEPS_TPU_REPL=1): every publish streams the
+    // key's serialized state to its ring successor, and the zero-loss
+    // gate parks pulls until the successor acks within
+    // BYTEPS_TPU_REPL_LAG rounds (default 0: a round is pullable only
+    // once it can survive this server's death).  Unarmed (default): no
+    // replication thread, no peer traffic, no gate — wire and timing
+    // byte-identical to the pre-replication server.
+    repl_armed_ = truthy(std::getenv("BYTEPS_TPU_REPL"));
+    const char* rlag = std::getenv("BYTEPS_TPU_REPL_LAG");
+    if (rlag && rlag[0]) {
+      char* end = nullptr;
+      uint64_t v = std::strtoull(rlag, &end, 10);
+      if (end && *end == '\0')
+        repl_lag_window_ = v;
+      else
+        std::fprintf(stderr,
+                     "[byteps server] ignoring invalid "
+                     "BYTEPS_TPU_REPL_LAG=%s (want a round count)\n",
+                     rlag);
+    }
     const char* sid = std::getenv("DMLC_SERVER_ID");
     if (sid && sid[0])
       my_server_id_ = static_cast<uint32_t>(std::strtoul(sid, nullptr, 10));
@@ -1870,8 +1922,21 @@ class Server {
     std::thread join_thread;
     if (ring_join_) join_thread = std::thread(&Server::JoinLoop, this);
 
+    // Chain-replication sender (BYTEPS_TPU_REPL): drains the per-key
+    // newest-blob queue to each key's ring successor off the publish
+    // critical path.  Unarmed runs start zero extra threads.
+    std::thread repl_thread;
+    if (repl_armed_) repl_thread = std::thread(&Server::ReplLoop, this);
+
     AcceptLoop(listen_fd_, true);
     if (join_thread.joinable()) join_thread.join();
+    if (repl_thread.joinable()) {
+      // Joined BEFORE the engine queues stop: the replication thread
+      // fans kReplFlushTask into them on every ack.
+      { std::lock_guard<std::mutex> lk(repl_mu_); }
+      repl_cv_.notify_all();
+      repl_thread.join();
+    }
     if (lease_thread.joinable()) lease_thread.join();
     if (uds_acceptor.joinable()) uds_acceptor.join();
     if (uds_listen_fd_ >= 0) {
@@ -2131,13 +2196,28 @@ class Server {
   }
 
   std::string StatsJson() {
-    // Worst-case row: the header now carries ~25 numeric fields at up
-    // to 20 digits + ~330 chars of labels — keep comfortable headroom
+    // Worst-case row: the header now carries ~30 numeric fields at up
+    // to 20 digits + ~450 chars of labels — keep comfortable headroom
     // (snprintf truncation would silently corrupt the JSON).
-    char buf[1536];
+    char buf[2048];
     std::string js;
     js.reserve(4096);
     const uint64_t keys_owned = ring_armed_ ? KeysOwned() : 0;
+    // Chain-replication gauges: replicas parked for OTHER servers'
+    // keys, and the owner-side lag (newest published round minus the
+    // successor's acked round, max over keys) — what the doctor's
+    // replication_lag rule and bps_repl_lag_rounds watch.
+    uint64_t replicas_held = 0, repl_lag = 0;
+    if (repl_armed_) {
+      std::lock_guard<std::mutex> lk(repl_mu_);
+      replicas_held = replicas_.size();
+      for (auto& kv : repl_pub_) {
+        auto it = repl_ack_.find(kv.first);
+        const uint64_t acked = it == repl_ack_.end() ? 0 : it->second;
+        if (kv.second > acked && kv.second - acked > repl_lag)
+          repl_lag = kv.second - acked;
+      }
+    }
     std::snprintf(buf, sizeof(buf),
                   "{\"bytes_in\":%llu,\"bytes_out\":%llu,\"async\":%d,"
                   "\"num_workers\":%d,\"scatter_frames\":%llu,"
@@ -2152,6 +2232,10 @@ class Server {
                   "\"knob_stale_frames\":%llu,"
                   "\"embed_rows_served\":%llu,"
                   "\"embed_table_bytes\":%llu,"
+                  "\"repl_armed\":%d,\"repl_rounds_out\":%llu,"
+                  "\"repl_bytes_out\":%llu,\"repl_rounds_in\":%llu,"
+                  "\"repl_bytes_in\":%llu,\"repl_replicas_held\":%llu,"
+                  "\"repl_promotions\":%llu,\"repl_lag_rounds\":%llu,"
                   "\"slice_size\":%d,\"keys\":{",
                   static_cast<unsigned long long>(
                       bytes_in_.load(std::memory_order_relaxed)),
@@ -2195,6 +2279,19 @@ class Server {
                       embed_rows_served_.load(std::memory_order_relaxed)),
                   static_cast<unsigned long long>(
                       embed_table_bytes_.load(std::memory_order_relaxed)),
+                  repl_armed_ ? 1 : 0,
+                  static_cast<unsigned long long>(
+                      repl_rounds_out_.load(std::memory_order_relaxed)),
+                  static_cast<unsigned long long>(
+                      repl_bytes_out_.load(std::memory_order_relaxed)),
+                  static_cast<unsigned long long>(
+                      repl_rounds_in_.load(std::memory_order_relaxed)),
+                  static_cast<unsigned long long>(
+                      repl_bytes_in_.load(std::memory_order_relaxed)),
+                  static_cast<unsigned long long>(replicas_held),
+                  static_cast<unsigned long long>(
+                      repl_promotions_.load(std::memory_order_relaxed)),
+                  static_cast<unsigned long long>(repl_lag),
                   slice_size_);
     js += buf;
     std::lock_guard<std::mutex> lk(stats_mu_);
@@ -2610,11 +2707,35 @@ class Server {
         cpts = std::move(pts);
     std::atomic_store_explicit(&ring_points_, std::move(cpts),
                                std::memory_order_release);
+    // Successor table for chain replication: the same point set MINUS
+    // this server's own vnodes, so Owner(key, repl_points) is the next
+    // distinct server clockwise of the key — exactly who inherits the
+    // key if this owner dies.  Published the same lock-free way; empty
+    // on a single-member ring (ReplEnqueue then self-acks).
+    auto rpts = std::make_shared<
+        std::vector<std::pair<uint64_t, uint32_t>>>();
+    for (auto& m : ring_members_) {
+      if (m.id == my_server_id_) continue;
+      for (int v = 0; v < ring_vnodes_; ++v)
+        rpts->emplace_back(
+            ring::VnodePoint(m.id, static_cast<uint32_t>(v)), m.id);
+    }
+    std::sort(rpts->begin(), rpts->end());
+    std::shared_ptr<const std::vector<std::pair<uint64_t, uint32_t>>>
+        crpts = std::move(rpts);
+    std::atomic_store_explicit(&repl_points_, std::move(crpts),
+                               std::memory_order_release);
   }
 
   std::shared_ptr<const std::vector<std::pair<uint64_t, uint32_t>>>
   RingPoints() {
     return std::atomic_load_explicit(&ring_points_,
+                                     std::memory_order_acquire);
+  }
+
+  std::shared_ptr<const std::vector<std::pair<uint64_t, uint32_t>>>
+  ReplPoints() {
+    return std::atomic_load_explicit(&repl_points_,
                                      std::memory_order_acquire);
   }
 
@@ -2760,6 +2881,7 @@ class Server {
       ring_members_ = std::move(servers);
       if (make_draining) draining_.store(true, std::memory_order_relaxed);
       RebuildRingPointsLocked();
+      if (repl_armed_) ReplSweepLocked();
       ring_epoch_atomic_.store(ring_epoch_, std::memory_order_release);
       bool member = false;
       for (auto& m : ring_members_)
@@ -3138,6 +3260,16 @@ class Server {
     ks.embed_row_step.shrink_to_fit();
     OptSlotAccount(ks);
     StatOpt(key, 0, 0);
+    // Chain-replication bookkeeping leaves with the key: the new owner
+    // replicates to ITS successor from its next publish, and a stale
+    // pending blob from here must never resurrect the old trajectory.
+    if (repl_armed_) {
+      std::lock_guard<std::mutex> lk(repl_mu_);
+      repl_pending_.erase(key);
+      repl_pub_.erase(key);
+      repl_ack_.erase(key);
+    }
+    ks.repl_acked_round.store(0, std::memory_order_relaxed);
     ks.active.store(false, std::memory_order_relaxed);
     // Drop the migrated key's digest window too: the new owner records
     // fresh digests from its next publish, and a stale window here
@@ -3190,9 +3322,16 @@ class Server {
     }
   }
 
-  // Install a migrated key (CMD_MIGRATE, engine side).
-  void HandleMigrate(Task& t) {
-    const std::vector<char>& p = t.payload;
+  // Parse a serialized key-state blob (SerializeKeyState's format) and
+  // install it into `ks` — the shared install leg of CMD_MIGRATE and
+  // the CMD_REPL failover adoption (MaybeAdoptReplica).  Returns false
+  // with `ks` untouched when the mandatory header/buffer section is
+  // malformed, so a corrupt blob is discarded WHOLE, never
+  // half-installed; the version-tolerant trailers (codec/opt/knob/
+  // embed) keep their reset defaults when absent, exactly as a
+  // pre-subsystem sender's blob always behaved.  Engine thread.
+  bool InstallKeyStateBlob(uint64_t key, KeyState& ks,
+                           const std::vector<char>& p) {
     size_t pos = 0;
     auto take = [&](void* dst, size_t n) {
       if (pos + n > p.size()) return false;
@@ -3212,66 +3351,37 @@ class Server {
     if (!take(&completed, 8) || !take(&declared, 8) ||
         !take(&pushes, 8) || !take(&dtype, 1) || !take(&flags, 1) ||
         !take(&klen, 4) || klen > remaining()) {
-      Respond(t.conn, kError, t.req_id, t.key, nullptr, 0);
-      return;
+      return false;
     }
     std::string kwargs(p.data() + pos, klen);
     pos += klen;
     uint64_t store_n = 0, out_n = 0, ef_n = 0;
     if (!take(&store_n, 8) || store_n > remaining()) {
-      Respond(t.conn, kError, t.req_id, t.key, nullptr, 0);
-      return;
+      return false;
     }
     size_t store_at = pos;
     pos += static_cast<size_t>(store_n);
     if (!take(&out_n, 8) || out_n > remaining()) {
-      Respond(t.conn, kError, t.req_id, t.key, nullptr, 0);
-      return;
+      return false;
     }
     size_t out_at = pos;
     pos += static_cast<size_t>(out_n);
     if (!take(&ef_n, 8) || ef_n > remaining() / 4) {
-      Respond(t.conn, kError, t.req_id, t.key, nullptr, 0);
-      return;
+      return false;
     }
     size_t ef_at = pos;
     pos += static_cast<size_t>(ef_n) * 4;
     uint32_t n_seen = 0;
     if (!take(&n_seen, 4) || n_seen > remaining() / 4) {
-      Respond(t.conn, kError, t.req_id, t.key, nullptr, 0);
-      return;
+      return false;
     }
     size_t seen_at = pos;
     pos += static_cast<size_t>(n_seen) * 4;
     uint32_t n_members = 0;
     if (!take(&n_members, 4) || n_members > remaining() / 4) {
-      Respond(t.conn, kError, t.req_id, t.key, nullptr, 0);
-      return;
+      return false;
     }
     size_t members_at = pos;
-    KeyState& ks = StateFor(t.key);
-    if (ks.active.load(std::memory_order_relaxed) &&
-        ks.push_count.load(std::memory_order_relaxed) > 0) {
-      // The local key already carries LIVE pushes: either workers
-      // rebased onto this server before a straggling migration landed
-      // (local rounds are ahead), or a worker that adopted the new ring
-      // early fresh-INITed and pushed here while the old owner's
-      // reshard stream was still in flight (local round 0, migrated
-      // round r).  Installing over either would silently destroy
-      // merged gradients and desync round counters across the fleet —
-      // refuse loudly instead: the sender keeps its copy, its next
-      // frame answers kError, and the job fails EXACT-OR-LOUD rather
-      // than diverging.
-      std::fprintf(stderr,
-                   "[byteps server] refusing migration of key %llu: local "
-                   "state has live pushes at round %llu (migrated round "
-                   "%llu)\n",
-                   static_cast<unsigned long long>(t.key),
-                   static_cast<unsigned long long>(ks.completed_round),
-                   static_cast<unsigned long long>(completed));
-      Respond(t.conn, kError, t.req_id, t.key, nullptr, 0);
-      return;
-    }
     ks.completed_round = completed;
     ks.dtype = dtype;
     ks.kwargs = std::move(kwargs);
@@ -3491,11 +3601,57 @@ class Server {
       }
     }
     OptSlotAccount(ks);
-    StatOpt(t.key, ks.param_version, ks.opt_kind);
+    StatOpt(key, ks.param_version, ks.opt_kind);
     ks.merge_ts.clear();
     ks.push_count.store(pushes, std::memory_order_relaxed);
     ks.declared_len.store(declared, std::memory_order_release);
     ks.active.store(true, std::memory_order_relaxed);
+    return true;
+  }
+
+  // Install a migrated key (CMD_MIGRATE, engine side).
+  void HandleMigrate(Task& t) {
+    KeyState& ks = StateFor(t.key);
+    if (ks.active.load(std::memory_order_relaxed) &&
+        ks.push_count.load(std::memory_order_relaxed) > 0) {
+      // The local key already carries LIVE pushes: either workers
+      // rebased onto this server before a straggling migration landed
+      // (local rounds are ahead), or a worker that adopted the new ring
+      // early fresh-INITed and pushed here while the old owner's
+      // reshard stream was still in flight (local round 0, migrated
+      // round r).  Installing over either would silently destroy
+      // merged gradients and desync round counters across the fleet —
+      // refuse loudly instead: the sender keeps its copy, its next
+      // frame answers kError, and the job fails EXACT-OR-LOUD rather
+      // than diverging.
+      uint64_t completed = 0;
+      if (t.payload.size() >= 8)
+        std::memcpy(&completed, t.payload.data(), 8);
+      std::fprintf(stderr,
+                   "[byteps server] refusing migration of key %llu: local "
+                   "state has live pushes at round %llu (migrated round "
+                   "%llu)\n",
+                   static_cast<unsigned long long>(t.key),
+                   static_cast<unsigned long long>(ks.completed_round),
+                   static_cast<unsigned long long>(completed));
+      Respond(t.conn, kError, t.req_id, t.key, nullptr, 0);
+      return;
+    }
+    if (!InstallKeyStateBlob(t.key, ks, t.payload)) {
+      Respond(t.conn, kError, t.req_id, t.key, nullptr, 0);
+      return;
+    }
+    // A chain replica parked here for this key is superseded by the
+    // richer migration blob (it carries the OPEN round too) — drop it,
+    // and re-replicate the adopted state to THIS server's successor so
+    // the drain handoff is never the one unprotected copy.
+    if (repl_armed_) {
+      {
+        std::lock_guard<std::mutex> lk(repl_mu_);
+        replicas_.erase(t.key);
+      }
+      ReplEnqueue(ks, t.key);
+    }
     migrations_in_.fetch_add(1, std::memory_order_relaxed);
     StatPublish(t.key, ks.completed_round);
     Respond(t.conn, kOk, t.req_id, t.key, nullptr, 0);
@@ -3504,6 +3660,222 @@ class Server {
     // published round — serve it now, not at some unrelated later
     // publish.
     FlushPulls(ks, t.key);
+  }
+
+  // --- chain replication (CMD_REPL) -----------------------------------
+  // Zero-loss failover: after every publish the owner hands the key's
+  // serialized state to ReplLoop, which streams it to the key's ring
+  // successor; pulls for the new round park (ReplBlocked) until the
+  // successor's ack proves a second copy exists, so a SIGKILLed owner
+  // can never take an already-consumed round with it.  On failover the
+  // fresh owner adopts the replica (MaybeAdoptReplica) instead of
+  // rebasing workers to round 0 — zero lost rounds, zero optimizer
+  // resets, with slots_crc + the audit digest as the proof surface.
+
+  // True while the key's newest published round has not been acked by
+  // the ring successor within the lag window — the pull gate.  Engine
+  // thread (completed_round is engine-owned); unarmed runs answer
+  // false on one boolean test.
+  bool ReplBlocked(const KeyState& ks) {
+    if (!repl_armed_) return false;
+    return ks.completed_round >
+           ks.repl_acked_round.load(std::memory_order_acquire) +
+               repl_lag_window_;
+  }
+
+  // Hand the just-published (or just-installed) state to the
+  // replication thread: newest blob per key wins, so a slow successor
+  // coalesces rounds instead of queueing them.  Engine thread — the
+  // serialize runs while the key's state is stable, and the peer I/O
+  // never sits on the publish critical path.
+  void ReplEnqueue(KeyState& ks, uint64_t key) {
+    if (!repl_armed_) return;
+    auto rpts = ReplPoints();
+    if (!ring_armed_ || draining_.load(std::memory_order_relaxed) ||
+        !rpts || rpts->empty()) {
+      // No successor to wait for (single-member ring, ring unarmed, or
+      // this server is draining — its keys are leaving anyway): the
+      // gate must never park pulls forever.
+      ks.repl_acked_round.store(ks.completed_round,
+                                std::memory_order_release);
+      return;
+    }
+    std::vector<char> blob = SerializeKeyState(ks);
+    {
+      std::lock_guard<std::mutex> lk(repl_mu_);
+      repl_pending_[key] = std::move(blob);
+      repl_pub_[key] = ks.completed_round;
+    }
+    repl_cv_.notify_one();
+  }
+
+  // Ack bookkeeping shared by the success and no-successor legs: lift
+  // the key's acked round (only-if-newer — acks can arrive out of
+  // order around a coalesced re-send), then wake the key's engine so
+  // the gated pulls flush on the thread that owns the round state.
+  void ReplAck(uint64_t key, uint64_t round) {
+    KeyState* ks = FindState(key);
+    if (ks != nullptr) {
+      uint64_t prev = ks->repl_acked_round.load(std::memory_order_relaxed);
+      while (prev < round &&
+             !ks->repl_acked_round.compare_exchange_weak(
+                 prev, round, std::memory_order_release,
+                 std::memory_order_relaxed)) {
+      }
+    }
+    {
+      std::lock_guard<std::mutex> lk(repl_mu_);
+      auto& acked = repl_ack_[key];
+      if (round > acked) acked = round;
+    }
+    Task t;
+    t.cmd = kReplFlushTask;
+    t.dtype = 0;
+    t.flags = 0;
+    t.req_id = 0;
+    t.worker_id = 0;
+    t.key = key;
+    t.conn = nullptr;
+    t.seq = seq_.fetch_add(1);
+    t.priority = UINT64_MAX;
+    queues_[EngineFor(key, 0)].Push(std::move(t));
+  }
+
+  // Replication sender thread (Run starts it only when armed): drains
+  // the newest-blob queue to each key's ring successor.  A failed send
+  // re-queues the blob and backs off — PeerRequest's 2s negative cache
+  // makes the retry a fast false while the successor is down, and a
+  // ring transition re-homes the key's successor via ReplPoints.
+  void ReplLoop() {
+    for (;;) {
+      uint64_t key = 0;
+      std::vector<char> blob;
+      {
+        std::unique_lock<std::mutex> lk(repl_mu_);
+        repl_cv_.wait(lk, [&] {
+          return shutdown_.load() || !repl_pending_.empty();
+        });
+        if (shutdown_.load()) return;
+        auto it = repl_pending_.begin();
+        key = it->first;
+        blob = std::move(it->second);
+        repl_pending_.erase(it);
+      }
+      uint64_t round = 0;
+      if (blob.size() >= 8) std::memcpy(&round, blob.data(), 8);
+      uint32_t target = 0;
+      std::string host;
+      int port = 0;
+      {
+        auto rpts = ReplPoints();
+        if (rpts && !rpts->empty()) {
+          target = ring::Owner(key, *rpts);
+          std::lock_guard<std::mutex> lk(ring_mu_);
+          auto it = peer_book_.find(target);
+          if (it != peer_book_.end()) {
+            host = it->second.first;
+            port = it->second.second;
+          }
+        }
+      }
+      if (host.empty()) {
+        // Successor vanished mid-flight (scale-down to one server):
+        // nothing to replicate to — self-ack so the gate opens.
+        ReplAck(key, round);
+        continue;
+      }
+      if (PeerRequest(target, host, port, kRepl, 0, key, blob.data(),
+                      blob.size())) {
+        repl_rounds_out_.fetch_add(1, std::memory_order_relaxed);
+        repl_bytes_out_.fetch_add(blob.size(), std::memory_order_relaxed);
+        ReplAck(key, round);
+      } else {
+        {
+          std::lock_guard<std::mutex> lk(repl_mu_);
+          // Newest wins: only re-queue when no fresher publish landed.
+          if (repl_pending_.find(key) == repl_pending_.end())
+            repl_pending_[key] = std::move(blob);
+        }
+        // Throttle the retry loop; the negative cache already makes
+        // each failed attempt cheap.
+        std::this_thread::sleep_for(std::chrono::milliseconds(100));
+        if (shutdown_.load()) return;
+      }
+    }
+  }
+
+  // Failover adoption: the FIRST frame touching a key this server now
+  // owns but holds no live state for consumes the parked chain replica
+  // — the fresh owner resumes from the replicated published round +
+  // optimizer slots instead of rebasing workers to round 0.  Engine
+  // thread.  A malformed replica is discarded whole and the legacy
+  // rebase path takes over (adopt-whole-or-discard).  Gated on an
+  // advanced ring epoch: at epoch 0 ownership is not enforced and a
+  // misrouted frame must not install a replica under a live owner.
+  void MaybeAdoptReplica(uint64_t key, KeyState& ks) {
+    if (!repl_armed_) return;
+    if (ks.active.load(std::memory_order_relaxed) ||
+        ks.push_count.load(std::memory_order_relaxed) != 0)
+      return;
+    if (ring_epoch_atomic_.load(std::memory_order_acquire) == 0 ||
+        RingMisplaced(key))
+      return;
+    std::vector<char> blob;
+    {
+      std::lock_guard<std::mutex> lk(repl_mu_);
+      auto it = replicas_.find(key);
+      if (it == replicas_.end()) return;
+      blob = std::move(it->second.second);
+      replicas_.erase(it);
+    }
+    if (!InstallKeyStateBlob(key, ks, blob)) {
+      std::fprintf(stderr,
+                   "[byteps server] discarding malformed replica for key "
+                   "%llu (%zu bytes)\n",
+                   static_cast<unsigned long long>(key), blob.size());
+      return;
+    }
+    repl_promotions_.fetch_add(1, std::memory_order_relaxed);
+    std::fprintf(stderr,
+                 "[byteps server] adopted replica for key %llu at round "
+                 "%llu (param_version %llu)\n",
+                 static_cast<unsigned long long>(key),
+                 static_cast<unsigned long long>(ks.completed_round),
+                 static_cast<unsigned long long>(ks.param_version));
+    StatPublish(key, ks.completed_round);
+    // Re-protect immediately: the adopted round is the only copy until
+    // THIS server's successor acks it (the gate stays closed exactly
+    // that long), so a second failure still loses nothing.
+    ReplEnqueue(ks, key);
+    FlushPulls(ks, key);
+  }
+
+  // Replica GC on a ring transition (under ring_mu_): keep a parked
+  // replica only while this server is the key's owner (a promotion
+  // candidate) or its current successor; anything else — e.g. a
+  // scale-up moved the successor role — is dropped, and the live owner
+  // re-protects at its next publish.
+  void ReplSweepLocked() {
+    auto pts = RingPoints();
+    if (!pts || pts->empty()) return;
+    std::lock_guard<std::mutex> lk(repl_mu_);
+    for (auto it = replicas_.begin(); it != replicas_.end();) {
+      const uint64_t key = it->first;
+      const uint32_t owner = ring::Owner(key, *pts);
+      bool keep = owner == my_server_id_;
+      if (!keep) {
+        std::vector<std::pair<uint64_t, uint32_t>> minus;
+        minus.reserve(pts->size());
+        for (auto& pt : *pts)
+          if (pt.second != owner) minus.push_back(pt);
+        keep = !minus.empty() &&
+               ring::Owner(key, minus) == my_server_id_;
+      }
+      if (keep)
+        ++it;
+      else
+        it = replicas_.erase(it);
+    }
   }
 
   // Joining server: read the current ring from a launch peer (binary
@@ -3828,6 +4200,38 @@ class Server {
           HandleKnobFrame(conn, h.req_id, key, h.flags, h.worker_id,
                           payload);
           break;
+        case kRepl: {
+          // Chain-replica install (peer traffic): park the serialized
+          // key-state blob only-if-newer — the first 8 bytes are the
+          // sender's completed_round, and a replayed or reordered blob
+          // can never regress the parked copy (the CMD_RING_SET
+          // idempotency law).  NOTHING is installed here: the blob
+          // waits, whole, for a failover to re-home the key
+          // (MaybeAdoptReplica) — a torn transfer never reaches this
+          // point at all because the frame header's length prefix makes
+          // delivery all-or-nothing (adopt-whole-or-discard).  Reader
+          // thread, like kStats: a replica must land even when this
+          // server's engines are wedged mid-round.
+          uint64_t r = 0;
+          if (!repl_armed_ || payload.size() < 30) {
+            Respond(conn, kError, h.req_id, h.key, nullptr, 0);
+            break;
+          }
+          std::memcpy(&r, payload.data(), 8);
+          {
+            std::lock_guard<std::mutex> lk(repl_mu_);
+            auto& slot = replicas_[key];
+            if (slot.second.empty() || r > slot.first) {
+              slot.first = r;
+              slot.second = std::move(payload);
+            }
+          }
+          repl_rounds_in_.fetch_add(1, std::memory_order_relaxed);
+          repl_bytes_in_.fetch_add(h.len, std::memory_order_relaxed);
+          Respond(conn, kOk, h.req_id, h.key,
+                  reinterpret_cast<const char*>(&r), 8);
+          break;
+        }
         case kAudit: {
           // Reader-thread digest-window read, same rationale as kStats:
           // the auditor's cross-check must answer even when an engine is
@@ -3985,6 +4389,17 @@ class Server {
           // Same wire-rejection rule as kMembershipTask.
           if (t.conn == nullptr) HandleReshard(idx);
           else Respond(t.conn, kError, t.req_id, t.key, nullptr, 0);
+          break;
+        case kReplFlushTask:
+          // Successor ack landed (ReplAck): serve the pulls the
+          // zero-loss gate parked.  Same wire-rejection rule as the
+          // other internal tasks.
+          if (t.conn == nullptr) {
+            KeyState* ks = FindState(t.key);
+            if (ks != nullptr) FlushPulls(*ks, t.key);
+          } else {
+            Respond(t.conn, kError, t.req_id, t.key, nullptr, 0);
+          }
           break;
         case kMigrate: HandleMigrate(t); break;
         case kCodec: HandleCodec(t); break;
@@ -4500,6 +4915,11 @@ class Server {
       return;
     }
     KeyState& ks = StateFor(t.key);
+    // Failover: a client probing/reseeding the optimizer plane after a
+    // server death must see the REPLICATED slots, not an empty key —
+    // the adopted param_version/params_n are what lets it skip the
+    // reseed entirely (zero optimizer resets).
+    MaybeAdoptReplica(t.key, ks);
     if (t.flags & 2) {
       // PARAM SEED: raw f32 initial parameters, applied only while the
       // key holds none — idempotent across racing workers (they all
@@ -4802,6 +5222,11 @@ class Server {
       return;
     }
     KeyState& ks = StateFor(t.key);
+    // Failover: adopt the chain replica BEFORE the size check below —
+    // the adopted store matches the declared size, so a reconnecting
+    // worker's re-INIT resumes at the replicated round instead of
+    // resetting to a fresh store.
+    MaybeAdoptReplica(t.key, ks);
     ks.active.store(true, std::memory_order_relaxed);
     uint64_t n = 0;
     if (t.payload.size() >= 8)
@@ -4863,6 +5288,11 @@ class Server {
 
   void HandlePush(Task& t) {
     KeyState& ks = StateFor(t.key);
+    // Failover: a re-pushed open round adopts the chain replica first,
+    // so the merge lands on the replicated published state (and the
+    // replica's `seen` set dedups contributions the dead owner already
+    // merged — the exactly-once law).
+    MaybeAdoptReplica(t.key, ks);
     // A scattered frame's payload lives in ks.scatter_buf (reader-filled
     // under the scatter lease); this engine task owns releasing the
     // lease — RAII, so every validation early-return below releases it.
@@ -5384,6 +5814,12 @@ class Server {
         dq.pop_front();
     }
     StatPublish(key, ks.completed_round);
+    // Chain replication: enqueue the published state for the successor
+    // BEFORE the flush below — when armed, the flush is gated on the
+    // successor's ack (ReplBlocked), so this round's pulls serve only
+    // once a second copy exists.  Unarmed: one boolean test, the flush
+    // behaves exactly as before.
+    ReplEnqueue(ks, key);
     FlushPulls(ks, key);
   }
 
@@ -5513,12 +5949,27 @@ class Server {
       return;
     }
     KeyState& ks = StateFor(t.key);
+    MaybeAdoptReplica(t.key, ks);
     if (t.dtype == kSparseRead) {
       // Ungated inference read: serves whatever the table holds RIGHT
       // NOW — no round gate, no parking, no round-state mutation at
       // all, so a pull-only session can never stall (or be stalled by)
       // round completion.  Readers order themselves by the returned
-      // param_version, which is monotone per key.
+      // param_version, which is monotone per key.  The one exception is
+      // the zero-loss gate: while the newest publish awaits its
+      // successor ack, the read parks (`ungated`) so an observer can
+      // never consume table state that a failover would roll back —
+      // param_version stays monotone ACROSS a SIGKILL because nothing
+      // unreplicated is ever served.
+      if (ReplBlocked(ks)) {
+        AddRef(t.conn);
+        ks.pending.push_back({t.conn, t.req_id, t.key, t.flags,
+                              t.worker_id, false, false});
+        ks.pending.back().ungated = true;
+        ks.pending.back().sparse = std::move(t.payload);
+        StatPendingPulls(t.key, 1);
+        return;
+      }
       RespondSparse(t.conn, t.req_id, t.key, ks, t.payload.data(),
                     t.payload.size());
       return;
@@ -5543,7 +5994,12 @@ class Server {
       Respond(t.conn, kError, t.req_id, t.key, nullptr, 0);
       return;
     }
-    bool ready = async_ || !RoundMatch(t.flags, ks.completed_round);
+    // The zero-loss gate joins the round check: a pull whose round is
+    // ready but whose publish has not been replicated yet parks until
+    // the successor acks (kReplFlushTask serves it) — unarmed runs pay
+    // one boolean test.
+    bool ready = (async_ || !RoundMatch(t.flags, ks.completed_round)) &&
+                 !ReplBlocked(ks);
     if (ready) {
       const int64_t t0 = traced ? NowUs() : 0;
       if (t.dtype == kSparseRows)
@@ -5571,10 +6027,18 @@ class Server {
   }
 
   void FlushPulls(KeyState& ks, uint64_t key) {
+    // Zero-loss gate: while the newest publish awaits its successor
+    // ack, NOTHING serves (the parked pulls are exactly the ones the
+    // gate exists for); kReplFlushTask re-runs this the moment the ack
+    // lands.  `ungated` entries (kSparseRead reads parked only by the
+    // gate) ignore the round match once the gate opens.
+    const bool blocked = ReplBlocked(ks);
     std::vector<PendingPull> still;
     int64_t flushed = 0;
     for (auto& p : ks.pending) {
-      if (async_ || !RoundMatch(p.want_round, ks.completed_round)) {
+      if (!blocked &&
+          (p.ungated || async_ ||
+           !RoundMatch(p.want_round, ks.completed_round))) {
         const int64_t t0 = p.traced ? NowUs() : 0;
         if (!p.sparse.empty())
           RespondSparse(p.conn, p.req_id, key, ks, p.sparse.data(),
@@ -5715,6 +6179,30 @@ class Server {
   std::mutex peer_mu_;
   std::map<uint32_t, int> peer_fds_;
   std::map<uint32_t, int64_t> peer_down_until_us_;  // negative cache
+
+  // Chain replication (CMD_REPL; see the "chain replication" section).
+  // repl_points_ is the ring point table minus this server's vnodes —
+  // Owner(key, repl_points_) is the key's successor — published
+  // lock-free like ring_points_.  Everything else under repl_mu_:
+  // the newest-blob send queue + owner-side published/acked rounds
+  // (engine + repl threads), and the replicas parked FOR other owners'
+  // keys (reader threads in, engine threads out at adoption).
+  bool repl_armed_ = false;          // BYTEPS_TPU_REPL
+  uint64_t repl_lag_window_ = 0;     // BYTEPS_TPU_REPL_LAG (rounds the
+                                     // publish may run ahead of the ack)
+  std::shared_ptr<const std::vector<std::pair<uint64_t, uint32_t>>>
+      repl_points_;
+  std::mutex repl_mu_;
+  std::condition_variable repl_cv_;
+  std::map<uint64_t, std::vector<char>> repl_pending_;
+  std::map<uint64_t, uint64_t> repl_pub_;
+  std::map<uint64_t, uint64_t> repl_ack_;
+  std::map<uint64_t, std::pair<uint64_t, std::vector<char>>> replicas_;
+  std::atomic<uint64_t> repl_rounds_out_{0};
+  std::atomic<uint64_t> repl_bytes_out_{0};
+  std::atomic<uint64_t> repl_rounds_in_{0};
+  std::atomic<uint64_t> repl_bytes_in_{0};
+  std::atomic<uint64_t> repl_promotions_{0};
 
   // CMD_AUDIT publish-digest window (see AuditJson / PublishRound).
   struct AuditRec {

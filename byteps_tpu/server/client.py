@@ -1303,6 +1303,17 @@ class PSSession:
         # byte-identical to pre-audit and nothing is digested.
         self.audit = bool(audit)
         self.audit_window = max(1, int(audit_window))
+        # Chain replication armed on the server tier (BYTEPS_TPU_REPL=1,
+        # docs/elasticity.md "zero-loss law"): a SIGKILLed owner's fresh
+        # replacement adopts the ring successor's replica at the last
+        # publish boundary — with an EMPTY open round.  Reconcile must
+        # then re-push a round whose pushes died with the old owner even
+        # from a partition already parked in its pull phase (the server's
+        # per-worker `seen` dedup absorbs the duplicate whenever the push
+        # DID survive, so the replay is always safe).
+        self._repl_armed = os.environ.get(
+            "BYTEPS_TPU_REPL", "").strip().lower() not in (
+                "", "0", "false", "no", "off")
         # Gradient-health monitor (BYTEPS_TPU_HEALTH_SAMPLE_ROUNDS > 0):
         # per-key norm/max/NaN/Inf/EF-residual sampling on the push path.
         self.health_sample_rounds = max(0, int(health_sample_rounds))
@@ -2867,6 +2878,27 @@ class PSSession:
         if not rec or rec.get("params_fn") is None:
             return
         try:
+            # Probe first: a replication-armed ring hands the fresh owner
+            # the replicated params/m/v (docs/elasticity.md "zero-loss
+            # law"), so a rebase onto an owner that already HOLDS params
+            # must not re-seed (the server would ignore the flags&2 seed
+            # anyway) and must not count an opt_reseed — the counter is
+            # the proof surface for slot continuity.
+            import json as _json
+            doc = _json.loads(bytes(conn.request(
+                CMD_OPT, part.pkey, b"", worker_id=self.worker_id,
+                timeout=10.0)).decode())
+            if int(doc.get("param_version", 0)) > 0 \
+                    or int(doc.get("params_n", 0)) > 0:
+                get_logger().info(
+                    "server-opt key %d: owner %s:%d already holds "
+                    "params (param_version=%s) — skipping re-seed",
+                    part.pkey, conn.host, conn.port,
+                    doc.get("param_version"))
+                return
+        except Exception:
+            pass    # probe is best-effort; fall through to the re-seed
+        try:
             kwstr = rec.get("kwargs_str", "")
             kb = kwstr.encode()
             payload = struct.pack("<IQI", int(rec.get("epoch", 1)), 0,
@@ -3599,6 +3631,22 @@ class PSSession:
                 # so re-pushing would pollute the next round — pull only.
                 replay_push = False
                 part.phase = "pull"
+            elif completed == part.round and part.phase == "pull" \
+                    and self._repl_armed:
+                # Replication failover: the fresh owner adopted the
+                # successor's replica at the LAST publish boundary, so
+                # round `part.round` is open again with an empty `seen`
+                # set — every worker's push for it died with the old
+                # owner even though each was individually acked.  Re-push
+                # from gradient state; if the owner in fact survived (a
+                # plain reconnect) its `seen` dedup drops the duplicate.
+                get_logger().warning(
+                    "PS server %s:%d at replica boundary for key %d "
+                    "(completed=%d == staged round): re-pushing the open "
+                    "round (repl failover; seen-dedup absorbs duplicates)",
+                    conn.host, conn.port, part.pkey, completed)
+                replay_push = True
+                part.phase = "push"
             elif completed < part.round:
                 # The server lost state (restart): rebase this partition
                 # onto the server's round and re-push — the store is gone,
@@ -4477,7 +4525,9 @@ class PSSession:
                   "codec_sets": 0, "codec_stale_frames": 0,
                   "opt_sets": 0, "opt_updates": 0, "opt_slot_bytes": 0,
                   "embed_rows_served": 0, "embed_table_bytes": 0,
-                  "slice_size": 1}
+                  "slice_size": 1, "repl_armed": False,
+                  "repl_bytes_total": 0, "repl_lag_rounds": 0,
+                  "repl_replicas_held": 0, "repl_promotions": 0}
         import json as _json
         for slot, c in enumerate(self.conns):
             sid = self._slot_srv.get(slot, slot)
@@ -4561,6 +4611,27 @@ class PSSession:
                 st.get("embed_table_bytes", 0))
             merged["servers"][row_id]["embed_table_bytes"] = int(
                 st.get("embed_table_bytes", 0))
+            # Chain replication (CMD_REPL; old servers omit all of
+            # these).  Per-server rows keep the publish-side lag and
+            # replica census — the doctor's replication_lag rule and the
+            # autoscaler both read the ROWS, because lag is a property of
+            # one owner→successor edge, not of the tier.
+            merged["repl_armed"] = (merged["repl_armed"]
+                                    or bool(st.get("repl_armed", 0)))
+            merged["repl_bytes_total"] += int(st.get("repl_bytes_out", 0))
+            merged["repl_lag_rounds"] = max(
+                merged["repl_lag_rounds"], int(st.get("repl_lag_rounds", 0)))
+            merged["repl_replicas_held"] += int(
+                st.get("repl_replicas_held", 0))
+            merged["repl_promotions"] += int(st.get("repl_promotions", 0))
+            merged["servers"][row_id]["repl_lag_rounds"] = int(
+                st.get("repl_lag_rounds", 0))
+            merged["servers"][row_id]["repl_bytes_out"] = int(
+                st.get("repl_bytes_out", 0))
+            merged["servers"][row_id]["repl_replicas_held"] = int(
+                st.get("repl_replicas_held", 0))
+            merged["servers"][row_id]["repl_promotions"] = int(
+                st.get("repl_promotions", 0))
             for w, rec in (st.get("members") or {}).items():
                 _merge_member_rec(merged["members"], int(w), rec)
             for k, v in (st.get("keys") or {}).items():

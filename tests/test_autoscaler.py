@@ -1,0 +1,311 @@
+"""Self-driving PS autoscaler tests (common/autoscaler.py, ISSUE 18).
+
+The policy is one pure function — ``Autoscaler.decide`` — so the
+hysteresis table (hold streaks, cooldown freeze, min/max bounds, the
+open-drain veto) pins without sockets; ``observe()`` is tested against
+synthetic window summaries with fake session/executor/doctor; and the
+acceptance e2e drives REAL ring servers 1 -> 3 -> 1 through a synthetic
+load ramp with no manual drain/join call anywhere.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from byteps_tpu.common import telemetry as tm
+from byteps_tpu.common.autoscaler import Autoscaler, SubprocessExecutor
+
+from test_server_elastic import (  # noqa: F401
+    ring_servers, _ring_session,
+)
+
+
+class FakeExec:
+    def __init__(self, fail=False):
+        self.fail = fail
+        self.ups = []
+        self.reaped = []
+
+    def scale_up(self, sid):
+        if self.fail:
+            raise RuntimeError("boom")
+        self.ups.append(sid)
+
+    def reap(self, sid):
+        self.reaped.append(sid)
+
+
+class FakeSession:
+    def __init__(self):
+        self.drained = []
+
+    def drain_server(self, sid, shutdown=False):
+        self.drained.append((sid, shutdown))
+        return {"keys_owned": 0}
+
+
+class FakeDoctor:
+    def __init__(self, open_findings=()):
+        self.open = list(open_findings)
+
+    def diagnosis(self):
+        return {"open": list(self.open)}
+
+
+def A(**kw):
+    kw.setdefault("hold", 2)
+    kw.setdefault("cooldown", 3)
+    return Autoscaler(FakeSession(), FakeExec(), **kw)
+
+
+def W(idx, bytes_by_server, draining=False):
+    """Synthetic window summary with LIFETIME byte counters per server
+    (observe() takes per-window deltas itself)."""
+    rows = {str(s): {"alive": True, "draining": draining,
+                     "bytes_in": b, "bytes_out": 0}
+            for s, b in bytes_by_server.items()}
+    return {"window": idx, "server": {"servers": rows}}
+
+
+# ---------------------------------------------------------------------------
+# decide(): the pure policy table
+# ---------------------------------------------------------------------------
+def test_decide_hold_hysteresis():
+    a = A(hold=2)
+    a._window = 10
+    assert a.decide(2, 999e6, False, True, False) is None   # streak 1
+    a._window = 11
+    assert a.decide(2, 999e6, False, True, False) == "up"   # streak 2
+    # A quiet window resets the streak.
+    b = A(hold=2)
+    b._window = 10
+    assert b.decide(2, 999e6, False, True, False) is None
+    b._window = 11
+    assert b.decide(2, 1.0, False, True, False) is None     # reset
+    b._window = 12
+    assert b.decide(2, 999e6, False, True, False) is None   # streak 1 again
+
+
+def test_decide_bounds_and_directions():
+    a = A(hold=1, min_servers=1, max_servers=3)
+    a._window = 1
+    # At the ceiling: pressure never scales past max.
+    assert a.decide(3, 999e6, False, True, False) is None
+    # At the floor: quiet never scales below min.
+    a2 = A(hold=1, min_servers=1, max_servers=3)
+    a2._window = 1
+    assert a2.decide(1, 0.0, False, True, False) is None
+    # Mid-range: quiet + tiny bytes goes down, pressure goes up.
+    a3 = A(hold=1)
+    a3._window = 1
+    assert a3.decide(2, 0.0, False, True, False) == "down"
+    a4 = A(hold=1)
+    a4._window = 1
+    assert a4.decide(2, 999e6, False, True, False) == "up"
+    # A doctor hot finding is up-pressure on its own (skewed shard with
+    # a comfortable MEAN) and up always wins over down.
+    a5 = A(hold=1)
+    a5._window = 1
+    assert a5.decide(2, 0.0, True, False, False) == "up"
+    # Open findings (not necessarily hot ones) veto scale-down.
+    a6 = A(hold=1)
+    a6._window = 1
+    assert a6.decide(2, 0.0, False, False, False) is None
+
+
+def test_decide_unknown_bytes_is_never_pressure():
+    a = A(hold=1)
+    a._window = 1
+    # First window / partial poll: per_server_bytes unknown.
+    assert a.decide(2, None, False, True, False) is None
+    a._window = 2
+    assert a.decide(2, None, False, True, False) is None
+
+
+def test_decide_open_drain_vetoes_and_resets():
+    a = A(hold=1)
+    a._window = 1
+    assert a.decide(2, 999e6, False, True, True) is None    # mid-drain
+    assert a._up_streak == 0                                 # and reset
+    a._window = 2
+    assert a.decide(2, 999e6, False, True, False) == "up"
+
+
+# ---------------------------------------------------------------------------
+# observe(): live wiring over synthetic windows
+# ---------------------------------------------------------------------------
+def test_observe_scales_up_and_freezes():
+    tm.reset_registry()
+    ex = FakeExec()
+    a = Autoscaler(FakeSession(), ex, hold=2, cooldown=3, up_mb=1.0)
+    mb = 1 << 20
+    assert a.observe(W(0, {0: 0, 1: 0})) is None          # baseline
+    assert a.observe(W(1, {0: 50 * mb, 1: 50 * mb})) is None  # streak 1
+    rec = a.observe(W(2, {0: 100 * mb, 1: 100 * mb}))     # streak 2
+    assert rec and rec["dir"] == "up" and rec["server"] == 2
+    assert ex.ups == [2]
+    assert a.last_detect_ms is not None and a.last_detect_ms >= 0
+    # Cooldown: 3 more pressured windows actuate nothing.
+    for i in (3, 4, 5):
+        assert a.observe(W(i, {0: (i + 2) * 100 * mb,
+                               1: (i + 2) * 100 * mb})) is None
+    st = a.stats()
+    assert st["actions_up"] == 1 and st["actions_down"] == 0
+    snap = tm.get_registry().snapshot()
+    assert snap.get('bps_autoscale_actions_total{dir="up"}') == 1
+    tm.reset_registry()
+
+
+def test_observe_scales_down_highest_id_never_zero():
+    sess = FakeSession()
+    ex = FakeExec()
+    a = Autoscaler(sess, ex, hold=2, cooldown=0, down_mb=8.0)
+    a.observe(W(0, {0: 0, 1: 0, 2: 0}))
+    a.observe(W(1, {0: 10, 1: 10, 2: 10}))                # quiet 1
+    rec = a.observe(W(2, {0: 20, 1: 20, 2: 20}))          # quiet 2
+    assert rec and rec["dir"] == "down" and rec["server"] == 2
+    assert sess.drained == [(2, True)] and ex.reaped == [2]
+
+
+def test_observe_doctor_pressure_and_quiet():
+    ex = FakeExec()
+    doc = FakeDoctor([{"rule": "server_hot_shard", "subject": "server=1"}])
+    a = Autoscaler(FakeSession(), ex, hold=2, cooldown=0, doctor=doc)
+    # A hot finding needs no byte delta at all — it pressures even the
+    # baseline window, so hold=2 is met at the second observe.
+    assert a.observe(W(0, {0: 0, 1: 0})) is None          # hot streak 1
+    rec = a.observe(W(1, {0: 0, 1: 0}))                   # hot streak 2
+    assert rec and rec["dir"] == "up"
+    # An open NON-hot finding blocks scale-down (quiet=False).
+    doc2 = FakeDoctor([{"rule": "tuner_thrash"}])
+    sess = FakeSession()
+    b = Autoscaler(sess, FakeExec(), hold=1, cooldown=0, doctor=doc2)
+    b.observe(W(0, {0: 0, 1: 0}))
+    assert b.observe(W(1, {0: 10, 1: 10})) is None
+    assert sess.drained == []
+
+
+def test_observe_membership_change_resets_baseline():
+    """A window whose alive set differs from the previous one has no
+    trustworthy delta: per_server is unknown, never a pressure."""
+    a = Autoscaler(FakeSession(), FakeExec(), hold=1, cooldown=0,
+                   up_mb=1.0)
+    mb = 1 << 20
+    a.observe(W(0, {0: 0, 1: 0}))
+    # Server 2 appeared: prev rows lack it -> baseline only.
+    assert a.observe(W(1, {0: 500 * mb, 1: 500 * mb,
+                           2: 500 * mb})) is None
+    assert a._up_streak == 0
+
+
+def test_observe_failed_executor_freezes_without_action():
+    ex = FakeExec(fail=True)
+    a = Autoscaler(FakeSession(), ex, hold=1, cooldown=5, up_mb=1.0)
+    mb = 1 << 20
+    a.observe(W(0, {0: 0}))
+    assert a.observe(W(1, {0: 500 * mb})) is None         # boom -> None
+    st = a.stats()
+    assert st["actions_up"] == 0 and st["actions"] == []
+    assert st["frozen_until"] == 1 + 5                    # still frozen
+    # And the freeze really holds: pressure inside it actuates nothing.
+    assert a.observe(W(2, {0: 1000 * mb})) is None
+
+
+def test_observe_draining_row_vetoes():
+    sess = FakeSession()
+    a = Autoscaler(sess, FakeExec(), hold=1, cooldown=0, up_mb=1.0)
+    mb = 1 << 20
+    a.observe(W(0, {0: 0, 1: 0}))
+    assert a.observe(W(1, {0: 500 * mb, 1: 500 * mb},
+                       draining=True)) is None
+    assert sess.drained == [] and a._up_streak == 0
+
+
+# ---------------------------------------------------------------------------
+# e2e acceptance: synthetic load ramp, real servers, 1 -> 3 -> 1
+# ---------------------------------------------------------------------------
+def _wait_members(sess, n, timeout=30):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        try:
+            ring = sess.get_ring()
+            if len(ring["servers"]) == n:
+                return ring
+        except Exception:
+            pass
+        time.sleep(0.2)
+    raise AssertionError(f"ring never reached {n} member(s)")
+
+
+def test_autoscale_e2e_ramp_1_3_1(ring_servers):
+    """The headline demo: the autoscaler — not the test — boots two
+    joiners under a synthetic pressure ramp (1 -> 3) and drains them
+    again when the load quiets (3 -> 1).  Training rounds interleave
+    every transition and stay exact: zero lost rounds, no manual
+    drain/join call anywhere."""
+    tm.reset_registry()
+    ports, base = ring_servers(1)
+    s = _ring_session(ports)
+    ex = SubprocessExecutor(root_port=base - 1, num_workers=1)
+    a = Autoscaler(s, ex, min_servers=1, max_servers=3, hold=1,
+                   cooldown=0, up_mb=1.0, down_mb=0.5)
+    mb = 1 << 20
+    keys = list(range(1, 7))
+    x = np.arange(1 << 12, dtype=np.float32)
+    mult = [0.0]
+
+    def round_all(timeout=60):
+        mult[0] += 1.0
+        hs = [s.push_pull_async(k, x * mult[0]) for k in keys]
+        for h in hs:
+            np.testing.assert_array_equal(h.wait(timeout), x * mult[0])
+
+    try:
+        round_all()
+        # -- ramp up: window deltas above up_mb drive two scale-ups.
+        w = [0]
+
+        def feed(by_server):
+            w[0] += 1
+            return a.observe(W(w[0], by_server))
+
+        feed({0: 0})                                     # baseline
+        rec = feed({0: 200 * mb})
+        assert rec and rec["dir"] == "up" and rec["server"] == 1
+        _wait_members(s, 2)
+        round_all()
+        feed({0: 400 * mb, 1: 0})                        # new baseline
+        rec = feed({0: 600 * mb, 1: 200 * mb})
+        assert rec and rec["dir"] == "up" and rec["server"] == 2
+        _wait_members(s, 3)
+        round_all()
+        # At max_servers: further pressure actuates nothing.
+        feed({0: 800 * mb, 1: 400 * mb, 2: 0})
+        assert feed({0: 1000 * mb, 1: 600 * mb, 2: 200 * mb}) is None
+
+        # -- ramp down: quiet windows drain 2 then 1 (never 0).
+        rec = feed({0: 1000 * mb, 1: 600 * mb, 2: 200 * mb})
+        assert rec and rec["dir"] == "down" and rec["server"] == 2
+        _wait_members(s, 2)
+        round_all()
+        # The survivors' lifetime counters went flat: quiet again, and
+        # with hold=1 the very next window drains server 1 (never 0).
+        rec = feed({0: 1000 * mb, 1: 600 * mb})
+        assert rec and rec["dir"] == "down" and rec["server"] == 1
+        ring = _wait_members(s, 1)
+        assert [sv["id"] for sv in ring["servers"]] == [0]
+        round_all()
+        # At min_servers: quiet actuates nothing further.
+        feed({0: 1000 * mb})
+        assert feed({0: 1000 * mb}) is None
+
+        st = a.stats()
+        assert st["actions_up"] == 2 and st["actions_down"] == 2
+        snap = tm.get_registry().snapshot()
+        assert snap.get('bps_autoscale_actions_total{dir="up"}') == 2
+        assert snap.get('bps_autoscale_actions_total{dir="down"}') == 2
+    finally:
+        s.close()
+        ex.close()
+        tm.reset_registry()

@@ -150,6 +150,40 @@ def test_sparse_family_direction():
     assert rep["groups"][0]["direction"] == "lower"
 
 
+def test_robustness_family_direction():
+    """BENCH_ELASTIC replication records (ISSUE 18): lost rounds on a
+    failover, replication lag, replication overhead, and the
+    autoscaler's detect latency are all LOWER-is-better — 0 is the law
+    for the first three — while a bare "_rounds" progress counter keeps
+    reading higher-is-better (the rule names the loss/lag shapes
+    explicitly, it does not blanket the suffix)."""
+    for metric, unit in [
+        ("failover_lost_rounds", "rounds"),
+        ("repl_lag_rounds", "rounds"),
+        ("repl_overhead_pct", "pct"),
+        ("autoscale_detect_ms", "ms"),          # via the _ms time rule
+    ]:
+        assert bench_compare._lower_is_better(metric, unit), (metric, unit)
+    # A progress counter is NOT a loss metric: more rounds completed is
+    # better, and the robustness rule must not flip it.
+    assert not bench_compare._lower_is_better("completed_rounds", "rounds")
+
+    # End to end: a failover that starts losing rounds (0 -> 1) flags
+    # against the zero baseline...
+    recs = [R(1, "failover_lost_rounds", 0.0, unit="rounds"),
+            R(2, "failover_lost_rounds", 1.0, unit="rounds")]
+    rep = bench_compare.check(recs, threshold=0.10)
+    assert len(rep["regressions"]) == 1
+    assert rep["groups"][0]["direction"] == "lower"
+    # ...staying at zero is ok...
+    recs[-1] = R(2, "failover_lost_rounds", 0.0, unit="rounds")
+    assert bench_compare.check(recs, threshold=0.10)["regressions"] == []
+    # ...and replication getting CHEAPER must not read as a regression.
+    recs = [R(1, "repl_overhead_pct", 40.0, unit="pct"),
+            R(2, "repl_overhead_pct", 12.0, unit="pct")]
+    assert bench_compare.check(recs, threshold=0.10)["regressions"] == []
+
+
 def test_throughput_units_are_higher_is_better():
     """The unit-direction law (ISSUE 15 satellite): *_mbps / *_goodput /
     throughput-ish units are explicitly HIGHER-is-better — including

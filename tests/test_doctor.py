@@ -344,6 +344,41 @@ def test_embedding_cache_thrash_boundary():
     assert "embedding_cache_thrash" not in rules_fired(at)
 
 
+def test_replication_lag_boundary():
+    """Chain replication trailing the publish cursor (ISSUE 18): fires
+    when a server's repl_lag_rounds stays above the floor for 2
+    consecutive windows; quiet on one bad window, lag at the floor,
+    replication unarmed, or a lag that recovered."""
+    def srv(l0, l1, armed=True):
+        return {"server": {"repl_armed": armed,
+                           "servers": {"0": {"repl_lag_rounds": l0},
+                                       "1": {"repl_lag_rounds": l1}}}}
+
+    # Fires: server 1's lag > 3 (default floor) for 2 windows.
+    hot = [W(0, **srv(0, 5)), W(1, **srv(0, 6))]
+    assert "replication_lag" in rules_fired(hot)
+    diag = doctor.evaluate_stream(hot)
+    f = next(x for x in diag["open"] if x["rule"] == "replication_lag")
+    assert f["subject"] == "server=1"
+    assert f["evidence"]["lag_history"] == [5, 6]
+    assert f["playbook"].endswith("#rule-replication_lag")
+    # One hot window is not persistence (threshold = 2 windows).
+    assert "replication_lag" not in rules_fired([W(0, **srv(0, 9))])
+    # Exactly AT the floor (3) is not above it.
+    at = [W(0, **srv(0, 3)), W(1, **srv(0, 3))]
+    assert "replication_lag" not in rules_fired(at)
+    # Recovered in the second window: quiet (every window must exceed).
+    rec = [W(0, **srv(0, 9)), W(1, **srv(0, 0))]
+    assert "replication_lag" not in rules_fired(rec)
+    # Replication unarmed: the rows mean nothing, never fire.
+    off = [W(0, **srv(0, 9, armed=False)), W(1, **srv(0, 9, armed=False))]
+    assert "replication_lag" not in rules_fired(off)
+    # Threshold override: floor 1 catches the lag the default tolerates.
+    low = [W(0, **srv(0, 2)), W(1, **srv(0, 2))]
+    assert "replication_lag" not in rules_fired(low)
+    assert "replication_lag" in rules_fired(low, repl_lag_rounds=1)
+
+
 def test_every_rule_has_a_boundary_test():
     """The fire/no-fire coverage above must track the rule set: a new
     rule without a test here is exactly the drift this file pins."""
@@ -352,7 +387,7 @@ def test_every_rule_has_a_boundary_test():
                "fusion_dilution", "server_hot_shard",
                "nonfinite_gradients", "audit_mismatch", "barrier_stall",
                "tuner_thrash", "knob_thrash", "param_version_stall",
-               "embedding_cache_thrash"}
+               "embedding_cache_thrash", "replication_lag"}
     assert set(doctor.RULE_IDS) == covered
 
 

@@ -1,0 +1,399 @@
+"""Chain-replicated server state tests (ISSUE 18, docs/elasticity.md
+"The zero-loss law").
+
+Drives the REAL client/server wire through the replication plane:
+every publish ships the key's boundary state (published ``out``,
+``completed_round``, optimizer slots, embedding rows) to the ring
+successor over CMD_REPL, pulls gate on the successor's ack, and a
+SIGKILLed owner's state is ADOPTED by the fresh owner instead of
+rebased — zero lost rounds, zero optimizer resets.  Also pins the
+negative space: an unarmed run's worker wire is byte-identical
+(CMD_REPL is server-to-server only), and a poisoned/torn replica blob
+is adopt-whole-or-discard — never installed torn.
+"""
+
+import os
+import socket
+import struct
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from byteps_tpu.server.client import (
+    PSSession, CMD_HELLO, CMD_INIT, CMD_PUSH, CMD_PULL,
+)
+from testutil import StubPSServer
+
+# Shared elastic-tier harness: N ring-armed subprocess servers + the
+# SIGKILL fault (re-exporting the fixture is the point of the import).
+from test_server_elastic import (  # noqa: F401
+    ring_servers, _ring_session, _kill_listener,
+)
+
+CMD_REPL = 20   # server.cc Cmd::kRepl — the Python client never sends
+                # it in production; the poison test below crafts one.
+
+
+# ---------------------------------------------------------------------------
+# fast: unarmed (and armed) worker wire is byte-identical — CMD_REPL is
+# a server-to-server frame, never a worker one
+# ---------------------------------------------------------------------------
+def _recorded_roundtrip():
+    store = {}
+
+    def handler(cmd, dt, fl, req_id, wid, key, payload):
+        if cmd == CMD_HELLO:
+            return 0, b"\x00\x00"
+        if cmd == CMD_INIT:
+            return 0, struct.pack("<Q", 0)
+        if cmd == CMD_PUSH:
+            store[key] = bytes(payload)
+            return 0, b""
+        if cmd == CMD_PULL:
+            return 0, store[key]
+        return 1, b""
+
+    srv = StubPSServer(handler, record_payload=True)
+    try:
+        s = PSSession(["127.0.0.1"], [srv.port], worker_id=0,
+                      num_servers=1, wire_conns=1, compress_threads=0)
+        x = np.arange(256, dtype=np.float32)
+        for m in (1.0, 2.0, 3.0):
+            np.testing.assert_array_equal(s.push_pull(3, x * m), x * m)
+        s.close()
+        with srv.lock:
+            return list(zip(srv.frames, srv.payloads))
+    finally:
+        srv.close()
+
+
+def test_repl_unarmed_wire_byte_identical(monkeypatch):
+    """BYTEPS_TPU_REPL=0 (and even =1, worker-side) sends byte-for-byte
+    the pre-replication worker protocol: replication is owner->successor
+    only, so the recording stub must see the same frames either way and
+    never a CMD_REPL."""
+    monkeypatch.delenv("BYTEPS_TPU_REPL", raising=False)
+    off = _recorded_roundtrip()
+    monkeypatch.setenv("BYTEPS_TPU_REPL", "1")
+    on = _recorded_roundtrip()
+    for (fo, po), (fn, pn) in zip(off, on):
+        assert fo == fn and po == pn
+    assert len(off) == len(on)
+    cmds = {c for (_, c, _), _ in off + on}
+    assert cmds <= {CMD_HELLO, CMD_INIT, CMD_PUSH, CMD_PULL}, cmds
+    assert CMD_REPL not in cmds
+
+
+# ---------------------------------------------------------------------------
+# fast: two ring servers — every publish replicates, stats surface it
+# ---------------------------------------------------------------------------
+def test_repl_two_server_stats(ring_servers, monkeypatch):
+    monkeypatch.setenv("BYTEPS_TPU_REPL", "1")
+    ports, _ = ring_servers(2, extra_env={"BYTEPS_TPU_REPL": "1"})
+    s = _ring_session(ports)
+    try:
+        keys = list(range(1, 9))
+        x = np.arange(1 << 12, dtype=np.float32)
+        for m in (1.0, 2.0, 3.0):
+            hs = [s.push_pull_async(k, x * m) for k in keys]
+            for h in hs:
+                np.testing.assert_array_equal(h.wait(30), x * m)
+        st = s.server_stats()
+        assert st["repl_armed"]
+        assert st["repl_bytes_total"] > 0
+        assert st["repl_replicas_held"] >= 1     # successors hold blobs
+        assert st["repl_promotions"] == 0        # nobody died
+        # With lag window 0 every served round is already acked — the
+        # steady-state lag the doctor rule watches is 0.
+        assert st["repl_lag_rounds"] == 0
+        for row in st["servers"].values():
+            assert "repl_lag_rounds" in row and "repl_bytes_out" in row
+        # The gauges ride the same merged dict (satellite 6).
+        from byteps_tpu.common import telemetry as tm
+        tm.reset_registry()
+        try:
+            tm.update_repl(st)
+            snap = tm.get_registry().snapshot()
+            assert snap.get("bps_repl_bytes_total", 0) > 0
+            assert 'bps_repl_lag_rounds{server="0"}' in snap
+            # Unarmed stats register NOTHING (quiet-when-off law).
+            tm.reset_registry()
+            tm.update_repl({"repl_armed": False, "repl_bytes_total": 9})
+            assert "bps_repl_bytes_total" not in tm.get_registry() \
+                .snapshot()
+        finally:
+            tm.reset_registry()
+    finally:
+        s.close()
+
+
+# ---------------------------------------------------------------------------
+# fast: SIGKILL failover adopts the replica — zero lost rounds
+# ---------------------------------------------------------------------------
+def test_repl_failover_adopts_replica_zero_lost_rounds(ring_servers,
+                                                       monkeypatch):
+    """1-of-2 servers SIGKILLed with replication + the auditor armed:
+    the survivor promotes the dead server's replicas (published rounds
+    included), the auditor's cross-check reports ZERO lost rounds, and
+    values stay exact."""
+    monkeypatch.setenv("BYTEPS_TPU_REPL", "1")
+    monkeypatch.setenv("BYTEPS_TPU_AUDIT", "1")
+    ports, _ = ring_servers(
+        2, extra_env={"BYTEPS_TPU_REPL": "1", "BYTEPS_TPU_AUDIT": "1"})
+    s = _ring_session(ports, srv_evict=0.8, audit=True)
+    try:
+        keys = list(range(1, 9))
+        x = np.arange(1 << 12, dtype=np.float32)
+        for m in (1.0, 2.0, 3.0):
+            hs = [s.push_pull_async(k, x * m) for k in keys]
+            for h in hs:
+                np.testing.assert_array_equal(h.wait(30), x * m)
+
+        _kill_listener(ports[1])
+
+        hs = [s.push_pull_async(k, x * 5) for k in keys]
+        for h in hs:
+            np.testing.assert_array_equal(h.wait(60), x * 5)
+        hs = [s.push_pull_async(k, x * 6) for k in keys]
+        for h in hs:
+            np.testing.assert_array_equal(h.wait(30), x * 6)
+
+        st = s.server_stats()
+        assert st["repl_promotions"] >= 1, st
+        audit = s.audit_check()
+        assert audit["compared"] > 0
+        assert list(audit.get("lost_rounds") or ()) == []
+        assert list(audit.get("mismatches") or ()) == []
+        assert s.transport_stats()["server_failovers"] >= 1
+    finally:
+        s.close()
+
+
+# ---------------------------------------------------------------------------
+# fast: adopt-whole-or-discard — a garbage replica blob is refused, the
+# failover falls back to the fresh-declare path, values stay exact
+# ---------------------------------------------------------------------------
+def test_repl_poisoned_replica_discarded_never_torn(ring_servers,
+                                                    monkeypatch):
+    """A replica blob that does not parse whole (here: a crafted
+    CMD_REPL carrying garbage at a round newer than the genuine
+    replicas) must be DISCARDED at adoption — the fresh owner falls
+    back to re-declare + worker re-push, never installs a torn/partial
+    state.  This is the receive-side half of the kill_after_bytes law:
+    whatever arrives, adoption is whole-or-nothing."""
+    monkeypatch.setenv("BYTEPS_TPU_REPL", "1")
+    ports, _ = ring_servers(2, extra_env={"BYTEPS_TPU_REPL": "1"})
+    s = _ring_session(ports, srv_evict=0.8)
+    try:
+        keys = list(range(1, 9))
+        x = np.arange(1 << 12, dtype=np.float32)
+        for m in (1.0, 2.0):
+            hs = [s.push_pull_async(k, x * m) for k in keys]
+            for h in hs:
+                np.testing.assert_array_equal(h.wait(30), x * m)
+
+        # Pick a key OWNED by server 1 (its genuine replica lives on
+        # server 0) and poison server 0's replica for it: round 999
+        # wins newest-round-wins, but the blob body is garbage.
+        doomed = [pk for pk, srv in s._pkey_srv.items() if srv == 1]
+        assert doomed, "ring placed nothing on server 1; test vacuous"
+        slot0 = next(sl for sl, sid in s._slot_srv.items() if sid == 0)
+        poison = struct.pack("<Q", 999) + b"\xde\xad" * 40
+        s.conns[slot0].request(CMD_REPL, doomed[0], poison,
+                               worker_id=0, timeout=10.0)
+
+        _kill_listener(ports[1])
+
+        # Every key — poisoned one included — completes the next rounds
+        # with exact values: genuine replicas adopt, the poisoned one
+        # discards and re-declares (the open round re-pushes).
+        hs = [s.push_pull_async(k, x * 7) for k in keys]
+        for h in hs:
+            np.testing.assert_array_equal(h.wait(60), x * 7)
+        hs = [s.push_pull_async(k, x * 8) for k in keys]
+        for h in hs:
+            np.testing.assert_array_equal(h.wait(30), x * 8)
+        assert s.transport_stats()["server_failovers"] >= 1
+    finally:
+        s.close()
+
+
+# ---------------------------------------------------------------------------
+# fast: chaos_proxy kill_after_bytes — the transport fault itself
+# ---------------------------------------------------------------------------
+def test_chaos_proxy_kill_after_bytes():
+    """kill_after_bytes(n): the proxy forwards exactly n more bytes —
+    mid-chunk, mid-frame, wherever n lands — then RSTs every
+    connection and refuses new ones (the SIGKILL-shaped transport
+    fault a severed replication/migration transfer sees)."""
+    import sys
+    sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tools"))
+    from chaos_proxy import ChaosProxy
+
+    received = []
+    done = threading.Event()
+    sink = socket.socket()
+    sink.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    sink.bind(("127.0.0.1", 0))
+    sink.listen(1)
+
+    def drain():
+        c, _ = sink.accept()
+        try:
+            while True:
+                b = c.recv(4096)
+                if not b:
+                    break
+                received.append(b)
+        except OSError:
+            pass
+        finally:
+            c.close()
+            done.set()
+
+    t = threading.Thread(target=drain, daemon=True)
+    t.start()
+    try:
+        with ChaosProxy("127.0.0.1", sink.getsockname()[1]) as proxy:
+            c = socket.create_connection(("127.0.0.1", proxy.port), 5)
+            c.sendall(b"x" * 64)                 # pre-fault traffic
+            deadline = time.time() + 5
+            while sum(map(len, received)) < 64 and time.time() < deadline:
+                time.sleep(0.01)
+            proxy.kill_after_bytes(10)
+            try:
+                c.sendall(b"y" * 1000)           # torn after 10 bytes
+                # The kill lands as an RST or an EOF depending on
+                # where the race catches the socket — dead either way.
+                c.settimeout(5)
+                while c.recv(4096):
+                    pass
+            except OSError:
+                pass
+            finally:
+                c.close()
+            done.wait(5)
+            got = b"".join(received)
+            assert got == b"x" * 64 + b"y" * 10, (len(got), got[-16:])
+            # Refusal is permanent: a reconnect never reaches the sink.
+            try:
+                c2 = socket.create_connection(
+                    ("127.0.0.1", proxy.port), 2)
+                c2.settimeout(2)
+                assert c2.recv(1) == b""         # immediate close/RST
+                c2.close()
+            except OSError:
+                pass
+    finally:
+        sink.close()
+
+
+# ---------------------------------------------------------------------------
+# fast: PR 17 pull-only observer survives a server SIGKILL with
+# monotone param_version (only drain was covered before)
+# ---------------------------------------------------------------------------
+def test_pull_only_observer_survives_server_sigkill(ring_servers,
+                                                    monkeypatch):
+    monkeypatch.setenv("BYTEPS_TPU_REPL", "1")
+    monkeypatch.setenv("BYTEPS_TPU_SPARSE_CACHE_TTL_MS", "0")
+    ports, _ = ring_servers(
+        2, num_workers=1, extra_env={"BYTEPS_TPU_REPL": "1"})
+    s = _ring_session(ports, srv_evict=0.8, compress_threads=0)
+    r = _ring_session(ports, wid=77, srv_evict=0.8, compress_threads=0,
+                      pull_only=True)
+    try:
+        rows, width = 300, 8
+        rng = np.random.RandomState(4)
+        # Several tables so at least one lands on the doomed server.
+        eids = list(range(21, 27))
+        for e in eids:
+            s.declare_embedding(e, rows, width)
+            r.declare_embedding(e, rows, width)
+        victims = [e for e in eids
+                   if s._embed_srv(s._embed_pkey(e)) == 1]
+        assert victims, "ring placed no table on server 1; test vacuous"
+        idx = np.arange(0, rows, 7, dtype=np.uint32)
+        want = {}
+        for e in eids:
+            g = rng.randn(idx.size, width).astype(np.float32)
+            want[e] = s.push_pull_sparse(e, idx, g)
+        for e in eids:
+            np.testing.assert_array_equal(r.pull_rows(e, idx), want[e])
+        v_pre = {e: r.embed_version(e) for e in eids}
+        assert all(v is not None for v in v_pre.values()), v_pre
+
+        _kill_listener(ports[1])
+
+        # Training continues through the failover; the reader follows
+        # the re-placement and its version clock never runs backwards.
+        for e in eids:
+            g = rng.randn(idx.size, width).astype(np.float32)
+            want[e] = s.push_pull_sparse(e, idx, g, timeout=60)
+        for e in eids:
+            np.testing.assert_array_equal(r.pull_rows(e, idx), want[e])
+            v = r.embed_version(e)
+            assert v is not None and v >= v_pre[e], (e, v, v_pre[e])
+    finally:
+        r.close()
+        s.close()
+
+
+# ---------------------------------------------------------------------------
+# slow: the ISSUE acceptance chaos test — SIGKILL 1-of-3 with
+# server-side Adam armed: bit-identical, zero lost rounds, zero reseeds
+# ---------------------------------------------------------------------------
+@pytest.mark.slow
+def test_chaos_sigkill_server_adam_bit_identical(ring_servers,
+                                                 monkeypatch):
+    from byteps_tpu.parallel.server_opt import ServerOptTrainer
+
+    monkeypatch.setenv("BYTEPS_TPU_REPL", "1")
+    monkeypatch.setenv("BYTEPS_TPU_AUDIT", "1")
+    extra = {"BYTEPS_TPU_REPL": "1", "BYTEPS_TPU_AUDIT": "1"}
+    rng = np.random.RandomState(13)
+    nel = 6 * (1 << 14)           # 384 KiB -> 6 partitions at 64 KiB
+    params0 = {"w": rng.randn(nel).astype(np.float32)}
+    grads = [{"w": rng.randn(nel).astype(np.float32)} for _ in range(8)]
+    kw = {"opt": "adam", "lr": 1e-3}
+
+    def run(ports, kill_at=None):
+        s = _ring_session(ports, srv_evict=1.0, audit=True)
+        try:
+            tr = ServerOptTrainer(s, params0, kw, mode="server",
+                                  declared_key=83)
+            traj = []
+            for i, g in enumerate(grads):
+                if kill_at is not None and i == kill_at:
+                    by_srv = {}
+                    for pk in s._opt_pkeys(83):
+                        sid = s._pkey_srv.get(pk, 0)
+                        by_srv[sid] = by_srv.get(sid, 0) + 1
+                    target = max((sid for sid in by_srv if sid != 0),
+                                 key=lambda sid: by_srv[sid],
+                                 default=None)
+                    assert target is not None and by_srv[target] > 0, \
+                        "ring placed no Adam partition off server 0"
+                    _kill_listener(ports[target])
+                traj.append(np.asarray(tr.step(g, timeout=120.0)["w"]))
+            st = s.transport_stats()
+            audit = s.audit_check()
+            return traj, st, audit
+        finally:
+            s.close()
+
+    ports_a, _ = ring_servers(3, extra_env=extra)
+    ref, _, _ = run(ports_a)
+    ports_b, _ = ring_servers(3, extra_env=extra)
+    got, st, audit = run(ports_b, kill_at=3)
+
+    # m/v preserved across the kill: the full Adam trajectory is
+    # bit-identical to the unfaulted run — not merely close.
+    for i, (a, b) in enumerate(zip(ref, got)):
+        np.testing.assert_array_equal(a, b, err_msg=f"round {i}")
+    assert st["server_failovers"] >= 1
+    assert st.get("opt_reseeds", 0) == 0, st     # adopted, not re-seeded
+    assert list(audit.get("lost_rounds") or ()) == []
+    assert list(audit.get("mismatches") or ()) == []

@@ -108,6 +108,16 @@ def _lower_is_better(metric: str, unit: str) -> bool:
     # a converging tuner would flag as a regression.
     if metric.endswith("_gap_pct") or unit == "pct_gap":
         return True
+    # The robustness families (BENCH_ELASTIC with replication armed):
+    # lost rounds on a failover, how far replication trails the publish
+    # cursor, and how long the autoscaler took to notice pressure — all
+    # counts where 0 is the law and any growth is a regression.  A bare
+    # "_rounds" suffix would otherwise fall through to higher-is-better
+    # (completed_round-style progress counters legitimately read that
+    # way), so the loss/lag shapes are named explicitly
+    # (autoscale_detect_ms already reads lower via the _ms rule above).
+    if metric.endswith(("_lost_rounds", "_lag_rounds", "_overhead_pct")):
+        return True
     return unit in ("ms", "ns", "s", "seconds", "us")
 
 

@@ -299,6 +299,8 @@ def render(metrics: dict, prev: dict, dt: float,
             mig.setdefault(d.get("server"), {})[d.get("direction")] = int(v)
         slot_bytes = {dict(k).get("server"): int(v) for k, v in
                       (metrics.get("bps_opt_slot_bytes") or {}).items()}
+        repl_lag = {dict(k).get("server"): int(v) for k, v in
+                    (metrics.get("bps_repl_lag_rounds") or {}).items()}
         total_owned = sum(owned.values()) or 1
         lines.append(f"PS servers (ring epoch {ring_epoch})")
         for key, alive in sorted(srv_alive.items(),
@@ -313,8 +315,24 @@ def render(metrics: dict, prev: dict, dt: float,
             flag = "" if alive else "  <-- dead/retired"
             ob = slot_bytes.get(sid)
             opttxt = f"  opt slots {_fmt_bytes(ob)}" if ob else ""
+            # Chain replication (BYTEPS_TPU_REPL=1): rounds the ring
+            # successor has not acked yet — non-zero is a growing
+            # would-be loss window (doctor rule replication_lag).
+            rl = repl_lag.get(sid)
+            repltxt = (f"  repl lag {rl}" if rl else "")
             lines.append(f"  server {sid:>3}  keys {n:5d}  {bar}"
-                         f"{migtxt}{opttxt}{flag}")
+                         f"{migtxt}{opttxt}{repltxt}{flag}")
+        repl_bytes = _get(metrics, "bps_repl_bytes_total")
+        if repl_bytes:
+            lines.append(f"  replication: {_fmt_bytes(repl_bytes)} "
+                         f"shipped to ring successors")
+        # Autoscaler actions (BYTEPS_TPU_AUTOSCALE=1): executed ring
+        # transitions by direction.
+        asc = {dict(k).get("dir"): int(v) for k, v in
+               (metrics.get("bps_autoscale_actions_total") or {}).items()}
+        if asc:
+            lines.append(f"  autoscale: up {asc.get('up', 0)} / "
+                         f"down {asc.get('down', 0)} action(s)")
         lines.append("")
 
     # Server-resident optimizer plane: per-key published update counts

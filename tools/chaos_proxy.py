@@ -117,6 +117,19 @@ class ChaosProxy:
         with self._lock:
             self._fault = _Fault("drop", nbytes, once)
 
+    def kill_after_bytes(self, nbytes: int = 0) -> None:
+        """Sever this target PERMANENTLY after `nbytes` further
+        upstream-bound bytes: forward exactly that prefix (so the cut
+        lands mid-frame, not politely on a frame boundary), RST every
+        live leg, and refuse all future dials — the SIGKILL that lands
+        partway through a replication/migration state transfer.  The
+        receiver of the torn transfer must discard it whole (the wire's
+        length-prefixed framing never dispatches a partial frame), so
+        handoff is adopt-whole-or-discard, never torn.  pass_through()
+        undoes the refusal (replacement hardware)."""
+        with self._lock:
+            self._fault = _Fault("kill", nbytes, True)
+
     def delay(self, ms: float) -> None:
         """Add per-chunk latency in both directions (crude WAN emulation)."""
         with self._lock:
@@ -258,6 +271,10 @@ class ChaosProxy:
                             # on a frame boundary.
                             fire, cut = fault.kind, fault.after_bytes
                             self._faults_fired += 1
+                            if fault.kind == "kill":
+                                # The peer dies WITH the torn transfer:
+                                # no future dial may find it healed.
+                                self._refuse = True
                             if fault.once:
                                 self._fault = None
                             else:
@@ -276,7 +293,11 @@ class ChaosProxy:
                             dst.sendall(data[:cut])
                         except OSError:
                             pass
-                    self._kill_pair(src, dst, rst=(fire == "reset"))
+                    self._kill_pair(src, dst, rst=(fire != "drop"))
+                    if fire == "kill":
+                        # Every OTHER live connection to this target dies
+                        # too — a SIGKILLed process takes all its sockets.
+                        self.kill_connections()
                     return
                 dst.sendall(data)
                 with self._lock:
@@ -375,6 +396,11 @@ class MultiChaosProxy:
         """Target i is gone for good: drop and refuse forever."""
         self.proxies[i].kill_permanently()
 
+    def kill_after_bytes(self, i: int, nbytes: int = 0) -> None:
+        """Target i dies mid-frame after `nbytes` more upstream bytes
+        (then refuses forever) — the torn-transfer SIGKILL."""
+        self.proxies[i].kill_after_bytes(nbytes)
+
     def restore(self, i: int) -> None:
         """Heal target i (clear every armed fault)."""
         self.proxies[i].pass_through()
@@ -401,6 +427,10 @@ def main() -> int:
                     help="RST connections after N upstream bytes")
     ap.add_argument("--drop-after", type=int, default=None, metavar="N",
                     help="FIN connections after N upstream bytes")
+    ap.add_argument("--kill-after", type=int, default=None, metavar="N",
+                    help="RST mid-frame after N upstream bytes, then "
+                         "refuse all future connections (torn-transfer "
+                         "SIGKILL)")
     ap.add_argument("--blackhole", action="store_true",
                     help="swallow all traffic silently")
     ap.add_argument("--kill-permanent", action="store_true",
@@ -425,6 +455,8 @@ def main() -> int:
             proxy.reset_after(args.reset_after, once=not args.flap)
         if args.drop_after is not None:
             proxy.drop_after(args.drop_after, once=not args.flap)
+        if args.kill_after is not None:
+            proxy.kill_after_bytes(args.kill_after)
         if args.blackhole:
             proxy.blackhole(True)
         if args.kill_permanent:
