@@ -45,6 +45,10 @@ Modes:
   BENCH_DOCTOR=1     signal-plane/doctor-overhead bench: sync-round time
                      with the windowed key-signal plane + doctor rules
                      hot vs off, plus the per-window roll cost
+  BENCH_FLEET=1      fleet-plane bench: sync-round time with CMD_WINDOW
+                     publishing + CMD_FLEET fetching hot per window vs
+                     off; emits fleet_plane_overhead_ms and the goodput
+                     ledger's fleet_goodput_pct over the live merged view
   BENCH_AUTOTUNE=1   adaptive-compression bench: the same mixed-key
                      workload UNTUNED-with-tuner (starts raw, the tuner
                      renegotiates codecs live off the signal plane) vs
@@ -1433,6 +1437,124 @@ def bench_doctor():
         proc.wait()
 
 
+def bench_fleet():
+    """Fleet-plane benchmark (BENCH_FLEET=1): the two headline numbers
+    the observability plane is accountable for.
+
+    `fleet_plane_overhead_ms` — median 4 MB sync-round time with the
+    fleet plane HOT (0.5 s signal windows each publishing one
+    CMD_WINDOW frame and fetching the merged CMD_FLEET view — the full
+    armed per-window wire cost) minus median with the plane idle (fleet
+    wire armed, nothing published).  The publish/fetch pair rides the
+    window-roll thread, so the delta is expected within round-to-round
+    noise — the armed-cost-off-critical-path law this bench exists to
+    keep honest.  Lower is better.
+
+    `fleet_goodput_pct` — the goodput ledger's compute share over the
+    live merged view's last aligned window: wall-time partitioned
+    EXACTLY into compute/wire/straggler-wait/stall/recovery/disruption
+    (the partition is asserted inside the ledger).  Higher is better.
+    Host-only, like BENCH_PS; mirrors BENCH_DOCTOR's shape.
+    """
+    import numpy as np
+
+    from byteps_tpu.common import doctor as doctor_mod
+    from byteps_tpu.common import goodput as goodput_mod
+    from byteps_tpu.common import signals
+    from byteps_tpu.server.client import PSSession
+
+    reps = int(os.environ.get("BENCH_FLEET_REPS", "30"))
+    proc, port = _boot_ps_server(engine_threads=2,
+                                 extra_env={"BYTEPS_TPU_FLEET": "1"})
+    try:
+        sess = PSSession(["127.0.0.1"], [port], worker_id=0,
+                         num_servers=1, fleet=True)
+        if not sess._fleet_wire:
+            raise RuntimeError("fleet bootstrap probe downgraded against "
+                               "a fleet-armed server — wire bug")
+        x = np.random.default_rng(0).standard_normal(
+            1 << 20, dtype=np.float32)            # 4 MB, one partition
+        sess.push_pull(1, x)                      # init + warm
+
+        def rounds(n):
+            times = []
+            for _ in range(n):
+                t0 = time.perf_counter()
+                sess.push_pull(1, x)
+                times.append(time.perf_counter() - t0)
+            return times
+
+        rounds(5)                                 # settle
+        off = rounds(reps)                        # armed wire, idle plane
+
+        published = {"n": 0}
+
+        def _on_window(summary):
+            doc = doctor_mod.fleet_publish_doc(
+                summary, 0, clock=sess.fleet_clock_offset())
+            if sess.publish_window(int(doc.get("window") or 0), doc):
+                published["n"] += 1
+            sess.fetch_fleet()
+
+        signals.arm(window_s=0.5, history=32,
+                    refresh=lambda: sess.server_stats(),
+                    providers={"transport": sess.transport_stats},
+                    on_window=_on_window)
+        rounds(5)                                 # settle under windows
+        hot = rounds(reps)                        # publish+fetch per window
+        time.sleep(0.7)                           # let the last window roll
+        view = sess.fetch_fleet()
+        fw = doctor_mod.fleet_windows_from_view(view)
+        signals.disarm()
+        sess.close()
+        if not fw:
+            raise RuntimeError("no fleet window published over the run")
+        ledger = goodput_mod.fleet_ledger(fw[-1])
+
+        off_med = sorted(off)[len(off) // 2]
+        hot_med = sorted(hot)[len(hot) // 2]
+        delta_ms = (hot_med - off_med) * 1e3
+        print(json.dumps({
+            "metric": "fleet_goodput_pct",
+            "value": round(ledger["goodput_pct"], 2),
+            "unit": "pct",
+            "detail": {
+                "window": ledger["window"],
+                "total_s": round(ledger["total_s"], 3),
+                "seconds": {c: round(v, 4)
+                            for c, v in ledger["seconds"].items()},
+                "windows_published": published["n"],
+                "note": "compute share of fleet wall-time from the "
+                        "goodput ledger over the live merged CMD_FLEET "
+                        "view's last aligned window; the six categories "
+                        "sum exactly to the total (asserted)",
+                **_note(),
+            },
+        }))
+        print(json.dumps({
+            "metric": "fleet_plane_overhead_ms",
+            "value": round(delta_ms, 3),
+            "unit": "ms",
+            "vs_baseline": round(hot_med / off_med, 3),
+            "detail": {
+                "round_off_median_ms": round(off_med * 1e3, 2),
+                "round_hot_median_ms": round(hot_med * 1e3, 2),
+                "window_s": 0.5,
+                "reps": reps,
+                "windows_published": published["n"],
+                "note": "value = median 4MB sync round with one "
+                        "CMD_WINDOW publish + CMD_FLEET fetch per 0.5s "
+                        "window minus median with the plane idle; the "
+                        "pair rides the window-roll thread, so expected "
+                        "within round-to-round noise",
+                **_note(),
+            },
+        }))
+    finally:
+        proc.kill()
+        proc.wait()
+
+
 def bench_autotune():
     """Adaptive-compression benchmark (BENCH_AUTOTUNE=1): how close the
     self-tuning control loop gets an UNTUNED job to the HAND-TUNED
@@ -2488,6 +2610,8 @@ def main():
         bench_audit()        # host-only: no device backend involved
     elif os.environ.get("BENCH_DOCTOR", "0") == "1":
         bench_doctor()       # host-only: no device backend involved
+    elif os.environ.get("BENCH_FLEET", "0") == "1":
+        bench_fleet()        # host-only: no device backend involved
     elif os.environ.get("BENCH_SERVEROPT", "0") == "1":
         bench_serveropt()    # host-only: no device backend involved
     elif os.environ.get("BENCH_HIER", "0") == "1":

@@ -24,7 +24,7 @@ from .common.api import (
     get_pushpull_speed, get_codec_stats, get_fusion_stats,
     get_transport_stats, get_metrics, get_server_stats,
     get_health, get_audit, get_key_signals, get_diagnosis,
-    get_tuner, get_hierarchy, get_autoscaler,
+    get_tuner, get_hierarchy, get_autoscaler, get_fleet,
     mark_step, current_step,
 )
 from .parallel.async_ps import AsyncPSTrainer
@@ -75,7 +75,7 @@ __all__ = [
     "get_pushpull_speed", "get_codec_stats", "get_fusion_stats",
     "get_transport_stats", "get_metrics", "get_server_stats",
     "get_health", "get_audit", "get_key_signals", "get_diagnosis",
-    "get_tuner", "get_hierarchy", "get_autoscaler",
+    "get_tuner", "get_hierarchy", "get_autoscaler", "get_fleet",
     "HierarchicalReducer", "SliceGroup",
     "mark_step", "current_step",
     "Compression", "collectives",

@@ -77,6 +77,15 @@ class _State:
     # PS-tier autoscaler (BYTEPS_TPU_AUTOSCALE=1): chained after the
     # doctor on the same window stream; worker 0 only.
     autoscaler: Optional[Any] = None
+    # Fleet observability plane (BYTEPS_TPU_FLEET=1, PS mode): every
+    # worker publishes its window summary via CMD_WINDOW; worker 0
+    # additionally fetches the merged CMD_FLEET view each window and
+    # runs the fleet doctor + goodput ledger over it.
+    fleet_engine: Optional[Any] = None       # fleet-rule DoctorEngine (w0)
+    fleet_view: Optional[dict] = None        # last merged CMD_FLEET view
+    fleet_windows: Optional[list] = None     # last aligned window stream
+    fleet_ledger: Optional[dict] = None      # last window's goodput ledger
+    fleet_published: Optional[Any] = None    # this worker's publish ring
     # Hierarchical reduction (BYTEPS_TPU_HIERARCHY=1, PS mode): the
     # HierarchicalReducer push_pull_tree/push_pull_async route through —
     # slice-reduce in-graph, leader-only wire round, broadcast back.
@@ -1280,6 +1289,10 @@ def get_server_stats() -> dict:
     # Chain-replication plane: bps_repl_lag_rounds{server=} +
     # bps_repl_bytes_total.  Quiet unless BYTEPS_TPU_REPL is armed.
     telemetry.update_repl(stats)
+    # Fleet observability plane: bps_fleet_windows_held{server=} +
+    # bps_fleet_publishes_total.  Quiet unless BYTEPS_TPU_FLEET is
+    # armed on the server tier.
+    telemetry.update_fleet(stats)
     return stats
 
 
@@ -1370,6 +1383,63 @@ def _start_signal_plane(cfg) -> None:
                 down_mb=cfg.autoscale_down_mb,
                 doctor=eng)
 
+    # Fleet observability plane (BYTEPS_TPU_FLEET=1, docs/monitoring.md
+    # "Fleet plane"): chained onto the same window stream.  Every worker
+    # publishes one compact CMD_WINDOW frame per roll; worker 0 fetches
+    # the merged CMD_FLEET view, runs the fleet doctor + goodput ledger
+    # over it, and — when the autoscaler is armed — feeds the scaler the
+    # FLEET view instead of its own possibly-blind local one.  All of it
+    # rides the window-roll thread, off the push_pull critical path.
+    fleet_eng = None
+    fleet_on = bool(cfg.fleet and sess is not None
+                    and getattr(sess, "_fleet_wire", False))
+    if fleet_on:
+        import collections
+        _state.fleet_published = collections.deque(
+            maxlen=max(1, cfg.fleet_windows))
+        if cfg.worker_id == 0:
+            fleet_eng = doctor_mod.DoctorEngine(
+                rules=doctor_mod.FLEET_RULES)
+            _state.fleet_engine = fleet_eng
+
+    def _fleet_pass(summary):
+        from . import goodput as goodput_mod
+        open_ids = [f.get("rule") for f in
+                    (eng.diagnosis().get("open") or [])]
+        doc = doctor_mod.fleet_publish_doc(
+            summary, cfg.worker_id,
+            clock=sess.fleet_clock_offset(),
+            open_findings=open_ids,
+            codecs=sess.codec_table())
+        if sess.publish_window(int(doc.get("window") or 0), doc):
+            _state.fleet_published.append(doc)
+        if fleet_eng is None:
+            return
+        view = sess.fetch_fleet()
+        _state.fleet_view = view
+        fw = doctor_mod.fleet_windows_from_view(view)
+        _state.fleet_windows = fw
+        if not fw:
+            return
+        # The engine keeps its own history; feed only windows it has
+        # not seen (aligned rows for OLD indexes may still gain late
+        # workers, but re-observing them would reset finding identity).
+        last_seen = getattr(_fleet_pass, "_last_idx", -1)
+        for w in fw:
+            if w["window"] > last_seen:
+                fleet_eng.observe(w)
+                _fleet_pass._last_idx = w["window"]
+        try:
+            led = goodput_mod.fleet_ledger(fw[-1])
+            _state.fleet_ledger = led
+            goodput_mod.update_goodput(led)
+        except Exception:
+            get_logger().exception("goodput ledger failed")
+        if autoscaler is not None:
+            fs = autoscaler_mod.fleet_summary(fw[-1])
+            if fs is not None:
+                autoscaler.observe(fs)
+
     def _on_window(summary):
         eng.observe(summary)
         if tuner is not None:
@@ -1377,7 +1447,14 @@ def _start_signal_plane(cfg) -> None:
                 tuner.observe(summary)
             except Exception:
                 get_logger().exception("tuner window pass failed")
-        if autoscaler is not None:
+        if fleet_on:
+            try:
+                _fleet_pass(summary)
+            except Exception:
+                get_logger().exception("fleet window pass failed")
+        if autoscaler is not None and not fleet_on:
+            # Fleet-armed runs feed the scaler the merged view inside
+            # _fleet_pass; unarmed runs keep the local-summary feed.
             try:
                 autoscaler.observe(summary)
             except Exception:
@@ -1396,6 +1473,12 @@ def _start_signal_plane(cfg) -> None:
         lambda: {"diagnosis": eng.diagnosis(),
                  "signals": plane.history()},
         name="doctor")
+    if fleet_on:
+        # Postmortem bundles gain a "fleet" section: this worker's
+        # published ring (the exact docs its CMD_WINDOW frames carried
+        # — what fleet_view_from_bundles merges for offline parity) and,
+        # on worker 0, the last merged view + fleet diagnosis.
+        flightrec.set_extra_provider(_fleet_extra, name="fleet")
     if not _state.doctor_atexit:
         # Crash guard: a run that never reaches shutdown() still logs
         # its one-line verdict (and the postmortem bundle's diagnosis
@@ -1403,6 +1486,26 @@ def _start_signal_plane(cfg) -> None:
         import atexit
         atexit.register(_emit_doctor_verdict)
         _state.doctor_atexit = True
+
+
+def _fleet_extra() -> dict:
+    """The postmortem bundle's ``fleet`` section (strictly local state:
+    a bundle dumps when the wire may be broken, so no CMD_FLEET fetch
+    here — worker 0's section carries its LAST successful fetch).
+    Providers merge FLAT into ``extra``, so the payload nests itself
+    under the ``fleet`` key the offline readers
+    (doctor.fleet_view_from_bundles, postmortem.fleet_section) expect."""
+    out: dict = {"published": list(_state.fleet_published or ())}
+    cfg = _state.config
+    if cfg is not None:
+        out["worker"] = cfg.worker_id
+    if _state.fleet_view is not None:
+        out["view"] = _state.fleet_view
+    if _state.fleet_engine is not None:
+        out["diagnosis"] = _state.fleet_engine.diagnosis()
+    if _state.fleet_ledger is not None:
+        out["goodput"] = _state.fleet_ledger
+    return {"fleet": out}
 
 
 def _emit_doctor_verdict() -> None:
@@ -1440,10 +1543,20 @@ def _stop_signal_plane() -> None:
         flightrec.set_extra_provider(lambda: final, name="doctor")
     except Exception:
         flightrec.set_extra_provider(None, name="doctor")
+    if _state.fleet_published is not None:
+        # Same freeze for the fleet section: the atexit bundle must
+        # still carry the published ring after the state is torn down.
+        try:
+            fleet_final = _fleet_extra()
+            flightrec.set_extra_provider(lambda: fleet_final,
+                                         name="fleet")
+        except Exception:
+            flightrec.set_extra_provider(None, name="fleet")
     signals.disarm()
     _state.signal_plane = None
     _state.doctor = None
     _state.tuner = None
+    _state.fleet_engine = None
 
 
 def _signal_routes() -> dict:
@@ -1455,13 +1568,24 @@ def _signal_routes() -> dict:
     if _state.signal_plane is None:
         return {}
     plane, eng = _state.signal_plane, _state.doctor
-    routes = {"/signals": lambda: {"schema": signals.SCHEMA,
-                                   "window_s": plane.window_s,
-                                   "windows": plane.history()},
+
+    def _signals_payload():
+        hist = plane.history()
+        # "window" = the newest CLOSED window's index — pollers align
+        # scrapes across workers by it instead of guessing from wall
+        # clocks (the fleet plane's alignment key).
+        return {"schema": signals.SCHEMA,
+                "window_s": plane.window_s,
+                "window": (hist[-1].get("window") if hist else -1),
+                "windows": hist}
+
+    routes = {"/signals": _signals_payload,
               "/diagnosis": lambda: eng.diagnosis()}
     if _state.tuner is not None:
         tuner = _state.tuner
         routes["/tuner"] = lambda: tuner.state()
+    if _state.fleet_published is not None:
+        routes["/fleet"] = get_fleet
     return routes
 
 
@@ -1490,6 +1614,28 @@ def get_diagnosis() -> dict:
         return {"armed": False, "healthy": True, "open": [],
                 "findings_total": 0}
     return _state.doctor.diagnosis()
+
+
+def get_fleet() -> dict:
+    """The fleet observability plane's merged view (``BYTEPS_TPU_FLEET=1``,
+    PS mode): the last CMD_FLEET fetch (per-worker window rings), the
+    ALIGNED window stream, the fleet doctor's verdict over it, and the
+    last goodput ledger.  What ``bps_doctor --fleet`` polls live and
+    the bps_top fleet panel renders.  Non-zero-worker processes publish
+    but do not fetch, so they return only their own published ring;
+    ``{"armed": False}`` when the plane is off."""
+    if _state.fleet_published is None:
+        return {"armed": False, "workers": {}, "windows": [],
+                "diagnosis": {"healthy": True, "open": []}}
+    out: dict = {"armed": True,
+                 "published": list(_state.fleet_published),
+                 "view": _state.fleet_view or {},
+                 "windows": _state.fleet_windows or []}
+    if _state.fleet_engine is not None:
+        out["diagnosis"] = _state.fleet_engine.diagnosis()
+    if _state.fleet_ledger is not None:
+        out["goodput"] = _state.fleet_ledger
+    return out
 
 
 def get_tuner() -> dict:

@@ -284,3 +284,39 @@ class Autoscaler:
                     "down_streak": self._down_streak,
                     "last_detect_ms": self.last_detect_ms,
                     "actions": list(self.actions)}
+
+
+def fleet_summary(fleet_window: dict) -> Optional[dict]:
+    """Collapse one ALIGNED fleet window (``doctor.fleet_windows_from_view``)
+    into the summary shape ``observe()`` consumes.
+
+    Per-server byte counters are taken as the MAX across the workers'
+    published views: every worker polls the same lifetime counters but
+    at slightly different instants, so max is the freshest reading —
+    and a load spike one worker's CMD_STATS poll caught while worker
+    0's own poll missed it (a partial poll, a reconnect gap) still
+    registers as pressure.  That is the point of fleet-feeding the
+    scaler: it no longer scales on one worker's possibly-blind view.
+    ``alive``/``draining`` are OR-folded the same way (any view that
+    saw a drain means a transition is in flight).  Returns None when
+    no worker's row carried server rows (the scaler then skips the
+    window rather than reading it as "no servers")."""
+    rows: Dict[str, dict] = {}
+    for doc in (fleet_window.get("workers") or {}).values():
+        for sid, rec in (doc.get("servers") or {}).items():
+            if not isinstance(rec, dict):
+                continue
+            cur = rows.setdefault(str(sid), {"alive": False,
+                                             "draining": False,
+                                             "bytes_in": 0,
+                                             "bytes_out": 0})
+            cur["alive"] = cur["alive"] or bool(rec.get("alive"))
+            cur["draining"] = cur["draining"] or bool(rec.get("draining"))
+            cur["bytes_in"] = max(cur["bytes_in"],
+                                  int(rec.get("bytes_in", 0)))
+            cur["bytes_out"] = max(cur["bytes_out"],
+                                   int(rec.get("bytes_out", 0)))
+    if not rows:
+        return None
+    return {"window": fleet_window.get("window"),
+            "server": {"servers": rows}}

@@ -250,6 +250,13 @@ class Config:
     # up-pressure; any open finding vetoes a shrink).
     autoscale_up_mb: float = 64.0        # BYTEPS_TPU_AUTOSCALE_UP_MB
     autoscale_down_mb: float = 8.0       # BYTEPS_TPU_AUTOSCALE_DOWN_MB
+    # Fleet observability plane (docs/monitoring.md "Fleet plane"):
+    # each signal-window roll publishes a compact summary to the server
+    # tier (CMD_WINDOW) and any endpoint serves the merged per-worker
+    # view (CMD_FLEET).  Off (default): zero hot-path work, wire
+    # byte-identical.  fleet_windows bounds the per-worker server ring.
+    fleet: bool = False                  # BYTEPS_TPU_FLEET
+    fleet_windows: int = 32              # BYTEPS_TPU_FLEET_WINDOWS
 
     # ---- logging ----
     log_level: str = "WARNING"           # BYTEPS_LOG_LEVEL
@@ -363,6 +370,8 @@ class Config:
                 os.environ.get("BYTEPS_TPU_AUTOSCALE_UP_MB") or 64.0),
             autoscale_down_mb=float(
                 os.environ.get("BYTEPS_TPU_AUTOSCALE_DOWN_MB") or 8.0),
+            fleet=_env_bool("BYTEPS_TPU_FLEET"),
+            fleet_windows=_env_int("BYTEPS_TPU_FLEET_WINDOWS", 32),
             log_level=_env_str("BYTEPS_LOG_LEVEL", "WARNING"),
             mesh_dp=_env_int("BYTEPS_TPU_MESH_DP", 0),
             mesh_tp=_env_int("BYTEPS_TPU_MESH_TP", 1),

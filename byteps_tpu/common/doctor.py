@@ -116,6 +116,41 @@ DEFAULT_THRESHOLDS = {
     # every pull is parked behind the backlog.
     "repl_lag_rounds": 3,
     "repl_lag_windows": 2,
+    # ---- fleet rules (evaluated over the MERGED per-worker view the
+    # CMD_FLEET plane serves, docs/monitoring.md "Fleet plane"; the
+    # windows these rules see are ALIGNED fleet windows — one entry per
+    # window index with every worker's published row) ----
+    # fleet_straggler_confirmed: the SAME worker is max-round-lag blame
+    # in >= fleet_quorum_frac of the workers' views (at least
+    # fleet_straggler_min_lag rounds behind) for
+    # fleet_straggler_windows consecutive fleet windows.  One worker's
+    # local persistent_straggler names whoever IT waited on; this is
+    # the fleet-confirmed version — everyone agrees who is slow.
+    "fleet_quorum_frac": 0.5,
+    "fleet_straggler_windows": 2,
+    "fleet_straggler_min_lag": 1,
+    # clock_skew: a worker's NTP-style offset estimate vs its rank-0
+    # server drifts more than clock_skew_ms from the fleet MEDIAN
+    # estimate for clock_skew_windows consecutive fleet windows — its
+    # timestamps (trace spans, window anchors) can no longer be merged
+    # onto the fleet timeline without correction.
+    "clock_skew_ms": 50.0,
+    "clock_skew_windows": 2,
+    # codec_epoch_divergence: two workers report the SAME codec epoch
+    # for a key but DIFFERENT active codec names, with no switch
+    # pending on either side, for codec_divergence_windows consecutive
+    # fleet windows.  The epoch->codec mapping is server-authoritative,
+    # so past the declared boundary this must never happen — it means
+    # some worker merged a renegotiation wrong and the wire formats
+    # have forked.
+    "codec_divergence_windows": 2,
+    # signal_disagreement: a key's per-worker wire_mbps spread exceeds
+    # signal_spread_ratio (max/min) across workers while the fastest
+    # view moves at least signal_min_mbps — the tuner-is-flying-blind
+    # signal: worker 0 negotiates codecs from a bandwidth sample the
+    # other N-1 do not see.
+    "signal_spread_ratio": 4.0,
+    "signal_min_mbps": 1.0,
 }
 
 _SERIES_RE = re.compile(r'^([A-Za-z_:][A-Za-z0-9_:]*)\{(.*)\}$')
@@ -761,7 +796,353 @@ RULES: List[Rule] = [
          _r_replication_lag),
 ]
 
-RULE_IDS = tuple(r.id for r in RULES)
+# ---------------------------------------------------------------------------
+# Fleet plane (docs/monitoring.md "Fleet plane"): publish-doc builder,
+# view alignment, and the fleet rule set — rules over the MERGED
+# per-worker window view the CMD_WINDOW/CMD_FLEET wire serves.  Same
+# Rule/Finding/playbook machinery as the local rules; the windows a
+# fleet RuleCtx sees are ALIGNED fleet windows (one entry per window
+# index, every worker's published row preserved), so live
+# (bps.get_fleet / bps_doctor --fleet) and offline (merged postmortem
+# bundles) verdicts are identical by construction.
+# ---------------------------------------------------------------------------
+
+FLEET_SCHEMA = "bps-fleet-window-v1"
+
+
+def fleet_publish_doc(summary: dict, worker_id: int,
+                      clock: Optional[dict] = None,
+                      open_findings=(),
+                      codecs: Optional[dict] = None) -> dict:
+    """The compact per-worker slice CMD_WINDOW ships at each window
+    roll: per-key KeySignal slices (class / wire_mbps / component
+    seconds), summed critical-path component seconds, straggler blame
+    (this worker's max-round-lag view), the clock-offset estimate vs
+    its rank-0 server, open doctor finding ids, and — when the summary
+    carried a CMD_STATS refresh — per-server byte rows (what the
+    fleet-fed autoscaler consumes).  Deliberately NOT the full summary:
+    the metrics snapshot alone can be tens of KB, and the fleet law is
+    one SMALL frame per worker per window."""
+    metrics = summary.get("metrics") or {}
+    lag: Dict[str, int] = {}
+    for labels, v in parse_series(metrics, "bps_worker_round_lag").items():
+        d = dict(labels)
+        if "worker" in d:
+            lag[str(d["worker"])] = int(v)
+    blame = None
+    if lag:
+        worst = max(lag, key=lambda k: lag[k])
+        if lag[worst] > 0:
+            blame = {"worker": worst, "lag": lag[worst]}
+    keys: Dict[str, dict] = {}
+    comp_total: Dict[str, float] = {}
+    for label, rec in (summary.get("keys") or {}).items():
+        comps = {k: float(v or 0.0)
+                 for k, v in (rec.get("components") or {}).items()}
+        keys[label] = {"class": rec.get("class"),
+                       "wire_mbps": float(rec.get("wire_mbps") or 0.0),
+                       "components": comps}
+        for c, v in comps.items():
+            comp_total[c] = comp_total.get(c, 0.0) + v
+    doc = {
+        "schema": FLEET_SCHEMA,
+        "window": summary.get("window"),
+        "ts": summary.get("ts"),
+        "mono": summary.get("mono"),
+        "anchor": summary.get("anchor"),
+        "dur_s": float(summary.get("dur_s") or 0.0),
+        "worker": int(worker_id),
+        "keys": keys,
+        "components": comp_total,
+        "events": dict(summary.get("events") or {}),
+        "lag": lag,
+        "blame": blame,
+        "clock_offset_us": (float(clock["offset_us"])
+                            if clock and isinstance(
+                                clock.get("offset_us"),
+                                (int, float)) else None),
+        "findings": sorted(set(open_findings)),
+    }
+    if codecs:
+        doc["codecs"] = {
+            str(label): {"name": c.get("name"),
+                         "epoch": int(c.get("epoch", 0)),
+                         "pending": bool(c.get("pending"))}
+            for label, c in codecs.items() if isinstance(c, dict)}
+    rows = (summary.get("server") or {}).get("servers") or {}
+    servers = {str(sid): {"alive": bool(row.get("alive")),
+                          "draining": bool(row.get("draining")),
+                          "bytes_in": int(row.get("bytes_in", 0)),
+                          "bytes_out": int(row.get("bytes_out", 0))}
+               for sid, row in rows.items() if isinstance(row, dict)}
+    if servers:
+        doc["servers"] = servers
+    return doc
+
+
+def fleet_windows_from_view(view: dict) -> List[dict]:
+    """ALIGN a merged CMD_FLEET view ({"workers": {wid: [doc, ...]}})
+    into the fleet-window stream the fleet rules consume: one entry per
+    window index present in ANY worker's ring, oldest..newest, each
+    carrying every worker's row for that index.  Alignment is by the
+    explicit window index the summaries publish (never poll timing), so
+    a joiner appears the first window it publishes and an evicted
+    worker's expired ring simply stops contributing rows."""
+    by_idx: Dict[int, Dict[int, dict]] = {}
+    for wid, rows in (view.get("workers") or {}).items():
+        for row in rows or ():
+            if not isinstance(row, dict) or "window" not in row:
+                continue
+            try:
+                idx = int(row["window"])
+            except (TypeError, ValueError):
+                continue
+            by_idx.setdefault(idx, {})[int(wid)] = row
+    out = []
+    for idx in sorted(by_idx):
+        workers = by_idx[idx]
+        ts = max((float(r.get("ts") or 0.0)
+                  for r in workers.values()), default=0.0)
+        out.append({"schema": FLEET_SCHEMA, "window": idx, "ts": ts,
+                    "workers": workers, "n_workers": len(workers)})
+    return out
+
+
+def fleet_view_from_bundles(bundles: List[dict]) -> dict:
+    """Reconstruct the fleet view offline from postmortem bundles: each
+    bundle's ``extra.fleet.published`` list is that worker's ring (the
+    exact docs its CMD_WINDOW frames carried), so merging them per
+    (worker, window) rebuilds what CMD_FLEET would have served —
+    identical verdicts by construction."""
+    by_idx: Dict[int, Dict[int, dict]] = {}
+    for b in bundles:
+        sec = ((b.get("extra") or {}).get("fleet") or {})
+        for row in sec.get("published") or ():
+            if not isinstance(row, dict) or "window" not in row:
+                continue
+            try:
+                wid = int(row.get("worker", b.get("rank", -1)))
+                idx = int(row["window"])
+            except (TypeError, ValueError):
+                continue
+            by_idx.setdefault(wid, {}).setdefault(idx, row)
+    return {"armed": bool(by_idx),
+            "workers": {wid: [ring[i] for i in sorted(ring)]
+                        for wid, ring in by_idx.items()}}
+
+
+def _fleet_quorum(n_views: int, frac: float) -> int:
+    """Votes needed for "the same worker in >= quorum of views": at
+    least ceil(frac * n) and never less than 2 — one worker blaming
+    itself alone must not confirm a fleet-level verdict."""
+    need = int(frac * n_views)
+    if need < frac * n_views:
+        need += 1
+    return max(2, need)
+
+
+def _fr_straggler_confirmed(ctx: RuleCtx) -> List[dict]:
+    need = int(ctx.th["fleet_straggler_windows"])
+    if len(ctx.windows) < need:
+        return []
+    min_lag = int(ctx.th["fleet_straggler_min_lag"])
+    confirmed_per_window = []
+    for w in ctx.windows[-need:]:
+        workers = w.get("workers") or {}
+        if len(workers) < 2:
+            return []
+        votes: Dict[str, int] = {}
+        for doc in workers.values():
+            b = doc.get("blame") or {}
+            if b.get("worker") is not None \
+                    and int(b.get("lag", 0)) >= min_lag:
+                wid = str(b["worker"])
+                votes[wid] = votes.get(wid, 0) + 1
+        quorum = _fleet_quorum(len(workers),
+                               float(ctx.th["fleet_quorum_frac"]))
+        confirmed_per_window.append(
+            ({w2 for w2, n in votes.items() if n >= quorum},
+             votes, len(workers)))
+    persist = set.intersection(
+        *[c for c, _, _ in confirmed_per_window])
+    out = []
+    last_votes, last_n = (confirmed_per_window[-1][1],
+                          confirmed_per_window[-1][2])
+    for wid in sorted(persist):
+        out.append({
+            "subject": f"worker {wid}",
+            "message": (f"worker {wid} is max round-lag blame in "
+                        f"{last_votes.get(wid, 0)}/{last_n} workers' "
+                        f"fleet views for {need} consecutive windows — "
+                        f"a fleet-confirmed straggler, not one view's "
+                        f"opinion"),
+            "evidence": {"worker": wid,
+                         "votes": last_votes.get(wid, 0),
+                         "views": last_n, "windows": need},
+        })
+    return out
+
+
+def _fr_clock_skew(ctx: RuleCtx) -> List[dict]:
+    need = int(ctx.th["clock_skew_windows"])
+    if len(ctx.windows) < need:
+        return []
+    limit_us = float(ctx.th["clock_skew_ms"]) * 1000.0
+    persist: Optional[set] = None
+    last_detail: Dict[str, tuple] = {}
+    for w in ctx.windows[-need:]:
+        offs = {}
+        for wid, doc in (w.get("workers") or {}).items():
+            v = doc.get("clock_offset_us")
+            if isinstance(v, (int, float)):
+                offs[str(wid)] = float(v)
+        if len(offs) < 2:
+            return []
+        vals = sorted(offs.values())
+        mid = len(vals) // 2
+        median = (vals[mid] if len(vals) % 2
+                  else (vals[mid - 1] + vals[mid]) / 2.0)
+        offenders = {wid for wid, v in offs.items()
+                     if abs(v - median) > limit_us}
+        last_detail = {wid: (offs[wid], median) for wid in offenders}
+        persist = offenders if persist is None else (persist & offenders)
+    out = []
+    for wid in sorted(persist or ()):
+        off, median = last_detail.get(wid, (0.0, 0.0))
+        out.append({
+            "subject": f"worker {wid}",
+            "message": (f"worker {wid}'s clock-offset estimate "
+                        f"({off / 1000.0:.1f} ms) drifts "
+                        f"{abs(off - median) / 1000.0:.1f} ms from the "
+                        f"fleet median ({median / 1000.0:.1f} ms) for "
+                        f"{need} consecutive windows — its timestamps "
+                        f"cannot be merged onto the fleet timeline"),
+            "evidence": {"worker": wid, "offset_us": off,
+                         "median_us": median, "limit_ms":
+                         float(ctx.th["clock_skew_ms"])},
+        })
+    return out
+
+
+def _fr_codec_epoch_divergence(ctx: RuleCtx) -> List[dict]:
+    need = int(ctx.th["codec_divergence_windows"])
+    if len(ctx.windows) < need:
+        return []
+    persist: Optional[set] = None
+    last_detail: Dict[str, dict] = {}
+    for w in ctx.windows[-need:]:
+        divergent = set()
+        by_key: Dict[str, Dict[int, dict]] = {}
+        for wid, doc in (w.get("workers") or {}).items():
+            for label, c in (doc.get("codecs") or {}).items():
+                if isinstance(c, dict) and not c.get("pending"):
+                    by_key.setdefault(str(label), {})[int(wid)] = c
+        for label, views in by_key.items():
+            if len(views) < 2:
+                continue
+            # Server-authoritative law: one epoch maps to ONE codec.
+            # Workers at the SAME epoch with different active names,
+            # none pending, have forked wire formats.
+            by_epoch: Dict[int, set] = {}
+            for c in views.values():
+                by_epoch.setdefault(int(c.get("epoch", 0)), set()).add(
+                    str(c.get("name")))
+            names = next((ns for ns in by_epoch.values() if len(ns) > 1),
+                         None)
+            if names:
+                divergent.add(label)
+                last_detail[label] = {
+                    "names": sorted(names),
+                    "workers": sorted(views)}
+        persist = divergent if persist is None else (persist & divergent)
+    out = []
+    for label in sorted(persist or ()):
+        d = last_detail.get(label, {})
+        out.append({
+            "subject": f"key {label}",
+            "message": (f"workers {d.get('workers')} report the same "
+                        f"codec epoch for key {label} but different "
+                        f"active codecs {d.get('names')} past the "
+                        f"declared boundary for {need} consecutive "
+                        f"windows — the wire formats have forked"),
+            "evidence": {"key": label, **d, "windows": need},
+        })
+    return out
+
+
+def _fr_signal_disagreement(ctx: RuleCtx) -> List[dict]:
+    w = ctx.cur
+    workers = w.get("workers") or {}
+    if len(workers) < 2:
+        return []
+    ratio = float(ctx.th["signal_spread_ratio"])
+    floor = float(ctx.th["signal_min_mbps"])
+    per_key: Dict[str, Dict[str, float]] = {}
+    for wid, doc in workers.items():
+        for label, rec in (doc.get("keys") or {}).items():
+            mbps = float(rec.get("wire_mbps") or 0.0)
+            per_key.setdefault(str(label), {})[str(wid)] = mbps
+    out = []
+    for label in sorted(per_key):
+        views = per_key[label]
+        if len(views) < 2:
+            continue
+        hi_w = max(views, key=lambda k: views[k])
+        lo_w = min(views, key=lambda k: views[k])
+        hi, lo = views[hi_w], views[lo_w]
+        if hi >= floor and hi > lo * ratio:
+            out.append({
+                "subject": f"key {label}",
+                "message": (f"key {label}'s wire_mbps spreads "
+                            f"{hi:.1f} (worker {hi_w}) vs {lo:.1f} "
+                            f"(worker {lo_w}) across workers (> "
+                            f"{ratio:g}x) — per-worker bandwidth "
+                            f"samples disagree, so a single worker's "
+                            f"tuner view is flying blind"),
+                "evidence": {"key": label, "max_mbps": hi,
+                             "min_mbps": lo, "max_worker": hi_w,
+                             "min_worker": lo_w, "ratio": ratio},
+            })
+    return out
+
+
+FLEET_RULES: List[Rule] = [
+    Rule("fleet_straggler_confirmed", SEV_ERROR,
+         "the same worker is max-blame in a quorum of fleet views",
+         _fr_straggler_confirmed),
+    Rule("clock_skew", SEV_WARN,
+         "a worker's clock-offset estimate drifts from the fleet median",
+         _fr_clock_skew),
+    Rule("codec_epoch_divergence", SEV_ERROR,
+         "workers disagree on a key's active codec past the boundary",
+         _fr_codec_epoch_divergence),
+    Rule("signal_disagreement", SEV_WARN,
+         "a key's per-worker wire_mbps spread exceeds the tuner's trust",
+         _fr_signal_disagreement),
+]
+
+# Every rule id — local AND fleet — carries a playbook anchor
+# (check_doctor_docs pins both directions).
+RULE_IDS = tuple(r.id for r in RULES) + tuple(r.id for r in FLEET_RULES)
+
+
+def evaluate_fleet_stream(fleet_windows: List[dict],
+                          thresholds: Optional[dict] = None,
+                          history: int = 8) -> dict:
+    """Offline fleet evaluation: replay ALIGNED fleet windows (from
+    ``fleet_windows_from_view``) through a silent engine running the
+    fleet rule set.  The one entry point ``tools/bps_doctor.py --fleet``
+    and ``tools/postmortem.py`` use for merged bundles — live/offline
+    parity by construction (the live /fleet route evaluates the same
+    aligned stream)."""
+    eng = DoctorEngine(rules=FLEET_RULES, thresholds=thresholds,
+                       history=history, emit=False)
+    for w in fleet_windows:
+        eng.observe(w)
+    diag = eng.diagnosis()
+    diag["windows_evaluated"] = len(fleet_windows)
+    diag["fleet"] = True
+    return diag
 
 
 class DoctorEngine:

@@ -366,11 +366,20 @@ class SignalPlane:
             rec["class"] = classify(rec)
             keys[label] = rec
 
+        # Same-instant wall/mono anchor pair: "ts" (wall) and "mono"
+        # are sampled at DIFFERENT instants (mono at roll start, wall
+        # here, with the whole summary build in between), which is fine
+        # for humans but not for cross-worker alignment — the fleet
+        # merge maps one worker's monotonic durations onto another's
+        # wall timeline through this pair, so both clocks must be read
+        # back-to-back (the flightrec bundle "clock" law).
+        anchor_wall, anchor_mono = time.time(), time.monotonic()
         summary = {
             "schema": SCHEMA,
             "window": idx,
-            "ts": time.time(),
+            "ts": anchor_wall,
             "mono": now,
+            "anchor": {"wall": anchor_wall, "mono": anchor_mono},
             "dur_s": dur,
             "keys": keys,
             "metrics": metrics,

@@ -571,6 +571,35 @@ def update_repl(server_stats: dict,
                       int(rec.get("repl_lag_rounds", 0)))
 
 
+def update_fleet(server_stats: dict,
+                 registry: Optional[MetricsRegistry] = None) -> None:
+    """Fold the fleet observability plane (CMD_WINDOW rings) from a
+    merged CMD_STATS payload into the registry.
+
+    Exports ``bps_fleet_windows_held{server=}`` (window summaries
+    parked per server — the elastic tests watch a drained server's
+    ring re-appear on the survivor) and ``bps_fleet_publishes_total``
+    (CMD_WINDOW frames accepted tier-wide).  Quiet when the fleet
+    plane is unarmed (BYTEPS_TPU_FLEET unset): no gauge is registered
+    and the snapshot is unchanged — the zero-overhead-when-off law
+    every plane here follows."""
+    reg = registry or get_registry()
+    if not server_stats.get("fleet_armed"):
+        return
+    reg.gauge("bps_fleet_publishes_total",
+              help="worker window summaries accepted by the server "
+                   "tier (CMD_WINDOW), tier-wide").set(
+                  int(server_stats.get("fleet_publishes", 0)))
+    for sid, rec in (server_stats.get("servers") or {}).items():
+        if not isinstance(rec, dict) or "fleet_windows_held" not in rec:
+            continue
+        reg.gauge("bps_fleet_windows_held",
+                  help="worker window summaries parked in this "
+                       "server's per-worker fleet rings",
+                  labels={"server": str(sid)}).set(
+                      int(rec.get("fleet_windows_held", 0)))
+
+
 def update_embed(server_stats: dict,
                  registry: Optional[MetricsRegistry] = None) -> None:
     """Fold the row-sparse embedding plane from a merged CMD_STATS

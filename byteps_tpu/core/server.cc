@@ -223,6 +223,33 @@ enum Cmd : uint8_t {
                  // (BYTEPS_TPU_REPL=0, the default) the command is
                  // rejected and no peer byte is ever sent — the wire is
                  // byte-identical to the pre-replication server.
+  kWindow = 21,  // Fleet window publish (CMD_WINDOW): at each signal-
+                 // window roll an armed worker ships its compact JSON
+                 // window summary (key = window index, payload = the
+                 // summary doc) to its rank-0 server, which parks it in
+                 // a bounded per-worker ring (BYTEPS_TPU_FLEET_WINDOWS,
+                 // default 32).  Reader thread, like kStats/kRepl: the
+                 // ring is control-plane state and a publish must land
+                 // even when every engine is wedged mid-round.  The
+                 // payload is stored verbatim — the server never parses
+                 // worker JSON.  Re-publish of an already-held window
+                 // index replaces in place (idempotent retries).
+                 // Unarmed (BYTEPS_TPU_FLEET=0, the default) the command
+                 // answers kError and an armed client downgrades loudly
+                 // at bootstrap (the kAudit probe law) — the unarmed
+                 // wire is byte-identical to the pre-fleet server.
+  kFleet = 22,   // Fleet view read (CMD_FLEET): answers the merged
+                 // per-worker window rings as one JSON doc
+                 // ({"armed":1,"cap":N,"server_id":S,
+                 //   "workers":{"<wid>":[<summary>,...],...}} — worker
+                 // blobs spliced raw, ordered by window index), so any
+                 // single endpoint answers for the whole job.  Also the
+                 // client's bootstrap probe: unarmed servers answer
+                 // {"armed":0} (kOk — probing must not look like a
+                 // wire error), old servers answer kError via the
+                 // engine default arm, and either response downgrades
+                 // the session's fleet plane before any CMD_WINDOW
+                 // frame is ever sent.
 };
 
 // Request `dtype` marker on PULL frames: the worker asks for the 24-byte
@@ -1785,6 +1812,23 @@ class Server {
                      "BYTEPS_TPU_REPL_LAG=%s (want a round count)\n",
                      rlag);
     }
+    // Fleet observability plane (BYTEPS_TPU_FLEET=1): retain a bounded
+    // per-worker ring of published window summaries (CMD_WINDOW) and
+    // serve the merged view (CMD_FLEET).  Unarmed (default): no ring
+    // exists, both commands answer their downgrade shapes, the migrate
+    // blob carries no fleet trailer — wire byte-identical to pre-fleet.
+    fleet_armed_ = truthy(std::getenv("BYTEPS_TPU_FLEET"));
+    const char* fwn = std::getenv("BYTEPS_TPU_FLEET_WINDOWS");
+    if (fwn && fwn[0]) {
+      char* end = nullptr;
+      uint64_t v = std::strtoull(fwn, &end, 10);
+      if (end && *end == '\0' && v > 0 && v <= 4096)
+        fleet_windows_ = static_cast<int>(v);
+      else
+        std::fprintf(stderr,
+                     "[byteps server] ignoring invalid "
+                     "BYTEPS_TPU_FLEET_WINDOWS=%s (want 1..4096)\n", fwn);
+    }
     const char* sid = std::getenv("DMLC_SERVER_ID");
     if (sid && sid[0])
       my_server_id_ = static_cast<uint32_t>(std::strtoul(sid, nullptr, 10));
@@ -2218,6 +2262,15 @@ class Server {
           repl_lag = kv.second - acked;
       }
     }
+    // Fleet-plane gauges: worker rings held and total window blobs
+    // parked — what bps_top's fleet panel and the elastic-edge tests
+    // watch to confirm publishes landed and eviction expired a ring.
+    uint64_t fleet_workers = 0, fleet_held = 0;
+    if (fleet_armed_) {
+      std::lock_guard<std::mutex> lk(fleet_mu_);
+      fleet_workers = fleet_rings_.size();
+      for (auto& kv : fleet_rings_) fleet_held += kv.second.size();
+    }
     std::snprintf(buf, sizeof(buf),
                   "{\"bytes_in\":%llu,\"bytes_out\":%llu,\"async\":%d,"
                   "\"num_workers\":%d,\"scatter_frames\":%llu,"
@@ -2236,6 +2289,8 @@ class Server {
                   "\"repl_bytes_out\":%llu,\"repl_rounds_in\":%llu,"
                   "\"repl_bytes_in\":%llu,\"repl_replicas_held\":%llu,"
                   "\"repl_promotions\":%llu,\"repl_lag_rounds\":%llu,"
+                  "\"fleet_armed\":%d,\"fleet_workers\":%llu,"
+                  "\"fleet_windows_held\":%llu,\"fleet_publishes\":%llu,"
                   "\"slice_size\":%d,\"keys\":{",
                   static_cast<unsigned long long>(
                       bytes_in_.load(std::memory_order_relaxed)),
@@ -2292,6 +2347,11 @@ class Server {
                   static_cast<unsigned long long>(
                       repl_promotions_.load(std::memory_order_relaxed)),
                   static_cast<unsigned long long>(repl_lag),
+                  fleet_armed_ ? 1 : 0,
+                  static_cast<unsigned long long>(fleet_workers),
+                  static_cast<unsigned long long>(fleet_held),
+                  static_cast<unsigned long long>(
+                      fleet_publishes_.load(std::memory_order_relaxed)),
                   slice_size_);
     js += buf;
     std::lock_guard<std::mutex> lk(stats_mu_);
@@ -2405,6 +2465,39 @@ class Server {
     return js;
   }
 
+  // Merged fleet view (CMD_FLEET): per-worker rings as JSON arrays of
+  // the raw worker-published window summaries, ordered by window index.
+  // The server splices blobs verbatim — it never parses worker JSON —
+  // so a malformed publish can corrupt only its own row, which the
+  // Python merge side skips (the same trust boundary as CMD_STATS keys).
+  std::string FleetJson() {
+    if (!fleet_armed_) return "{\"armed\":0}";
+    char buf[128];
+    std::string js;
+    js.reserve(4096);
+    std::snprintf(buf, sizeof(buf),
+                  "{\"armed\":1,\"cap\":%d,\"server_id\":%u,"
+                  "\"workers\":{", fleet_windows_, my_server_id_);
+    js += buf;
+    std::lock_guard<std::mutex> lk(fleet_mu_);
+    bool first_w = true;
+    for (auto& kv : fleet_rings_) {
+      std::snprintf(buf, sizeof(buf), "%s\"%u\":[",
+                    first_w ? "" : ",", kv.first);
+      js += buf;
+      first_w = false;
+      bool first_e = true;
+      for (auto& e : kv.second) {
+        if (!first_e) js += ",";
+        js += e.second;
+        first_e = false;
+      }
+      js += "]";
+    }
+    js += "}}";
+    return js;
+  }
+
   // --- elastic membership --------------------------------------------
   // The worker set is epoch-versioned: every join (HELLO from a non-live
   // id), graceful leave (CMD_LEAVE) and lease eviction bumps `epoch_` and
@@ -2494,6 +2587,14 @@ class Server {
     }
     FanOutMembership(old_live, removed, /*refinalize=*/true);
     RecheckBarriers();
+    // Expire the evicted worker's fleet ring: a departed worker must
+    // drop out of the merged CMD_FLEET view (its stale windows would
+    // otherwise pin fleet rules on a ghost forever).  fleet_mu_ is a
+    // leaf lock — never taken while holding member_mu_.
+    if (fleet_armed_ && !removed.empty()) {
+      std::lock_guard<std::mutex> lk(fleet_mu_);
+      for (uint32_t w : removed) fleet_rings_.erase(w);
+    }
   }
 
   int LiveCount() {
@@ -3003,7 +3104,8 @@ class Server {
 
   // Serialize one key's full merge state for CMD_MIGRATE.  Runs on the
   // key's engine thread, so every field is stable.
-  std::vector<char> SerializeKeyState(const KeyState& ks) {
+  std::vector<char> SerializeKeyState(const KeyState& ks,
+                                      bool with_fleet = false) {
     std::vector<char> out;
     auto put = [&](const void* p, size_t n) {
       out.insert(out.end(), static_cast<const char*>(p),
@@ -3143,6 +3245,32 @@ class Server {
           put(&ks.embed_row_step[r], 4);
         }
     }
+    // Fleet-ring trailer (appended AFTER the embed trailer, same
+    // version-tolerance law).  MIGRATE blobs only (with_fleet is false
+    // on the per-publish replication path — rings are server-global, so
+    // re-serializing them per publish would tax every round for state
+    // one drain-time copy preserves).  Written only when fleet-armed:
+    // an unarmed server's blob stays byte-identical to pre-fleet, which
+    // the elastic byte-equality tests pin.  Like the knob trailer this
+    // is GLOBAL state riding a per-key blob; the receiver adopts each
+    // (worker, window) only-if-absent, so a drain's N key blobs install
+    // idempotently.
+    if (fleet_armed_ && with_fleet) {
+      std::lock_guard<std::mutex> lk(fleet_mu_);
+      uint32_t nw = static_cast<uint32_t>(fleet_rings_.size());
+      put(&nw, 4);
+      for (auto& kv : fleet_rings_) {
+        put(&kv.first, 4);
+        uint32_t nwin = static_cast<uint32_t>(kv.second.size());
+        put(&nwin, 4);
+        for (auto& e : kv.second) {
+          put(&e.first, 8);
+          uint32_t bl = static_cast<uint32_t>(e.second.size());
+          put(&bl, 4);
+          put(e.second.data(), bl);
+        }
+      }
+    }
     return out;
   }
 
@@ -3168,7 +3296,7 @@ class Server {
         }
     }
     if (host.empty()) return false;
-    std::vector<char> blob = SerializeKeyState(ks);
+    std::vector<char> blob = SerializeKeyState(ks, /*with_fleet=*/true);
     if (!PeerRequest(owner, host, port, kMigrate, 0, key, blob.data(),
                      blob.size())) {
       std::fprintf(stderr,
@@ -3577,7 +3705,12 @@ class Server {
             };
         std::unordered_map<uint64_t, std::vector<float>> eo, em;
         uint64_t nz = 0;
-        bool eok = er != 0 && ew != 0 && er <= (max_msg_ / 4) / ew &&
+        // The sender writes the (empty) rows/step sections even for a
+        // dense key, so they must be CONSUMED even when er/ew say
+        // "no table" — short-circuiting on the shape here would leave
+        // the cursor 24 bytes behind and misalign every trailer that
+        // follows (the fleet rings would silently parse as absent).
+        bool eok = (ew == 0 || er <= (max_msg_ / 4) / ew) &&
                    take_rows(&eo) && take_rows(&em) && take(&nz, 8) &&
                    nz <= remaining() / 12;
         if (eok) {
@@ -3588,7 +3721,7 @@ class Server {
             eok = take(&row, 8) && take(&s, 4) && row < er;
             if (eok) steps[static_cast<size_t>(row)] = s;
           }
-          if (eok) {
+          if (eok && er != 0 && ew != 0) {
             ks.embed_rows = er;
             ks.embed_width = ew;
             ks.embed_out = std::move(eo);
@@ -3596,6 +3729,48 @@ class Server {
             ks.embed_row_step = std::move(steps);
             embed_table_bytes_.fetch_add(er * ew * 4,
                                          std::memory_order_relaxed);
+          }
+        }
+      }
+    }
+    // Fleet-ring trailer: global state riding a per-key blob (the knob
+    // law).  Adopt each (worker, window) ONLY-IF-ABSENT — a drain sends
+    // one copy per migrated key and the install must be idempotent —
+    // then trim to this server's cap.  Absent from pre-fleet and
+    // unarmed senders (and from repl blobs): the first take() fails on
+    // an exhausted buffer and the rings stay untouched.  Every length
+    // is bounds-checked against remaining() before use; a '{' sniff
+    // rejects blobs that can't be a published summary.
+    if (fleet_armed_) {
+      uint32_t fnw = 0;
+      if (take(&fnw, 4) && fnw <= 4096) {
+        std::lock_guard<std::mutex> lk(fleet_mu_);
+        bool fok = true;
+        for (uint32_t i = 0; i < fnw && fok; ++i) {
+          uint32_t wid = 0, nwin = 0;
+          fok = take(&wid, 4) && take(&nwin, 4) && nwin <= 4096;
+          for (uint32_t j = 0; j < nwin && fok; ++j) {
+            uint64_t widx = 0;
+            uint32_t bl = 0;
+            fok = take(&widx, 8) && take(&bl, 4) && bl <= remaining();
+            if (!fok) break;
+            const char* blob = p.data() + pos;
+            pos += bl;
+            if (bl == 0 || blob[0] != '{') continue;
+            auto& ring = fleet_rings_[wid];
+            bool have = false;
+            for (auto& e : ring)
+              if (e.first == widx) {
+                have = true;
+                break;
+              }
+            if (!have) {
+              auto it = ring.begin();
+              while (it != ring.end() && it->first < widx) ++it;
+              ring.insert(it, {widx, std::string(blob, bl)});
+              while (static_cast<int>(ring.size()) > fleet_windows_)
+                ring.pop_front();
+            }
           }
         }
       }
@@ -4240,6 +4415,51 @@ class Server {
           // {"armed":0} so a probing client downgrades instead of
           // sending audit markers nothing will honor.
           std::string js = AuditJson();
+          Respond(conn, kOk, h.req_id, h.key, js.data(), js.size());
+          break;
+        }
+        case kWindow: {
+          // Fleet window publish: park the worker's JSON summary in its
+          // bounded ring, keyed by window index (the frame's key field).
+          // Reader thread, like kStats/kRepl — a publish is control-
+          // plane state and must land even when every engine is wedged.
+          // Re-publishing a held index replaces in place (idempotent
+          // retries); a fresh index appends in order and the ring trims
+          // from the oldest end.  The blob is stored verbatim, never
+          // parsed — only a shape sniff (leading '{') rejects garbage.
+          if (!fleet_armed_ || payload.empty() || payload[0] != '{') {
+            Respond(conn, kError, h.req_id, h.key, nullptr, 0);
+            break;
+          }
+          {
+            std::lock_guard<std::mutex> lk(fleet_mu_);
+            auto& ring = fleet_rings_[h.worker_id];
+            bool replaced = false;
+            for (auto& e : ring)
+              if (e.first == key) {
+                e.second.assign(payload.begin(), payload.end());
+                replaced = true;
+                break;
+              }
+            if (!replaced) {
+              auto it = ring.begin();
+              while (it != ring.end() && it->first < key) ++it;
+              ring.insert(it, {key, std::string(payload.begin(),
+                                                payload.end())});
+              while (static_cast<int>(ring.size()) > fleet_windows_)
+                ring.pop_front();
+            }
+          }
+          fleet_publishes_.fetch_add(1, std::memory_order_relaxed);
+          Respond(conn, kOk, h.req_id, h.key, nullptr, 0);
+          break;
+        }
+        case kFleet: {
+          // Merged fleet view, and the client's bootstrap probe: an
+          // unarmed server answers {"armed":0} (kOk) so a probing
+          // client downgrades instead of publishing windows nothing
+          // retains — the kAudit probe law.
+          std::string js = FleetJson();
           Respond(conn, kOk, h.req_id, h.key, js.data(), js.size());
           break;
         }
@@ -6221,6 +6441,18 @@ class Server {
   uint64_t fault_round_ = 0;
   uint64_t fault_bit_ = 0;
   std::atomic<bool> fault_done_{false};
+
+  // Fleet observability plane (CMD_WINDOW / CMD_FLEET): per-worker
+  // rings of published window summaries, ordered by window index and
+  // bounded by fleet_windows_.  fleet_mu_ is a LEAF lock: taken only
+  // around ring reads/writes, never while holding (or before taking)
+  // member_mu_ / stats_mu_ / repl_mu_.
+  bool fleet_armed_ = false;     // BYTEPS_TPU_FLEET
+  int fleet_windows_ = 32;       // BYTEPS_TPU_FLEET_WINDOWS (per worker)
+  std::mutex fleet_mu_;
+  std::map<uint32_t,
+           std::deque<std::pair<uint64_t, std::string>>> fleet_rings_;
+  std::atomic<uint64_t> fleet_publishes_{0};
 
   // CMD_TRACE span ring (see ServerTracer).
   ServerTracer tracer_;

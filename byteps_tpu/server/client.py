@@ -83,6 +83,15 @@ CMD_HELLO, CMD_INIT, CMD_PUSH, CMD_PULL, CMD_BARRIER, CMD_SHUTDOWN, \
     CMD_MEMBERS, CMD_RING, CMD_RING_SET, CMD_DRAIN, CMD_MIGRATE, \
     CMD_AUDIT, CMD_CODEC, CMD_OPT, CMD_KNOB = range(20)
 
+# Fleet observability plane (server.cc kWindow / kFleet).  Deliberately
+# NOT part of the range(20) enum above: wire value 20 is kRepl, the
+# peer-only chain-replication command no client ever sends — skipping it
+# keeps the client constants exactly aligned with the server's Cmd
+# values.  CMD_WINDOW publishes one worker's window summary (key =
+# window index); CMD_FLEET reads the merged per-worker rings and doubles
+# as the bootstrap probe (the CMD_AUDIT downgrade law).
+CMD_WINDOW, CMD_FLEET = 21, 22
+
 # Response status bytes (server.cc Status).  MOVED carries the server's
 # current ring table as JSON: the addressed server is not (or no longer)
 # the consistent-hash owner of the frame's key — re-plan and re-route.
@@ -199,7 +208,8 @@ _CMD_NAMES = {0: "HELLO", 1: "INIT", 2: "PUSH", 3: "PULL", 4: "BARRIER",
               5: "SHUTDOWN", 6: "PING", 7: "LR_SCALE", 8: "STATS",
               9: "TRACE", 10: "LEAVE", 11: "MEMBERS", 12: "RING",
               13: "RING_SET", 14: "DRAIN", 15: "MIGRATE", 16: "AUDIT",
-              17: "CODEC"}
+              17: "CODEC", 18: "OPT", 19: "KNOB", 21: "WINDOW",
+              22: "FLEET"}
 
 
 def _round_flags(rnd: int, traced: bool) -> int:
@@ -1232,6 +1242,8 @@ class PSSession:
                  server_evict_timeout_s: float = 0.0,
                  audit: bool = False,
                  audit_window: int = 16,
+                 fleet: bool = False,
+                 fleet_windows: int = 32,
                  health_sample_rounds: int = 0,
                  slice_size: int = 1,
                  pull_only: bool = False):
@@ -1303,6 +1315,14 @@ class PSSession:
         # byte-identical to pre-audit and nothing is digested.
         self.audit = bool(audit)
         self.audit_window = max(1, int(audit_window))
+        # Fleet observability plane (BYTEPS_TPU_FLEET=1): each signal-
+        # window roll publishes this worker's compact summary to its
+        # rank-0 server (CMD_WINDOW) and any endpoint answers the merged
+        # per-worker view (CMD_FLEET).  Armed only after the bootstrap
+        # probe confirms the server tier retains windows — otherwise it
+        # downgrades loudly and the wire stays byte-identical.
+        self.fleet = bool(fleet)
+        self.fleet_windows = max(1, int(fleet_windows))
         # Chain replication armed on the server tier (BYTEPS_TPU_REPL=1,
         # docs/elasticity.md "zero-loss law"): a SIGKILLed owner's fresh
         # replacement adopts the ring successor's replica at the last
@@ -1332,6 +1352,8 @@ class PSSession:
                 self._ring_bootstrap()
             if self.audit:
                 self._audit_bootstrap()
+            if self.fleet:
+                self._fleet_bootstrap()
         except Exception:
             self._abort_init()
             raise
@@ -1604,6 +1626,15 @@ class PSSession:
         self._audit_wire = False
         self._audit_stats = {"checked": 0, "mismatches": 0,
                              "round_skew": 0, "unverified": 0}
+        # Fleet-plane state: armed-wire flag (set only once the
+        # bootstrap probe confirmed every server retains windows),
+        # publish accounting, and the cached clock-offset estimate that
+        # rides each published summary (refreshed off the plane thread,
+        # never on a round's critical path).
+        self._fleet_wire = False
+        self._fleet_publishes = 0
+        self._fleet_publish_errors = 0
+        self._fleet_clock: Optional[Tuple[float, float]] = None
         self._audit_last: Optional[dict] = None   # last verdict detail
         self._m_audit_checked = reg.counter(
             "bps_audit_checked_total",
@@ -1747,6 +1778,8 @@ class PSSession:
                    server_evict_timeout_s=cfg.server_evict_timeout_s,
                    audit=cfg.audit,
                    audit_window=cfg.audit_window,
+                   fleet=cfg.fleet,
+                   fleet_windows=cfg.fleet_windows,
                    health_sample_rounds=cfg.health_sample_rounds,
                    slice_size=cfg.slice_size)
 
@@ -4527,7 +4560,9 @@ class PSSession:
                   "embed_rows_served": 0, "embed_table_bytes": 0,
                   "slice_size": 1, "repl_armed": False,
                   "repl_bytes_total": 0, "repl_lag_rounds": 0,
-                  "repl_replicas_held": 0, "repl_promotions": 0}
+                  "repl_replicas_held": 0, "repl_promotions": 0,
+                  "fleet_armed": False, "fleet_workers": 0,
+                  "fleet_windows_held": 0, "fleet_publishes": 0}
         import json as _json
         for slot, c in enumerate(self.conns):
             sid = self._slot_srv.get(slot, slot)
@@ -4632,6 +4667,19 @@ class PSSession:
                 st.get("repl_replicas_held", 0))
             merged["servers"][row_id]["repl_promotions"] = int(
                 st.get("repl_promotions", 0))
+            # Fleet observability plane (CMD_WINDOW rings; old servers
+            # omit all of these).  worker/ring counts stay per-row too:
+            # after a drain the elastic tests compare the survivor's
+            # census against the drained server's.
+            merged["fleet_armed"] = (merged["fleet_armed"]
+                                     or bool(st.get("fleet_armed", 0)))
+            merged["fleet_workers"] = max(
+                merged["fleet_workers"], int(st.get("fleet_workers", 0)))
+            merged["fleet_windows_held"] += int(
+                st.get("fleet_windows_held", 0))
+            merged["fleet_publishes"] += int(st.get("fleet_publishes", 0))
+            merged["servers"][row_id]["fleet_windows_held"] = int(
+                st.get("fleet_windows_held", 0))
             for w, rec in (st.get("members") or {}).items():
                 _merge_member_rec(merged["members"], int(w), rec)
             for k, v in (st.get("keys") or {}).items():
@@ -4845,6 +4893,153 @@ class PSSession:
                 merged["keys"][int(k)] = [by_round[r]
                                           for r in sorted(by_round)]
         return merged
+
+    # -- fleet observability plane (docs/monitoring.md "Fleet plane") -------
+    def _fleet_probe(self, conn: "_ServerConn",
+                     timeout: float = 10.0) -> dict:
+        """One CMD_FLEET round trip, parsed.  A pre-fleet server routes
+        the unknown command to an engine whose default arm answers an
+        error status — surfaced as a clean "server too old" RuntimeError,
+        never a hang (the kStats pattern)."""
+        import json as _json
+        try:
+            raw = conn.request(CMD_FLEET, worker_id=self.worker_id,
+                               timeout=timeout)
+        except RuntimeError as e:
+            raise RuntimeError(
+                f"PS server at {conn.host}:{conn.port} does not support "
+                f"CMD_FLEET (server too old — rebuild/redeploy the server "
+                f"tier to match this client): {e}") from e
+        return _json.loads(bytes(raw).decode())
+
+    def _fleet_bootstrap(self) -> None:
+        """Arm the fleet publish wire — but only after proving the
+        server tier actually retains windows (CMD_FLEET probe on EVERY
+        server: rings must survive a drain onto any member).  A
+        mixed/old deployment downgrades loudly to "fleet plane off"
+        instead of publishing summaries nothing retains; the unarmed
+        wire therefore stays byte-identical whichever side is missing
+        the feature (the CMD_AUDIT bootstrap law)."""
+        for c in self.conns:
+            try:
+                doc = self._fleet_probe(c)
+            except Exception as e:
+                get_logger().warning(
+                    "BYTEPS_TPU_FLEET armed but the server tier cannot "
+                    "answer CMD_FLEET (%s); fleet plane disabled", e)
+                return
+            if not doc.get("armed"):
+                get_logger().warning(
+                    "BYTEPS_TPU_FLEET armed on this worker but NOT on "
+                    "PS server %s:%d (set BYTEPS_TPU_FLEET=1 on every "
+                    "server); fleet plane disabled", c.host, c.port)
+                return
+        self._fleet_wire = True
+        get_logger().info(
+            "fleet plane armed: window summaries publish to the server "
+            "tier (last-%d ring per worker)", self.fleet_windows)
+
+    def fleet_clock_offset(self, max_age_s: float = 60.0,
+                           samples: int = 3,
+                           timeout: float = 5.0) -> Optional[dict]:
+        """This worker's clock offset vs its rank-0 server, for the
+        published window summary (the fleet doctor's clock_skew rule
+        compares workers against the fleet median).  NTP-style estimate
+        over CMD_PING round trips, cached for ``max_age_s`` so a window
+        roll does not cost ping frames every time; called only from the
+        signal-plane thread, never on a round's critical path.  None
+        when no live server can answer."""
+        now = time.monotonic()
+        if self._fleet_clock is not None \
+                and now - self._fleet_clock[0] < max_age_s:
+            return self._fleet_clock[1]
+        for slot, c in enumerate(self.conns):
+            if slot in self._dead_slots:
+                continue
+            try:
+                off, rtt = estimate_clock_offset(self._ping_server_clock(
+                    c, samples=samples, timeout=timeout))
+            except (ConnectionError, OSError, TimeoutError, ValueError,
+                    RuntimeError):
+                continue
+            est = {"offset_us": float(off), "rtt_us": float(rtt),
+                   "server": slot}
+            self._fleet_clock = (now, est)
+            return est
+        return None
+
+    def publish_window(self, window: int, doc: dict,
+                       timeout: float = 10.0) -> bool:
+        """Publish one window summary (CMD_WINDOW, key = window index)
+        to this worker's rank-0 server — the first live conn, so a
+        drained/dead server 0 fails over to the next member instead of
+        silencing the worker's row.  Swallows wire errors (the plane
+        must outlive a flaky server; the ring just misses a window) and
+        returns whether the publish landed."""
+        if not self._fleet_wire:
+            return False
+        import json as _json
+        payload = _json.dumps(doc, separators=(",", ":")).encode()
+        for slot, c in enumerate(self.conns):
+            if slot in self._dead_slots:
+                continue
+            try:
+                c.request(CMD_WINDOW, key=int(window), payload=payload,
+                          worker_id=self.worker_id, timeout=timeout)
+                self._fleet_publishes += 1
+                return True
+            except (ConnectionError, OSError, TimeoutError,
+                    RuntimeError) as e:
+                self._fleet_publish_errors += 1
+                get_logger().debug(
+                    "fleet publish of window %d to server %d failed: %s",
+                    window, slot, e)
+                return False
+        self._fleet_publish_errors += 1
+        return False
+
+    def fetch_fleet(self, timeout: float = 10.0) -> dict:
+        """The merged fleet view: every live server's CMD_FLEET rings,
+        folded per (worker, window index).  After a drain two servers
+        may briefly both hold a worker's windows (the migrated copy and
+        the publisher's ongoing ring) — same-index rows are identical by
+        construction (publishes are idempotent replace-in-place), so
+        first-seen wins.  ``{"armed", "cap", "workers": {wid:
+        [summary, ...]}, "servers_down"}`` with each worker's summaries
+        ordered by window index."""
+        merged: dict = {"armed": False, "cap": 0, "workers": {},
+                        "servers_down": 0}
+        by_idx: Dict[int, Dict[int, dict]] = {}
+        for slot, c in enumerate(self.conns):
+            if slot in self._dead_slots:
+                merged["servers_down"] += 1
+                continue
+            try:
+                doc = self._fleet_probe(c, timeout=timeout)
+            except (ConnectionError, OSError, TimeoutError,
+                    RuntimeError):
+                # A dead server must not break the fleet plane — it is
+                # exactly when the operator reads it.
+                merged["servers_down"] += 1
+                continue
+            merged["armed"] = merged["armed"] or bool(doc.get("armed"))
+            merged["cap"] = max(merged["cap"], int(doc.get("cap", 0)))
+            for wid, rows in (doc.get("workers") or {}).items():
+                ring = by_idx.setdefault(int(wid), {})
+                for row in rows:
+                    if not isinstance(row, dict) or "window" not in row:
+                        continue   # a malformed publish poisons only
+                        #            its own row, never the merge
+                    ring.setdefault(int(row["window"]), row)
+        for wid, ring in by_idx.items():
+            merged["workers"][wid] = [ring[i] for i in sorted(ring)]
+        return merged
+
+    def fleet_stats(self) -> dict:
+        """Publish-side accounting for telemetry / the /fleet route."""
+        return {"armed": self._fleet_wire,
+                "publishes": self._fleet_publishes,
+                "publish_errors": self._fleet_publish_errors}
 
     def audit_check(self, timeout: float = 10.0) -> dict:
         """Cross-check this worker's last-K pulled-digest window against

@@ -309,3 +309,79 @@ def test_autoscale_e2e_ramp_1_3_1(ring_servers):
         s.close()
         ex.close()
         tm.reset_registry()
+
+
+# ---------------------------------------------------------------------------
+# fleet-fed input (ISSUE 19): fleet_summary folding + the blind spike
+# ---------------------------------------------------------------------------
+def _fleet_window(idx, per_worker_servers):
+    """One ALIGNED fleet window whose worker docs carry server rows."""
+    return {"window": idx,
+            "workers": {wid: {"worker": wid, "window": idx,
+                              "servers": rows}
+                        for wid, rows in per_worker_servers.items()},
+            "n_workers": len(per_worker_servers)}
+
+
+def test_fleet_summary_folds_max_and_or():
+    from byteps_tpu.common.autoscaler import fleet_summary
+    fw = _fleet_window(4, {
+        0: {"0": {"alive": True, "draining": False,
+                  "bytes_in": 100, "bytes_out": 5}},
+        1: {"0": {"alive": True, "draining": True,
+                  "bytes_in": 90, "bytes_out": 30},
+            "1": {"alive": False, "draining": False,
+                  "bytes_in": 7, "bytes_out": 0}},
+    })
+    s = fleet_summary(fw)
+    assert s["window"] == 4
+    rows = s["server"]["servers"]
+    # bytes: MAX across views (freshest poll wins, blind polls lose).
+    assert rows["0"]["bytes_in"] == 100 and rows["0"]["bytes_out"] == 30
+    # draining: OR across views (any observed transition vetoes).
+    assert rows["0"]["draining"] is True
+    assert rows["1"] == {"alive": False, "draining": False,
+                         "bytes_in": 7, "bytes_out": 0}
+    # No worker carried server rows: the window is unreadable, not
+    # "zero servers".
+    assert fleet_summary(_fleet_window(5, {0: {}, 1: {}})) is None
+    assert fleet_summary({"window": 6, "workers": {}}) is None
+
+
+def test_fleet_fed_scaler_sees_blind_spike():
+    """The acceptance case for fleet-feeding the autoscaler: a load
+    spike visible ONLY in worker 2's published window (worker 0's own
+    CMD_STATS poll was blind to it) must still trip scale-up."""
+    from byteps_tpu.common.autoscaler import fleet_summary
+    tm.reset_registry()
+    ex = FakeExec()
+    a = Autoscaler(FakeSession(), ex, hold=2, cooldown=3, up_mb=1.0)
+    mb = 1 << 20
+
+    def srv(b0, b1):
+        return {"0": {"alive": True, "draining": False,
+                      "bytes_in": b0, "bytes_out": 0},
+                "1": {"alive": True, "draining": False,
+                      "bytes_in": b1, "bytes_out": 0}}
+
+    def feed(idx, flat, spiky):
+        # Workers 0 and 1 publish the flat counters; only worker 2's
+        # poll caught the real (spiking) lifetime counters.
+        fw = _fleet_window(idx, {0: srv(*flat), 1: srv(*flat),
+                                 2: srv(*spiky)})
+        return a.observe(fleet_summary(fw))
+
+    assert feed(0, (0, 0), (0, 0)) is None                    # baseline
+    assert feed(1, (0, 0), (50 * mb, 50 * mb)) is None        # streak 1
+    rec = feed(2, (0, 0), (100 * mb, 100 * mb))               # streak 2
+    assert rec is not None and rec["dir"] == "up"
+    assert ex.ups == [2]
+    # Control: the same scaler fed only worker 0's blind (flat) view
+    # never sees up-pressure — it would have missed the spike.
+    bx = FakeExec()
+    b = Autoscaler(FakeSession(), bx, hold=2, cooldown=3, up_mb=1.0)
+    for i in range(3):
+        rec = b.observe(W(i, {0: 0, 1: 0}))
+        assert rec is None or rec["dir"] != "up"
+    assert bx.ups == []
+    tm.reset_registry()

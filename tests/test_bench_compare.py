@@ -184,6 +184,29 @@ def test_robustness_family_direction():
     assert bench_compare.check(recs, threshold=0.10)["regressions"] == []
 
 
+def test_fleet_family_direction():
+    """BENCH_FLEET headlines (ISSUE 19): the goodput ledger's compute
+    share is HIGHER-is-better (named explicitly — a *_pct fallthrough
+    must never flip it), the armed plane's round overhead reads lower
+    via the _ms time rule."""
+    assert not bench_compare._lower_is_better("fleet_goodput_pct", "pct")
+    assert bench_compare._lower_is_better("fleet_plane_overhead_ms", "ms")
+
+    # End to end: goodput IMPROVING (60 -> 80) must not flag...
+    recs = [R(1, "fleet_goodput_pct", 60.0, unit="pct"),
+            R(2, "fleet_goodput_pct", 80.0, unit="pct")]
+    rep = bench_compare.check(recs, threshold=0.10)
+    assert rep["regressions"] == []
+    assert rep["groups"][0]["direction"] == "higher"
+    # ...goodput COLLAPSING flags...
+    recs[-1] = R(2, "fleet_goodput_pct", 30.0, unit="pct")
+    assert len(bench_compare.check(recs, threshold=0.10)["regressions"]) == 1
+    # ...and the plane's overhead growing flags as a regression.
+    recs = [R(1, "fleet_plane_overhead_ms", 0.1, unit="ms"),
+            R(2, "fleet_plane_overhead_ms", 5.0, unit="ms")]
+    assert len(bench_compare.check(recs, threshold=0.10)["regressions"]) == 1
+
+
 def test_throughput_units_are_higher_is_better():
     """The unit-direction law (ISSUE 15 satellite): *_mbps / *_goodput /
     throughput-ish units are explicitly HIGHER-is-better — including

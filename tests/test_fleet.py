@@ -1,0 +1,656 @@
+"""Fleet observability plane tests (docs/monitoring.md "Fleet plane",
+ISSUE 19): the CMD_WINDOW/CMD_FLEET wire (publish rings, merge, trim,
+idempotent replace, probe downgrade, unarmed byte-identity), the fleet
+doctor rule set over synthetic aligned views, live/offline (bundle)
+parity, the goodput ledger's exact-partition law, and the elastic
+edges — joiner visibility, evicted-ring expiry, and rings surviving a
+server drain through the CMD_MIGRATE trailer.
+"""
+
+import json
+import socket
+import struct
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from byteps_tpu.common import doctor as doctor_mod
+from byteps_tpu.common import goodput as goodput_mod
+from byteps_tpu.common import telemetry as tm
+from byteps_tpu.server.client import (
+    PSSession,
+    CMD_HELLO, CMD_INIT, CMD_PUSH, CMD_PULL, CMD_WINDOW, CMD_FLEET,
+)
+
+from testutil import StubPSServer, cpu_env, free_port
+
+
+# ---------------------------------------------------------------------------
+# synthetic-doc helpers: the publish-doc / aligned-window shapes
+# ---------------------------------------------------------------------------
+def _doc(wid, window, dur_s=10.0, blame=None, clock=None, codecs=None,
+         keys=None, events=None, servers=None, comps=None):
+    d = {"schema": doctor_mod.FLEET_SCHEMA, "window": int(window),
+         "ts": 1000.0 + window, "mono": 10.0 + window,
+         "dur_s": float(dur_s), "worker": int(wid),
+         "keys": keys or {}, "components": comps or {},
+         "events": events or {}, "lag": {}, "blame": blame,
+         "clock_offset_us": clock, "findings": []}
+    if codecs:
+        d["codecs"] = codecs
+    if servers:
+        d["servers"] = servers
+    return d
+
+
+def _fw(idx, docs):
+    """One ALIGNED fleet window (the fleet_windows_from_view shape)."""
+    return {"schema": doctor_mod.FLEET_SCHEMA, "window": int(idx),
+            "ts": max(d["ts"] for d in docs),
+            "workers": {d["worker"]: d for d in docs},
+            "n_workers": len(docs)}
+
+
+# ---------------------------------------------------------------------------
+# publish doc: what each worker's CMD_WINDOW frame carries
+# ---------------------------------------------------------------------------
+def test_fleet_publish_doc_shape():
+    summary = {
+        "window": 7, "ts": 123.0, "mono": 5.0,
+        "anchor": {"wall": 123.0, "mono": 5.0}, "dur_s": 2.0,
+        "keys": {"g": {"class": "wire_bound", "wire_mbps": 12.5,
+                       "pushes": 3,
+                       "components": {"queue": 0.1, "push_wire": 0.4}},
+                 "h": {"class": "tiny", "wire_mbps": 0.1,
+                       "components": {"queue": 0.2}}},
+        "metrics": {'bps_worker_round_lag{worker="0"}': 0,
+                    'bps_worker_round_lag{worker="1"}': 3,
+                    "bps_pushpull_bytes_total": 1 << 30},
+        "events": {"reconnected": 1},
+        "server": {"servers": {"0": {"alive": True, "draining": False,
+                                     "bytes_in": 10, "bytes_out": 20}}},
+    }
+    doc = doctor_mod.fleet_publish_doc(
+        summary, 2, clock={"offset_us": 150.0, "rtt_us": 80.0},
+        open_findings=["barrier_stall", "barrier_stall"],
+        codecs={"g": {"name": "onebit", "epoch": 3, "pending": False}})
+    assert doc["schema"] == doctor_mod.FLEET_SCHEMA
+    assert doc["worker"] == 2 and doc["window"] == 7
+    assert doc["dur_s"] == 2.0 and doc["ts"] == 123.0
+    # Straggler blame: the max-lag worker with lag > 0.
+    assert doc["lag"] == {"0": 0, "1": 3}
+    assert doc["blame"] == {"worker": "1", "lag": 3}
+    # Per-key slices keep class/rate/components; components also sum.
+    assert doc["keys"]["g"]["class"] == "wire_bound"
+    assert doc["keys"]["g"]["wire_mbps"] == 12.5
+    assert doc["components"] == pytest.approx(
+        {"queue": 0.1 + 0.2, "push_wire": 0.4})
+    assert doc["clock_offset_us"] == 150.0
+    assert doc["findings"] == ["barrier_stall"]          # deduped
+    assert doc["codecs"]["g"] == {"name": "onebit", "epoch": 3,
+                                  "pending": False}
+    assert doc["servers"]["0"]["bytes_in"] == 10
+    # The compact-frame law: never the full metrics snapshot.
+    assert "metrics" not in doc
+    # No lag at all -> no blame; no clock -> explicit None.
+    doc2 = doctor_mod.fleet_publish_doc({"window": 0, "dur_s": 1.0}, 0)
+    assert doc2["blame"] is None and doc2["clock_offset_us"] is None
+
+
+# ---------------------------------------------------------------------------
+# alignment + offline (bundle) parity
+# ---------------------------------------------------------------------------
+def test_fleet_windows_alignment_joiner_and_leaver():
+    view = {"workers": {
+        0: [_doc(0, 1), _doc(0, 2), _doc(0, 3)],
+        1: [_doc(1, 2), _doc(1, 3)],          # joiner: first publish at 2
+        2: [_doc(2, 1)],                      # left/evicted after 1
+        3: [{"not": "a window"}, {"window": "bogus"}],   # malformed rows
+    }}
+    fw = doctor_mod.fleet_windows_from_view(view)
+    assert [w["window"] for w in fw] == [1, 2, 3]
+    assert sorted(fw[0]["workers"]) == [0, 2]
+    assert sorted(fw[1]["workers"]) == [0, 1]     # joiner in ITS window
+    assert sorted(fw[2]["workers"]) == [0, 1]     # leaver contributes 0
+    assert fw[1]["n_workers"] == 2
+    assert fw[2]["ts"] == max(r["ts"] for r in fw[2]["workers"].values())
+
+
+def test_fleet_view_from_bundles_matches_live_view():
+    """The offline reconstruction (bundles' fleet.published rings) and
+    the live CMD_FLEET view align to the same windows and reach the
+    SAME fleet verdict — the bps_doctor --fleet parity law."""
+    docs = {wid: [_doc(wid, i,
+                       blame=({"worker": "1", "lag": 2}
+                              if wid != 1 else None))
+                  for i in range(3)]
+            for wid in (0, 1, 2)}
+    live_view = {"armed": True, "workers": docs}
+    bundles = [{"schema": "bps-postmortem-v1", "rank": wid,
+                "extra": {"fleet": {"published": rows}}}
+               for wid, rows in docs.items()]
+    off_view = doctor_mod.fleet_view_from_bundles(bundles)
+    assert off_view["armed"] is True
+    assert off_view["workers"] == docs
+    live = doctor_mod.evaluate_fleet_stream(
+        doctor_mod.fleet_windows_from_view(live_view))
+    off = doctor_mod.evaluate_fleet_stream(
+        doctor_mod.fleet_windows_from_view(off_view))
+    assert live == off
+    assert any(f["rule"] == "fleet_straggler_confirmed"
+               for f in live["open"])
+    # A bundle with no fleet section contributes nothing (plane off).
+    assert doctor_mod.fleet_view_from_bundles(
+        [{"schema": "bps-postmortem-v1", "rank": 0}]) \
+        == {"armed": False, "workers": {}}
+
+
+# ---------------------------------------------------------------------------
+# fleet rules over synthetic aligned views
+# ---------------------------------------------------------------------------
+def test_fleet_straggler_confirmed_quorum_and_persistence():
+    blame1 = {"worker": "1", "lag": 2}
+    wins = [_fw(i, [_doc(0, i, blame=blame1), _doc(1, i),
+                    _doc(2, i, blame=blame1)]) for i in (0, 1)]
+    diag = doctor_mod.evaluate_fleet_stream(wins)
+    f = next(f for f in diag["open"]
+             if f["rule"] == "fleet_straggler_confirmed")
+    assert f["subject"] == "worker 1"
+    assert f["severity"] == "error"
+    assert f["evidence"]["worker"] == "1"
+    assert f["evidence"]["votes"] == 2 and f["evidence"]["views"] == 3
+    assert f["playbook"].endswith("#rule-fleet_straggler_confirmed")
+    assert diag["fleet"] is True and diag["windows_evaluated"] == 2
+
+    # One window of votes is not persistence.
+    assert doctor_mod.evaluate_fleet_stream(wins[:1])["open"] == []
+    # Blame flipping between workers never confirms anyone.
+    flip = [_fw(0, [_doc(0, 0, blame=blame1), _doc(1, 0),
+                    _doc(2, 0, blame=blame1)]),
+            _fw(1, [_doc(0, 1, blame={"worker": "2", "lag": 2}),
+                    _doc(1, 1, blame={"worker": "2", "lag": 2}),
+                    _doc(2, 1)])]
+    assert doctor_mod.evaluate_fleet_stream(flip)["open"] == []
+    # A single blaming view is below quorum (min 2) in a 3-worker fleet.
+    solo = [_fw(i, [_doc(0, i, blame=blame1), _doc(1, i), _doc(2, i)])
+            for i in (0, 1)]
+    assert doctor_mod.evaluate_fleet_stream(solo)["open"] == []
+    # Lag below the floor never votes.
+    weak = [_fw(i, [_doc(0, i, blame={"worker": "1", "lag": 0}),
+                    _doc(1, i),
+                    _doc(2, i, blame={"worker": "1", "lag": 0})])
+            for i in (0, 1)]
+    assert doctor_mod.evaluate_fleet_stream(weak)["open"] == []
+
+
+def test_fleet_rules_quiet_on_single_worker():
+    """Every fleet rule needs at least two views — a 1-worker fleet is
+    healthy by definition, whatever its rows claim."""
+    wins = [_fw(i, [_doc(0, i, blame={"worker": "0", "lag": 9},
+                         clock=9e9,
+                         codecs={"g": {"name": "onebit", "epoch": 1,
+                                       "pending": False}},
+                         keys={"g": {"class": "wire_bound",
+                                     "wire_mbps": 99.0,
+                                     "components": {}}})])
+            for i in range(4)]
+    diag = doctor_mod.evaluate_fleet_stream(wins)
+    assert diag["healthy"] and diag["open"] == []
+
+
+def test_clock_skew_rule():
+    # Worker 2 sits 200 ms from the fleet median for 2 windows.
+    wins = [_fw(i, [_doc(0, i, clock=0.0), _doc(1, i, clock=100.0),
+                    _doc(2, i, clock=200_000.0)]) for i in (0, 1)]
+    diag = doctor_mod.evaluate_fleet_stream(wins)
+    f = next(f for f in diag["open"] if f["rule"] == "clock_skew")
+    assert f["subject"] == "worker 2" and f["severity"] == "warn"
+    assert f["evidence"]["offset_us"] == 200_000.0
+    assert f["evidence"]["median_us"] == 100.0
+    # Under the 50 ms threshold: quiet.
+    near = [_fw(i, [_doc(0, i, clock=0.0), _doc(1, i, clock=100.0),
+                    _doc(2, i, clock=40_000.0)]) for i in (0, 1)]
+    assert doctor_mod.evaluate_fleet_stream(near)["open"] == []
+    # One skewed window then recovered: not persistent.
+    flap = [wins[0],
+            _fw(1, [_doc(0, 1, clock=0.0), _doc(1, 1, clock=100.0),
+                    _doc(2, 1, clock=200.0)])]
+    assert doctor_mod.evaluate_fleet_stream(flap)["open"] == []
+
+
+def test_codec_epoch_divergence_rule():
+    def cw(i, name1, pending=False, epoch1=3):
+        return _fw(i, [
+            _doc(0, i, codecs={"g": {"name": "onebit", "epoch": 3,
+                                     "pending": False}}),
+            _doc(1, i, codecs={"g": {"name": name1, "epoch": epoch1,
+                                     "pending": pending}})])
+    # Same epoch, different active names, 2 windows: forked wire format.
+    diag = doctor_mod.evaluate_fleet_stream([cw(0, "topk"),
+                                             cw(1, "topk")])
+    f = next(f for f in diag["open"]
+             if f["rule"] == "codec_epoch_divergence")
+    assert f["subject"] == "key g" and f["severity"] == "error"
+    assert f["evidence"]["names"] == ["onebit", "topk"]
+    # A pending renegotiation is a transition, not a fork.
+    assert doctor_mod.evaluate_fleet_stream(
+        [cw(0, "topk", pending=True),
+         cw(1, "topk", pending=True)])["open"] == []
+    # Different epochs = mid-rollout, legal.
+    assert doctor_mod.evaluate_fleet_stream(
+        [cw(0, "topk", epoch1=4), cw(1, "topk", epoch1=4)])["open"] == []
+
+
+def test_signal_disagreement_rule():
+    def kd(mbps):
+        return {"g": {"class": "wire_bound", "wire_mbps": mbps,
+                      "components": {}}}
+    w = _fw(0, [_doc(0, 0, keys=kd(50.0)), _doc(1, 0, keys=kd(0.5))])
+    diag = doctor_mod.evaluate_fleet_stream([w])
+    f = next(f for f in diag["open"]
+             if f["rule"] == "signal_disagreement")
+    assert f["subject"] == "key g" and f["severity"] == "warn"
+    assert f["evidence"]["max_worker"] == "0"
+    assert f["evidence"]["min_worker"] == "1"
+    # Both views tiny (under the floor): spread on noise is not a fork.
+    quiet = _fw(0, [_doc(0, 0, keys=kd(0.8)), _doc(1, 0, keys=kd(0.01))])
+    assert doctor_mod.evaluate_fleet_stream([quiet])["open"] == []
+    # Within the 4x trust band: quiet.
+    close = _fw(0, [_doc(0, 0, keys=kd(8.0)), _doc(1, 0, keys=kd(4.0))])
+    assert doctor_mod.evaluate_fleet_stream([close])["open"] == []
+
+
+# ---------------------------------------------------------------------------
+# goodput ledger: the exact-partition law
+# ---------------------------------------------------------------------------
+def test_event_category_mapping():
+    assert goodput_mod.event_category("barrier_timeout") == "stall"
+    assert goodput_mod.event_category("ring_epoch") == "disruption"
+    assert goodput_mod.event_category("reconnected") == "recovery"
+    # Prefix fallback: future barrier_*/conn_*/audit_* kinds stay billed.
+    assert goodput_mod.event_category("barrier_future_kind") == "stall"
+    assert goodput_mod.event_category("conn_whatever") == "recovery"
+    # Informational kinds cost nothing.
+    assert goodput_mod.event_category("init") is None
+
+
+def test_worker_ledger_exact_partition():
+    doc = {"dur_s": 10.0,
+           "components": {"queue": 1.0, "push_wire": 2.0, "encode": 0.5,
+                          "decode": 0.5, "serve": 2.0},
+           "events": {"barrier_timeout": 1, "reconnected": 1,
+                      "ring_epoch": 1, "init": 5}}
+    led = goodput_mod.worker_ledger(doc)
+    assert led["wire"] == pytest.approx(4.0)
+    assert led["straggler_wait"] == pytest.approx(2.0)
+    assert led["stall"] == pytest.approx(1.0)
+    assert led["recovery"] == pytest.approx(1.0)
+    assert led["disruption"] == pytest.approx(1.0)
+    assert led["compute"] == pytest.approx(1.0)
+    assert sum(led.values()) == pytest.approx(10.0, abs=1e-9)
+    assert set(led) == set(goodput_mod.CATEGORIES)
+
+
+def test_worker_ledger_scales_when_oversubscribed():
+    # Measured components exceed wall (they overlap): scaled down, the
+    # partition stays exact with zero compute.
+    led = goodput_mod.worker_ledger(
+        {"dur_s": 5.0, "components": {"queue": 4.0, "serve": 4.0},
+         "events": {}})
+    assert led["wire"] == pytest.approx(2.5)
+    assert led["straggler_wait"] == pytest.approx(2.5)
+    assert led["compute"] == pytest.approx(0.0)
+    assert sum(led.values()) == pytest.approx(5.0)
+    # Event claims exceeding the residual scale down proportionally.
+    led2 = goodput_mod.worker_ledger(
+        {"dur_s": 10.0, "components": {"serve": 4.0},
+         "events": {"stall": 9, "reconnected": 3}})
+    assert led2["straggler_wait"] == pytest.approx(4.0)
+    assert led2["stall"] == pytest.approx(6.0 * 9 / 12)
+    assert led2["recovery"] == pytest.approx(6.0 * 3 / 12)
+    assert led2["compute"] == pytest.approx(0.0)
+    assert sum(led2.values()) == pytest.approx(10.0)
+    # An empty doc is a zero-wall exact partition, not an error.
+    assert sum(goodput_mod.worker_ledger({}).values()) == 0.0
+
+
+def test_fleet_ledger_and_gauges():
+    fw = _fw(3, [
+        _doc(0, 3, dur_s=10.0,
+             comps={"queue": 1.0, "push_wire": 1.0, "serve": 2.0}),
+        _doc(1, 3, dur_s=10.0, comps={"serve": 5.0},
+             events={"barrier_timeout": 1}),
+    ])
+    led = goodput_mod.fleet_ledger(fw)
+    assert led["window"] == 3 and led["n_workers"] == 2
+    assert led["total_s"] == pytest.approx(20.0)
+    assert led["seconds"]["wire"] == pytest.approx(2.0)
+    assert led["seconds"]["straggler_wait"] == pytest.approx(7.0)
+    assert led["seconds"]["stall"] == pytest.approx(1.0)
+    assert led["seconds"]["compute"] == pytest.approx(10.0)
+    assert sum(led["pct"].values()) == pytest.approx(100.0)
+    assert led["goodput_pct"] == pytest.approx(50.0)
+    # Gauge export: the headline + one gauge per category.
+    reg = tm.MetricsRegistry()
+    goodput_mod.update_goodput(led, registry=reg)
+    snap = reg.snapshot()
+    assert snap["bps_fleet_goodput_pct"] == pytest.approx(50.0)
+    for cat in goodput_mod.CATEGORIES:
+        assert f'bps_fleet_time_pct{{category="{cat}"}}' in snap
+    assert sum(v for k, v in snap.items()
+               if k.startswith("bps_fleet_time_pct")) \
+        == pytest.approx(100.0)
+
+
+# ---------------------------------------------------------------------------
+# wire: recording-stub contracts (fast, no subprocess)
+# ---------------------------------------------------------------------------
+def _stub_roundtrip(fleet, armed_stub, publish=False):
+    """One push_pull (+ optional window publish) against a recording
+    stub; returns the raw (header, cmd, flags) frames."""
+    store = {}
+
+    def handler(cmd, dt, fl, req_id, wid, key, payload):
+        if cmd == CMD_HELLO:
+            return 0, b"\x00\x00"
+        if cmd == CMD_INIT:
+            return 0, struct.pack("<Q", 0)
+        if cmd == CMD_PUSH:
+            store[key] = bytes(payload)
+            return 0, b""
+        if cmd == CMD_PULL:
+            return 0, store[key]
+        if armed_stub and cmd == CMD_FLEET:
+            return 0, json.dumps({"armed": 1, "cap": 32,
+                                  "workers": {}}).encode()
+        if armed_stub and cmd == CMD_WINDOW:
+            return 0, b""
+        return 1, b""     # the old-engine default arm: unknown = error
+
+    srv = StubPSServer(handler, record=True)
+    try:
+        s = PSSession(["127.0.0.1"], [srv.port], worker_id=0,
+                      num_servers=1, wire_conns=1, fleet=fleet)
+        x = np.arange(256, dtype=np.float32)
+        np.testing.assert_array_equal(s.push_pull(3, x), x)
+        if publish:
+            assert s.publish_window(0, {"window": 0, "worker": 0})
+        stats = s.fleet_stats()
+        s.close()
+        with srv.lock:
+            return list(srv.frames), stats
+    finally:
+        srv.close()
+
+
+def test_unarmed_wire_byte_identity():
+    """ISSUE-19 acceptance: BYTEPS_TPU_FLEET=0 (the default) sends ZERO
+    fleet frames and the whole wire is byte-identical whether or not
+    the server even understands CMD_WINDOW/CMD_FLEET — recorded off a
+    stub, header for header."""
+    off_new, _ = _stub_roundtrip(fleet=False, armed_stub=True)
+    off_old, _ = _stub_roundtrip(fleet=False, armed_stub=False)
+    assert off_new == off_old        # raw header bytes, frame for frame
+    assert all(cmd not in (CMD_WINDOW, CMD_FLEET)
+               for _, cmd, _ in off_new)
+
+
+def test_armed_wire_adds_only_fleet_frames():
+    """Armed against a fleet-capable server, the wire grows by exactly
+    the bootstrap probe (CMD_FLEET) and the publish (CMD_WINDOW) — the
+    push/pull command sequence is untouched."""
+    off, _ = _stub_roundtrip(fleet=False, armed_stub=True)
+    on, stats = _stub_roundtrip(fleet=True, armed_stub=True,
+                                publish=True)
+    assert stats["armed"] and stats["publishes"] == 1
+    assert [c for _, c, _ in on if c not in (CMD_WINDOW, CMD_FLEET)] \
+        == [c for _, c, _ in off]
+    assert [c for _, c, _ in on
+            if c in (CMD_WINDOW, CMD_FLEET)] == [CMD_FLEET, CMD_WINDOW]
+
+
+def test_fleet_bootstrap_downgrades_against_old_server():
+    """A fleet-armed worker against a pre-fleet server (unknown command
+    answers an error status) downgrades loudly to fleet-off — never a
+    wire error, never a publish nothing retains."""
+    frames, stats = _stub_roundtrip(fleet=True, armed_stub=False,
+                                    publish=False)
+    assert not stats["armed"] and stats["publishes"] == 0
+    # The probe is the ONLY fleet frame that ever went out.
+    assert [c for _, c, _ in frames
+            if c in (CMD_WINDOW, CMD_FLEET)] == [CMD_FLEET]
+
+
+# ---------------------------------------------------------------------------
+# wire: real fleet-armed server (subprocess)
+# ---------------------------------------------------------------------------
+@pytest.fixture
+def fleet_server():
+    """Yields start(num_workers=..., windows=...) -> port against a
+    BYTEPS_TPU_FLEET=1 server; kills servers after."""
+    made = []
+
+    def start(num_workers=2, windows=4, extra_env=None):
+        last = None
+        for _ in range(3):
+            try:
+                return _once(num_workers, windows, extra_env)
+            except RuntimeError as e:
+                last = e
+        raise last
+
+    def _once(num_workers, windows, extra_env):
+        port = free_port()
+        env = cpu_env({
+            "DMLC_PS_ROOT_PORT": str(port - 1),
+            "DMLC_NUM_WORKER": str(num_workers),
+            "BYTEPS_SERVER_ENGINE_THREAD": "2",
+            "BYTEPS_TPU_FLEET": "1",
+            "BYTEPS_TPU_FLEET_WINDOWS": str(windows),
+            "JAX_PLATFORMS": "cpu",
+            **(extra_env or {}),
+        })
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "byteps_tpu.server"], env=env,
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+        made.append(proc)
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            try:
+                socket.create_connection(("127.0.0.1", port), 0.5).close()
+                return port
+            except OSError:
+                if proc.poll() is not None:
+                    raise RuntimeError(f"server died rc={proc.returncode}")
+                time.sleep(0.1)
+        raise TimeoutError("PS server did not come up")
+
+    yield start
+    for p in made:
+        p.kill()
+        p.wait()
+
+
+def test_cmd_window_fleet_roundtrip(fleet_server):
+    """Publish/merge/trim/replace against the real server: bounded
+    per-worker rings keyed by window index, idempotent re-publish, a
+    joiner visible at its first publish, and the CMD_STATS fleet
+    gauges."""
+    port = fleet_server(num_workers=2, windows=4)
+    s0 = PSSession(["127.0.0.1"], [port], worker_id=0, num_servers=1,
+                   fleet=True, fleet_windows=4)
+    s1 = None
+    try:
+        assert s0._fleet_wire, "bootstrap probe must arm vs this server"
+        for i in range(6):
+            assert s0.publish_window(i, _doc(0, i))
+        view = s0.fetch_fleet()
+        assert view["armed"] and view["cap"] == 4
+        # Ring bounded at the cap, trimmed from the FRONT.
+        assert [r["window"] for r in view["workers"][0]] == [2, 3, 4, 5]
+        # Re-publishing an index replaces in place (idempotent).
+        tagged = _doc(0, 5)
+        tagged["tag"] = "replaced"
+        assert s0.publish_window(5, tagged)
+        view = s0.fetch_fleet()
+        assert [r["window"] for r in view["workers"][0]] == [2, 3, 4, 5]
+        assert view["workers"][0][-1]["tag"] == "replaced"
+        # A joiner's row appears at its first publish.
+        s1 = PSSession(["127.0.0.1"], [port], worker_id=1,
+                       num_servers=1, fleet=True, fleet_windows=4)
+        assert s1._fleet_wire
+        assert s1.publish_window(5, _doc(1, 5))
+        view = s0.fetch_fleet()
+        assert sorted(view["workers"]) == [0, 1]
+        assert [r["window"] for r in view["workers"][1]] == [5]
+        # Clock-offset estimate for the publish doc (NTP over CMD_PING).
+        est = s0.fleet_clock_offset()
+        assert est is not None and "offset_us" in est and "rtt_us" in est
+        # CMD_STATS carries the fleet gauges.
+        st = s0.server_stats()
+        assert st["fleet_armed"]
+        assert st["fleet_workers"] == 2
+        assert st["fleet_windows_held"] == 5
+        assert st["fleet_publishes"] == 8
+    finally:
+        s0.close()
+        if s1 is not None:
+            s1.close()
+
+
+def test_fleet_probe_downgrades_against_unarmed_server(fleet_server):
+    """Worker armed, server NOT (BYTEPS_TPU_FLEET unset there): the
+    probe answers {"armed":0} and the client downgrades — mixed
+    deployments are safe in both directions."""
+    port = fleet_server(num_workers=1, extra_env={"BYTEPS_TPU_FLEET": ""})
+    s = PSSession(["127.0.0.1"], [port], worker_id=0, num_servers=1,
+                  fleet=True)
+    try:
+        assert not s._fleet_wire
+        assert not s.publish_window(0, _doc(0, 0))
+        view = s.fetch_fleet()
+        assert not view["armed"] and view["workers"] == {}
+        st = s.server_stats()
+        assert not st["fleet_armed"] and st["fleet_windows_held"] == 0
+    finally:
+        s.close()
+
+
+def test_evicted_worker_ring_expires(fleet_server):
+    """A worker that leaves the membership drops out of the merged
+    CMD_FLEET view — stale windows must not pin fleet rules on a
+    ghost."""
+    port = fleet_server(num_workers=2, windows=8)
+    s0 = PSSession(["127.0.0.1"], [port], worker_id=0, num_servers=1,
+                   fleet=True)
+    s1 = PSSession(["127.0.0.1"], [port], worker_id=1, num_servers=1,
+                   fleet=True)
+    try:
+        x = np.arange(64, dtype=np.float32)
+        # Both workers register membership through a real round.
+        import threading
+        t = threading.Thread(target=lambda: s1.push_pull(2, x))
+        t.start()
+        s0.push_pull(2, x)
+        t.join(timeout=60)
+        for i in range(3):
+            assert s0.publish_window(i, _doc(0, i))
+            assert s1.publish_window(i, _doc(1, i))
+        assert sorted(s0.fetch_fleet()["workers"]) == [0, 1]
+        s1.leave()
+        view = s0.fetch_fleet()
+        assert sorted(view["workers"]) == [0], view
+        assert s0.server_stats()["fleet_workers"] == 1
+    finally:
+        s0.close()
+        s1.close()
+
+
+@pytest.fixture
+def fleet_ring_servers():
+    """Two ring-armed, fleet-armed servers on consecutive ports (the
+    test_server_elastic harness, fleet flavour)."""
+    made = []
+
+    def start(n=2, windows=8):
+        last = None
+        for _ in range(4):
+            try:
+                return _start_group(n, windows)
+            except (RuntimeError, TimeoutError) as e:
+                last = e
+        raise last
+
+    def _start_group(n, windows):
+        with socket.socket() as sk:
+            sk.bind(("127.0.0.1", 0))
+            base = sk.getsockname()[1]
+        ports = [base + i for i in range(n)]
+        procs = []
+        for i in range(n):
+            env = cpu_env({
+                "DMLC_PS_ROOT_PORT": str(base - 1),
+                "DMLC_NUM_WORKER": "1",
+                "DMLC_NUM_SERVER": str(n),
+                "DMLC_SERVER_ID": str(i),
+                "BYTEPS_TPU_RING": "1",
+                "BYTEPS_TPU_FLEET": "1",
+                "BYTEPS_TPU_FLEET_WINDOWS": str(windows),
+                "BYTEPS_SERVER_ENGINE_THREAD": "2",
+                "JAX_PLATFORMS": "cpu",
+            })
+            procs.append(subprocess.Popen(
+                [sys.executable, "-m", "byteps_tpu.server"], env=env,
+                stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL))
+        made.extend(procs)
+        deadline = time.time() + 30
+        up = set()
+        while time.time() < deadline and len(up) < n:
+            for i, p in enumerate(ports):
+                if i in up:
+                    continue
+                try:
+                    socket.create_connection(("127.0.0.1", p), 0.5).close()
+                    up.add(i)
+                except OSError:
+                    if procs[i].poll() is not None:
+                        raise RuntimeError(
+                            f"server {i} died rc={procs[i].returncode}")
+            time.sleep(0.1)
+        if len(up) < n:
+            raise TimeoutError("ring servers did not come up")
+        return ports
+
+    yield start
+    for p in made:
+        p.kill()
+        p.wait()
+
+
+def test_fleet_rings_survive_server_drain(fleet_ring_servers):
+    """Rings ride the CMD_MIGRATE trailer: drain the server holding
+    them and the merged view on the survivor is equal row for row."""
+    ports = fleet_ring_servers(2, windows=8)
+    s = PSSession(["127.0.0.1"] * 2, list(ports), worker_id=0,
+                  num_servers=2, ring=True, wire_conns=1,
+                  partition_bytes=1 << 16, fleet=True, fleet_windows=8)
+    try:
+        assert s._fleet_wire
+        x = np.arange(1 << 12, dtype=np.float32)
+        for k in range(1, 7):       # spread keys over both servers
+            np.testing.assert_array_equal(s.push_pull(k, x), x)
+        for i in range(4):
+            assert s.publish_window(i, _doc(0, i))
+        before = s.fetch_fleet()["workers"]
+        assert [r["window"] for r in before[0]] == [0, 1, 2, 3]
+        # Drain server 0 — the rank-0 server holding the ring.
+        s.drain_server(0, shutdown=True)
+        after = s.fetch_fleet()
+        assert after["armed"]
+        assert after["workers"] == before, \
+            "fleet rings must survive the drain byte-equal"
+    finally:
+        s.close()

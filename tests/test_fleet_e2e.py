@@ -1,0 +1,246 @@
+"""ISSUE-19 fleet acceptance: a THREE-worker PS run with worker 1
+delayed through chaos_proxy yields `fleet_straggler_confirmed` NAMING
+worker 1 — through `bps_doctor --fleet --json` against worker 0's ONE
+live endpoint, AND offline from the run's merged postmortem bundles —
+plus the goodput ledger's exact partition over the same run.
+
+All workers run a FIXED round count in lockstep (sync rounds need every
+push).  Worker 0 watches `bps.get_fleet()` and FREEZES the plane
+(`signals.disarm()`) the moment the finding opens — the delayed rounds
+end with the run, so trailing quiet windows would otherwise close the
+finding before the CLI polls; the frozen `/fleet` view is exactly what
+the in-job engine convicted on.  It then holds its endpoint open
+(blocked on stdin) while the test runs the live CLI against it.
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import threading
+import time
+
+from testutil import cpu_env, free_port
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TOOLS = os.path.join(ROOT, "tools")
+if TOOLS not in sys.path:
+    sys.path.insert(0, TOOLS)
+
+from chaos_proxy import ChaosProxy  # noqa: E402
+
+ROUNDS = 40
+
+
+def _boot_server(port, num_workers):
+    env = cpu_env({
+        "DMLC_PS_ROOT_PORT": str(port - 1),
+        "DMLC_NUM_WORKER": str(num_workers),
+        "BYTEPS_SERVER_ENGINE_THREAD": "2",
+        "BYTEPS_TPU_FLEET": "1",
+        "JAX_PLATFORMS": "cpu",
+    })
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "byteps_tpu.server"], env=env,
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        try:
+            socket.create_connection(("127.0.0.1", port), 0.5).close()
+            return proc
+        except OSError:
+            if proc.poll() is not None:
+                raise RuntimeError(f"server died rc={proc.returncode}")
+            time.sleep(0.1)
+    proc.kill()
+    raise TimeoutError("PS server did not come up")
+
+
+WORKER_CODE = """
+import json, os, sys
+import numpy as np, jax.numpy as jnp
+import byteps_tpu as bps
+from byteps_tpu.common import signals
+bps.init()
+watch = os.environ.get("E2E_WATCH") == "1"
+x = jnp.asarray(np.arange(2048, dtype=np.float32))
+found = None
+for r in range(int(os.environ["E2E_ROUNDS"])):
+    bps.push_pull(x, name="e2e.grad", average=False)
+    bps.mark_step()
+    if watch and found is None:
+        fl = bps.get_fleet()
+        for f in (fl.get("diagnosis") or {}).get("open", []):
+            if f["rule"] == "fleet_straggler_confirmed":
+                found = f
+                signals.disarm()   # freeze the fleet view for the CLI
+                break
+if watch:
+    fl = bps.get_fleet()
+    if found is None:
+        print("E2E_NO_FINDING " + json.dumps(fl.get("diagnosis")),
+              flush=True)
+        bps.shutdown()
+        sys.exit(4)
+    print("E2E_FINDING " + json.dumps(found), flush=True)
+    print("E2E_GOODPUT " + json.dumps(fl.get("goodput")), flush=True)
+    import urllib.request
+    sig = json.loads(urllib.request.urlopen(
+        "http://127.0.0.1:" + os.environ["BYTEPS_TPU_METRICS_PORT"]
+        + "/signals", timeout=10).read())
+    print("E2E_SIGWIN " + json.dumps({"window": sig.get("window")}),
+          flush=True)
+    print("E2E_READY", flush=True)
+    sys.stdin.readline()   # the test polls bps_doctor --fleet now
+bps.shutdown()
+print("E2E_OK", flush=True)
+"""
+
+
+def test_three_worker_fleet_straggler_attribution(tmp_path):
+    port = free_port()
+    mport = free_port()
+    server = _boot_server(port, num_workers=3)
+    proxy = ChaosProxy("127.0.0.1", port).start()
+    proxy.delay(100)                       # ms per forwarded chunk
+    pm_dir = str(tmp_path / "postmortems")
+    base = {
+        "BYTEPS_TPU_PS_MODE": "1",
+        "DMLC_NUM_WORKER": "3",
+        "DMLC_NUM_SERVER": "1",
+        "DMLC_PS_ROOT_PORT": str(port - 1),
+        "BYTEPS_TPU_FUSION_BYTES": "0",
+        "BYTEPS_TPU_FLEET": "1",
+        # Fast windows so two consecutive convicting windows land in
+        # seconds; EVERY worker publishes (the fleet quorum needs the
+        # healthy workers' independent views of the lag).
+        "BYTEPS_TPU_SIGNAL_WINDOW_S": "0.35",
+        "BYTEPS_TPU_POSTMORTEM_DIR": pm_dir,
+        "E2E_ROUNDS": str(ROUNDS),
+    }
+    envs = []
+    for wid in range(3):
+        host_port = proxy.port if wid == 1 else port
+        env = cpu_env({**base,
+                       "DMLC_WORKER_ID": str(wid),
+                       "BYTEPS_TPU_PS_HOSTS": f"127.0.0.1:{host_port}"})
+        if wid == 0:
+            env["E2E_WATCH"] = "1"
+            env["BYTEPS_TPU_METRICS_PORT"] = str(mport)
+        envs.append(env)
+
+    procs = []
+    out0_lines = []
+    ready = threading.Event()
+
+    def _pump(stream):
+        for line in stream:
+            out0_lines.append(line.rstrip("\n"))
+            if line.startswith("E2E_READY"):
+                ready.set()
+
+    try:
+        for wid in (1, 2):
+            procs.append(subprocess.Popen(
+                [sys.executable, "-c", WORKER_CODE], env=envs[wid],
+                stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                text=True))
+        p0 = subprocess.Popen(
+            [sys.executable, "-c", WORKER_CODE], env=envs[0],
+            stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE, text=True, bufsize=1)
+        procs.append(p0)
+        pump = threading.Thread(target=_pump, args=(p0.stdout,),
+                                daemon=True)
+        pump.start()
+        assert ready.wait(timeout=240), (
+            "worker 0 never reached E2E_READY",
+            "\n".join(out0_lines)[-3000:],
+            p0.poll() and p0.stderr.read()[-3000:])
+
+        # -- LIVE half: ONE endpoint, the fleet CLI, worker 1 named.
+        cli = subprocess.run(
+            [sys.executable, os.path.join(TOOLS, "bps_doctor.py"),
+             "--fleet", "--port", str(mport), "--json"],
+            capture_output=True, text=True, timeout=120)
+        assert cli.returncode == 0, cli.stderr[-2000:]
+        live = json.loads(cli.stdout)
+        assert live["mode"] == "fleet-live"
+        diag = live["diagnosis"]
+        hits = [f for f in diag["open"] + diag["history"]
+                if f["rule"] == "fleet_straggler_confirmed"]
+        assert hits, diag
+        assert all(f["subject"] == "worker 1" for f in hits), hits
+        assert all(f["evidence"]["worker"] == "1" for f in hits)
+        # Quorum: at least 2 of the 3 views voted worker 1 down.
+        assert all(f["evidence"]["votes"] >= 2 for f in hits)
+        # Goodput rode the same poll: the partition is exact.
+        gp = live.get("goodput")
+        assert gp, live
+        assert set(gp["pct"]) == {"compute", "wire", "straggler_wait",
+                                  "stall", "recovery", "disruption"}
+        assert abs(sum(gp["pct"].values()) - 100.0) < 1e-6
+        assert abs(sum(gp["seconds"].values()) - gp["total_s"]) < 1e-6
+
+        # Release worker 0, collect everyone.
+        p0.stdin.write("\n")
+        p0.stdin.flush()
+        outs = []
+        for p in procs:
+            if p is p0:
+                p.wait(timeout=240)
+                pump.join(timeout=10)
+                outs.append(("\n".join(out0_lines), p.stderr.read()))
+            else:
+                outs.append(p.communicate(timeout=240))
+        for p, (out, err) in zip(procs, outs):
+            assert p.returncode == 0, (out[-2000:], err[-3000:])
+        out0 = outs[-1][0]
+    finally:
+        proxy.stop()
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait()
+        server.kill()
+        server.wait()
+
+    # The IN-JOB engine convicted the same worker the CLI did.
+    line = next(l for l in out0.splitlines()
+                if l.startswith("E2E_FINDING "))
+    finding = json.loads(line[len("E2E_FINDING "):])
+    assert finding["rule"] == "fleet_straggler_confirmed"
+    assert finding["subject"] == "worker 1", finding
+    assert finding["playbook"].endswith(
+        "#rule-fleet_straggler_confirmed")
+    # The worker-side ledger agreed with the CLI's goodput surface.
+    gp_line = next(l for l in out0.splitlines()
+                   if l.startswith("E2E_GOODPUT "))
+    wgp = json.loads(gp_line[len("E2E_GOODPUT "):])
+    assert wgp and abs(sum(wgp["pct"].values()) - 100.0) < 1e-6
+    # /signals carries the cross-worker alignment key (ISSUE-19 sat 1).
+    sig_line = next(l for l in out0.splitlines()
+                    if l.startswith("E2E_SIGWIN "))
+    assert json.loads(sig_line[len("E2E_SIGWIN "):])["window"] >= 1
+    assert "E2E_OK" in out0
+
+    # -- OFFLINE half: the SAME rule set over the merged bundles names
+    # the SAME worker (each bundle carries only ITS worker's ring; the
+    # merge reconstructs the view CMD_FLEET served).
+    bundles = os.listdir(pm_dir)
+    assert len([f for f in bundles
+                if f.startswith("bps-postmortem-")]) >= 3, bundles
+    proc = subprocess.run(
+        [sys.executable, os.path.join(TOOLS, "bps_doctor.py"),
+         "--fleet", pm_dir, "--json"],
+        capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    off = json.loads(proc.stdout)
+    assert off["mode"] == "fleet-offline"
+    assert sorted(off["workers"]) == [0, 1, 2]
+    odiag = off["diagnosis"]
+    ohits = [f for f in odiag["open"] + odiag["history"]
+             if f["rule"] == "fleet_straggler_confirmed"]
+    assert ohits, odiag
+    assert all(f["subject"] == "worker 1" for f in ohits), ohits

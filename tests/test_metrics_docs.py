@@ -1,0 +1,55 @@
+"""Tier-1 guard: every exported bps_* metric must be documented in
+docs/monitoring.md, and every exact documented metric must still be
+registered (tools/check_metrics_docs.py).  Undocumented metrics and
+stale rows both drift in one PR at a time unless a fast test pins
+them — the metric-name companion of test_env_docs (knobs) and
+test_doctor_docs (rule playbooks)."""
+
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+import check_metrics_docs  # noqa: E402
+
+
+def test_metrics_docs_in_sync():
+    problems = check_metrics_docs.check(REPO)
+    assert not problems, "\n" + "\n".join(problems)
+
+
+def test_checker_catches_drift(tmp_path):
+    """The checker itself must actually detect both directions — a
+    vacuously-green guard is worse than none."""
+    pkg = tmp_path / "byteps_tpu"
+    pkg.mkdir()
+    (pkg / "x.py").write_text(
+        'reg.gauge("bps_undocumented_metric", help="x").set(1)\n')
+    docs = tmp_path / "docs"
+    docs.mkdir()
+    (docs / "monitoring.md").write_text(
+        "| `bps_stale_metric` | gauge | x |\n")
+    problems = check_metrics_docs.check(str(tmp_path))
+    assert any("bps_undocumented_metric" in p for p in problems)
+    assert any("bps_stale_metric" in p for p in problems)
+
+
+def test_collector_families_cover_dynamic_names(tmp_path):
+    """register_collector("codec", ...) exports the dynamic bps_codec_*
+    family: the doc may cover it with a `bps_codec_*` wildcard row, and
+    an exact doc name under a live family is not stale."""
+    pkg = tmp_path / "byteps_tpu"
+    pkg.mkdir()
+    (pkg / "x.py").write_text(
+        'reg.register_collector("codec", lambda: stats())\n')
+    docs = tmp_path / "docs"
+    docs.mkdir()
+    (docs / "monitoring.md").write_text(
+        "| `bps_codec_*` | gauge | mirror family |\n"
+        "also `bps_codec_encoded_parts` specifically.\n")
+    assert check_metrics_docs.check(str(tmp_path)) == []
+    # An undocumented family IS drift.
+    (docs / "monitoring.md").write_text("nothing here\n")
+    problems = check_metrics_docs.check(str(tmp_path))
+    assert any("bps_codec_" in p for p in problems)

@@ -335,3 +335,22 @@ def test_plane_thread_rolls_windows():
         p.stop()
     # stop() closes the in-flight window too.
     assert any(s["keys"] for s in p.history())
+
+
+def test_window_anchor_same_instant_clocks():
+    """Every summary carries a same-instant wall/mono anchor (ISSUE 19:
+    the fleet plane aligns cross-worker windows by it) plus its window
+    index — with the wall anchor doubling as the summary's ts."""
+    p = signals.SignalPlane(window_s=1.0)
+    s = p.roll()
+    assert s["anchor"]["wall"] == s["ts"]
+    # The anchor's mono leg is sampled back-to-back with the wall leg
+    # (NOT the window's roll boundary, which is a separate instant).
+    assert abs(s["anchor"]["mono"] - s["mono"]) < 0.5
+    assert isinstance(s["window"], int)
+    assert s["dur_s"] > 0
+    s2 = p.roll()
+    assert s2["window"] == s["window"] + 1
+    # Monotonic anchors advance together with wall anchors.
+    assert s2["anchor"]["mono"] >= s["anchor"]["mono"]
+    assert s2["anchor"]["wall"] >= s["anchor"]["wall"]

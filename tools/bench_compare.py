@@ -74,6 +74,10 @@ _HIGHER_METRIC_SUFFIXES = (
     "_mbps", "_gbps", "_mb_s", "_gb_s", "_goodput", "_throughput",
     "_per_s", "_per_sec", "_rows_s", "_tokens_s", "_items_s", "_qps",
     "_mfu", "_efficiency", "_pct_of_floor", "_saved_pct", "_hit_rate",
+    # BENCH_FLEET's goodput-ledger headline: a percentage where more
+    # compute share is better — named explicitly so it never drifts
+    # onto a lower-is-better *_pct rule (the _gap_pct family below).
+    "_goodput_pct",
 )
 _HIGHER_UNITS = {
     "mbps", "gbps", "mb/s", "gb/s", "mb_s", "gb_s", "goodput_mbps",

@@ -171,6 +171,129 @@ def _print_diag(d: dict) -> None:
 
 
 # ---------------------------------------------------------------------------
+# Fleet mode (--fleet): the cross-worker rule set over the MERGED view
+# ---------------------------------------------------------------------------
+def _load_bundles(paths) -> list:
+    files = []
+    for p in paths:
+        if os.path.isdir(p):
+            files.extend(sorted(glob.glob(
+                os.path.join(p, "bps-postmortem-*.json"))))
+        else:
+            files.append(p)
+    bundles = []
+    for f in files:
+        try:
+            doc = _load_json(f)
+            if doc.get("schema") == BUNDLE_SCHEMA:
+                bundles.append(doc)
+        except (OSError, ValueError) as e:
+            print(f"bps_doctor: skipping {f}: {e}", file=sys.stderr)
+    return bundles
+
+
+def run_fleet_offline(paths, as_json: bool) -> tuple:
+    """Merge every bundle's ``fleet.published`` ring (each worker's
+    exact CMD_WINDOW docs) back into the view CMD_FLEET would have
+    served, align, and evaluate the fleet rules — identical to the live
+    verdict by construction."""
+    bundles = _load_bundles(paths)
+    view = doctor.fleet_view_from_bundles(bundles)
+    fw = doctor.fleet_windows_from_view(view)
+    if not fw:
+        print("bps_doctor: no fleet windows in the given bundle(s) — "
+              "was BYTEPS_TPU_FLEET=1 set on the workers?",
+              file=sys.stderr)
+        return 1, False
+    diag = doctor.evaluate_fleet_stream(fw)
+    any_findings = bool(diag["open"] or diag["history"])
+    if as_json:
+        print(json.dumps({"mode": "fleet-offline",
+                          "workers": sorted(view.get("workers") or ()),
+                          "diagnosis": diag}))
+    else:
+        print(f"== fleet ({len(view.get('workers') or ())} worker "
+              f"ring(s), {diag['windows_evaluated']} aligned window(s) "
+              f"replayed)")
+        _print_diag(diag)
+    return 0, any_findings
+
+
+def run_fleet_live(base: str, interval: float, once: bool,
+                   as_json: bool) -> tuple:
+    """Poll ONE worker's ``/fleet`` route (worker 0's endpoint — the
+    one that fetches the merged CMD_FLEET view) and evaluate the fleet
+    rules locally over the raw view, exactly as the in-job engine and
+    the offline replay do."""
+    eng = doctor.DoctorEngine(rules=doctor.FLEET_RULES, emit=False)
+    seen = -1
+    printed = set()
+    while True:
+        try:
+            doc = _fetch_json(base + "/fleet")
+        except OSError as e:
+            print(f"bps_doctor: cannot reach {base}/fleet: {e} — is "
+                  f"BYTEPS_TPU_FLEET=1 set and this worker 0's "
+                  f"endpoint?", file=sys.stderr)
+            if once:
+                return 1, False
+            time.sleep(interval)
+            continue
+        if not doc.get("armed"):
+            print(f"bps_doctor: {base} reports the fleet plane unarmed "
+                  f"(BYTEPS_TPU_FLEET=1 missing, or the bootstrap "
+                  f"probe downgraded against an old server tier)",
+                  file=sys.stderr)
+            return 1, False
+        fw = doctor.fleet_windows_from_view(doc.get("view") or {})
+        if not fw:
+            # Worker-N endpoint (publishes, never fetches) or no window
+            # has rolled yet: nothing mergeable here.
+            fw = doc.get("windows") or []
+        top = max((int(w.get("window", -1)) for w in fw), default=-1)
+        if top < seen:
+            print(f"bps_doctor: window index reset ({top} < {seen}) — "
+                  f"worker restarted, re-evaluating from scratch",
+                  file=sys.stderr)
+            eng = doctor.DoctorEngine(rules=doctor.FLEET_RULES,
+                                      emit=False)
+            seen = -1
+        for w in fw:
+            if int(w.get("window", -1)) > seen:
+                seen = int(w.get("window", -1))
+                fired = eng.observe(w)
+                if not (once or as_json):
+                    for f in fired:
+                        key = (f["rule"], f["subject"],
+                               f["first_window"])
+                        if key not in printed:
+                            printed.add(key)
+                            print(f"[window {f['window']}] "
+                                  f"[{f['severity'].upper()}] "
+                                  f"{f['rule']} ({f['subject']}): "
+                                  f"{f['summary']}\n    playbook: "
+                                  f"{f['playbook']}")
+        diag = eng.diagnosis()
+        if once:
+            if as_json:
+                out = {"mode": "fleet-live", "diagnosis": diag}
+                if doc.get("goodput"):
+                    out["goodput"] = doc["goodput"]
+                print(json.dumps(out))
+            else:
+                print(f"== {base} fleet ({len(fw)} aligned window(s))")
+                _print_diag(diag)
+                gp = doc.get("goodput")
+                if gp:
+                    print(f"  goodput: {gp.get('goodput_pct', 0.0):.1f}% "
+                          f"compute over {gp.get('total_s', 0.0):.1f}s "
+                          f"fleet wall-time (window "
+                          f"{gp.get('window')})")
+            return 0, bool(diag["open"] or diag["history"])
+        time.sleep(interval)
+
+
+# ---------------------------------------------------------------------------
 # Live mode
 # ---------------------------------------------------------------------------
 def _fetch_json(url: str, timeout: float = 5.0):
@@ -256,17 +379,26 @@ def main(argv=None) -> int:
     ap.add_argument("--fail-on-findings", action="store_true",
                     help="exit 3 when any finding fired during the "
                          "run, even if it later cleared (CI gate)")
+    ap.add_argument("--fleet", action="store_true",
+                    help="run the FLEET rule set over the merged "
+                         "cross-worker view: live against one /fleet "
+                         "endpoint (worker 0), offline against the "
+                         "merged postmortem bundles")
     args = ap.parse_args(argv)
     if bool(args.paths) == bool(args.url or args.port):
         ap.error("need offline paths OR --url/--port (not both)")
     if args.paths:
-        rc, findings = run_offline(args.paths, args.json)
+        if args.fleet:
+            rc, findings = run_fleet_offline(args.paths, args.json)
+        else:
+            rc, findings = run_offline(args.paths, args.json)
     else:
         base = (args.url or f"http://127.0.0.1:{args.port}").rstrip("/")
         base = base.rsplit("/metrics", 1)[0]
-        rc, findings = run_live(base, args.interval,
-                                once=args.once or args.json,
-                                as_json=args.json)
+        run = run_fleet_live if args.fleet else run_live
+        rc, findings = run(base, args.interval,
+                           once=args.once or args.json,
+                           as_json=args.json)
     if rc == 0 and args.fail_on_findings and findings:
         return 3
     return rc

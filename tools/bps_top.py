@@ -159,6 +159,26 @@ def render(metrics: dict, prev: dict, dt: float,
                          f"{diagnosis.get('findings_total', 0)} cleared]")
         lines.append("")
 
+    # Fleet panel (BYTEPS_TPU_FLEET=1): the goodput ledger's exact
+    # wall-time partition — compute share first, then every category as
+    # a bar (they sum to 100 by construction) — plus the CMD_WINDOW
+    # plumbing counters.  Absent in unarmed runs: the gauges are only
+    # registered when the fleet plane publishes (the
+    # quiet-when-unarmed law).
+    gp = metrics.get("bps_fleet_goodput_pct")
+    if gp is not None:
+        cats = {dict(k).get("category", "?"): v for k, v in
+                (metrics.get("bps_fleet_time_pct") or {}).items()}
+        pub = int(_get(metrics, "bps_fleet_publishes_total"))
+        held = int(_get(metrics, "bps_fleet_windows_held"))
+        lines.append(f"fleet: goodput {_get(metrics, 'bps_fleet_goodput_pct'):5.1f}%"
+                     f"   [{pub} window(s) published, {held} held "
+                     f"server-side]")
+        for cat, v in sorted(cats.items(), key=lambda kv: -kv[1]):
+            bar = "#" * int(30 * v / 100.0)
+            lines.append(f"  {cat:<16}{v:5.1f}%  {bar}")
+        lines.append("")
+
     # Tuner panel (BYTEPS_TPU_TUNER=1): the current wire codec per key
     # (bps_codec_active gauge — set at every renegotiation apply) with
     # per-key switch counts, hottest-switching first.  Absent when no

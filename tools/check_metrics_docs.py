@@ -1,0 +1,164 @@
+#!/usr/bin/env python
+"""check_metrics_docs — assert every exported bps_* metric is documented.
+
+Every ``bps_*`` metric the code registers (``gauge(`` / ``counter(`` /
+``histogram(`` / ``register_collector(`` calls anywhere under
+``byteps_tpu/`` or ``tools/``) must have a row (or at least a mention)
+in ``docs/monitoring.md`` — and every exact ``bps_*`` metric name that
+document mentions must still be exported by the code.  Undocumented
+metrics are how operators end up reading source to build dashboards,
+and stale rows are how they alert on series that no longer exist; both
+directions drift one PR at a time unless a test pins them.  The
+companion of tools/check_env_docs.py (knobs) and
+tools/check_doctor_docs.py (rule playbooks).
+
+A doc mention ending in ``*`` (e.g. ``bps_codec_*``) covers every
+exported name under that prefix — the collector-backed mirror families
+are documented as families on purpose.
+
+Wired as a fast tier-1 test (tests/test_metrics_docs.py); also runnable
+standalone:
+
+    python tools/check_metrics_docs.py [repo_root]
+
+Exit 0 = in sync; 1 = drift (each missing name printed with where it
+was seen).
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+from typing import Dict, List, Set, Tuple
+
+# A registration is the metric-name literal in first-argument position
+# of a registry call; \s* after the paren rides call-site line breaks.
+REG_RE = re.compile(
+    r"(?:gauge|counter|histogram)\(\s*"
+    r"[\"'](bps_[a-z0-9_]+)[\"']")
+
+# A collector registers a NAME, and the snapshot synthesizes one series
+# per stats key under it: register_collector("codec", ...) exports the
+# bps_codec_* family.  Those dynamic names can only be documented (and
+# checked) as a prefix family.
+COLLECTOR_RE = re.compile(
+    r"register_collector\(\s*[\"']([a-z0-9_]+)[\"']")
+
+# Doc mentions: bare names plus the `bps_family_*` wildcard form.
+DOC_RE = re.compile(r"bps_[a-z0-9_]+\*?")
+
+# bps_*-shaped words that are not metric names: the tools themselves
+# (their filenames pepper the docs) and the histogram sub-series the
+# exposition format derives from a documented base name.  Keep this
+# list short and literal — every entry is a hole in the check.
+IGNORE = {
+    "bps_top", "bps_doctor", "bps_fleet",
+}
+DERIVED_SUFFIXES = ("_bucket", "_sum", "_count")
+
+CODE_DIRS = ("byteps_tpu", "tools")
+CODE_EXTS = (".py",)
+DOC_FILE = os.path.join("docs", "monitoring.md")
+
+
+def scan_code(root: str) -> Tuple[Dict[str, List[str]], Set[str]]:
+    """({metric_name: [files registering it]}, {collector family
+    prefixes like "bps_codec_"}) across the sources."""
+    out: Dict[str, List[str]] = {}
+    families: Set[str] = set()
+    for d in CODE_DIRS:
+        for dirpath, _dirnames, filenames in os.walk(os.path.join(root,
+                                                                  d)):
+            for fn in filenames:
+                if not fn.endswith(CODE_EXTS):
+                    continue
+                p = os.path.join(dirpath, fn)
+                try:
+                    with open(p, errors="replace") as f:
+                        text = f.read()
+                except OSError:
+                    continue
+                for name in set(REG_RE.findall(text)):
+                    if name in IGNORE:
+                        continue
+                    out.setdefault(name, []).append(
+                        os.path.relpath(p, root))
+                for cname in set(COLLECTOR_RE.findall(text)):
+                    families.add(f"bps_{cname}_")
+    return out, families
+
+
+def scan_docs(root: str) -> Tuple[Set[str], Set[str]]:
+    """(exact names, wildcard prefixes) mentioned in docs/monitoring.md."""
+    try:
+        with open(os.path.join(root, DOC_FILE), errors="replace") as f:
+            text = f.read()
+    except OSError:
+        return set(), set()
+    exact: Set[str] = set()
+    prefixes: Set[str] = set()
+    for m in DOC_RE.findall(text):
+        if m.endswith("*"):
+            prefixes.add(m[:-1])
+        elif m not in IGNORE and not m.endswith(DERIVED_SUFFIXES):
+            exact.add(m)
+    return exact, prefixes
+
+
+def check(root: str) -> List[str]:
+    """Drift report lines; empty = in sync."""
+    code, families = scan_code(root)
+    exact, prefixes = scan_docs(root)
+
+    def covered(name: str) -> bool:
+        return name in exact or any(name.startswith(p) for p in prefixes)
+
+    def exported(name: str) -> bool:
+        return name in code or any(name.startswith(p) for p in families)
+
+    problems = []
+    for name in sorted(n for n in code if not covered(n)):
+        problems.append(
+            f"UNDOCUMENTED: {name} is registered in "
+            f"{', '.join(sorted(code[name])[:3])} but has no row in "
+            f"{DOC_FILE}")
+    for fam in sorted(families):
+        if not (fam + "*" in {p + "*" for p in prefixes}
+                or any(n.startswith(fam) for n in exact)):
+            problems.append(
+                f"UNDOCUMENTED: the {fam}* collector family is exported "
+                f"but {DOC_FILE} mentions neither the family nor any "
+                f"series under it")
+    for name in sorted(n for n in exact if not exported(n)):
+        problems.append(
+            f"STALE DOC: {name} appears in {DOC_FILE} but nothing under "
+            f"{'/'.join(CODE_DIRS)} registers it")
+    for prefix in sorted(prefixes):
+        if not (any(n.startswith(prefix) for n in code)
+                or any(f.startswith(prefix) or prefix.startswith(f)
+                       for f in families)):
+            problems.append(
+                f"STALE DOC: the {prefix}* family appears in {DOC_FILE} "
+                f"but nothing under {'/'.join(CODE_DIRS)} registers a "
+                f"metric with that prefix")
+    return problems
+
+
+def main(argv=None) -> int:
+    args = argv if argv is not None else sys.argv[1:]
+    root = args[0] if args else os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__)))
+    problems = check(root)
+    if problems:
+        print("\n".join(problems))
+        print(f"\n{len(problems)} metric-doc drift problem(s); every "
+              f"exported bps_* metric must appear in {DOC_FILE} (and "
+              f"vice versa)")
+        return 1
+    print("metric docs in sync")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -171,6 +171,41 @@ def diagnosis_rows(bundles: List[dict]) -> List[dict]:
     return rows
 
 
+def fleet_section(bundles: List[dict]) -> Optional[dict]:
+    """The fleet plane's offline verdict: merge every bundle's
+    ``fleet.published`` ring (each worker's exact CMD_WINDOW docs) back
+    into the view CMD_FLEET served and replay the fleet rule set over
+    it — the same evaluation ``bps_doctor --fleet`` runs, so the two
+    tools agree by construction.  None when no bundle carries a fleet
+    section (BYTEPS_TPU_FLEET unset) or the package is unimportable
+    (the rest of this tool stays stdlib-only)."""
+    if not any((b.get("extra") or {}).get("fleet") for b in bundles):
+        return None
+    try:
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        if repo not in sys.path:
+            sys.path.insert(0, repo)
+        from byteps_tpu.common import doctor, goodput
+    except ImportError as e:
+        print(f"postmortem: fleet section skipped (cannot import "
+              f"byteps_tpu: {e})", file=sys.stderr)
+        return None
+    view = doctor.fleet_view_from_bundles(bundles)
+    fw = doctor.fleet_windows_from_view(view)
+    if not fw:
+        return None
+    diag = doctor.evaluate_fleet_stream(fw)
+    out = {"workers": sorted(view.get("workers") or ()),
+           "windows": [w["window"] for w in fw],
+           "diagnosis": diag}
+    try:
+        out["goodput"] = goodput.fleet_ledger(fw[-1])
+    except Exception as e:
+        print(f"postmortem: fleet goodput skipped: {e}",
+              file=sys.stderr)
+    return out
+
+
 def analyze(bundles: List[dict]) -> dict:
     events = merged_timeline(bundles)
     return {
@@ -184,6 +219,7 @@ def analyze(bundles: List[dict]) -> dict:
         "first_bad": first_bad_event(events),
         "last_rounds": last_rounds(events),
         "diagnosis": diagnosis_rows(bundles),
+        "fleet": fleet_section(bundles),
     }
 
 
@@ -242,6 +278,23 @@ def render(analysis: dict, max_events: int = 200) -> str:
             lines.append(f"  r{row['rank']}  [{row['severity']}] "
                          f"{row['rule']} ({row['subject']})  "
                          f"-> {row['playbook']}")
+        lines.append("")
+    fs = analysis.get("fleet")
+    if fs:
+        d = fs["diagnosis"]
+        lines.append(f"fleet ({len(fs['workers'])} worker ring(s), "
+                     f"{len(fs['windows'])} aligned window(s) replayed):")
+        if d.get("healthy"):
+            lines.append("  healthy — no open fleet findings")
+        for f in d.get("open", []):
+            lines.append(f"  [{f['severity']}] {f['rule']} "
+                         f"({f['subject']})  -> {f['playbook']}")
+        gp = fs.get("goodput")
+        if gp:
+            lines.append(
+                f"  goodput {gp.get('goodput_pct', 0.0):.1f}% compute "
+                f"over {gp.get('total_s', 0.0):.1f}s fleet wall-time "
+                f"(last window)")
         lines.append("")
     fb = analysis["first_bad"]
     if fb is not None:
