@@ -104,74 +104,32 @@ import json
 import os
 import time
 
-# Peak dense bf16 FLOPs/s per chip by device kind (public spec sheets).
-_PEAK_BF16 = {
-    "TPU v4": 275e12,
-    "TPU v5 lite": 197e12,   # v5e
-    "TPU v5": 459e12,        # v5p
-    "TPU v5p": 459e12,
-    "TPU v6 lite": 918e12,   # v6e (Trillium)
-    "TPU v6e": 918e12,
-}
-
-
-def _peak_flops(device) -> float:
-    env = os.environ.get("BYTEPS_BENCH_PEAK_FLOPS")
-    if env:
-        return float(env)
-    kind = getattr(device, "device_kind", "")
-    for k, v in _PEAK_BF16.items():
-        if kind.startswith(k):
-            return v
-    return 0.0  # unknown (CPU): MFU reported as 0
-
-
 def _param_count(params) -> int:
     import jax
     return sum(int(l.size) for l in jax.tree.leaves(params))
+
+
+def _peak_flops(device) -> float:
+    """Peak dense bf16 FLOPs/s for a device.  The spec-sheet table and
+    the env override live in byteps_tpu.common.devprof now (PR 20) —
+    ONE table shared with the live MFU gauges, so bench MFU and
+    `bps_mfu` can never disagree on a platform's peak.  Lazy import:
+    bench.py's module load must stay side-effect-free for the hermetic
+    subprocess benches."""
+    from byteps_tpu.common.devprof import peak_flops
+    return peak_flops(device)
 
 
 def _device_stamp() -> dict:
     """Platform-honesty stamp for every BENCH record (ROADMAP: BENCH_r05
     silently recorded CPU-fallback numbers that read like on-chip ones).
 
-    `device_platform` is what the jax backend actually initialized as by
-    record time — or "none(host-only)" for the wire/fault/telemetry
-    benches, which never touch a device backend (detected WITHOUT
-    initializing one: probing jax.devices() here could wedge on a dead
-    device tunnel, the exact failure mode the benches guard against).
-    `device_fallback` is True when an accelerator bench ended up on the
-    CPU host platform without the run being an explicit local CPU one
-    (BENCH_FORCE_CPU)."""
-    import sys
-    try:
-        xb = sys.modules.get("jax._src.xla_bridge")
-        if xb is None:
-            # jax never imported: host-only bench by construction.
-            return {"device_platform": "none(host-only)",
-                    "device_fallback": False}
-        backends = getattr(xb, "_backends", None)
-        if backends is None:
-            # jax IS imported but the private probe point moved (jax
-            # internals churn): fail LOUD rather than mislabel a real
-            # accelerator run as host-only — the stamp exists to prevent
-            # exactly that silent misread.
-            return {"device_platform": "unknown(jax xla_bridge internals "
-                                       "changed; update _device_stamp)",
-                    "device_fallback": True}
-        if not backends:
-            # jax imported, no backend initialized: host-only bench.
-            return {"device_platform": "none(host-only)",
-                    "device_fallback": False}
-        import jax
-        platform = jax.devices()[0].platform
-    except Exception as e:  # noqa: BLE001 — a stamp must never kill a record
-        return {"device_platform": f"unknown({e!r:.60})",
-                "device_fallback": True}
-    explicit_cpu = os.environ.get("BENCH_FORCE_CPU", "0") == "1" \
-        and os.environ.get("BENCH_CPU_FALLBACK_CHILD", "0") != "1"
-    return {"device_platform": platform,
-            "device_fallback": platform == "cpu" and not explicit_cpu}
+    The detector itself moved to byteps_tpu.common.devprof (PR 20): the
+    live doctor's device sentinel probes the SAME function every signal
+    window, so the bench-time stamp and the runtime verdict cannot
+    drift.  Semantics unchanged — see devprof.device_stamp."""
+    from byteps_tpu.common.devprof import device_stamp
+    return device_stamp()
 
 
 def _note() -> dict:

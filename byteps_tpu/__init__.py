@@ -25,6 +25,7 @@ from .common.api import (
     get_transport_stats, get_metrics, get_server_stats,
     get_health, get_audit, get_key_signals, get_diagnosis,
     get_tuner, get_hierarchy, get_autoscaler, get_fleet,
+    get_device_profile,
     mark_step, current_step,
 )
 from .parallel.async_ps import AsyncPSTrainer
@@ -76,6 +77,7 @@ __all__ = [
     "get_transport_stats", "get_metrics", "get_server_stats",
     "get_health", "get_audit", "get_key_signals", "get_diagnosis",
     "get_tuner", "get_hierarchy", "get_autoscaler", "get_fleet",
+    "get_device_profile",
     "HierarchicalReducer", "SliceGroup",
     "mark_step", "current_step",
     "Compression", "collectives",

@@ -33,7 +33,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from . import doctor as doctor_mod
-from . import flightrec, signals, telemetry
+from . import devprof, flightrec, signals, telemetry
 from .config import Config, get_config
 from .logging import get_logger, set_level, set_rank
 from ..core.native import get_core
@@ -248,6 +248,21 @@ def init(lazy: bool = True) -> None:
         # runs (and everything logged before init) keep the old format.
         set_rank(rank())
     _register_builtin_collectors()
+    if cfg.devprof:
+        # Device plane (common/devprof.py): arm the profiler, run the
+        # init-time sentinel probe (the re-probe rides every window
+        # roll below), and hand the flight recorder its `device` bundle
+        # section.  Off (default): none of this exists — zero gauges,
+        # zero frames, the trainer hooks are a None check.
+        prof = devprof.arm(intended_platform=cfg.device_platform,
+                           worker=cfg.worker_id,
+                           telemetry_on=cfg.telemetry_on)
+        probe = prof.probe()
+        if probe.get("fallback"):
+            get_logger().error(
+                "device sentinel convicted a fallback at init: %s",
+                probe.get("reason"))
+        flightrec.set_extra_provider(prof.flight_section, name="device")
     # One knob, one meaning: the plane arms iff SIGNAL_WINDOW_S > 0.
     # Deliberately NOT gated on BYTEPS_TELEMETRY_ON (which only governs
     # the throughput/step-time feeds) — a hidden second condition would
@@ -302,8 +317,18 @@ def shutdown() -> None:
         _state.exporter.stop()
         _state.exporter = None
     # Dump BEFORE the session teardown: the merged export drains the
-    # server-side span ring over the live connections.
+    # server-side span ring over the live connections — and BEFORE the
+    # device plane disarms, so a run that never reached its trace end
+    # step still gets its device lanes in the final merged export.
     _maybe_dump_trace(final=True)
+    prof = devprof.active()
+    if prof is not None:
+        # Freeze the bundle's device section to the final snapshot (the
+        # same static-provider law _stop_signal_plane applies): bundles
+        # dumped after shutdown still answer "was it on-chip?".
+        snap = prof.flight_section()
+        flightrec.set_extra_provider(lambda: snap, name="device")
+        devprof.disarm()
     if _state.hierarchy is not None:
         # Retire this session's SliceGroup from the process registry: a
         # re-init must meet fresh rendezvous counters (a failed round
@@ -1328,6 +1353,15 @@ def _start_signal_plane(cfg) -> None:
         providers = {"transport": sess.transport_stats,
                      "health": sess.health_snapshot,
                      "audit": sess.audit_stats}
+    prof = devprof.active()
+    if prof is not None:
+        # Device plane: the provider IS the window roll — it re-probes
+        # the sentinel, drains the step accumulators, and updates the
+        # MFU/fallback gauges; the returned section rides the summary
+        # for the device_fallback / mfu_regression rules (and the fleet
+        # publish doc).  Works with or without a PS session — the
+        # device side has no wire dependency.
+        providers["device"] = prof.window_roll
 
     def _refresh():
         if _state.ps_session is None:
@@ -1586,6 +1620,8 @@ def _signal_routes() -> dict:
         routes["/tuner"] = lambda: tuner.state()
     if _state.fleet_published is not None:
         routes["/fleet"] = get_fleet
+    if devprof.active() is not None:
+        routes["/device"] = get_device_profile
     return routes
 
 
@@ -1614,6 +1650,22 @@ def get_diagnosis() -> dict:
         return {"armed": False, "healthy": True, "open": [],
                 "findings_total": 0}
     return _state.doctor.diagnosis()
+
+
+def get_device_profile() -> dict:
+    """The device plane's live profile (``BYTEPS_TPU_DEVPROF=1``):
+    the last sentinel probe (actual vs intended platform, fallback
+    conviction), lifetime and recent per-step device times
+    (dispatch → ``block_until_ready``), the last window's MFU when
+    ``cost_analysis()`` reports FLOPs, and the cost-analysis cache
+    counters.  Served on the metrics endpoint as ``/device``.  Returns
+    ``{"armed": False, ...}`` when the plane is off."""
+    prof = devprof.active()
+    if prof is None:
+        return {"armed": False, "platform": None, "mfu": None,
+                "steps_total": 0, "device_s_total": 0.0,
+                "mean_step_ms": None}
+    return prof.profile()
 
 
 def get_fleet() -> dict:
@@ -1910,6 +1962,23 @@ def _merge_server_trace(path: str, exiting: bool = False) -> None:
                     k = (e.get("args") or {}).get("key")
                     if k is not None and (k >> 16) in members:
                         e["args"]["members"] = members[k >> 16]
+        prof = devprof.active()
+        if prof is not None:
+            # Device lane (pid = DEVICE_PID_BASE + rank): the profiler's
+            # step spans are stamped on the same monotonic-µs timebase
+            # as the wire spans (core.trace_now_us), so they merge with
+            # no offset — one timeline finally shows compute, codec,
+            # and wire end to end.
+            dev_events = prof.trace_events(rank())
+            if dev_events:
+                events.extend(dev_events)
+                meta.append({
+                    "name": "process_name", "ph": "M",
+                    "pid": trace_analysis.DEVICE_PID_BASE + rank(),
+                    "tid": 0,
+                    "args": {"name": f"device{rank()} "
+                             f"({(prof.profile().get('platform') or '?')}"
+                             f")"}})
         doc["traceEvents"] = meta + events
         with open(path, "w") as f:
             json.dump(doc, f)

@@ -257,6 +257,16 @@ class Config:
     # byte-identical.  fleet_windows bounds the per-worker server ring.
     fleet: bool = False                  # BYTEPS_TPU_FLEET
     fleet_windows: int = 32              # BYTEPS_TPU_FLEET_WINDOWS
+    # Device/compute-plane profiler (common/devprof.py): per-step
+    # device timers, live MFU gauges, device lanes in the merged trace,
+    # and the device-fallback sentinel feeding doctor rules
+    # device_fallback / mfu_regression.  Off (default): trainers pay a
+    # module-global None check, zero gauges, wire byte-identical.
+    # device_platform is the INTENDED jax platform ("tpu"/"gpu"/...);
+    # when set, the sentinel convicts any run whose backend initialized
+    # as something else (the BENCH_r05 silent-CPU class, live).
+    devprof: bool = False                # BYTEPS_TPU_DEVPROF
+    device_platform: str = ""            # BYTEPS_TPU_DEVICE_PLATFORM
 
     # ---- logging ----
     log_level: str = "WARNING"           # BYTEPS_LOG_LEVEL
@@ -372,6 +382,8 @@ class Config:
                 os.environ.get("BYTEPS_TPU_AUTOSCALE_DOWN_MB") or 8.0),
             fleet=_env_bool("BYTEPS_TPU_FLEET"),
             fleet_windows=_env_int("BYTEPS_TPU_FLEET_WINDOWS", 32),
+            devprof=_env_bool("BYTEPS_TPU_DEVPROF"),
+            device_platform=_env_str("BYTEPS_TPU_DEVICE_PLATFORM", ""),
             log_level=_env_str("BYTEPS_LOG_LEVEL", "WARNING"),
             mesh_dp=_env_int("BYTEPS_TPU_MESH_DP", 0),
             mesh_tp=_env_int("BYTEPS_TPU_MESH_TP", 1),

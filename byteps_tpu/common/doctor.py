@@ -116,6 +116,15 @@ DEFAULT_THRESHOLDS = {
     # every pull is parked behind the backlog.
     "repl_lag_rounds": 3,
     "repl_lag_windows": 2,
+    # mfu_regression: the windowed MFU dropped more than
+    # mfu_regress_frac vs the previous window's WHILE wire seconds
+    # stayed flat (grew less than mfu_wire_flat_frac) — the slowdown is
+    # on the DEVICE side (thermal throttle, a preempted chip, a new
+    # compilation gone wrong), not a wire story the other rules would
+    # catch.  Needs the devprof plane armed (BYTEPS_TPU_DEVPROF=1);
+    # quiet when either window has no MFU sample.
+    "mfu_regress_frac": 0.25,
+    "mfu_wire_flat_frac": 0.25,
     # ---- fleet rules (evaluated over the MERGED per-worker view the
     # CMD_FLEET plane serves, docs/monitoring.md "Fleet plane"; the
     # windows these rules see are ALIGNED fleet windows — one entry per
@@ -751,6 +760,98 @@ def _r_barrier_stall(ctx: RuleCtx) -> List[dict]:
                           "barrier_timeouts": barrier}}]
 
 
+def _r_device_fallback(ctx: RuleCtx) -> List[dict]:
+    """The BENCH_r05 silent-CPU class, live: the devprof sentinel
+    (re-probed every window roll) convicted a platform fallback —
+    either the jax backend initialized as something other than the
+    intended BYTEPS_TPU_DEVICE_PLATFORM, or the probe itself errored
+    (a mid-run backend wedge / jax-internals drift).  Gauge-snapshot
+    law: fires from the FIRST window carrying a convicting probe; quiet
+    whenever the summary has no device section (devprof unarmed, or an
+    offline replay of a pre-devprof bundle)."""
+    probe = (ctx.cur.get("device") or {}).get("probe") or {}
+    if not probe.get("fallback"):
+        return []
+    platform = str(probe.get("platform", "unknown"))
+    intended = str(probe.get("intended", "") or "")
+    reason = str(probe.get("reason", "") or "") or \
+        f"backend initialized as {platform!r}"
+    tunnel = probe.get("tunnel_alive")
+    tunnel_note = ""
+    if tunnel is False:
+        tunnel_note = ("; a fresh interpreter cannot reach a backend "
+                       "either — the device tunnel itself is down")
+    elif tunnel is True:
+        tunnel_note = ("; a fresh interpreter CAN still reach a backend "
+                       "— this process's backend is wedged, restart it")
+    return [{"subject": "device",
+             "message": (f"device sentinel convicted a fallback: {reason}"
+                         f"{tunnel_note} — every step since is computing "
+                         f"on the wrong platform while the wire metrics "
+                         f"read healthy (the BENCH_r05 failure mode, "
+                         f"now caught live)"),
+             "evidence": {"platform": platform,
+                          "intended": intended,
+                          "reason": reason,
+                          "tunnel_alive": tunnel}}]
+
+
+def _wire_seconds(window: dict) -> float:
+    """Summed wire-side seconds (queue + push RTT) across a window's
+    keys — the 'is the wire flat?' input to mfu_regression."""
+    total = 0.0
+    for rec in (window.get("keys") or {}).values():
+        comps = rec.get("components") or {}
+        total += float(comps.get("queue") or 0.0) \
+            + float(comps.get("push_wire") or 0.0)
+    return total
+
+
+def _r_mfu_regression(ctx: RuleCtx) -> List[dict]:
+    """Windowed MFU dropped > mfu_regress_frac vs the previous window
+    while the wire stayed flat — a DEVICE-side slowdown (throttling, a
+    sick chip, a pathological recompilation) that no wire rule can see:
+    the round keeps completing, just slower, and the wire components
+    barely move.  Consecutive-window rule over the device sections the
+    summaries carry, so the offline bundle replay fires identically.
+    Quiet unless BOTH windows carry a positive MFU sample (devprof
+    armed AND cost_analysis reporting), and quiet when wire seconds
+    grew past the flat tolerance — a congested wire also depresses MFU,
+    and that story belongs to the wire rules."""
+    cur_dev = ctx.cur.get("device") or {}
+    prev_dev = ctx.prev.get("device") or {}
+    cur_mfu = cur_dev.get("mfu")
+    prev_mfu = prev_dev.get("mfu")
+    if not isinstance(cur_mfu, (int, float)) \
+            or not isinstance(prev_mfu, (int, float)) or prev_mfu <= 0.0:
+        return []
+    frac = float(ctx.th["mfu_regress_frac"])
+    # The 1e-9 absolute slack keeps "exactly at the threshold" on the
+    # quiet side of the f32/f64 rounding of prev_mfu * (1 - frac).
+    if cur_mfu >= prev_mfu * (1.0 - frac) - 1e-9:
+        return []
+    cur_wire = _wire_seconds(ctx.cur)
+    prev_wire = _wire_seconds(ctx.prev)
+    flat = float(ctx.th["mfu_wire_flat_frac"])
+    if cur_wire > prev_wire * (1.0 + flat) + 1e-9:
+        return []   # the wire grew too: not a device regression
+    drop = 1.0 - cur_mfu / prev_mfu
+    return [{"subject": "device",
+             "message": (f"MFU dropped {drop:.0%} in one window "
+                         f"({prev_mfu:.3f} -> {cur_mfu:.3f}) with wire "
+                         f"seconds flat ({prev_wire:.3f}s -> "
+                         f"{cur_wire:.3f}s): the device itself slowed "
+                         f"down — check for thermal throttling, a "
+                         f"preempted/shared chip, or an unexpected "
+                         f"recompilation (bps.get_device_profile() has "
+                         f"the step history)"),
+             "evidence": {"mfu": float(cur_mfu),
+                          "prev_mfu": float(prev_mfu),
+                          "drop_frac": round(drop, 4),
+                          "wire_s": round(cur_wire, 4),
+                          "prev_wire_s": round(prev_wire, 4)}}]
+
+
 RULES: List[Rule] = [
     Rule("persistent_straggler", SEV_WARN,
          "one worker trails the lead for consecutive windows",
@@ -794,6 +895,12 @@ RULES: List[Rule] = [
     Rule("replication_lag", SEV_WARN,
          "a server's chain replication trails its publishes",
          _r_replication_lag),
+    Rule("device_fallback", SEV_CRITICAL,
+         "the device sentinel convicted a platform fallback or wedge",
+         _r_device_fallback),
+    Rule("mfu_regression", SEV_WARN,
+         "windowed MFU dropped sharply while the wire stayed flat",
+         _r_mfu_regression),
 ]
 
 # ---------------------------------------------------------------------------
@@ -844,6 +951,16 @@ def fleet_publish_doc(summary: dict, worker_id: int,
                        "components": comps}
         for c, v in comps.items():
             comp_total[c] = comp_total.get(c, 0.0) + v
+    # Devprof plane (PR 20): measured on-device seconds ride as their
+    # own component (the goodput ledger's measured `compute` input —
+    # per-key components are wire-side only, so this never collides),
+    # and mfu / device_platform ride top-level so worker 0 can convict
+    # a slow-chip worker whose MFU lags the quorum.
+    dev = summary.get("device") or {}
+    dev_s = float(dev.get("compute_s") or 0.0)
+    if dev_s > 0.0:
+        comp_total["device_compute"] = \
+            comp_total.get("device_compute", 0.0) + dev_s
     doc = {
         "schema": FLEET_SCHEMA,
         "window": summary.get("window"),
@@ -863,6 +980,9 @@ def fleet_publish_doc(summary: dict, worker_id: int,
                                 (int, float)) else None),
         "findings": sorted(set(open_findings)),
     }
+    if dev:
+        doc["mfu"] = dev.get("mfu")
+        doc["device_platform"] = dev.get("platform")
     if codecs:
         doc["codecs"] = {
             str(label): {"name": c.get("name"),

@@ -90,19 +90,28 @@ def worker_ledger(doc: dict) -> Dict[str, float]:
 
     Measured component seconds claim first (scaled down proportionally
     if they exceed wall — components can overlap in time); event
-    claims split what remains; compute is the exact remainder."""
+    claims split what remains; compute is the exact remainder.  With
+    the devprof plane armed the doc carries a measured
+    ``device_compute`` component (block_until_ready device seconds):
+    it claims alongside wire/wait and lands IN the compute bucket, so
+    ``compute`` becomes measured-device-seconds + unexplained remainder
+    instead of pure inference.  Docs without it (devprof off, pre-PR-20
+    workers) partition exactly as before — device_compute=0 is
+    arithmetically the old ledger."""
     wall = max(0.0, float(doc.get("dur_s") or 0.0))
     comps = doc.get("components") or {}
     wire = sum(float(comps.get(c) or 0.0)
                for c in ("queue", "push_wire", "encode", "decode"))
     wait = float(comps.get("serve") or 0.0)
+    dev = max(0.0, float(comps.get("device_compute") or 0.0))
     wire, wait = max(0.0, wire), max(0.0, wait)
-    measured = wire + wait
+    measured = wire + wait + dev
     if measured > wall and measured > 0.0:
         scale = wall / measured
         wire *= scale
         wait *= scale
-    residual = wall - wire - wait
+        dev *= scale
+    residual = wall - wire - wait - dev
     claims = {"stall": 0.0, "recovery": 0.0, "disruption": 0.0}
     for kind, n in (doc.get("events") or {}).items():
         cat = event_category(str(kind))
@@ -113,7 +122,7 @@ def worker_ledger(doc: dict) -> Dict[str, float]:
         scale = residual / claimed
         claims = {c: v * scale for c, v in claims.items()}
         claimed = residual
-    ledger = {"compute": residual - claimed, "wire": wire,
+    ledger = {"compute": dev + (residual - claimed), "wire": wire,
               "straggler_wait": wait, **claims}
     total = sum(ledger.values())
     if abs(total - wall) > _REL_TOL * max(1.0, wall):

@@ -107,6 +107,15 @@ def classify(rec: dict, tiny_bytes: int = TINY_BYTES) -> str:
         ack to pull data, which is the server's merge wait on *other*
         workers' pushes (plus the pull wire); the per-worker round-lag
         gauges name which peer.
+
+    Boundary law (PR 20): ``compute`` here is CODEC compute only —
+    encode + decode, the seconds the tuner can actually trade against
+    the wire by switching codecs.  Measured DEVICE compute (the
+    ``device_compute`` component the devprof plane contributes to fleet
+    docs and the goodput ledger) is deliberately excluded: a model
+    whose matmuls dominate the step must never read as
+    ``compute_bound`` and trick the tuner into compressing less — that
+    knob cannot buy device FLOPs back.
     """
     health = rec.get("health") or {}
     if health.get("nonfinite") or rec.get("audit_bad"):
@@ -387,7 +396,7 @@ class SignalPlane:
         }
         if server:
             summary["server"] = server
-        for name in ("transport", "health", "audit"):
+        for name in ("transport", "health", "audit", "device"):
             if sections.get(name):
                 summary[name] = sections[name]
         self._history.append(summary)

@@ -39,6 +39,12 @@ from typing import Dict, List, Optional
 
 # Server lanes start here in the merged file; worker lanes are the ranks.
 SERVER_PID_BASE = 10000
+# Device lanes (common/devprof.py step spans + parsed XLA events) start
+# here — ABOVE the server band, so the lane bands are rank < 10000 <=
+# server < 20000 <= device and `_is_server` must be bounded on both
+# sides (an unbounded `pid >= SERVER_PID_BASE` would walk device spans
+# as server work and corrupt the critical path).
+DEVICE_PID_BASE = 20000
 
 WORKER_STAGES = ("QUEUE", "ENCODE", "PUSH", "PULL", "DECODE")
 SERVER_STAGES = ("RECV", "SUM", "MERGE_WAIT", "PUBLISH", "PULL_SEND")
@@ -48,7 +54,7 @@ COMPONENTS = ("queue", "encode", "server_recv", "server_sum", "merge_wait",
 
 def _is_server(e: dict) -> bool:
     pid = e.get("pid")
-    return isinstance(pid, int) and pid >= SERVER_PID_BASE
+    return isinstance(pid, int) and SERVER_PID_BASE <= pid < DEVICE_PID_BASE
 
 
 def _overlaps(e: dict, t0: int, t1: int) -> bool:

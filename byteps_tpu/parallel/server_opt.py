@@ -233,17 +233,26 @@ class ServerOptTrainer:
             import jax.numpy as jnp
             import optax
 
+            from ..common import devprof
+
             g = pulled
             if self._grad_scale != 1.0:
                 # One weak-f32 scalar multiply, mirrored exactly by the
                 # server's gscale leg.
                 g = np.float32(self._grad_scale) * g
+            # Device-plane hook (common/devprof.py): the local-mode
+            # optimizer update is this trainer's on-device work (server
+            # mode runs it on the PS tier, so there is nothing to
+            # time).  np.asarray below already synchronizes, so the
+            # step_end token needs no extra block.
+            tok = devprof.step_begin()
             updates, self._opt_state = self._opt.update(
                 jnp.asarray(g), self._opt_state,
                 jnp.asarray(self._flat))
             self._flat = np.asarray(
                 optax.apply_updates(jnp.asarray(self._flat), updates),
                 np.float32)
+            devprof.step_end(tok)
         self._rounds += 1
         return self.params
 

@@ -207,6 +207,30 @@ def test_fleet_family_direction():
     assert len(bench_compare.check(recs, threshold=0.10)["regressions"]) == 1
 
 
+def test_device_family_direction():
+    """The devprof headlines (ISSUE 20): MFU and percent-of-peak shapes
+    are HIGHER-is-better by metric suffix AND by unit alone, and a
+    collapsing MFU flags as the regression — not an improving one."""
+    assert not bench_compare._lower_is_better("flagship_mfu", "mfu")
+    assert not bench_compare._lower_is_better(
+        "matmul_pct_of_peak", "pct_of_peak")
+    # Unit alone decides when the metric name carries no suffix hint.
+    assert not bench_compare._lower_is_better("headline", "pct_of_peak")
+    # The device-step time itself stays lower-is-better.
+    assert bench_compare._lower_is_better("device_step_ms", "ms")
+
+    # End to end: MFU falling 0.4 -> 0.2 flags...
+    recs = [R(1, "flagship_mfu", 0.4, unit="mfu"),
+            R(2, "flagship_mfu", 0.2, unit="mfu")]
+    rep = bench_compare.check(recs, threshold=0.10)
+    assert len(rep["regressions"]) == 1
+    assert rep["groups"][0]["direction"] == "higher"
+    # ...and pct-of-peak RISING never does.
+    recs = [R(1, "matmul_pct_of_peak", 40.0, unit="pct_of_peak"),
+            R(2, "matmul_pct_of_peak", 55.0, unit="pct_of_peak")]
+    assert bench_compare.check(recs, threshold=0.10)["regressions"] == []
+
+
 def test_throughput_units_are_higher_is_better():
     """The unit-direction law (ISSUE 15 satellite): *_mbps / *_goodput /
     throughput-ish units are explicitly HIGHER-is-better — including

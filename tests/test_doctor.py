@@ -379,6 +379,92 @@ def test_replication_lag_boundary():
     assert "replication_lag" in rules_fired(low, repl_lag_rounds=1)
 
 
+def _dev(mfu=None, fallback=False, reason="", platform="cpu",
+         intended="", tunnel=None, **extra):
+    """One window's device section (devprof.window_roll shape)."""
+    probe = {"platform": platform, "intended": intended,
+             "fallback": fallback, "reason": reason}
+    if tunnel is not None:
+        probe["tunnel_alive"] = tunnel
+    d = {"schema": "bps-device-v1", "probe": probe, "platform": platform,
+         "steps": 10, "compute_s": 1.0, "device_step_ms": 100.0,
+         "mfu": mfu}
+    d.update(extra)
+    return {"device": d}
+
+
+def _wire_keys(wire_s):
+    """Window keys whose summed queue + push_wire seconds == wire_s."""
+    return {"k": {"components": {"queue": wire_s / 2,
+                                 "push_wire": wire_s / 2}}}
+
+
+def test_device_fallback_boundary():
+    """The sentinel's conviction (ISSUE 20): a convicting probe fires
+    from the FIRST window (gauge-snapshot law — the BENCH_r05 silent-CPU
+    class must not wait for persistence); a healthy probe, an intended
+    platform that matches, or no device section at all stay quiet."""
+    hot = [W(0, **_dev(fallback=True, platform="cpu", intended="tpu",
+                       reason="intended platform 'tpu' but the jax "
+                              "backend initialized as 'cpu'"))]
+    assert "device_fallback" in rules_fired(hot)
+    diag = doctor.evaluate_stream(hot)
+    f = next(x for x in diag["open"] if x["rule"] == "device_fallback")
+    assert f["severity"] == "critical"
+    assert f["subject"] == "device"
+    assert f["evidence"]["platform"] == "cpu"
+    assert f["evidence"]["intended"] == "tpu"
+    assert f["playbook"].endswith("#rule-device_fallback")
+    # Healthy probe: quiet.
+    assert "device_fallback" not in rules_fired(
+        [W(0, **_dev(platform="cpu", intended="cpu"))])
+    # Bare CPU with NO declared intent (the tier-1 suite itself): quiet.
+    assert "device_fallback" not in rules_fired(
+        [W(0, **_dev(platform="cpu"))])
+    # No device section (devprof unarmed / pre-devprof bundle): quiet.
+    assert "device_fallback" not in rules_fired([W(0)])
+    # The wedge path's tunnel corroboration lands in the message.
+    wedged = doctor.evaluate_stream([W(0, **_dev(
+        fallback=True, platform="unknown(RuntimeError('dead'))",
+        reason="device probe errored", tunnel=False))])
+    f = next(x for x in wedged["open"] if x["rule"] == "device_fallback")
+    assert "tunnel" in f["summary"]
+    assert f["evidence"]["tunnel_alive"] is False
+
+
+def test_mfu_regression_boundary():
+    """MFU drop > 25% with the wire flat fires; a drop at the boundary,
+    a drop with the wire growing, a missing/None MFU sample on either
+    side, and a first-window sample all stay quiet."""
+    hot = [W(0, keys=_wire_keys(1.0), **_dev(mfu=0.40)),
+           W(1, keys=_wire_keys(1.0), **_dev(mfu=0.20))]
+    assert "mfu_regression" in rules_fired(hot)
+    diag = doctor.evaluate_stream(hot)
+    f = next(x for x in diag["open"] if x["rule"] == "mfu_regression")
+    assert f["subject"] == "device"
+    assert f["evidence"]["prev_mfu"] == 0.40
+    assert f["evidence"]["mfu"] == 0.20
+    assert f["playbook"].endswith("#rule-mfu_regression")
+    # Exactly AT the threshold (25% drop) is not past it.
+    at = [W(0, keys=_wire_keys(1.0), **_dev(mfu=0.40)),
+          W(1, keys=_wire_keys(1.0), **_dev(mfu=0.30))]
+    assert "mfu_regression" not in rules_fired(at)
+    # Same drop but the wire grew >25% too: the wire rules own it.
+    congested = [W(0, keys=_wire_keys(1.0), **_dev(mfu=0.40)),
+                 W(1, keys=_wire_keys(2.0), **_dev(mfu=0.20))]
+    assert "mfu_regression" not in rules_fired(congested)
+    # cost_analysis unavailable (mfu None) on either side: quiet.
+    assert "mfu_regression" not in rules_fired(
+        [W(0, **_dev(mfu=None)), W(1, **_dev(mfu=0.20))])
+    assert "mfu_regression" not in rules_fired(
+        [W(0, **_dev(mfu=0.40)), W(1, **_dev(mfu=None))])
+    # One window has no prev: quiet.
+    assert "mfu_regression" not in rules_fired(
+        [W(0, **_dev(mfu=0.10))])
+    # Threshold override: a 30% drop clears a lowered frac.
+    assert "mfu_regression" in rules_fired(at, mfu_regress_frac=0.20)
+
+
 def test_every_rule_has_a_boundary_test():
     """The fire/no-fire coverage above must track the rule set: a new
     rule without a test here is exactly the drift this file pins."""
@@ -387,7 +473,8 @@ def test_every_rule_has_a_boundary_test():
                "fusion_dilution", "server_hot_shard",
                "nonfinite_gradients", "audit_mismatch", "barrier_stall",
                "tuner_thrash", "knob_thrash", "param_version_stall",
-               "embedding_cache_thrash", "replication_lag"}
+               "embedding_cache_thrash", "replication_lag",
+               "device_fallback", "mfu_regression"}
     # The cross-worker fleet rules' fire/no-fire boundaries live in
     # tests/test_fleet.py (they run over ALIGNED fleet windows, not the
     # local summary stream this file drives).

@@ -129,6 +129,23 @@ def test_classification_boundaries():
         == "unhealthy"
 
 
+def test_device_compute_excluded_from_compute_class():
+    """The classify() boundary law (PR 20): `compute_bound` means CODEC
+    compute — encode + decode seconds, the thing the tuner can trade
+    against wire bytes.  Measured DEVICE step time (the devprof plane's
+    `device_compute` goodput category) must never steer the dial: a
+    model that legitimately spends 100x the wire time in matmuls is not
+    a candidate for lighter compression."""
+    rec = _rec(wire=0.5, serve=0.1)
+    rec["components"]["device_compute"] = 100.0
+    assert signals.classify(rec) == "wire_bound"
+    # And with codec time genuinely dominant, device time doesn't
+    # dilute the compute share either way.
+    rec2 = _rec(enc=0.4, dec=0.3, wire=0.5)
+    rec2["components"]["device_compute"] = 100.0
+    assert signals.classify(rec2) == "compute_bound"
+
+
 def test_classification_stable_on_quiet_run():
     """Identical traffic window after window classifies identically —
     the tuner must not see a key flapping between classes on noise-free

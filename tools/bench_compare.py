@@ -73,7 +73,8 @@ def _platform(parsed: dict) -> str:
 _HIGHER_METRIC_SUFFIXES = (
     "_mbps", "_gbps", "_mb_s", "_gb_s", "_goodput", "_throughput",
     "_per_s", "_per_sec", "_rows_s", "_tokens_s", "_items_s", "_qps",
-    "_mfu", "_efficiency", "_pct_of_floor", "_saved_pct", "_hit_rate",
+    "_mfu", "_efficiency", "_pct_of_floor", "_pct_of_peak", "_saved_pct",
+    "_hit_rate",
     # BENCH_FLEET's goodput-ledger headline: a percentage where more
     # compute share is better — named explicitly so it never drifts
     # onto a lower-is-better *_pct rule (the _gap_pct family below).
@@ -82,7 +83,8 @@ _HIGHER_METRIC_SUFFIXES = (
 _HIGHER_UNITS = {
     "mbps", "gbps", "mb/s", "gb/s", "mb_s", "gb_s", "goodput_mbps",
     "per_s", "per_sec", "qps", "rows_s", "rows_per_s", "tokens_s",
-    "items_per_s", "steps_per_s", "pct_of_floor", "mfu", "ratio", "x",
+    "items_per_s", "steps_per_s", "pct_of_floor", "pct_of_peak", "mfu",
+    "ratio", "x",
 }
 
 # Percentile-tail names (BENCH_SPARSE p99 pull latency and friends):

@@ -179,6 +179,32 @@ def render(metrics: dict, prev: dict, dt: float,
             lines.append(f"  {cat:<16}{v:5.1f}%  {bar}")
         lines.append("")
 
+    # Device panel (BYTEPS_TPU_DEVPROF=1): per-worker MFU, mean device
+    # step time, the platform the sentinel actually probed, and the
+    # fallback conviction flag — "is it on-chip, and how hot?" at a
+    # glance.  Absent in unarmed runs: devprof registers its gauges only
+    # when armed (the quiet-when-unarmed law).
+    mfu = {dict(k).get("worker", "?"): v for k, v in
+           (metrics.get("bps_mfu") or {}).items()}
+    step_ms = {dict(k).get("worker", "?"): v for k, v in
+               (metrics.get("bps_device_step_ms") or {}).items()}
+    fb = {dict(k).get("worker", "?"): (dict(k).get("platform", "?"), v)
+          for k, v in (metrics.get("bps_device_fallback") or {}).items()}
+    if mfu or step_ms or fb:
+        lines.append("device (MFU / step time / platform per worker)")
+        for wid in sorted(set(mfu) | set(step_ms) | set(fb)):
+            plat, fell = fb.get(wid, ("?", 0.0))
+            m = mfu.get(wid)
+            mtxt = f"mfu {m:6.3f}" if m is not None else "mfu      -"
+            bar = "#" * int(30 * m) if m else ""
+            ms = step_ms.get(wid)
+            mstxt = (f"step {ms:8.2f}ms" if ms is not None
+                     else "step        -")
+            flag = "  <-- DEVICE FALLBACK" if fell else ""
+            lines.append(f"  worker {wid:>3}  {mtxt}  {mstxt}  "
+                         f"platform {plat:<8} {bar}{flag}")
+        lines.append("")
+
     # Tuner panel (BYTEPS_TPU_TUNER=1): the current wire codec per key
     # (bps_codec_active gauge — set at every renegotiation apply) with
     # per-key switch counts, hottest-switching first.  Absent when no

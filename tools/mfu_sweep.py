@@ -121,17 +121,12 @@ SWEEP = [
      "env": {"BENCH_BATCH": "96", "BENCH_REMAT_POLICY": "proj"}},
 ]
 
-PROBE = ("import jax, jax.numpy as jnp; "
-         "print(float((jnp.ones((256,256))@jnp.ones((256,256))).sum()))")
-
-
-def tunnel_alive(timeout: float = 120.0) -> bool:
-    try:
-        r = subprocess.run([sys.executable, "-c", PROBE], timeout=timeout,
-                           capture_output=True, text=True)
-        return r.returncode == 0
-    except subprocess.TimeoutExpired:
-        return False
+# The tunnel-health probe moved to byteps_tpu.common.devprof (PR 20):
+# the live device sentinel corroborates a wedge conviction with the
+# SAME subprocess probe this sweep runs between entries, so the two
+# verdicts cannot drift.  Re-exported here under the original names.
+sys.path.insert(0, REPO)
+from byteps_tpu.common.devprof import PROBE, tunnel_alive  # noqa: E402,F401
 
 
 def run_one(entry: dict, timeout: float) -> dict:

@@ -171,6 +171,30 @@ def diagnosis_rows(bundles: List[dict]) -> List[dict]:
     return rows
 
 
+def device_rows(bundles: List[dict]) -> List[dict]:
+    """Per-bundle device-plane verdict (the ``device`` extra section a
+    devprof-armed run records): which platform each worker actually ran
+    on, whether the sentinel convicted a fallback/wedge, and the last
+    window's MFU — so "was it on-chip?" is answerable from the bundle
+    alone, with no live cluster."""
+    rows = []
+    for b in bundles:
+        dev = (b.get("extra") or {}).get("device") or {}
+        if not dev:
+            continue
+        probe = dev.get("probe") or {}
+        win = dev.get("last_window") or {}
+        rows.append({"rank": b.get("rank", "?"),
+                     "platform": probe.get("platform"),
+                     "intended": probe.get("intended") or "",
+                     "fallback": bool(probe.get("fallback")),
+                     "reason": probe.get("reason", ""),
+                     "mfu": win.get("mfu"),
+                     "device_step_ms": win.get("device_step_ms"),
+                     "steps_total": dev.get("steps_total", 0)})
+    return rows
+
+
 def fleet_section(bundles: List[dict]) -> Optional[dict]:
     """The fleet plane's offline verdict: merge every bundle's
     ``fleet.published`` ring (each worker's exact CMD_WINDOW docs) back
@@ -219,6 +243,7 @@ def analyze(bundles: List[dict]) -> dict:
         "first_bad": first_bad_event(events),
         "last_rounds": last_rounds(events),
         "diagnosis": diagnosis_rows(bundles),
+        "device": device_rows(bundles),
         "fleet": fleet_section(bundles),
     }
 
@@ -278,6 +303,24 @@ def render(analysis: dict, max_events: int = 200) -> str:
             lines.append(f"  r{row['rank']}  [{row['severity']}] "
                          f"{row['rule']} ({row['subject']})  "
                          f"-> {row['playbook']}")
+        lines.append("")
+    dv = analysis.get("device") or []
+    if dv:
+        lines.append("device plane (was it on-chip?):")
+        for row in dv:
+            mfu = (f"{row['mfu']:.3f}"
+                   if isinstance(row.get("mfu"), (int, float)) else "-")
+            ms = (f"{row['device_step_ms']:.2f}ms"
+                  if isinstance(row.get("device_step_ms"), (int, float))
+                  else "-")
+            want = (f" (intended {row['intended']})"
+                    if row["intended"] else "")
+            tag = (f"  <-- FALLBACK: {row['reason']}"
+                   if row["fallback"] else "")
+            lines.append(
+                f"  r{row['rank']}  platform={row['platform']}{want}  "
+                f"mfu={mfu}  device_step={ms}  "
+                f"steps={row['steps_total']}{tag}")
         lines.append("")
     fs = analysis.get("fleet")
     if fs:
